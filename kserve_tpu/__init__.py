@@ -1,0 +1,51 @@
+"""kserve_tpu: a TPU-native model-serving framework.
+
+KServe-shaped (CRDs -> controllers -> runtime registry -> protocol server ->
+engine) with a JAX/XLA/Pallas execution core instead of vLLM-CUDA.
+"""
+
+__version__ = "0.1.0"
+
+from .errors import (
+    InferenceError,
+    InvalidInput,
+    ModelNotFound,
+    ModelNotReady,
+)
+from .infer_type import (
+    InferInput,
+    InferOutput,
+    InferRequest,
+    InferResponse,
+    RequestedOutput,
+)
+from .model import (
+    BaseModel,
+    InferenceVerb,
+    Model,
+    ModelType,
+    PredictorConfig,
+    PredictorProtocol,
+)
+from .model_repository import ModelRepository
+from .model_server import ModelServer
+
+__all__ = [
+    "BaseModel",
+    "InferInput",
+    "InferOutput",
+    "InferRequest",
+    "InferResponse",
+    "InferenceError",
+    "InferenceVerb",
+    "InvalidInput",
+    "Model",
+    "ModelNotFound",
+    "ModelNotReady",
+    "ModelRepository",
+    "ModelServer",
+    "ModelType",
+    "PredictorConfig",
+    "PredictorProtocol",
+    "RequestedOutput",
+]

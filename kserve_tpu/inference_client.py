@@ -1,0 +1,280 @@
+"""Async inference clients (REST + gRPC) used by transformers, the graph
+router, and SDK users.
+
+Parity: reference python/kserve/kserve/inference_client.py
+(InferenceRESTClient :390, InferenceGRPCClient :61).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple, Union
+
+import httpx
+
+from .errors import InferenceError, InvalidInput, UnsupportedProtocol
+from .infer_type import InferRequest, InferResponse
+from .model import PredictorProtocol
+
+
+@dataclass
+class RESTConfig:
+    transport: Optional[httpx.AsyncBaseTransport] = None
+    protocol: Union[str, PredictorProtocol] = "v1"
+    retries: int = 3
+    http2: bool = False
+    timeout: float = 60
+    cert: Optional[object] = None
+    verify: Union[bool, str] = True
+    auth: Optional[object] = None
+    verbose: bool = False
+
+    def __post_init__(self):
+        if isinstance(self.protocol, PredictorProtocol):
+            self.protocol = self.protocol.value
+
+
+class InferenceRESTClient:
+    def __init__(self, config: Optional[RESTConfig] = None):
+        self._config = config or RESTConfig()
+        transport = self._config.transport
+        retry_transport = None
+        if transport is None:
+            retry_transport = httpx.AsyncHTTPTransport(retries=self._config.retries)
+        self._client = httpx.AsyncClient(
+            transport=transport or retry_transport,
+            http2=self._config.http2,
+            timeout=self._config.timeout,
+            verify=self._config.verify,
+        )
+
+    def _is_v2(self) -> bool:
+        return self._config.protocol in (
+            PredictorProtocol.REST_V2.value,
+            PredictorProtocol.GRPC_V2.value,
+        )
+
+    async def infer(
+        self,
+        base_url: str,
+        data: Union[Dict, InferRequest],
+        headers: Optional[Dict[str, str]] = None,
+        model_name: Optional[str] = None,
+        response_headers: Optional[Dict[str, str]] = None,
+        is_graph_endpoint: bool = False,
+        timeout: Optional[float] = None,
+    ) -> Union[Dict, InferResponse]:
+        url = self._construct_url(base_url, model_name, verb="infer")
+        headers = dict(headers or {})
+        if isinstance(data, InferRequest):
+            body, json_length = data.to_rest()
+            if json_length is not None:
+                headers["inference-header-content-length"] = str(json_length)
+                headers["content-type"] = "application/octet-stream"
+                response = await self._client.post(
+                    url, content=body, headers=headers, timeout=timeout
+                )
+            else:
+                response = await self._client.post(
+                    url, json=body, headers=headers, timeout=timeout
+                )
+        else:
+            response = await self._client.post(url, json=data, headers=headers, timeout=timeout)
+        if response_headers is not None:
+            response_headers.update(dict(response.headers))
+        return self._decode_response(response, is_graph_endpoint)
+
+    async def explain(
+        self,
+        base_url: str,
+        data: Union[Dict, InferRequest],
+        headers: Optional[Dict[str, str]] = None,
+        model_name: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ) -> Dict:
+        if isinstance(base_url, str) and ":explain" in base_url:
+            url = base_url
+        else:
+            url = self._construct_url(base_url, model_name, verb="explain")
+        if isinstance(data, InferRequest):
+            body, _ = data.to_rest()
+            response = await self._client.post(url, json=body, headers=headers, timeout=timeout)
+        else:
+            response = await self._client.post(url, json=data, headers=headers, timeout=timeout)
+        return self._decode_response(response, False)
+
+    def _construct_url(self, base_url: str, model_name: Optional[str], verb: str) -> str:
+        base = str(base_url)
+        if "://" not in base:
+            base = "http://" + base
+        if "/v1/models" in base or "/v2/models" in base:
+            return base
+        base = base.rstrip("/")
+        if self._is_v2():
+            if model_name is None:
+                raise InvalidInput("model_name is required for v2 urls")
+            return f"{base}/v2/models/{model_name}/{verb}"
+        if model_name is None:
+            raise InvalidInput("model_name is required for v1 urls")
+        return f"{base}/v1/models/{model_name}:{'predict' if verb == 'infer' else verb}"
+
+    def _decode_response(self, response: httpx.Response, is_graph_endpoint: bool):
+        if response.status_code != 200:
+            try:
+                message = response.json().get("error", response.text)
+            except Exception:
+                message = response.text
+            raise InferenceError(
+                f"HTTP {response.status_code}: {message}", status=str(response.status_code)
+            )
+        json_length = response.headers.get("inference-header-content-length")
+        if json_length is not None:
+            return InferResponse.from_bytes(response.content, int(json_length))
+        body = response.json()
+        if not is_graph_endpoint and self._is_v2() and "outputs" in body:
+            return InferResponse.from_dict(body)
+        return body
+
+    async def is_server_ready(self, base_url: str, headers=None, timeout=None) -> bool:
+        response = await self._client.get(
+            self._health_url(base_url, "ready"), headers=headers, timeout=timeout
+        )
+        response.raise_for_status()
+        return response.json().get("ready", False)
+
+    async def is_server_live(self, base_url: str, headers=None, timeout=None) -> bool:
+        if self._is_v2():
+            url = self._health_url(base_url, "live")
+            response = await self._client.get(url, headers=headers, timeout=timeout)
+            response.raise_for_status()
+            return response.json().get("live", False)
+        base = str(base_url).rstrip("/")
+        response = await self._client.get(base + "/", headers=headers, timeout=timeout)
+        response.raise_for_status()
+        return response.json().get("status") == "alive"
+
+    async def is_model_ready(self, base_url: str, model_name: str, headers=None, timeout=None) -> bool:
+        base = str(base_url).rstrip("/")
+        if self._is_v2():
+            url = f"{base}/v2/models/{model_name}/ready"
+        else:
+            url = f"{base}/v1/models/{model_name}"
+        response = await self._client.get(url, headers=headers, timeout=timeout)
+        if response.status_code == 503:
+            return False
+        response.raise_for_status()
+        return response.json().get("ready", False)
+
+    def _health_url(self, base_url: str, verb: str) -> str:
+        base = str(base_url).rstrip("/")
+        return f"{base}/v2/health/{verb}" if self._is_v2() else f"{base}/"
+
+    async def close(self):
+        await self._client.aclose()
+
+
+class InferenceGRPCClient:
+    def __init__(
+        self,
+        url: str,
+        verbose: bool = False,
+        use_ssl: bool = False,
+        root_certificates: Optional[str] = None,
+        private_key: Optional[str] = None,
+        certificate_chain: Optional[str] = None,
+        creds=None,
+        channel_args: Optional[List[Tuple[str, str]]] = None,
+        timeout: float = 60,
+        retries: int = 3,
+    ):
+        import grpc
+
+        from .protocol.grpc.servicer import build_stub_multicallables
+
+        options = list(channel_args or [])
+        if retries > 0:
+            service_config = {
+                "methodConfig": [
+                    {
+                        "name": [{"service": "inference.GRPCInferenceService"}],
+                        "retryPolicy": {
+                            "maxAttempts": retries + 1,
+                            "initialBackoff": "0.1s",
+                            "maxBackoff": "1s",
+                            "backoffMultiplier": 2,
+                            "retryableStatusCodes": ["UNAVAILABLE"],
+                        },
+                    }
+                ]
+            }
+            options.append(("grpc.enable_retries", 1))
+            options.append(("grpc.service_config", json.dumps(service_config)))
+        if creds is not None:
+            self._channel = grpc.aio.secure_channel(url, creds, options=options)
+        elif use_ssl:
+            ssl_creds = grpc.ssl_channel_credentials(
+                root_certificates=_read(root_certificates),
+                private_key=_read(private_key),
+                certificate_chain=_read(certificate_chain),
+            )
+            self._channel = grpc.aio.secure_channel(url, ssl_creds, options=options)
+        else:
+            self._channel = grpc.aio.insecure_channel(url, options=options)
+        self._calls = build_stub_multicallables(self._channel)
+        self._timeout = timeout
+
+    async def infer(
+        self,
+        infer_request: InferRequest,
+        timeout: Optional[float] = None,
+        headers: Optional[List[Tuple[str, str]]] = None,
+    ) -> InferResponse:
+        req = infer_request.to_grpc() if isinstance(infer_request, InferRequest) else infer_request
+        response = await self._calls["ModelInfer"](
+            req, timeout=timeout or self._timeout, metadata=headers
+        )
+        return InferResponse.from_grpc(response)
+
+    async def is_server_ready(self, timeout=None, headers=None) -> bool:
+        from .protocol.grpc import open_inference_pb2 as pb
+
+        res = await self._calls["ServerReady"](
+            pb.ServerReadyRequest(), timeout=timeout or self._timeout, metadata=headers
+        )
+        return res.ready
+
+    async def is_server_live(self, timeout=None, headers=None) -> bool:
+        from .protocol.grpc import open_inference_pb2 as pb
+
+        res = await self._calls["ServerLive"](
+            pb.ServerLiveRequest(), timeout=timeout or self._timeout, metadata=headers
+        )
+        return res.live
+
+    async def is_model_ready(self, model_name: str, timeout=None, headers=None) -> bool:
+        from .protocol.grpc import open_inference_pb2 as pb
+
+        res = await self._calls["ModelReady"](
+            pb.ModelReadyRequest(name=model_name),
+            timeout=timeout or self._timeout,
+            metadata=headers,
+        )
+        return res.ready
+
+    async def close(self):
+        await self._channel.close()
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.close()
+
+
+def _read(path: Optional[str]) -> Optional[bytes]:
+    if path is None:
+        return None
+    with open(path, "rb") as f:
+        return f.read()

@@ -1,0 +1,93 @@
+"""Structured logging for the data plane.
+
+Parity: reference python/kserve/kserve/logging.py (dictConfig with a server
+logger and a trace logger for per-request latency lines).
+"""
+
+from __future__ import annotations
+
+import logging
+import logging.config
+import sys
+
+KSERVE_TPU_LOGGER_NAME = "kserve_tpu"
+KSERVE_TPU_TRACE_LOGGER_NAME = "kserve_tpu.trace"
+KSERVE_TPU_LOGGER_FORMAT = (
+    "%(asctime)s.%(msecs)03d %(process)s %(name)s %(levelname)s [%(funcName)s():%(lineno)s] %(message)s"
+)
+KSERVE_TPU_TRACE_LOGGER_FORMAT = "%(asctime)s.%(msecs)03d %(name)s %(message)s"
+KSERVE_TPU_LOG_DATE_FORMAT = "%Y-%m-%d %H:%M:%S"
+
+logger = logging.getLogger(KSERVE_TPU_LOGGER_NAME)
+trace_logger = logging.getLogger(KSERVE_TPU_TRACE_LOGGER_NAME)
+
+KSERVE_TPU_LOG_CONFIG = {
+    "version": 1,
+    "disable_existing_loggers": False,
+    "formatters": {
+        "kserve_tpu": {
+            "()": "logging.Formatter",
+            "fmt": KSERVE_TPU_LOGGER_FORMAT,
+            "datefmt": KSERVE_TPU_LOG_DATE_FORMAT,
+        },
+        "kserve_tpu_trace": {
+            "()": "logging.Formatter",
+            "fmt": KSERVE_TPU_TRACE_LOGGER_FORMAT,
+            "datefmt": KSERVE_TPU_LOG_DATE_FORMAT,
+        },
+    },
+    "handlers": {
+        "kserve_tpu": {
+            "formatter": "kserve_tpu",
+            "class": "logging.StreamHandler",
+            "stream": "ext://sys.stderr",
+        },
+        "kserve_tpu_trace": {
+            "formatter": "kserve_tpu_trace",
+            "class": "logging.StreamHandler",
+            "stream": "ext://sys.stderr",
+        },
+    },
+    "loggers": {
+        KSERVE_TPU_LOGGER_NAME: {
+            "handlers": ["kserve_tpu"],
+            "level": "INFO",
+            "propagate": False,
+        },
+        KSERVE_TPU_TRACE_LOGGER_NAME: {
+            "handlers": ["kserve_tpu_trace"],
+            "level": "INFO",
+            "propagate": False,
+        },
+    },
+}
+
+_configured = False
+
+
+def configure_logging(log_config=None) -> None:
+    """Apply the default (or a user-provided) logging config exactly once per
+    process; safe to call repeatedly."""
+    global _configured
+    if log_config is None:
+        log_config = KSERVE_TPU_LOG_CONFIG
+    if isinstance(log_config, dict):
+        logging.config.dictConfig(log_config)
+    elif isinstance(log_config, str):
+        if log_config.endswith((".yaml", ".yml")):
+            import yaml
+
+            with open(log_config) as f:
+                logging.config.dictConfig(yaml.safe_load(f))
+        elif log_config.endswith(".json"):
+            import json
+
+            with open(log_config) as f:
+                logging.config.dictConfig(json.load(f))
+        else:
+            logging.config.fileConfig(log_config, disable_existing_loggers=False)
+    _configured = True
+
+
+def is_configured() -> bool:
+    return _configured

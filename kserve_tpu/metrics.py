@@ -1,0 +1,45 @@
+"""Prometheus metrics for the request path.
+
+Parity: reference python/kserve/kserve/metrics.py (per-stage latency
+histograms labeled by model name); extended with engine-level counters used
+by the JAX generative engine (tokens generated, batch occupancy) so KPA-style
+tokens/sec autoscaling has a native signal.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import Counter, Gauge, Histogram
+
+PRE_HIST_TIME = Histogram(
+    "request_preprocess_seconds", "pre-process request latency", ["model_name"]
+)
+POST_HIST_TIME = Histogram(
+    "request_postprocess_seconds", "post-process request latency", ["model_name"]
+)
+PREDICT_HIST_TIME = Histogram(
+    "request_predict_seconds", "predict request latency", ["model_name"]
+)
+EXPLAIN_HIST_TIME = Histogram(
+    "request_explain_seconds", "explain request latency", ["model_name"]
+)
+
+# Generative engine metrics (no reference analogue; vLLM keeps these internal).
+GENERATED_TOKENS = Counter(
+    "engine_generated_tokens_total", "decode tokens generated", ["model_name"]
+)
+PROMPT_TOKENS = Counter(
+    "engine_prompt_tokens_total", "prompt tokens prefill-processed", ["model_name"]
+)
+ENGINE_BATCH_OCCUPANCY = Gauge(
+    "engine_batch_occupancy", "active sequences in the decode batch", ["model_name"]
+)
+ENGINE_QUEUE_DEPTH = Gauge(
+    "engine_queue_depth", "requests waiting for admission", ["model_name"]
+)
+ENGINE_KV_PAGES_FREE = Gauge(
+    "engine_kv_pages_free", "free KV cache pages", ["model_name"]
+)
+
+
+def get_labels(model_name: str) -> dict:
+    return {"model_name": model_name}

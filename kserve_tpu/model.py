@@ -1,0 +1,298 @@
+"""Model lifecycle + request flow.
+
+`Model` is the user-facing base class: subclass it, override `load()` and
+`predict()` (and optionally `preprocess`/`postprocess`/`explain`), register it
+with a `ModelServer`.  `__call__` runs the staged pipeline with per-stage
+Prometheus timing.  When `predictor_config.predictor_host` is set the model
+acts as a transformer: `predict` forwards to a remote predictor over REST or
+gRPC.
+
+Parity: reference python/kserve/kserve/model.py (Model.__call__ at :197,
+_http_predict :385, _grpc_predict :405); rebuilt on httpx/grpc.aio with the
+same stage semantics.
+"""
+
+from __future__ import annotations
+
+import inspect
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Callable, Dict, Optional, Union
+
+from .errors import InvalidInput
+from .infer_type import InferRequest, InferResponse
+from .logging import trace_logger
+from .metrics import (
+    EXPLAIN_HIST_TIME,
+    POST_HIST_TIME,
+    PRE_HIST_TIME,
+    PREDICT_HIST_TIME,
+    get_labels,
+)
+
+PREDICTOR_HOST_SUFFIX = "-predictor"
+
+
+class ModelType(Enum):
+    EXPLAINER = 1
+    PREDICTOR = 2
+
+
+class InferenceVerb(Enum):
+    EXPLAIN = 1
+    PREDICT = 2
+
+
+class PredictorProtocol(Enum):
+    REST_V1 = "v1"
+    REST_V2 = "v2"
+    GRPC_V2 = "grpc-v2"
+
+
+def get_latency_ms(start: float, end: float) -> float:
+    return round((end - start) * 1000, 9)
+
+
+def is_v2(protocol: PredictorProtocol) -> bool:
+    return protocol != PredictorProtocol.REST_V1
+
+
+@dataclass
+class PredictorConfig:
+    """Where (and how) a transformer forwards to its predictor."""
+
+    predictor_host: str = ""
+    predictor_protocol: str = PredictorProtocol.REST_V1.value
+    predictor_use_ssl: bool = False
+    predictor_request_timeout_seconds: int = 600
+    predictor_request_retries: int = 0
+    predictor_health_check: bool = False
+    extra_headers: Dict[str, str] = field(default_factory=dict)
+
+
+class BaseModel:
+    """Minimal lifecycle every servable object implements."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.ready: bool = False
+        self.engine_paused: bool = False
+
+    async def healthy(self) -> bool:
+        """Liveness beyond `ready` — engine models override to reflect the
+        health of their background loop."""
+        return self.ready
+
+    def load(self) -> bool:
+        """Synchronously load weights/artifacts; set and return `self.ready`."""
+        self.ready = True
+        return self.ready
+
+    def start(self) -> None:
+        """Hook called when the server starts."""
+
+    def stop(self) -> None:
+        """Hook called when the server shuts down."""
+
+    def start_engine(self) -> None:
+        """Engine models (continuous-batching generative runtimes) override to
+        launch their background decode loop inside the server's event loop."""
+
+
+class Model(BaseModel):
+    def __init__(
+        self,
+        name: str,
+        predictor_config: Optional[PredictorConfig] = None,
+        return_response_headers: bool = False,
+    ):
+        super().__init__(name)
+        self.predictor_config = predictor_config
+        self.return_response_headers = return_response_headers
+        self._rest_client = None
+        self._grpc_client = None
+
+    # ---------- config helpers ----------
+
+    @property
+    def predictor_host(self) -> str:
+        return self.predictor_config.predictor_host if self.predictor_config else ""
+
+    @property
+    def protocol(self) -> str:
+        return (
+            self.predictor_config.predictor_protocol
+            if self.predictor_config
+            else PredictorProtocol.REST_V1.value
+        )
+
+    def _predict_url(self, payload) -> str:
+        scheme = "https" if self.predictor_config.predictor_use_ssl else "http"
+        host = self.predictor_config.predictor_host
+        if self.protocol == PredictorProtocol.REST_V1.value:
+            return f"{scheme}://{host}/v1/models/{self.name}:predict"
+        return f"{scheme}://{host}/v2/models/{self.name}/infer"
+
+    def _explain_url(self) -> str:
+        scheme = "https" if self.predictor_config.predictor_use_ssl else "http"
+        host = self.predictor_config.predictor_host
+        return f"{scheme}://{host}/v1/models/{self.name}:explain"
+
+    # ---------- request pipeline ----------
+
+    async def __call__(
+        self,
+        body: Union[Dict, bytes, InferRequest],
+        verb: InferenceVerb = InferenceVerb.PREDICT,
+        headers: Optional[Dict[str, str]] = None,
+        response_headers: Optional[Dict[str, str]] = None,
+    ):
+        request_id = headers.get("x-request-id", "N.A.") if headers else "N.A."
+
+        with PRE_HIST_TIME.labels(**get_labels(self.name)).time():
+            t0 = time.perf_counter()
+            payload = await _maybe_await(self.preprocess(body, headers))
+            t1 = time.perf_counter()
+        payload = self.validate(payload)
+
+        if verb == InferenceVerb.EXPLAIN:
+            with EXPLAIN_HIST_TIME.labels(**get_labels(self.name)).time():
+                t2 = time.perf_counter()
+                response = await _maybe_await(self.explain(payload, headers))
+                t3 = time.perf_counter()
+            trace_logger.info(
+                "requestId: %s, preprocess_ms: %s, explain_ms: %s",
+                request_id,
+                get_latency_ms(t0, t1),
+                get_latency_ms(t2, t3),
+            )
+        else:
+            with PREDICT_HIST_TIME.labels(**get_labels(self.name)).time():
+                t2 = time.perf_counter()
+                response = await _maybe_await(
+                    _call_with_optional_headers(self.predict, payload, headers, response_headers)
+                )
+                t3 = time.perf_counter()
+            with POST_HIST_TIME.labels(**get_labels(self.name)).time():
+                t4 = time.perf_counter()
+                response = await _maybe_await(
+                    _call_with_optional_headers(
+                        self.postprocess, response, headers, response_headers
+                    )
+                )
+                t5 = time.perf_counter()
+            trace_logger.info(
+                "requestId: %s, preprocess_ms: %s, predict_ms: %s, postprocess_ms: %s",
+                request_id,
+                get_latency_ms(t0, t1),
+                get_latency_ms(t2, t3),
+                get_latency_ms(t4, t5),
+            )
+        return response
+
+    def validate(self, payload):
+        if isinstance(payload, (InferRequest, InferResponse)):
+            return payload
+        if isinstance(payload, dict):
+            if self.protocol == PredictorProtocol.REST_V1.value:
+                if "instances" in payload and not isinstance(payload["instances"], list):
+                    raise InvalidInput('Expected "instances" to be a list')
+            elif "inputs" in payload and not isinstance(payload["inputs"], list):
+                raise InvalidInput('Expected "inputs" to be a list')
+        return payload
+
+    # ---------- stages (override points) ----------
+
+    async def preprocess(self, payload, headers: Optional[Dict[str, str]] = None):
+        return payload
+
+    async def predict(self, payload, headers: Optional[Dict[str, str]] = None, response_headers=None):
+        """Default behaviour: transformer mode (forward to predictor_host)."""
+        if not self.predictor_host:
+            raise NotImplementedError("Could not find predictor_host.")
+        if self.protocol == PredictorProtocol.GRPC_V2.value:
+            return await self._grpc_predict(payload, headers)
+        return await self._http_predict(payload, headers)
+
+    async def explain(self, payload, headers: Optional[Dict[str, str]] = None):
+        if not self.predictor_host:
+            raise NotImplementedError("Could not find predictor_host.")
+        from .inference_client import InferenceRESTClient, RESTConfig
+
+        if self._rest_client is None:
+            self._rest_client = InferenceRESTClient(
+                RESTConfig(
+                    protocol=self.protocol,
+                    timeout=self.predictor_config.predictor_request_timeout_seconds,
+                    retries=self.predictor_config.predictor_request_retries,
+                )
+            )
+        return await self._rest_client.explain(self._explain_url(), data=payload, headers=headers)
+
+    async def postprocess(self, result, headers: Optional[Dict[str, str]] = None, response_headers=None):
+        return result
+
+    # ---------- transformer forwarding ----------
+
+    async def _http_predict(self, payload, headers=None):
+        from .inference_client import InferenceRESTClient, RESTConfig
+
+        if self._rest_client is None:
+            self._rest_client = InferenceRESTClient(
+                RESTConfig(
+                    protocol=self.protocol,
+                    timeout=self.predictor_config.predictor_request_timeout_seconds,
+                    retries=self.predictor_config.predictor_request_retries,
+                )
+            )
+        predict_headers = dict(self.predictor_config.extra_headers) if self.predictor_config else {}
+        if headers:
+            for h in ("x-request-id", "x-b3-traceid"):
+                if h in headers:
+                    predict_headers[h] = headers[h]
+            if headers.get("content-type", "").startswith("application/cloudevents+json"):
+                predict_headers["content-type"] = "application/json"
+        return await self._rest_client.infer(
+            self._predict_url(payload), data=payload, headers=predict_headers, model_name=self.name
+        )
+
+    async def _grpc_predict(self, payload: InferRequest, headers=None):
+        from .inference_client import InferenceGRPCClient
+
+        if self._grpc_client is None:
+            self._grpc_client = InferenceGRPCClient(
+                url=self.predictor_host,
+                use_ssl=self.predictor_config.predictor_use_ssl,
+                timeout=self.predictor_config.predictor_request_timeout_seconds,
+            )
+        meta = []
+        if headers:
+            for h in ("x-request-id", "x-b3-traceid"):
+                if h in headers:
+                    meta.append((h, headers[h]))
+        return await self._grpc_client.infer(payload, headers=meta)
+
+    def get_input_types(self) -> list:
+        return []
+
+    def get_output_types(self) -> list:
+        return []
+
+
+async def _maybe_await(value):
+    if inspect.isawaitable(value):
+        return await value
+    return value
+
+
+def _call_with_optional_headers(fn: Callable, payload, headers, response_headers):
+    """Call a stage fn, passing response_headers only if its signature takes
+    it — keeps simple user overrides (payload, headers) working."""
+    try:
+        sig = inspect.signature(fn)
+        if "response_headers" in sig.parameters:
+            return fn(payload, headers, response_headers=response_headers)
+    except (ValueError, TypeError):
+        pass
+    return fn(payload, headers)

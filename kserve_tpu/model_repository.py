@@ -1,0 +1,70 @@
+"""Name -> model registry with hot load/unload.
+
+Parity: reference python/kserve/kserve/model_repository.py.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Union
+
+from .model import BaseModel
+
+MODEL_MOUNT_DIRS = "/mnt/models"
+
+
+class ModelRepository:
+    """Registry the data plane dispatches against.  Multi-model runtimes
+    override `load()`/`unload()` to fetch/evict artifacts on demand."""
+
+    def __init__(self, models_dir: str = MODEL_MOUNT_DIRS):
+        self.models: Dict[str, BaseModel] = {}
+        self.models_dir = models_dir
+
+    def set_models_dir(self, models_dir: str):
+        self.models_dir = models_dir
+
+    def get_model(self, name: str) -> Optional[BaseModel]:
+        return self.models.get(name)
+
+    def get_models(self) -> Dict[str, BaseModel]:
+        return self.models
+
+    async def is_model_ready(self, name: str) -> bool:
+        model = self.get_model(name)
+        if model is None:
+            return False
+        if not isinstance(model, BaseModel):  # e.g. Ray-style handle
+            return True
+        return model.ready
+
+    def update(self, model: BaseModel):
+        self.models[model.name] = model
+
+    def update_handle(self, name: str, handle):
+        self.models[name] = handle
+
+    def load(self, name: str) -> bool:
+        """Load a model by name from `models_dir/name`; runtimes that support
+        multi-model serving override this."""
+        return self.load_model(name)
+
+    def load_model(self, name: str) -> bool:
+        model = self.get_model(name)
+        if model is None:
+            return False
+        if isinstance(model, BaseModel) and not model.ready:
+            model.load()
+        return model.ready
+
+    def unload(self, name: str):
+        if name in self.models:
+            model = self.models[name]
+            if isinstance(model, BaseModel):
+                model.stop()
+            del self.models[name]
+        else:
+            raise KeyError(f"model with name {name} does not exist")
+
+    def model_dir_exists(self, name: str) -> bool:
+        return os.path.isdir(os.path.join(self.models_dir, name))

@@ -1,0 +1,199 @@
+"""Process entrypoint: wires models + DataPlane + REST/gRPC servers.
+
+`ModelServer.start(models)` blocks serving; `start_async()` is the embeddable
+form used by tests and by engine runtimes that own the event loop.
+
+Parity: reference python/kserve/kserve/model_server.py (start :332, engine
+startup :441-455, signal handling, arg parser :48-208); rebuilt on
+aiohttp/grpc.aio with the same lifecycle semantics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import concurrent.futures
+import signal
+from typing import Dict, List, Optional, Union
+
+from . import logging as ks_logging
+from .errors import NoModelReady
+from .logging import logger
+from .model import BaseModel, Model
+from .model_repository import ModelRepository
+from .protocol.dataplane import DataPlane
+from .protocol.grpc.server import GRPCServer
+from .protocol.model_repository_extension import ModelRepositoryExtension
+from .protocol.openai.dataplane import OpenAIDataPlane
+from .protocol.rest.server import RESTServer
+
+DEFAULT_HTTP_PORT = 8080
+DEFAULT_GRPC_PORT = 8081
+
+
+def build_arg_parser(parents: Optional[list] = None) -> argparse.ArgumentParser:
+    """Shared CLI surface; runtimes extend via parent-parser composition the
+    same way the reference runtimes do."""
+    parser = argparse.ArgumentParser(
+        add_help=(parents is None), parents=parents or [], conflict_handler="resolve"
+    )
+    parser.add_argument("--http_port", default=DEFAULT_HTTP_PORT, type=int)
+    parser.add_argument("--grpc_port", default=DEFAULT_GRPC_PORT, type=int)
+    parser.add_argument("--workers", default=1, type=int)
+    parser.add_argument("--max_threads", default=4, type=int)
+    parser.add_argument("--max_asyncio_workers", default=None, type=int)
+    parser.add_argument("--enable_grpc", default=True, type=lambda x: str(x).lower() == "true")
+    parser.add_argument("--enable_docs_url", default=False, type=lambda x: str(x).lower() == "true")
+    parser.add_argument(
+        "--enable_latency_logging", default=True, type=lambda x: str(x).lower() == "true"
+    )
+    parser.add_argument("--log_config_file", default=None, type=str)
+    parser.add_argument("--access_log_format", default=None, type=str)
+    parser.add_argument("--model_name", default="model", type=str)
+    parser.add_argument("--model_dir", default="/mnt/models", type=str)
+    return parser
+
+
+args, _ = build_arg_parser().parse_known_args()
+
+
+class ModelServer:
+    def __init__(
+        self,
+        http_port: int = args.http_port,
+        grpc_port: int = args.grpc_port,
+        workers: int = args.workers,
+        max_threads: int = args.max_threads,
+        max_asyncio_workers: Optional[int] = args.max_asyncio_workers,
+        registered_models: Optional[ModelRepository] = None,
+        enable_grpc: bool = args.enable_grpc,
+        enable_docs_url: bool = args.enable_docs_url,
+        enable_latency_logging: bool = args.enable_latency_logging,
+        access_log_format: Optional[str] = args.access_log_format,
+        grace_period: int = 30,
+    ):
+        self.http_port = http_port
+        self.grpc_port = grpc_port
+        self.workers = workers
+        self.max_threads = max_threads
+        self.max_asyncio_workers = max_asyncio_workers
+        self.enable_grpc = enable_grpc
+        self.enable_docs_url = enable_docs_url
+        self.enable_latency_logging = enable_latency_logging
+        self.access_log_format = access_log_format
+        self.grace_period = grace_period
+        self.registered_models = registered_models or ModelRepository()
+        self.dataplane = OpenAIDataPlane(self.registered_models)
+        self.model_repository_extension = ModelRepositoryExtension(self.registered_models)
+        self._rest_server: Optional[RESTServer] = None
+        self._grpc_server: Optional[GRPCServer] = None
+        self._engine_tasks: List[asyncio.Task] = []
+        self._grpc_task: Optional[asyncio.Task] = None
+        if not ks_logging.is_configured():
+            ks_logging.configure_logging(args.log_config_file)
+
+    # ---------- registration ----------
+
+    def register_model(self, model: BaseModel, name: Optional[str] = None) -> None:
+        if not (name or getattr(model, "name", None)):
+            raise Exception("Failed to register model, model.name must be provided.")
+        self.registered_models.update(model)
+        logger.info("Registering model: %s", name or model.name)
+
+    def _register_and_check_ready(self, models: Union[List[BaseModel], Dict[str, object]]):
+        if isinstance(models, dict):
+            for name, handle in models.items():
+                self.registered_models.update_handle(name, handle)
+                logger.info("Registering model handle: %s", name)
+        else:
+            at_least_one_ready = False
+            for model in models:
+                if not isinstance(model, BaseModel):
+                    raise RuntimeError("Model type should be 'BaseModel'")
+                self.register_model(model)
+                if model.ready:
+                    at_least_one_ready = True
+            engine_models = [m for m in models if _has_engine(m)]
+            if not at_least_one_ready and models and not engine_models:
+                raise NoModelReady(models)
+            return engine_models
+        return []
+
+    # ---------- lifecycle ----------
+
+    async def start_async(self, models: List[BaseModel]) -> None:
+        """Start servers inside an existing event loop (non-blocking serve)."""
+        engine_models = self._register_and_check_ready(models)
+        self._setup_asyncio_executor()
+        for model in engine_models:
+            task = asyncio.create_task(_start_engine(model))
+            self._engine_tasks.append(task)
+        self._rest_server = RESTServer(
+            self.dataplane,
+            self.model_repository_extension,
+            http_port=self.http_port,
+            access_log_format=self.access_log_format,
+            enable_docs_url=self.enable_docs_url,
+            enable_latency_logging=self.enable_latency_logging,
+        )
+        await self._rest_server.start()
+        if self.enable_grpc:
+            self._grpc_server = GRPCServer(
+                self.grpc_port, self.dataplane, self.model_repository_extension
+            )
+            self._grpc_task = asyncio.create_task(self._grpc_server.start(self.max_threads))
+
+    async def stop_async(self) -> None:
+        for model_name in list(self.registered_models.get_models().keys()):
+            try:
+                self.registered_models.unload(model_name)
+            except KeyError:
+                pass
+        for task in self._engine_tasks:
+            task.cancel()
+        if self._grpc_server is not None:
+            await self._grpc_server.stop()
+        if self._grpc_task is not None:
+            self._grpc_task.cancel()
+        if self._rest_server is not None:
+            await self._rest_server.stop()
+
+    def start(self, models: List[BaseModel]) -> None:
+        """Blocking entrypoint."""
+
+        async def serve():
+            await self.start_async(models)
+            stop_event = asyncio.Event()
+            loop = asyncio.get_event_loop()
+            for sig in (signal.SIGINT, signal.SIGTERM):
+                try:
+                    loop.add_signal_handler(sig, stop_event.set)
+                except NotImplementedError:  # pragma: no cover (non-unix)
+                    pass
+            await stop_event.wait()
+            logger.info("Stopping servers (grace period %ss)", self.grace_period)
+            await self.stop_async()
+
+        asyncio.run(serve())
+
+    def _setup_asyncio_executor(self):
+        workers = self.max_asyncio_workers
+        if workers is None:
+            import multiprocessing
+
+            # Mirrors the reference default: bounded small multiple of cores.
+            workers = min(32, multiprocessing.cpu_count() + 4)
+        loop = asyncio.get_event_loop()
+        loop.set_default_executor(concurrent.futures.ThreadPoolExecutor(max_workers=workers))
+
+
+def _has_engine(model: BaseModel) -> bool:
+    return type(model).start_engine is not BaseModel.start_engine or (
+        hasattr(model, "start_engine") and getattr(model, "_is_engine_model", False)
+    )
+
+
+async def _start_engine(model: BaseModel) -> None:
+    result = model.start_engine()
+    if asyncio.iscoroutine(result):
+        await result

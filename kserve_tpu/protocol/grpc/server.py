@@ -1,0 +1,56 @@
+"""grpc.aio server hosting the Open Inference Protocol service.
+
+Parity: reference python/kserve/kserve/protocol/grpc/server.py.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Optional
+
+import grpc
+
+from ...logging import logger
+from .servicer import InferenceServicer, add_inference_servicer_to_server
+
+if TYPE_CHECKING:
+    from ..dataplane import DataPlane
+    from ..model_repository_extension import ModelRepositoryExtension
+
+MAX_GRPC_MESSAGE_LENGTH = 8388608  # 8 MiB, matching the reference default
+
+
+class GRPCServer:
+    def __init__(
+        self,
+        port: int,
+        data_plane: "DataPlane",
+        model_repository_extension: "ModelRepositoryExtension" = None,
+        kwargs: Optional[dict] = None,
+    ):
+        self._port = port
+        self._data_plane = data_plane
+        self._mre = model_repository_extension
+        self._server: Optional[grpc.aio.Server] = None
+        self._kwargs = kwargs or {}
+
+    async def start(self, max_workers: int = 10) -> None:
+        options = self._kwargs.get(
+            "options",
+            [
+                ("grpc.max_send_message_length", MAX_GRPC_MESSAGE_LENGTH),
+                ("grpc.max_receive_message_length", MAX_GRPC_MESSAGE_LENGTH),
+            ],
+        )
+        self._server = grpc.aio.server(options=options)
+        servicer = InferenceServicer(self._data_plane, self._mre)
+        add_inference_servicer_to_server(servicer, self._server)
+        listen_addr = f"[::]:{self._port}"
+        self._server.add_insecure_port(listen_addr)
+        logger.info("gRPC server listening on %s", listen_addr)
+        await self._server.start()
+        await self._server.wait_for_termination()
+
+    async def stop(self, sig: Optional[int] = None) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=10)
+            self._server = None

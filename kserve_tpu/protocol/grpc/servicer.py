@@ -1,0 +1,171 @@
+"""gRPC Open Inference Protocol servicer bridging to the DataPlane.
+
+The image has protoc but no grpc python plugin, so instead of generated
+`*_pb2_grpc` stubs the service is wired with
+`grpc.method_handlers_generic_handler` — identical wire behaviour, one less
+codegen step.
+
+Parity: reference python/kserve/kserve/protocol/grpc/servicer.py (ModelInfer
+bridging at :109).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+import grpc
+
+from ...errors import InferenceError, InvalidInput, ModelNotFound, ModelNotReady
+from ...infer_type import InferRequest, InferResponse
+from . import open_inference_pb2 as pb
+
+if TYPE_CHECKING:
+    from ..dataplane import DataPlane
+    from ..model_repository_extension import ModelRepositoryExtension
+
+SERVICE_NAME = "inference.GRPCInferenceService"
+
+
+def to_grpc_headers(context: grpc.aio.ServicerContext) -> dict:
+    return {k: v for k, v in (context.invocation_metadata() or [])}
+
+
+class InferenceServicer:
+    def __init__(
+        self,
+        data_plane: "DataPlane",
+        model_repository_extension: "ModelRepositoryExtension" = None,
+    ):
+        self._data_plane = data_plane
+        self._mre = model_repository_extension
+
+    @staticmethod
+    async def _abort(context, code: grpc.StatusCode, details: str):
+        await context.abort(code, details)
+
+    async def ServerLive(self, request, context) -> pb.ServerLiveResponse:
+        status = await self._data_plane.live()
+        return pb.ServerLiveResponse(live=status["status"] == "alive")
+
+    async def ServerReady(self, request, context) -> pb.ServerReadyResponse:
+        return pb.ServerReadyResponse(ready=await self._data_plane.ready())
+
+    async def ModelReady(self, request, context) -> pb.ModelReadyResponse:
+        try:
+            ready = await self._data_plane.model_ready(request.name)
+            return pb.ModelReadyResponse(ready=ready)
+        except ModelNotFound as e:
+            await self._abort(context, grpc.StatusCode.NOT_FOUND, e.reason)
+
+    async def ServerMetadata(self, request, context) -> pb.ServerMetadataResponse:
+        metadata = self._data_plane.metadata()
+        return pb.ServerMetadataResponse(
+            name=metadata["name"],
+            version=metadata["version"],
+            extensions=metadata["extensions"],
+        )
+
+    async def ModelMetadata(self, request, context) -> pb.ModelMetadataResponse:
+        try:
+            metadata = await self._data_plane.model_metadata(request.name)
+            return pb.ModelMetadataResponse(
+                name=metadata["name"],
+                platform=metadata["platform"],
+                inputs=[
+                    pb.ModelMetadataResponse.TensorMetadata(
+                        name=t.get("name", ""),
+                        datatype=t.get("datatype", ""),
+                        shape=t.get("shape", []),
+                    )
+                    for t in metadata.get("inputs", [])
+                ],
+                outputs=[
+                    pb.ModelMetadataResponse.TensorMetadata(
+                        name=t.get("name", ""),
+                        datatype=t.get("datatype", ""),
+                        shape=t.get("shape", []),
+                    )
+                    for t in metadata.get("outputs", [])
+                ],
+            )
+        except ModelNotFound as e:
+            await self._abort(context, grpc.StatusCode.NOT_FOUND, e.reason)
+
+    async def ModelInfer(self, request, context) -> pb.ModelInferResponse:
+        headers = to_grpc_headers(context)
+        try:
+            infer_request = InferRequest.from_grpc(request)
+            response, _ = await self._data_plane.infer(
+                model_name=request.model_name, request=infer_request, headers=headers
+            )
+            if isinstance(response, InferResponse):
+                return response.to_grpc()
+            if isinstance(response, pb.ModelInferResponse):
+                return response
+            raise InvalidInput(
+                f"model {request.model_name} returned {type(response).__name__}, "
+                "expected InferResponse for gRPC"
+            )
+        except InvalidInput as e:
+            await self._abort(context, grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        except ModelNotFound as e:
+            await self._abort(context, grpc.StatusCode.NOT_FOUND, e.reason)
+        except ModelNotReady as e:
+            await self._abort(context, grpc.StatusCode.UNAVAILABLE, e.error_msg)
+        except InferenceError as e:
+            await self._abort(context, grpc.StatusCode.INTERNAL, str(e))
+
+    async def RepositoryModelLoad(self, request, context) -> pb.RepositoryModelLoadResponse:
+        try:
+            await self._mre.load(request.model_name)
+            return pb.RepositoryModelLoadResponse(model_name=request.model_name, isLoaded=True)
+        except ModelNotFound as e:
+            await self._abort(context, grpc.StatusCode.NOT_FOUND, e.reason)
+
+    async def RepositoryModelUnload(self, request, context) -> pb.RepositoryModelUnloadResponse:
+        try:
+            await self._mre.unload(request.model_name)
+            return pb.RepositoryModelUnloadResponse(
+                model_name=request.model_name, isUnloaded=True
+            )
+        except ModelNotFound as e:
+            await self._abort(context, grpc.StatusCode.NOT_FOUND, e.reason)
+
+
+_METHODS = {
+    "ServerLive": (pb.ServerLiveRequest, pb.ServerLiveResponse),
+    "ServerReady": (pb.ServerReadyRequest, pb.ServerReadyResponse),
+    "ModelReady": (pb.ModelReadyRequest, pb.ModelReadyResponse),
+    "ServerMetadata": (pb.ServerMetadataRequest, pb.ServerMetadataResponse),
+    "ModelMetadata": (pb.ModelMetadataRequest, pb.ModelMetadataResponse),
+    "ModelInfer": (pb.ModelInferRequest, pb.ModelInferResponse),
+    "RepositoryModelLoad": (pb.RepositoryModelLoadRequest, pb.RepositoryModelLoadResponse),
+    "RepositoryModelUnload": (pb.RepositoryModelUnloadRequest, pb.RepositoryModelUnloadResponse),
+}
+
+
+def add_inference_servicer_to_server(servicer: InferenceServicer, server) -> None:
+    handlers = {
+        name: grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req.FromString,
+            response_serializer=res.SerializeToString,
+        )
+        for name, (req, res) in _METHODS.items()
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+    )
+
+
+def build_stub_multicallables(channel: grpc.aio.Channel) -> dict:
+    """Client-side: method name -> unary_unary multicallable (used by
+    InferenceGRPCClient; replaces the generated Stub class)."""
+    return {
+        name: channel.unary_unary(
+            f"/{SERVICE_NAME}/{name}",
+            request_serializer=req.SerializeToString,
+            response_deserializer=res.FromString,
+        )
+        for name, (req, res) in _METHODS.items()
+    }

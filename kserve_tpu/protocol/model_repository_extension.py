@@ -1,0 +1,29 @@
+"""Repository (load/unload) extension shared by the V2 REST and gRPC heads.
+
+Parity: reference python/kserve/kserve/protocol/model_repository_extension.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..errors import ModelNotFound
+from ..model_repository import ModelRepository
+
+
+class ModelRepositoryExtension:
+    def __init__(self, model_registry: ModelRepository):
+        self._model_registry = model_registry
+
+    async def load(self, model_name: str) -> None:
+        loaded = await asyncio.get_event_loop().run_in_executor(
+            None, self._model_registry.load, model_name
+        )
+        if not loaded:
+            raise ModelNotFound(model_name)
+
+    async def unload(self, model_name: str) -> None:
+        try:
+            self._model_registry.unload(model_name)
+        except KeyError:
+            raise ModelNotFound(model_name)

@@ -1,0 +1,71 @@
+"""OpenAI data plane: resolves the model and dispatches typed requests.
+
+Parity: reference python/kserve/kserve/protocol/rest/openai/dataplane.py:41.
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator, Union
+
+from ...errors import InvalidInput, ModelNotFound, ModelNotReady
+from ..dataplane import DataPlane
+from .openai_model import OpenAIEncoderModel, OpenAIGenerativeModel, OpenAIModel
+from .types import (
+    ChatCompletion,
+    ChatCompletionChunk,
+    ChatCompletionRequest,
+    Completion,
+    CompletionRequest,
+    Embedding,
+    EmbeddingRequest,
+    ModelCard,
+    ModelList,
+    Rerank,
+    RerankRequest,
+)
+
+
+class OpenAIDataPlane(DataPlane):
+    """Adds OpenAI verbs on top of the core data plane."""
+
+    async def _get_openai_model(self, name: str, kind) -> OpenAIModel:
+        model = self._model_registry.get_model(name)
+        if model is None:
+            raise ModelNotFound(name)
+        if not await self._model_registry.is_model_ready(name):
+            raise ModelNotReady(name)
+        if not isinstance(model, kind):
+            raise InvalidInput(f"Model {name} does not support this endpoint")
+        return model
+
+    async def create_completion(
+        self, model_name: str, request: CompletionRequest, raw_request=None, context=None
+    ) -> Union[Completion, AsyncIterator[Completion]]:
+        model = await self._get_openai_model(model_name, OpenAIGenerativeModel)
+        return await model.create_completion(request, raw_request, context)
+
+    async def create_chat_completion(
+        self, model_name: str, request: ChatCompletionRequest, raw_request=None, context=None
+    ) -> Union[ChatCompletion, AsyncIterator[ChatCompletionChunk]]:
+        model = await self._get_openai_model(model_name, OpenAIGenerativeModel)
+        return await model.create_chat_completion(request, raw_request, context)
+
+    async def create_embedding(
+        self, model_name: str, request: EmbeddingRequest, raw_request=None, context=None
+    ) -> Embedding:
+        model = await self._get_openai_model(model_name, OpenAIEncoderModel)
+        return await model.create_embedding(request, raw_request, context)
+
+    async def create_rerank(
+        self, model_name: str, request: RerankRequest, raw_request=None, context=None
+    ) -> Rerank:
+        model = await self._get_openai_model(model_name, OpenAIEncoderModel)
+        return await model.create_rerank(request, raw_request, context)
+
+    async def models(self) -> ModelList:
+        cards = [
+            ModelCard(id=name)
+            for name, model in self._model_registry.get_models().items()
+            if isinstance(model, OpenAIModel)
+        ]
+        return ModelList(data=cards)

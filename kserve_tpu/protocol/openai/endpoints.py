@@ -1,0 +1,127 @@
+"""OpenAI REST endpoints (aiohttp) with SSE streaming.
+
+Routes: /openai/v1/{models,completions,chat/completions,embeddings,rerank}
+plus unprefixed /v1/chat/completions-style aliases for stock OpenAI clients.
+
+Parity: reference python/kserve/kserve/protocol/rest/openai/endpoints.py:52
+(SSE streaming at :58-146); aiohttp StreamResponse replaces FastAPI
+StreamingResponse.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import AsyncIterator
+
+from aiohttp import web
+from pydantic import ValidationError
+
+from ...errors import InvalidInput, ModelNotFound, ModelNotReady
+from .dataplane import OpenAIDataPlane
+from .types import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    EmbeddingRequest,
+    ErrorInfo,
+    ErrorResponse,
+    RerankRequest,
+)
+
+
+def _openai_error(status: int, message: str, err_type: str = "invalid_request_error"):
+    body = ErrorResponse(error=ErrorInfo(message=message, type=err_type))
+    return web.json_response(body.model_dump(), status=status)
+
+
+async def _stream_sse(request: web.Request, iterator: AsyncIterator) -> web.StreamResponse:
+    response = web.StreamResponse(
+        status=200,
+        headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "Connection": "keep-alive",
+        },
+    )
+    await response.prepare(request)
+    try:
+        async for chunk in iterator:
+            if isinstance(chunk, (bytes, str)):
+                data = chunk if isinstance(chunk, str) else chunk.decode("utf-8")
+            else:
+                data = chunk.model_dump_json(exclude_unset=False, exclude_none=True)
+            await response.write(f"data: {data}\n\n".encode("utf-8"))
+        await response.write(b"data: [DONE]\n\n")
+    except ConnectionResetError:
+        pass
+    await response.write_eof()
+    return response
+
+
+class OpenAIEndpoints:
+    def __init__(self, dataplane: OpenAIDataPlane):
+        self.dataplane = dataplane
+
+    async def models(self, request: web.Request) -> web.Response:
+        model_list = await self.dataplane.models()
+        return web.json_response(model_list.model_dump())
+
+    async def _parse(self, request: web.Request, model_cls):
+        try:
+            body = await request.json()
+        except json.JSONDecodeError as e:
+            raise InvalidInput(f"invalid JSON body: {e}")
+        try:
+            return model_cls.model_validate(body)
+        except ValidationError as e:
+            raise InvalidInput(str(e))
+
+    async def create_completion(self, request: web.Request):
+        params = await self._parse(request, CompletionRequest)
+        headers = {k.lower(): v for k, v in request.headers.items()}
+        result = await self.dataplane.create_completion(
+            params.model, params, raw_request=request, context=headers
+        )
+        if params.stream:
+            return await _stream_sse(request, result)
+        return web.json_response(result.model_dump(exclude_none=True))
+
+    async def create_chat_completion(self, request: web.Request):
+        params = await self._parse(request, ChatCompletionRequest)
+        headers = {k.lower(): v for k, v in request.headers.items()}
+        result = await self.dataplane.create_chat_completion(
+            params.model, params, raw_request=request, context=headers
+        )
+        if params.stream:
+            return await _stream_sse(request, result)
+        return web.json_response(result.model_dump(exclude_none=True))
+
+    async def create_embedding(self, request: web.Request):
+        params = await self._parse(request, EmbeddingRequest)
+        headers = {k.lower(): v for k, v in request.headers.items()}
+        result = await self.dataplane.create_embedding(
+            params.model, params, raw_request=request, context=headers
+        )
+        return web.json_response(result.model_dump(exclude_none=True))
+
+    async def create_rerank(self, request: web.Request):
+        params = await self._parse(request, RerankRequest)
+        headers = {k.lower(): v for k, v in request.headers.items()}
+        result = await self.dataplane.create_rerank(
+            params.model, params, raw_request=request, context=headers
+        )
+        return web.json_response(result.model_dump(exclude_none=True))
+
+    def register(self, app: web.Application) -> None:
+        for prefix in ("/openai/v1", "/openai"):
+            app.router.add_get(f"{prefix}/models", self.models)
+            app.router.add_post(f"{prefix}/completions", self.create_completion)
+            app.router.add_post(f"{prefix}/chat/completions", self.create_chat_completion)
+            app.router.add_post(f"{prefix}/embeddings", self.create_embedding)
+            app.router.add_post(f"{prefix}/rerank", self.create_rerank)
+
+
+def register_openai_routes(app: web.Application, dataplane) -> None:
+    if not isinstance(dataplane, OpenAIDataPlane):
+        # Share the registry; OpenAI verbs only need repository access.
+        dataplane = OpenAIDataPlane(dataplane.model_registry)
+    OpenAIEndpoints(dataplane).register(app)

@@ -1,0 +1,59 @@
+"""OpenAI-protocol model ABCs.
+
+`OpenAIModel` is the marker base the server uses to route OpenAI endpoints;
+`OpenAIGenerativeModel` adds completions/chat, `OpenAIEncoderModel` adds
+embeddings/rerank.  `ChatAdapterModel` upgrades a completions-only model to
+chat by applying a chat template.
+
+Parity: reference python/kserve/kserve/protocol/rest/openai/openai_model.py:42-110
+and chat_adapter_model.py.
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator, Optional, Union
+
+from ...model import BaseModel
+from .types import (
+    ChatCompletion,
+    ChatCompletionChunk,
+    ChatCompletionRequest,
+    Completion,
+    CompletionRequest,
+    Embedding,
+    EmbeddingRequest,
+    Rerank,
+    RerankRequest,
+)
+
+
+class OpenAIModel(BaseModel):
+    """Marker base; routed to /openai/v1/* instead of V1/V2 dispatch."""
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        self.ready = False
+
+
+class OpenAIGenerativeModel(OpenAIModel):
+    async def create_completion(
+        self, request: CompletionRequest, raw_request=None, context=None
+    ) -> Union[Completion, AsyncIterator[Completion]]:
+        raise NotImplementedError()
+
+    async def create_chat_completion(
+        self, request: ChatCompletionRequest, raw_request=None, context=None
+    ) -> Union[ChatCompletion, AsyncIterator[ChatCompletionChunk]]:
+        raise NotImplementedError()
+
+
+class OpenAIEncoderModel(OpenAIModel):
+    async def create_embedding(
+        self, request: EmbeddingRequest, raw_request=None, context=None
+    ) -> Embedding:
+        raise NotImplementedError()
+
+    async def create_rerank(
+        self, request: RerankRequest, raw_request=None, context=None
+    ) -> Rerank:
+        raise NotImplementedError()

@@ -1,0 +1,133 @@
+"""aiohttp REST server assembling all protocol heads.
+
+The reference builds on FastAPI/uvicorn; this image ships aiohttp, which is a
+better fit anyway for the streaming-heavy OpenAI path (no ASGI translation
+layer under SSE).  Exception -> status mapping, timing middleware, and the
+/metrics endpoint mirror the reference's rest/server.py.
+
+Parity: reference python/kserve/kserve/protocol/rest/server.py.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import TYPE_CHECKING, List, Optional
+
+from aiohttp import web
+from prometheus_client import CONTENT_TYPE_LATEST, generate_latest
+
+from ...errors import (
+    InferenceError,
+    InvalidInput,
+    ModelNotFound,
+    ModelNotReady,
+    ServerNotLive,
+    ServerNotReady,
+    UnsupportedProtocol,
+)
+from ...logging import logger, trace_logger
+from .v1_endpoints import V1Endpoints
+from .v2_endpoints import V2Endpoints
+
+if TYPE_CHECKING:
+    from ..dataplane import DataPlane
+    from ..model_repository_extension import ModelRepositoryExtension
+
+
+def _error_response(status: int, reason: str) -> web.Response:
+    return web.json_response({"error": reason}, status=status)
+
+
+@web.middleware
+async def error_middleware(request: web.Request, handler):
+    try:
+        return await handler(request)
+    except InvalidInput as e:
+        return _error_response(400, str(e))
+    except ModelNotFound as e:
+        return _error_response(404, e.reason)
+    except (ModelNotReady, ServerNotReady, ServerNotLive) as e:
+        return _error_response(503, str(e))
+    except UnsupportedProtocol as e:
+        return _error_response(400, e.reason)
+    except NotImplementedError as e:
+        return _error_response(501, str(e) or "Not implemented")
+    except InferenceError as e:
+        return _error_response(500, str(e))
+    except web.HTTPException:
+        raise
+    except Exception as e:  # noqa: BLE001 — last-resort 500 with log
+        logger.exception("Internal server error handling %s", request.path)
+        return _error_response(500, f"{type(e).__name__}: {e}")
+
+
+@web.middleware
+async def timing_middleware(request: web.Request, handler):
+    start = time.perf_counter()
+    response = await handler(request)
+    elapsed_ms = (time.perf_counter() - start) * 1000
+    trace_logger.info(
+        "%s %s %s %.3fms", request.method, request.path, response.status, elapsed_ms
+    )
+    return response
+
+
+async def metrics_handler(request: web.Request) -> web.Response:
+    body = generate_latest()
+    return web.Response(body=body, content_type=CONTENT_TYPE_LATEST.split(";")[0])
+
+
+async def root_handler(request: web.Request) -> web.Response:
+    return web.json_response({"status": "alive"})
+
+
+class RESTServer:
+    """Owns the aiohttp Application; `create_application()` is separated out
+    so tests can drive it with aiohttp's in-process test client."""
+
+    def __init__(
+        self,
+        dataplane: "DataPlane",
+        model_repository_extension: Optional["ModelRepositoryExtension"] = None,
+        http_port: int = 8080,
+        access_log_format: Optional[str] = None,
+        enable_docs_url: bool = False,
+        openai_models: Optional[List] = None,
+        enable_latency_logging: bool = True,
+    ):
+        self.dataplane = dataplane
+        self.model_repository_extension = model_repository_extension
+        self.http_port = http_port
+        self.access_log_format = access_log_format
+        self.enable_latency_logging = enable_latency_logging
+        self._runner: Optional[web.AppRunner] = None
+
+    def create_application(self) -> web.Application:
+        middlewares = [error_middleware]
+        if self.enable_latency_logging:
+            middlewares.append(timing_middleware)
+        app = web.Application(middlewares=middlewares, client_max_size=1024**3)
+        app.router.add_get("/", root_handler)
+        app.router.add_get("/metrics", metrics_handler)
+        V1Endpoints(self.dataplane, self.model_repository_extension).register(app)
+        V2Endpoints(self.dataplane, self.model_repository_extension).register(app)
+        # OpenAI + timeseries heads are registered lazily so pure-predictive
+        # servers never import transformers/pydantic generative types.
+        from ..openai.endpoints import register_openai_routes
+
+        register_openai_routes(app, self.dataplane)
+        return app
+
+    async def start(self) -> None:
+        app = self.create_application()
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host="0.0.0.0", port=self.http_port, reuse_port=True)
+        await site.start()
+        logger.info("REST server listening on port %s", self.http_port)
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None

@@ -1,0 +1,88 @@
+"""V1 (TFServing-style) REST protocol head.
+
+Routes: GET /v1/models, GET /v1/models/{name}, POST /v1/models/{name}:predict,
+POST /v1/models/{name}:explain.
+
+Parity: reference python/kserve/kserve/protocol/rest/v1_endpoints.py:155-170.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import TYPE_CHECKING
+
+from aiohttp import web
+
+from ...errors import ModelNotFound
+from ...infer_type import InferResponse
+
+if TYPE_CHECKING:
+    from ..dataplane import DataPlane
+    from ..model_repository_extension import ModelRepositoryExtension
+
+
+class V1Endpoints:
+    def __init__(self, dataplane: "DataPlane", model_repository_extension=None):
+        self.dataplane = dataplane
+        self.model_repository_extension = model_repository_extension
+
+    async def models(self, request: web.Request) -> web.Response:
+        models = list(self.dataplane.model_registry.get_models().keys())
+        return web.json_response({"models": models})
+
+    async def model_ready(self, request: web.Request) -> web.Response:
+        model_name = request.match_info["model_name"]
+        ready = await self.dataplane.model_ready(model_name)
+        return web.json_response({"name": model_name, "ready": ready})
+
+    async def predict(self, request: web.Request) -> web.Response:
+        model_name = request.match_info["model_name"]
+        headers = {k.lower(): v for k, v in request.headers.items()}
+        body = await request.read()
+        decoded, attributes = self.dataplane.decode(body, headers)
+        response_headers: dict = {}
+        response, res_headers = await self.dataplane.infer(
+            model_name, decoded, headers, response_headers
+        )
+        encoded, extra_headers = self.dataplane.encode(
+            model_name, response, headers, attributes
+        )
+        response_headers.update(extra_headers)
+        response_headers.pop("content-length", None)
+        if isinstance(encoded, (bytes, bytearray)):
+            return web.Response(body=bytes(encoded), headers=response_headers)
+        if isinstance(encoded, InferResponse):
+            encoded, _ = encoded.to_rest()
+        return web.Response(
+            body=json.dumps(encoded).encode("utf-8"),
+            content_type=response_headers.pop("content-type", None) or "application/json",
+            headers=response_headers,
+        )
+
+    async def explain(self, request: web.Request) -> web.Response:
+        model_name = request.match_info["model_name"]
+        headers = {k.lower(): v for k, v in request.headers.items()}
+        body = await request.read()
+        decoded, attributes = self.dataplane.decode(body, headers)
+        response_headers: dict = {}
+        response, res_headers = await self.dataplane.explain(
+            model_name, decoded, headers, response_headers
+        )
+        encoded, extra_headers = self.dataplane.encode(
+            model_name, response, headers, attributes
+        )
+        response_headers.update(extra_headers)
+        response_headers.pop("content-length", None)
+        if isinstance(encoded, (bytes, bytearray)):
+            return web.Response(body=bytes(encoded), headers=response_headers)
+        return web.Response(
+            body=json.dumps(encoded).encode("utf-8"),
+            content_type=response_headers.pop("content-type", None) or "application/json",
+            headers=response_headers,
+        )
+
+    def register(self, app: web.Application) -> None:
+        app.router.add_get("/v1/models", self.models)
+        app.router.add_get("/v1/models/{model_name}", self.model_ready)
+        app.router.add_post("/v1/models/{model_name}:predict", self.predict)
+        app.router.add_post("/v1/models/{model_name}:explain", self.explain)

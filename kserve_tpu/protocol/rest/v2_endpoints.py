@@ -1,0 +1,112 @@
+"""V2 / Open Inference Protocol REST head, including the binary-tensor
+extension (Inference-Header-Content-Length) and the model repository
+extension (load/unload).
+
+Parity: reference python/kserve/kserve/protocol/rest/v2_endpoints.py:237-302.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import TYPE_CHECKING, Optional
+
+from aiohttp import web
+
+from ...errors import InvalidInput
+from ...infer_type import InferRequest, InferResponse
+
+if TYPE_CHECKING:
+    from ..dataplane import DataPlane
+
+
+class V2Endpoints:
+    def __init__(self, dataplane: "DataPlane", model_repository_extension=None):
+        self.dataplane = dataplane
+        self.model_repository_extension = model_repository_extension
+
+    async def metadata(self, request: web.Request) -> web.Response:
+        return web.json_response(self.dataplane.metadata())
+
+    async def live(self, request: web.Request) -> web.Response:
+        status = await self.dataplane.live()
+        return web.json_response({"live": status["status"] == "alive"})
+
+    async def ready(self, request: web.Request) -> web.Response:
+        ready = await self.dataplane.ready()
+        if not ready:
+            return web.json_response({"ready": False}, status=503)
+        return web.json_response({"ready": True})
+
+    async def model_metadata(self, request: web.Request) -> web.Response:
+        model_name = request.match_info["model_name"]
+        metadata = await self.dataplane.model_metadata(model_name)
+        return web.json_response(metadata)
+
+    async def model_ready(self, request: web.Request) -> web.Response:
+        model_name = request.match_info["model_name"]
+        ready = await self.dataplane.model_ready(model_name)
+        if not ready:
+            return web.json_response({"name": model_name, "ready": False}, status=503)
+        return web.json_response({"name": model_name, "ready": True})
+
+    async def infer(self, request: web.Request) -> web.Response:
+        model_name = request.match_info["model_name"]
+        model_version = request.match_info.get("model_version")
+        headers = {k.lower(): v for k, v in request.headers.items()}
+        body = await request.read()
+        json_length: Optional[int] = None
+        if "inference-header-content-length" in headers:
+            try:
+                json_length = int(headers["inference-header-content-length"])
+            except ValueError:
+                raise InvalidInput("Inference-Header-Content-Length must be an integer")
+        infer_request, attributes = self.dataplane.decode(
+            body, headers, json_length=json_length, model_name=model_name
+        )
+        if isinstance(infer_request, dict):
+            infer_request = InferRequest.from_dict(infer_request, model_name=model_name)
+        if model_version:
+            infer_request.model_version = model_version
+        response_headers: dict = {}
+        response, _ = await self.dataplane.infer(
+            model_name, infer_request, headers, response_headers
+        )
+        if isinstance(response, InferResponse):
+            res, res_json_length = response.to_rest(infer_request.request_outputs)
+        else:
+            res, res_json_length = response, None
+        response_headers.pop("content-length", None)
+        if res_json_length is not None:
+            response_headers["inference-header-content-length"] = str(res_json_length)
+            return web.Response(
+                body=res, content_type="application/octet-stream", headers=response_headers
+            )
+        return web.Response(
+            body=json.dumps(res).encode("utf-8"),
+            content_type="application/json",
+            headers=response_headers,
+        )
+
+    async def load(self, request: web.Request) -> web.Response:
+        model_name = request.match_info["model_name"]
+        await self.model_repository_extension.load(model_name)
+        return web.json_response({"name": model_name, "load": True})
+
+    async def unload(self, request: web.Request) -> web.Response:
+        model_name = request.match_info["model_name"]
+        await self.model_repository_extension.unload(model_name)
+        return web.json_response({"name": model_name, "unload": True})
+
+    def register(self, app: web.Application) -> None:
+        app.router.add_get("/v2", self.metadata)
+        app.router.add_get("/v2/health/live", self.live)
+        app.router.add_get("/v2/health/ready", self.ready)
+        app.router.add_get("/v2/models/{model_name}", self.model_metadata)
+        app.router.add_get("/v2/models/{model_name}/ready", self.model_ready)
+        app.router.add_post("/v2/models/{model_name}/infer", self.infer)
+        app.router.add_post(
+            "/v2/models/{model_name}/versions/{model_version}/infer", self.infer
+        )
+        if self.model_repository_extension is not None:
+            app.router.add_post("/v2/repository/models/{model_name}/load", self.load)
+            app.router.add_post("/v2/repository/models/{model_name}/unload", self.unload)

@@ -1,0 +1,98 @@
+"""Open Inference Protocol tensor <-> numpy codec.
+
+Covers the datatype table of the V2 (OIP) protocol plus TPU-relevant BF16, and
+the BYTES binary wire format (4-byte little-endian length-prefixed elements).
+
+Parity: reference python/kserve/kserve/utils/numpy_codec.py and the datatype
+handling spread through python/kserve/kserve/infer_type.py; rebuilt clean.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional
+
+import numpy as np
+
+try:  # bfloat16 rides along with jax/ml_dtypes; optional for pure-CPU installs
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover
+    _BF16 = None
+
+# OIP datatype name -> numpy dtype
+_DTYPE_TABLE = {
+    "BOOL": np.dtype(np.bool_),
+    "UINT8": np.dtype(np.uint8),
+    "UINT16": np.dtype(np.uint16),
+    "UINT32": np.dtype(np.uint32),
+    "UINT64": np.dtype(np.uint64),
+    "INT8": np.dtype(np.int8),
+    "INT16": np.dtype(np.int16),
+    "INT32": np.dtype(np.int32),
+    "INT64": np.dtype(np.int64),
+    "FP16": np.dtype(np.float16),
+    "FP32": np.dtype(np.float32),
+    "FP64": np.dtype(np.float64),
+}
+if _BF16 is not None:
+    _DTYPE_TABLE["BF16"] = _BF16
+
+_REVERSE_TABLE = {v: k for k, v in _DTYPE_TABLE.items()}
+
+
+def to_np_dtype(datatype: str) -> Optional[np.dtype]:
+    """OIP datatype string -> numpy dtype (BYTES -> object dtype)."""
+    if datatype == "BYTES":
+        return np.dtype(object)
+    return _DTYPE_TABLE.get(datatype)
+
+
+def from_np_dtype(dtype: np.dtype) -> Optional[str]:
+    """numpy dtype -> OIP datatype string."""
+    dtype = np.dtype(dtype)
+    if dtype.kind in ("S", "U", "O"):
+        return "BYTES"
+    return _REVERSE_TABLE.get(dtype)
+
+
+def serialize_byte_tensor(tensor: np.ndarray) -> bytes:
+    """Flatten a BYTES tensor (object/str/bytes ndarray) to the OIP binary
+    format: each element is a uint32 little-endian length followed by raw bytes.
+    Elements are serialized in C order."""
+    if tensor.size == 0:
+        return b""
+    flat = np.ascontiguousarray(tensor).flatten()
+    out = bytearray()
+    for el in flat:
+        if isinstance(el, bytes):
+            raw = el
+        elif isinstance(el, str):
+            raw = el.encode("utf-8")
+        elif isinstance(el, (np.bytes_,)):
+            raw = bytes(el)
+        elif isinstance(el, (np.str_,)):
+            raw = str(el).encode("utf-8")
+        else:
+            raw = str(el).encode("utf-8")
+        out += struct.pack("<I", len(raw))
+        out += raw
+    return bytes(out)
+
+
+def deserialize_bytes_tensor(encoded: bytes) -> np.ndarray:
+    """Inverse of serialize_byte_tensor -> 1-D object ndarray of bytes."""
+    items: List[bytes] = []
+    offset = 0
+    n = len(encoded)
+    while offset < n:
+        if offset + 4 > n:
+            raise ValueError("malformed BYTES tensor: truncated length prefix")
+        (length,) = struct.unpack_from("<I", encoded, offset)
+        offset += 4
+        if offset + length > n:
+            raise ValueError("malformed BYTES tensor: truncated element")
+        items.append(encoded[offset : offset + length])
+        offset += length
+    return np.array(items, dtype=object)

@@ -1,0 +1,85 @@
+"""In-process gRPC OIP tests: real grpc.aio server + InferenceGRPCClient."""
+
+import asyncio
+
+import grpc
+import numpy as np
+import pytest
+
+from kserve_tpu import InferInput, InferOutput, InferRequest, InferResponse, ModelRepository
+from kserve_tpu.inference_client import InferenceGRPCClient
+from kserve_tpu.protocol.grpc.servicer import (
+    InferenceServicer,
+    add_inference_servicer_to_server,
+)
+from kserve_tpu.protocol.model_repository_extension import ModelRepositoryExtension
+from kserve_tpu.protocol.openai.dataplane import OpenAIDataPlane
+
+from conftest import async_test
+from test_rest_server import DummyModel
+
+
+async def start_server(repo):
+    dataplane = OpenAIDataPlane(repo)
+    server = grpc.aio.server()
+    servicer = InferenceServicer(dataplane, ModelRepositoryExtension(repo))
+    add_inference_servicer_to_server(servicer, server)
+    port = server.add_insecure_port("127.0.0.1:0")
+    await server.start()
+    return server, port
+
+
+@async_test
+async def test_grpc_lifecycle_and_infer():
+    repo = ModelRepository()
+    repo.update(DummyModel())
+    server, port = await start_server(repo)
+    try:
+        async with InferenceGRPCClient(f"127.0.0.1:{port}", timeout=10) as client:
+            assert await client.is_server_live()
+            assert await client.is_server_ready()
+            assert await client.is_model_ready("dummy")
+
+            x = np.arange(4, dtype=np.float32).reshape(2, 2)
+            inp = InferInput("input-0", [2, 2], "FP32")
+            inp.set_data_from_numpy(x, binary_data=True)
+            req = InferRequest(model_name="dummy", infer_inputs=[inp], request_id="g-1")
+            res = await client.infer(req)
+            assert isinstance(res, InferResponse)
+            assert res.model_name == "dummy"
+            np.testing.assert_array_equal(res.outputs[0].as_numpy(), x * 2)
+    finally:
+        await server.stop(None)
+
+
+@async_test
+async def test_grpc_model_not_found():
+    repo = ModelRepository()
+    repo.update(DummyModel())
+    server, port = await start_server(repo)
+    try:
+        async with InferenceGRPCClient(f"127.0.0.1:{port}", timeout=10, retries=0) as client:
+            inp = InferInput("input-0", [1], "INT32", data=[1])
+            req = InferRequest(model_name="ghost", infer_inputs=[inp])
+            with pytest.raises(grpc.aio.AioRpcError) as e:
+                await client.infer(req)
+            assert e.value.code() == grpc.StatusCode.NOT_FOUND
+    finally:
+        await server.stop(None)
+
+
+@async_test
+async def test_grpc_typed_contents():
+    repo = ModelRepository()
+    repo.update(DummyModel())
+    server, port = await start_server(repo)
+    try:
+        async with InferenceGRPCClient(f"127.0.0.1:{port}", timeout=10) as client:
+            inp = InferInput("input-0", [3], "INT64", data=[1, 2, 3])
+            req = InferRequest(model_name="dummy", infer_inputs=[inp])
+            res = await client.infer(req)
+            np.testing.assert_array_equal(
+                res.outputs[0].as_numpy(), np.array([2, 4, 6], dtype=np.int64)
+            )
+    finally:
+        await server.stop(None)

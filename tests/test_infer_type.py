@@ -1,0 +1,194 @@
+"""Tensor codec tests: V2 JSON, binary extension, gRPC, numpy roundtrips."""
+
+import numpy as np
+import pytest
+
+from kserve_tpu.errors import InvalidInput
+from kserve_tpu.infer_type import (
+    InferInput,
+    InferOutput,
+    InferRequest,
+    InferResponse,
+    RequestedOutput,
+)
+from kserve_tpu.utils.numpy_codec import (
+    deserialize_bytes_tensor,
+    from_np_dtype,
+    serialize_byte_tensor,
+    to_np_dtype,
+)
+
+
+class TestNumpyCodec:
+    def test_dtype_roundtrip(self):
+        for name in ["BOOL", "UINT8", "UINT16", "UINT32", "UINT64", "INT8", "INT16", "INT32", "INT64", "FP16", "FP32", "FP64"]:
+            dt = to_np_dtype(name)
+            assert dt is not None
+            assert from_np_dtype(dt) == name
+
+    def test_bytes_dtype(self):
+        assert to_np_dtype("BYTES") == np.dtype(object)
+        assert from_np_dtype(np.dtype("S10")) == "BYTES"
+        assert from_np_dtype(np.dtype("U10")) == "BYTES"
+
+    def test_bytes_tensor_roundtrip(self):
+        arr = np.array([b"hello", b"", b"world \xff"], dtype=object)
+        enc = serialize_byte_tensor(arr)
+        dec = deserialize_bytes_tensor(enc)
+        assert list(dec) == [b"hello", b"", b"world \xff"]
+
+    def test_bytes_tensor_truncated(self):
+        with pytest.raises(ValueError):
+            deserialize_bytes_tensor(b"\x05\x00\x00\x00ab")
+
+
+class TestInferInput:
+    def test_json_data_roundtrip(self):
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        inp = InferInput("x", [2, 3], "FP32")
+        inp.set_data_from_numpy(x, binary_data=False)
+        assert inp.data == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+        np.testing.assert_array_equal(inp.as_numpy(), x)
+
+    def test_binary_data_roundtrip(self):
+        x = np.arange(6, dtype=np.int64).reshape(3, 2)
+        inp = InferInput("x", [3, 2], "INT64")
+        inp.set_data_from_numpy(x, binary_data=True)
+        assert inp.raw_data is not None
+        assert inp.parameters["binary_data_size"] == len(inp.raw_data)
+        np.testing.assert_array_equal(inp.as_numpy(), x)
+
+    def test_bytes_input_as_string(self):
+        inp = InferInput("s", [2], "BYTES", data=["abc", "def"])
+        assert inp.as_string() == ["abc", "def"]
+        arr = inp.as_numpy()
+        assert arr.dtype == np.dtype(object)
+
+    def test_fp16_binary(self):
+        x = np.array([[1.5, 2.5]], dtype=np.float16)
+        inp = InferInput("h", [1, 2], "FP16")
+        inp.set_data_from_numpy(x, binary_data=True)
+        np.testing.assert_array_equal(inp.as_numpy(), x)
+
+    def test_bad_dtype(self):
+        inp = InferInput("x", [1], "NOPE", data=[1])
+        with pytest.raises(InvalidInput):
+            inp.as_numpy()
+
+
+class TestInferRequest:
+    def _request(self, binary=False):
+        x = np.arange(4, dtype=np.float32).reshape(2, 2)
+        inp = InferInput("input-0", [2, 2], "FP32")
+        inp.set_data_from_numpy(x, binary_data=binary)
+        return InferRequest(model_name="m", infer_inputs=[inp], request_id="req-1")
+
+    def test_from_dict(self):
+        req = InferRequest.from_dict(
+            {
+                "id": "42",
+                "inputs": [
+                    {"name": "input-0", "shape": [2], "datatype": "INT32", "data": [1, 2]}
+                ],
+                "outputs": [{"name": "output-0", "parameters": {"binary_data": False}}],
+            },
+            model_name="m",
+        )
+        assert req.id == "42"
+        assert req.model_name == "m"
+        np.testing.assert_array_equal(
+            req.inputs[0].as_numpy(), np.array([1, 2], dtype=np.int32)
+        )
+        assert req.request_outputs[0].name == "output-0"
+
+    def test_missing_inputs(self):
+        with pytest.raises(InvalidInput):
+            InferRequest.from_dict({"id": "1"}, model_name="m")
+
+    def test_rest_json_roundtrip(self):
+        req = self._request(binary=False)
+        body, json_length = req.to_rest()
+        assert json_length is None
+        again = InferRequest.from_dict(body, model_name="m")
+        np.testing.assert_array_equal(
+            again.inputs[0].as_numpy(), req.inputs[0].as_numpy()
+        )
+
+    def test_rest_binary_roundtrip(self):
+        req = self._request(binary=True)
+        body, json_length = req.to_rest()
+        assert isinstance(body, bytes) and json_length is not None
+        again = InferRequest.from_bytes(body, json_length, "m")
+        np.testing.assert_array_equal(
+            again.inputs[0].as_numpy(), req.inputs[0].as_numpy()
+        )
+
+    def test_grpc_roundtrip_contents(self):
+        x = np.array([[1, 2], [3, 4]], dtype=np.int32)
+        inp = InferInput("input-0", [2, 2], "INT32", data=x.flatten().tolist())
+        req = InferRequest(model_name="m", infer_inputs=[inp], request_id="g1",
+                           parameters={"p": "v"})
+        pb_req = req.to_grpc()
+        again = InferRequest.from_grpc(pb_req)
+        assert again.model_name == "m"
+        assert again.parameters["p"] == "v"
+        np.testing.assert_array_equal(again.inputs[0].as_numpy(), x)
+
+    def test_grpc_roundtrip_raw(self):
+        req = self._request(binary=True)
+        pb_req = req.to_grpc()
+        assert len(pb_req.raw_input_contents) == 1
+        again = InferRequest.from_grpc(pb_req)
+        np.testing.assert_array_equal(
+            again.inputs[0].as_numpy(), req.inputs[0].as_numpy()
+        )
+
+    def test_grpc_bytes_tensor(self):
+        inp = InferInput("s", [2], "BYTES", data=["ab", "cd"])
+        req = InferRequest(model_name="m", infer_inputs=[inp])
+        again = InferRequest.from_grpc(req.to_grpc())
+        assert [b.decode() for b in again.inputs[0].as_numpy()] == ["ab", "cd"]
+
+
+class TestInferResponse:
+    def _response(self, binary=False):
+        y = np.array([0.1, 0.9], dtype=np.float32)
+        out = InferOutput("output-0", [2], "FP32")
+        out.set_data_from_numpy(y, binary_data=binary)
+        return InferResponse(response_id="r1", model_name="m", infer_outputs=[out])
+
+    def test_rest_json(self):
+        res = self._response()
+        body, json_length = res.to_rest()
+        assert json_length is None
+        assert body["model_name"] == "m"
+        assert body["outputs"][0]["data"] == pytest.approx([0.1, 0.9])
+
+    def test_rest_binary(self):
+        res = self._response(binary=True)
+        body, json_length = res.to_rest()
+        assert isinstance(body, bytes)
+        again = InferResponse.from_bytes(body, json_length)
+        np.testing.assert_allclose(
+            again.outputs[0].as_numpy(), [0.1, 0.9], rtol=1e-6
+        )
+
+    def test_rest_binary_suppressed_by_requested_output(self):
+        res = self._response(binary=True)
+        ro = [RequestedOutput("output-0", parameters={"binary_data": False})]
+        body, json_length = res.to_rest(ro)
+        assert json_length is None
+        assert body["outputs"][0]["data"] == pytest.approx([0.1, 0.9])
+
+    def test_rest_binary_forced_by_requested_output(self):
+        res = self._response(binary=False)
+        ro = [RequestedOutput("output-0", parameters={"binary_data": True})]
+        body, json_length = res.to_rest(ro)
+        assert isinstance(body, bytes) and json_length is not None
+
+    def test_grpc_roundtrip(self):
+        res = self._response(binary=True)
+        pb_res = res.to_grpc()
+        again = InferResponse.from_grpc(pb_res)
+        np.testing.assert_allclose(again.outputs[0].as_numpy(), [0.1, 0.9], rtol=1e-6)
+        assert again.model_name == "m"
