@@ -1,0 +1,85 @@
+"""XGBoost / LightGBM runtimes: parse the model artifact, serve via XLA.
+
+Parity: reference python/xgbserver/xgbserver/model.py and
+python/lgbserver/lgbserver/model.py; here prediction is a jitted forest
+program (tensorize/{xgb_parse,lgb_parse}) so no GBDT framework is needed at
+serving time.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Union
+
+import jax
+import numpy as np
+
+from ..errors import InferenceError, InvalidInput
+from ..infer_type import InferRequest, InferResponse
+from ..model import Model
+from ..utils.inference import get_predict_input, get_predict_response, validate_feature_count
+from .artifact import find_model_file
+from .tensorize.lgb_parse import parse_lightgbm_text
+from .tensorize.trees import Link, forest_predict_fn
+from .tensorize.xgb_parse import parse_xgboost_json
+
+
+class _ForestModel(Model):
+    EXTENSIONS: tuple = ()
+
+    def __init__(self, name: str, model_dir: str, predict_proba: bool = False):
+        super().__init__(name)
+        self.model_dir = model_dir
+        self.predict_proba_mode = predict_proba
+        self._forest = None
+        self._proba_fn = None
+        self._raw_fn = None
+        self.ready = False
+
+    def _parse(self, path: str):
+        raise NotImplementedError
+
+    def load(self) -> bool:
+        self._forest = self._parse(find_model_file(self.model_dir, self.EXTENSIONS))
+        proba_fn, raw_fn = forest_predict_fn(self._forest)
+        self._proba_fn = jax.jit(proba_fn)
+        self._raw_fn = jax.jit(raw_fn)
+        probe = np.zeros((1, max(self._forest.n_features, 1)), dtype=np.float32)
+        self._proba_fn(probe)
+        self.ready = True
+        return self.ready
+
+    def predict(
+        self, payload: Union[Dict, InferRequest], headers=None, response_headers=None
+    ) -> Union[Dict, InferResponse]:
+        instances = get_predict_input(payload)
+        validate_feature_count(np.asarray(instances), self._forest.n_features, self.name)
+        try:
+            probs = np.asarray(self._proba_fn(instances))
+            # Booster.predict parity (reference xgbserver/lgbserver return the
+            # booster's transformed output, not argmax classes): sigmoid ->
+            # P(class 1), softmax -> full probability rows, identity -> raw.
+            if self._forest.link == Link.IDENTITY:
+                result = probs[..., 0] if probs.shape[-1] == 1 else probs
+            elif self._forest.link == Link.SIGMOID and not self.predict_proba_mode:
+                result = probs[..., 1]
+            else:
+                result = probs
+            return get_predict_response(payload, result, self.name)
+        except InvalidInput:
+            raise
+        except Exception as e:
+            raise InferenceError(str(e))
+
+
+class XGBoostModel(_ForestModel):
+    EXTENSIONS = (".json",)
+
+    def _parse(self, path: str):
+        return parse_xgboost_json(path)
+
+
+class LightGBMModel(_ForestModel):
+    EXTENSIONS = (".txt", ".bst", ".model")
+
+    def _parse(self, path: str):
+        return parse_lightgbm_text(path)

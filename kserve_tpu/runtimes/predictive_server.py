@@ -1,0 +1,55 @@
+"""Single predictive-runtime image: --framework {sklearn,xgboost,lightgbm}.
+
+Parity: reference python/predictiveserver/predictiveserver/model.py:42-88
+(one image wrapping the three tabular runtimes) and its __main__.
+
+Usage:
+    python -m kserve_tpu.runtimes.predictive_server \
+        --model_name=iris --model_dir=/mnt/models --framework=sklearn
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from ..model_server import ModelServer, build_arg_parser
+from .gbdt_server import LightGBMModel, XGBoostModel
+from .sklearn_server import SKLearnModel
+
+FRAMEWORKS = {
+    "sklearn": SKLearnModel,
+    "xgboost": XGBoostModel,
+    "lightgbm": LightGBMModel,
+}
+
+
+def build_model(framework: str, name: str, model_dir: str, predict_proba: bool = False):
+    try:
+        cls = FRAMEWORKS[framework]
+    except KeyError:
+        raise ValueError(
+            f"unknown framework {framework!r}; expected one of {sorted(FRAMEWORKS)}"
+        )
+    return cls(name, model_dir, predict_proba=predict_proba)
+
+
+def main(argv=None):
+    parent = build_arg_parser()
+    parser = argparse.ArgumentParser(parents=[parent], conflict_handler="resolve")
+    parser.add_argument("--framework", required=True, choices=sorted(FRAMEWORKS))
+    parser.add_argument(
+        "--predict_proba", default=False, type=lambda x: str(x).lower() == "true"
+    )
+    args = parser.parse_args(argv)
+    model = build_model(args.framework, args.model_name, args.model_dir, args.predict_proba)
+    model.load()
+    ModelServer(
+        http_port=args.http_port,
+        grpc_port=args.grpc_port,
+        workers=args.workers,
+        enable_grpc=args.enable_grpc,
+    ).start([model])
+
+
+if __name__ == "__main__":
+    main()

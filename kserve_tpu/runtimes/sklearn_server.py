@@ -1,0 +1,77 @@
+"""sklearn runtime: joblib/pickle artifacts, XLA-compiled predict.
+
+Parity: reference python/sklearnserver/sklearnserver/model.py:31-69 (load
+search order, predict/predict_proba selection via `mixedtype` content);
+execution is `jax.jit` via tensorize/sklearn_convert with native-sklearn
+fallback for unsupported estimators.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Union
+
+import numpy as np
+
+from ..errors import InferenceError, InvalidInput
+from ..infer_type import InferRequest, InferResponse
+from ..logging import logger
+from ..model import Model
+from ..utils.inference import get_predict_input, get_predict_response, validate_feature_count
+from .artifact import find_model_file
+from .tensorize.sklearn_convert import Tensorized, UnsupportedEstimator, convert_estimator, map_classes
+
+MODEL_EXTENSIONS = (".joblib", ".pkl", ".pickle")
+
+
+class SKLearnModel(Model):
+    def __init__(self, name: str, model_dir: str, predict_proba: bool = False):
+        super().__init__(name)
+        self.model_dir = model_dir
+        self.predict_proba_mode = predict_proba
+        self._estimator = None
+        self._tensorized: Tensorized | None = None
+        self.ready = False
+
+    def load(self) -> bool:
+        import joblib
+
+        self._estimator = joblib.load(find_model_file(self.model_dir, MODEL_EXTENSIONS))
+        try:
+            self._tensorized = convert_estimator(self._estimator)
+            # warm the XLA cache with a single-row probe
+            n_features = getattr(self._estimator, "n_features_in_", None)
+            if n_features:
+                probe = np.zeros((1, n_features), dtype=np.float32)
+                self._tensorized.predict(probe)
+        except UnsupportedEstimator as e:
+            logger.warning(
+                "Estimator %s has no XLA converter; serving native sklearn on host", e
+            )
+            self._tensorized = None
+        self.ready = True
+        return self.ready
+
+    def predict(
+        self, payload: Union[Dict, InferRequest], headers=None, response_headers=None
+    ) -> Union[Dict, InferResponse]:
+        instances = get_predict_input(payload)
+        validate_feature_count(
+            np.asarray(instances), getattr(self._estimator, "n_features_in_", 0), self.name
+        )
+        try:
+            if self._tensorized is not None:
+                if self.predict_proba_mode and self._tensorized.predict_proba is not None:
+                    result = np.asarray(self._tensorized.predict_proba(instances))
+                else:
+                    result = np.asarray(self._tensorized.predict(instances))
+                    result = map_classes(result, self._tensorized.classes)
+            else:
+                if self.predict_proba_mode and hasattr(self._estimator, "predict_proba"):
+                    result = self._estimator.predict_proba(instances)
+                else:
+                    result = self._estimator.predict(instances)
+            return get_predict_response(payload, result, self.name)
+        except InvalidInput:
+            raise
+        except Exception as e:
+            raise InferenceError(str(e))

@@ -1,0 +1,452 @@
+"""sklearn estimator -> jittable JAX inference functions.
+
+The reference sklearnserver calls estimator.predict on CPU
+(`python/sklearnserver/sklearnserver/model.py:31-69`); here the fitted
+estimator is compiled to XLA at load time: trees become dense gather
+programs (see trees.py), kernels/linear models become matmuls on the MXU.
+Anything unsupported falls back to native sklearn predict on host.
+
+Supported: Pipeline, StandardScaler/MinMaxScaler/MaxAbsScaler/Normalizer,
+DecisionTree*, RandomForest*, ExtraTrees*, GradientBoosting*, linear models
+(LinearRegression/Ridge/Lasso/ElasticNet/LogisticRegression/SGD*), SVC/SVR
+(libsvm ovo decision), MLPClassifier/MLPRegressor, KMeans.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .trees import Aggregation, Link, build_forest, forest_apply, apply_link
+
+
+def _jit(fn):
+    """jit with full-f32 matmuls: TPU default matmul precision is bf16, which
+    flips decision boundaries on tabular models; these matmuls are tiny so
+    HIGHEST costs nothing."""
+
+    def wrapped(*args):
+        with jax.default_matmul_precision("highest"):
+            return fn(*args)
+
+    return jax.jit(wrapped)
+
+
+@dataclass
+class Tensorized:
+    """Compiled inference functions for one fitted estimator."""
+
+    predict: Callable  # X -> labels / regression values
+    predict_proba: Optional[Callable] = None
+    decision_function: Optional[Callable] = None
+    classes: Optional[np.ndarray] = None
+
+
+class UnsupportedEstimator(Exception):
+    pass
+
+
+# ---------------- transforms ----------------
+
+
+def _convert_transform(tr) -> Callable:
+    name = type(tr).__name__
+    if name == "StandardScaler":
+        mean = jnp.asarray(tr.mean_) if tr.with_mean else 0.0
+        scale = jnp.asarray(tr.scale_) if tr.with_std else 1.0
+        return lambda X: (X - mean) / scale
+    if name == "MinMaxScaler":
+        scale = jnp.asarray(tr.scale_)
+        min_ = jnp.asarray(tr.min_)
+        return lambda X: X * scale + min_
+    if name == "MaxAbsScaler":
+        scale = jnp.asarray(tr.scale_)
+        return lambda X: X / scale
+    if name == "Normalizer":
+        if tr.norm == "l2":
+            return lambda X: X / jnp.clip(jnp.linalg.norm(X, axis=1, keepdims=True), 1e-12)
+        if tr.norm == "l1":
+            return lambda X: X / jnp.clip(jnp.abs(X).sum(axis=1, keepdims=True), 1e-12)
+        return lambda X: X / jnp.clip(jnp.max(jnp.abs(X), axis=1, keepdims=True), 1e-12)
+    if name == "PolynomialFeatures":
+        raise UnsupportedEstimator(name)  # combinatorial; fall back whole-pipeline
+    raise UnsupportedEstimator(name)
+
+
+# ---------------- trees ----------------
+
+
+def _sklearn_tree_arrays(tree, is_classifier: bool, normalize_leaves: bool):
+    t = tree.tree_
+    feature = np.where(t.children_left < 0, -1, t.feature).astype(np.int32)
+    value = t.value.astype(np.float32)  # [n_nodes, n_outputs, n_classes|1]
+    if value.shape[1] != 1:
+        raise UnsupportedEstimator(
+            f"multi-output tree ({value.shape[1]} outputs); native fallback"
+        )
+    value = value[:, 0, :]
+    if is_classifier and normalize_leaves:
+        denom = np.clip(value.sum(axis=1, keepdims=True), 1e-12, None)
+        value = value / denom
+    from .trees import threshold_to_f32
+
+    return (
+        feature,
+        threshold_to_f32(t.threshold),
+        t.children_left.astype(np.int32),
+        t.children_right.astype(np.int32),
+        value,
+    )
+
+
+def _convert_decision_tree(est) -> Tensorized:
+    is_clf = hasattr(est, "classes_")
+    arrays = _sklearn_tree_arrays(est, is_clf, normalize_leaves=True)
+    forest = build_forest(
+        [arrays],
+        max_depth=est.get_depth(),
+        n_features=est.n_features_in_,
+        n_outputs=arrays[4].shape[1],
+        aggregation=Aggregation.SUM,
+        link=Link.IDENTITY,
+    )
+    apply = forest_apply(forest)
+    if is_clf:
+        classes = est.classes_
+        proba = _jit(lambda X: apply(X))
+        predict = _jit(lambda X: jnp.argmax(apply(X), axis=-1))
+        return Tensorized(predict=predict, predict_proba=proba, classes=classes)
+    predict = _jit(lambda X: apply(X)[..., 0])
+    return Tensorized(predict=predict)
+
+
+def _convert_forest(est) -> Tensorized:
+    is_clf = hasattr(est, "classes_")
+    trees = [
+        _sklearn_tree_arrays(t, is_clf, normalize_leaves=True) for t in est.estimators_
+    ]
+    max_depth = max(t.get_depth() for t in est.estimators_)
+    forest = build_forest(
+        trees,
+        max_depth=max_depth,
+        n_features=est.n_features_in_,
+        n_outputs=trees[0][4].shape[1],
+        aggregation=Aggregation.MEAN,
+        link=Link.IDENTITY,
+    )
+    apply = forest_apply(forest)
+    if is_clf:
+        proba = _jit(lambda X: apply(X))
+        predict = _jit(lambda X: jnp.argmax(apply(X), axis=-1))
+        return Tensorized(predict=predict, predict_proba=proba, classes=est.classes_)
+    return Tensorized(predict=_jit(lambda X: apply(X)[..., 0]))
+
+
+def _convert_gradient_boosting(est) -> Tensorized:
+    is_clf = hasattr(est, "classes_")
+    lr = est.learning_rate
+    stages = est.estimators_  # [n_stages, K] of DecisionTreeRegressor
+    n_stages, K = stages.shape
+    trees = []
+    class_of_tree = []
+    for s in range(n_stages):
+        for k in range(K):
+            f, t, l, r, v = _sklearn_tree_arrays(stages[s, k], False, False)
+            trees.append((f, t, l, r, v * lr))
+            class_of_tree.append(k)
+    max_depth = max(t.get_depth() for row in stages for t in row)
+    # constant init contribution (DummyEstimator): probe at a zero point
+    zero = np.zeros((1, est.n_features_in_), dtype=np.float64)
+    try:
+        base = est._raw_predict_init(zero)[0].astype(np.float32)
+    except Exception:
+        base = np.zeros((K,), dtype=np.float32)
+    n_out = K if not is_clf or len(est.classes_) > 2 else 1
+    forest = build_forest(
+        trees,
+        max_depth=max_depth,
+        n_features=est.n_features_in_,
+        n_outputs=max(K, 1),
+        aggregation=Aggregation.SUM,
+        link=Link.IDENTITY,
+        class_of_tree=np.asarray(class_of_tree, dtype=np.int32),
+    )
+    apply = forest_apply(forest)
+    base_j = jnp.asarray(base)
+
+    def raw(X):
+        return apply(X) + base_j
+
+    if is_clf:
+        classes = est.classes_
+        if len(classes) == 2:
+            proba = _jit(lambda X: apply_link(raw(X), Link.SIGMOID))
+        else:
+            proba = _jit(lambda X: apply_link(raw(X), Link.SOFTMAX))
+        predict = _jit(lambda X: jnp.argmax(proba(X), axis=-1))
+        return Tensorized(
+            predict=predict, predict_proba=proba, decision_function=_jit(raw), classes=classes
+        )
+    return Tensorized(predict=_jit(lambda X: raw(X)[..., 0]))
+
+
+# ---------------- linear ----------------
+
+
+def _convert_linear(est) -> Tensorized:
+    coef = np.atleast_2d(est.coef_).astype(np.float32)
+    intercept = np.atleast_1d(est.intercept_).astype(np.float32)
+    W = jnp.asarray(coef.T)
+    b = jnp.asarray(intercept)
+    is_clf = hasattr(est, "classes_")
+    if not is_clf:
+        if coef.shape[0] == 1:
+            return Tensorized(predict=_jit(lambda X: X.astype(jnp.float32) @ W[:, 0] + b[0]))
+        return Tensorized(predict=_jit(lambda X: X.astype(jnp.float32) @ W + b))
+    classes = est.classes_
+    loss = getattr(est, "loss", None)
+    probabilistic = type(est).__name__ == "LogisticRegression" or loss in ("log_loss", "log")
+
+    def decision(X):
+        return X.astype(jnp.float32) @ W + b
+
+    if probabilistic:
+        if len(classes) == 2:
+            proba = _jit(
+                lambda X: apply_link(decision(X), Link.SIGMOID)
+            )
+        else:
+            proba = _jit(lambda X: jax.nn.softmax(decision(X), axis=-1))
+        predict = _jit(lambda X: jnp.argmax(proba(X), axis=-1))
+        return Tensorized(
+            predict=predict, predict_proba=proba, decision_function=_jit(decision), classes=classes
+        )
+    if len(classes) == 2:
+        predict = _jit(lambda X: (decision(X)[..., 0] > 0).astype(jnp.int32))
+    else:
+        predict = _jit(lambda X: jnp.argmax(decision(X), axis=-1))
+    return Tensorized(predict=predict, decision_function=_jit(decision), classes=classes)
+
+
+# ---------------- SVM (libsvm ovo) ----------------
+
+
+def _svm_kernel_fn(est):
+    kernel = est.kernel
+    gamma = est._gamma if hasattr(est, "_gamma") else est.gamma
+    coef0 = est.coef0
+    degree = est.degree
+    sv = jnp.asarray(est.support_vectors_.astype(np.float32))
+
+    def k(X):
+        X = X.astype(jnp.float32)
+        if kernel == "linear":
+            return X @ sv.T
+        if kernel == "rbf":
+            d2 = (
+                jnp.sum(X * X, axis=1, keepdims=True)
+                - 2.0 * X @ sv.T
+                + jnp.sum(sv * sv, axis=1)[None, :]
+            )
+            return jnp.exp(-gamma * d2)
+        if kernel == "poly":
+            return (gamma * (X @ sv.T) + coef0) ** degree
+        if kernel == "sigmoid":
+            return jnp.tanh(gamma * (X @ sv.T) + coef0)
+        raise UnsupportedEstimator(f"SVC kernel {kernel}")
+
+    return k
+
+
+def _convert_svc(est) -> Tensorized:
+    classes = est.classes_
+    n_classes = len(classes)
+    n_support = est.n_support_
+    starts = np.concatenate([[0], np.cumsum(n_support)])
+    dual = est.dual_coef_.astype(np.float32)  # [n_classes-1, n_sv]
+    intercept = est.intercept_.astype(np.float32)
+    n_sv = est.support_vectors_.shape[0]
+    pairs = [(i, j) for i in range(n_classes) for j in range(i + 1, n_classes)]
+    C = np.zeros((len(pairs), n_sv), dtype=np.float32)
+    for p, (i, j) in enumerate(pairs):
+        # libsvm: decision(i,j) uses class-i SVs with dual row (j-1) and
+        # class-j SVs with dual row i
+        si, ei = starts[i], starts[i + 1]
+        sj, ej = starts[j], starts[j + 1]
+        C[p, si:ei] = dual[j - 1, si:ei]
+        C[p, sj:ej] = dual[i, sj:ej]
+    Cj = jnp.asarray(C)
+    bj = jnp.asarray(intercept)
+    kernel = _svm_kernel_fn(est)
+    pos = np.zeros((len(pairs), n_classes), dtype=np.float32)
+    neg = np.zeros((len(pairs), n_classes), dtype=np.float32)
+    for p, (i, j) in enumerate(pairs):
+        pos[p, i] = 1.0
+        neg[p, j] = 1.0
+    posj, negj = jnp.asarray(pos), jnp.asarray(neg)
+
+    def decision(X):
+        K = kernel(X)  # [B, n_sv]
+        return K @ Cj.T + bj  # [B, n_pairs]
+
+    def predict_idx(X):
+        dec = decision(X)
+        win = (dec > 0).astype(jnp.float32)
+        votes = win @ posj + (1.0 - win) @ negj
+        # libsvm tie-break: lowest class index wins -> add tiny descending bias
+        bias = -jnp.arange(n_classes, dtype=jnp.float32) * 1e-6
+        return jnp.argmax(votes + bias, axis=-1)
+
+    if n_classes == 2:
+        # the public dual_coef_/intercept_ already carry sklearn's binary sign
+        # convention: decision>0 -> classes_[1]
+        def predict_bin(X):
+            return (decision(X)[..., 0] > 0).astype(jnp.int32)
+
+        return Tensorized(
+            predict=_jit(predict_bin),
+            decision_function=_jit(lambda X: decision(X)[..., 0]),
+            classes=classes,
+        )
+    return Tensorized(
+        predict=_jit(predict_idx), decision_function=_jit(decision), classes=classes
+    )
+
+
+def _convert_svr(est) -> Tensorized:
+    dual = jnp.asarray(est.dual_coef_[0].astype(np.float32))
+    b = float(est.intercept_[0])
+    kernel = _svm_kernel_fn(est)
+    return Tensorized(predict=_jit(lambda X: kernel(X) @ dual + b))
+
+
+# ---------------- MLP ----------------
+
+_MLP_ACT = {
+    "relu": jax.nn.relu,
+    "tanh": jnp.tanh,
+    "logistic": jax.nn.sigmoid,
+    "identity": lambda x: x,
+}
+
+
+def _convert_mlp(est) -> Tensorized:
+    Ws = [jnp.asarray(w.astype(np.float32)) for w in est.coefs_]
+    bs = [jnp.asarray(b.astype(np.float32)) for b in est.intercepts_]
+    act = _MLP_ACT[est.activation]
+    out_act = est.out_activation_
+    is_clf = hasattr(est, "classes_")
+
+    def forward(X):
+        h = X.astype(jnp.float32)
+        for W, b in zip(Ws[:-1], bs[:-1]):
+            h = act(h @ W + b)
+        out = h @ Ws[-1] + bs[-1]
+        if out_act == "softmax":
+            return jax.nn.softmax(out, axis=-1)
+        if out_act == "logistic":
+            return jax.nn.sigmoid(out)
+        return out
+
+    if is_clf:
+        classes = est.classes_
+        if len(classes) == 2:
+            proba = _jit(lambda X: jnp.concatenate([1 - forward(X), forward(X)], axis=-1))
+        else:
+            proba = _jit(forward)
+        predict = _jit(lambda X: jnp.argmax(proba(X), axis=-1))
+        return Tensorized(predict=predict, predict_proba=proba, classes=classes)
+    n_out = est.coefs_[-1].shape[1]
+    if n_out == 1:
+        return Tensorized(predict=_jit(lambda X: forward(X)[..., 0]))
+    return Tensorized(predict=_jit(forward))
+
+
+def _convert_kmeans(est) -> Tensorized:
+    centers = jnp.asarray(est.cluster_centers_.astype(np.float32))
+
+    def predict(X):
+        X = X.astype(jnp.float32)
+        d2 = (
+            jnp.sum(X * X, axis=1, keepdims=True)
+            - 2.0 * X @ centers.T
+            + jnp.sum(centers * centers, axis=1)[None, :]
+        )
+        return jnp.argmin(d2, axis=-1)
+
+    return Tensorized(predict=_jit(predict))
+
+
+# ---------------- dispatch ----------------
+
+_CONVERTERS = {
+    "DecisionTreeClassifier": _convert_decision_tree,
+    "DecisionTreeRegressor": _convert_decision_tree,
+    "ExtraTreeClassifier": _convert_decision_tree,
+    "ExtraTreeRegressor": _convert_decision_tree,
+    "RandomForestClassifier": _convert_forest,
+    "RandomForestRegressor": _convert_forest,
+    "ExtraTreesClassifier": _convert_forest,
+    "ExtraTreesRegressor": _convert_forest,
+    "GradientBoostingClassifier": _convert_gradient_boosting,
+    "GradientBoostingRegressor": _convert_gradient_boosting,
+    "LinearRegression": _convert_linear,
+    "Ridge": _convert_linear,
+    "Lasso": _convert_linear,
+    "ElasticNet": _convert_linear,
+    "LogisticRegression": _convert_linear,
+    "SGDClassifier": _convert_linear,
+    "SGDRegressor": _convert_linear,
+    "LinearSVC": _convert_linear,
+    "LinearSVR": _convert_linear,
+    "SVC": _convert_svc,
+    "NuSVC": _convert_svc,
+    "SVR": _convert_svr,
+    "NuSVR": _convert_svr,
+    "MLPClassifier": _convert_mlp,
+    "MLPRegressor": _convert_mlp,
+    "KMeans": _convert_kmeans,
+}
+
+
+def convert_estimator(est) -> Tensorized:
+    """Fitted sklearn estimator (or Pipeline) -> Tensorized JAX functions.
+    Raises UnsupportedEstimator when no converter exists."""
+    name = type(est).__name__
+    if name == "Pipeline":
+        transforms = [_convert_transform(tr) for _, tr in est.steps[:-1]]
+        final = convert_estimator(est.steps[-1][1])
+
+        def chain(fn):
+            if fn is None:
+                return None
+
+            def wrapped(X):
+                h = X.astype(jnp.float32)
+                for t in transforms:
+                    h = t(h)
+                return fn(h)
+
+            return jax.jit(wrapped)
+
+        return Tensorized(
+            predict=chain(final.predict),
+            predict_proba=chain(final.predict_proba),
+            decision_function=chain(final.decision_function),
+            classes=final.classes,
+        )
+    conv = _CONVERTERS.get(name)
+    if conv is None:
+        raise UnsupportedEstimator(name)
+    return conv(est)
+
+
+def map_classes(indices: np.ndarray, classes: Optional[np.ndarray]):
+    """Map argmax indices back to original class labels on host."""
+    if classes is None:
+        return indices
+    return np.asarray(classes)[np.asarray(indices)]

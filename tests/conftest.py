@@ -4,7 +4,10 @@ tests run without TPU hardware (the driver separately dry-runs multichip)."""
 import os
 import sys
 
+# NOTE: in this image the axon TPU plugin ignores JAX_PLATFORMS; the legacy
+# JAX_PLATFORM_NAME (or jax.config.update) is what actually forces CPU.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
 if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"

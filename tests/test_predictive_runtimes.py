@@ -1,0 +1,269 @@
+"""Predictive runtime tests: sklearn tensorization parity, XGBoost JSON and
+LightGBM text parsing against hand-computed references, and end-to-end
+serving through the DataPlane."""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from kserve_tpu import InferInput, InferRequest, InferResponse
+from kserve_tpu.runtimes.gbdt_server import LightGBMModel, XGBoostModel
+from kserve_tpu.runtimes.sklearn_server import SKLearnModel
+from kserve_tpu.runtimes.tensorize.sklearn_convert import (
+    convert_estimator,
+    map_classes,
+)
+
+
+@pytest.fixture(scope="module")
+def iris():
+    from sklearn.datasets import load_iris
+
+    return load_iris(return_X_y=True)
+
+
+class TestSklearnTensorize:
+    def test_svc_iris(self, iris):
+        from sklearn.svm import SVC
+
+        X, y = iris
+        est = SVC().fit(X, y)
+        t = convert_estimator(est)
+        got = map_classes(t.predict(X), t.classes)
+        assert (got == est.predict(X)).mean() == 1.0
+
+    def test_random_forest_proba(self, iris):
+        from sklearn.ensemble import RandomForestClassifier
+
+        X, y = iris
+        est = RandomForestClassifier(n_estimators=20, random_state=0).fit(X, y)
+        t = convert_estimator(est)
+        np.testing.assert_allclose(
+            np.asarray(t.predict_proba(X)), est.predict_proba(X), atol=1e-6
+        )
+
+    def test_gradient_boosting_multiclass(self, iris):
+        from sklearn.ensemble import GradientBoostingClassifier
+
+        X, y = iris
+        est = GradientBoostingClassifier(n_estimators=10, random_state=0).fit(X, y)
+        t = convert_estimator(est)
+        np.testing.assert_allclose(
+            np.asarray(t.predict_proba(X)), est.predict_proba(X), atol=1e-5
+        )
+
+    def test_logistic_regression(self, iris):
+        from sklearn.linear_model import LogisticRegression
+
+        X, y = iris
+        est = LogisticRegression(max_iter=500).fit(X, y)
+        t = convert_estimator(est)
+        np.testing.assert_allclose(
+            np.asarray(t.predict_proba(X)), est.predict_proba(X), atol=1e-5
+        )
+
+    def test_pipeline_scaler_svc(self, iris):
+        from sklearn.pipeline import make_pipeline
+        from sklearn.preprocessing import StandardScaler
+        from sklearn.svm import SVC
+
+        X, y = iris
+        est = make_pipeline(StandardScaler(), SVC()).fit(X, y)
+        t = convert_estimator(est)
+        got = map_classes(t.predict(X), t.classes)
+        assert (got == est.predict(X)).mean() == 1.0
+
+    def test_binary_svc_decision_sign(self):
+        from sklearn.datasets import make_classification
+        from sklearn.svm import SVC
+
+        X, y = make_classification(n_samples=100, n_features=5, random_state=1)
+        est = SVC().fit(X, y)
+        t = convert_estimator(est)
+        np.testing.assert_allclose(
+            np.asarray(t.decision_function(X)), est.decision_function(X), atol=1e-4
+        )
+        got = map_classes(t.predict(X), t.classes)
+        assert (got == est.predict(X)).mean() == 1.0
+
+    def test_multi_output_tree_falls_back(self):
+        from sklearn.ensemble import RandomForestRegressor
+        from kserve_tpu.runtimes.tensorize.sklearn_convert import UnsupportedEstimator
+
+        X = np.random.RandomState(0).rand(50, 4)
+        Y = np.random.RandomState(1).rand(50, 3)
+        est = RandomForestRegressor(n_estimators=3, random_state=0).fit(X, Y)
+        with pytest.raises(UnsupportedEstimator):
+            convert_estimator(est)
+
+    def test_regression(self):
+        from sklearn.datasets import make_regression
+        from sklearn.ensemble import RandomForestRegressor
+
+        X, y = make_regression(n_samples=100, n_features=5, random_state=0)
+        est = RandomForestRegressor(n_estimators=10, random_state=0).fit(X, y)
+        t = convert_estimator(est)
+        np.testing.assert_allclose(np.asarray(t.predict(X)), est.predict(X), rtol=1e-4, atol=1e-3)
+
+
+class TestSKLearnModelServing:
+    @pytest.fixture()
+    def model_dir(self, tmp_path, iris):
+        import joblib
+        from sklearn.svm import SVC
+
+        X, y = iris
+        joblib.dump(SVC().fit(X, y), tmp_path / "model.joblib")
+        return str(tmp_path)
+
+    def test_v1_predict(self, model_dir, iris, run_async):
+        X, y = iris
+        model = SKLearnModel("iris", model_dir)
+        assert model.load()
+        res = run_async(model({"instances": X[:4].tolist()}))
+        assert res["predictions"] == [0, 0, 0, 0]
+
+    def test_v2_predict(self, model_dir, iris, run_async):
+        X, y = iris
+        model = SKLearnModel("iris", model_dir)
+        model.load()
+        inp = InferInput("input-0", [4, 4], "FP64")
+        inp.set_data_from_numpy(X[:4], binary_data=False)
+        req = InferRequest(model_name="iris", infer_inputs=[inp])
+        res = run_async(model(req))
+        assert isinstance(res, InferResponse)
+        np.testing.assert_array_equal(res.outputs[0].as_numpy(), [0, 0, 0, 0])
+
+
+XGB_BINARY = {
+    "learner": {
+        "learner_model_param": {
+            "base_score": "5E-1",
+            "num_class": "0",
+            "num_feature": "2",
+        },
+        "objective": {"name": "binary:logistic"},
+        "gradient_booster": {
+            "name": "gbtree",
+            "model": {
+                "tree_info": [0, 0],
+                "trees": [
+                    {
+                        "left_children": [1, -1, -1],
+                        "right_children": [2, -1, -1],
+                        "split_indices": [0, 0, 0],
+                        "split_conditions": [0.5, 0.2, -0.1],
+                    },
+                    {
+                        "left_children": [1, -1, -1],
+                        "right_children": [2, -1, -1],
+                        "split_indices": [1, 0, 0],
+                        "split_conditions": [1.0, 0.3, -0.3],
+                    },
+                ],
+            },
+        },
+    }
+}
+
+
+class TestXGBoostParse:
+    def test_binary_logistic(self, tmp_path):
+        path = tmp_path / "model.json"
+        path.write_text(json.dumps(XGB_BINARY))
+        model = XGBoostModel("xgb", str(path), predict_proba=True)
+        model.load()
+        X = np.array([[0.0, 0.0], [1.0, 2.0], [0.5, 0.5]], dtype=np.float32)
+        # margins: [0.2+0.3, -0.1-0.3, -0.1+0.3] (x<thr goes left, 0.5 !< 0.5)
+        margins = np.array([0.5, -0.4, 0.2])
+        expected = 1.0 / (1.0 + np.exp(-margins))
+        probs = np.asarray(model._proba_fn(X))
+        np.testing.assert_allclose(probs[:, 1], expected, atol=1e-6)
+
+    def test_serving_returns_booster_probabilities(self, tmp_path, run_async):
+        path = tmp_path / "model.json"
+        path.write_text(json.dumps(XGB_BINARY))
+        model = XGBoostModel("xgb", str(path))
+        model.load()
+        res = run_async(model({"instances": [[0.0, 0.0], [1.0, 2.0]]}))
+        # Booster.predict parity: P(class 1), not argmax labels
+        expected = 1.0 / (1.0 + np.exp(-np.array([0.5, -0.4])))
+        np.testing.assert_allclose(res["predictions"], expected, atol=1e-6)
+
+
+LGB_BINARY = """tree
+version=v4
+num_class=1
+num_tree_per_iteration=1
+label_index=0
+max_feature_idx=1
+objective=binary sigmoid:1
+feature_names=f0 f1
+feature_infos=none none
+
+Tree=0
+num_leaves=3
+num_cat=0
+split_feature=0 1
+split_gain=1 1
+threshold=0.5 1.0
+decision_type=2 2
+left_child=1 -2
+right_child=-1 -3
+leaf_value=0.1 0.2 -0.3
+leaf_weight=1 1 1
+leaf_count=1 1 1
+internal_value=0 0
+internal_weight=0 0
+internal_count=2 2
+is_linear=0
+shrinkage=1
+
+Tree=1
+num_leaves=2
+num_cat=0
+split_feature=1
+split_gain=1
+threshold=2.0
+decision_type=2
+left_child=-1
+right_child=-2
+leaf_value=0.05 -0.05
+leaf_weight=1 1
+leaf_count=1 1
+internal_value=0
+internal_weight=0
+internal_count=2
+is_linear=0
+shrinkage=1
+
+end of trees
+
+feature_importances:
+f0=1
+
+parameters:
+[boosting: gbdt]
+end of parameters
+
+pandas_categorical:null
+"""
+
+
+class TestLightGBMParse:
+    def test_binary(self, tmp_path):
+        path = tmp_path / "model.txt"
+        path.write_text(LGB_BINARY)
+        model = LightGBMModel("lgb", str(path), predict_proba=True)
+        model.load()
+        X = np.array(
+            [[0.3, 0.8], [0.7, 0.5], [0.4, 1.5], [0.9, 3.0]], dtype=np.float32
+        )
+        # tree0 (x<=thr left): [leaf1=0.2, leaf0=0.1, leaf2=-0.3, leaf0=0.1]
+        # tree1: f1<=2 -> 0.05 else -0.05
+        margins = np.array([0.25, 0.15, -0.25, 0.05])
+        expected = 1.0 / (1.0 + np.exp(-margins))
+        probs = np.asarray(model._proba_fn(X))
+        np.testing.assert_allclose(probs[:, 1], expected, atol=1e-6)
