@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Headline benchmark: aggregate decode throughput of the JAX generative
+engine on one real TPU chip (Llama-3.2-1B-shaped flagship, bf16, paged KV,
+continuous batching).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tok/s/chip", "vs_baseline": N}
+
+Baseline: the BASELINE.json north star (>1000 tok/s/chip for the
+LLMInferenceService path on v5e); vs_baseline = value / 1000.
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("XLA_PYTHON_CLIENT_MEM_FRACTION", "0.92")
+
+BASELINE_TOK_S_PER_CHIP = 1000.0
+
+
+async def run_bench():
+    import jax
+
+    from kserve_tpu.engine.engine import EngineConfig, LLMEngine
+    from kserve_tpu.engine.sampling import SamplingParams
+    from kserve_tpu.engine.tokenizer import ByteTokenizer
+    from kserve_tpu.models.llama import LlamaConfig
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        model_config = LlamaConfig.llama3_1b()
+        batch = 16
+        prompt_len = 128
+        max_tokens = 128
+        num_pages = 4096
+        n_requests = 48
+    else:  # CPU smoke mode so the script is runnable anywhere
+        model_config = LlamaConfig.tiny(dtype="float32")
+        batch = 4
+        prompt_len = 16
+        max_tokens = 16
+        num_pages = 128
+        n_requests = 8
+
+    engine_config = EngineConfig(
+        max_batch_size=batch,
+        page_size=16,
+        num_pages=num_pages,
+        max_pages_per_seq=64,
+        max_prefill_len=512,
+        prefill_buckets=(128, 256, 512),
+        dtype="bfloat16" if on_tpu else "float32",
+        use_pallas=False,  # XLA paged attention; pallas kernel is opt-in
+    )
+    tokenizer = ByteTokenizer(model_config.vocab_size)
+    engine = LLMEngine(model_config, engine_config, tokenizer, rng_seed=0)
+    await engine.start()
+
+    rng = __import__("random").Random(0)
+
+    def prompt():
+        return [rng.randrange(3, 255) for _ in range(prompt_len)]
+
+    params = SamplingParams(max_tokens=max_tokens, temperature=0.0, ignore_eos=True)
+
+    async def one(p):
+        n = 0
+        async for out in engine.generate(p, params):
+            n = out.num_generated
+        return n
+
+    # warmup: compile prefill + decode
+    await asyncio.gather(*[one(prompt()) for _ in range(2)])
+
+    start = time.perf_counter()
+    counts = await asyncio.gather(*[one(prompt()) for _ in range(n_requests)])
+    elapsed = time.perf_counter() - start
+    await engine.stop()
+
+    total_tokens = sum(counts)
+    tok_s = total_tokens / elapsed
+    return {
+        "metric": "llama3_1b_decode_throughput" if on_tpu else "tiny_decode_throughput_cpu",
+        "value": round(tok_s, 2),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(tok_s / BASELINE_TOK_S_PER_CHIP, 4),
+        "detail": {
+            "requests": n_requests,
+            "batch_slots": batch,
+            "prompt_len": prompt_len,
+            "max_tokens": max_tokens,
+            "elapsed_s": round(elapsed, 2),
+            "backend": jax.default_backend(),
+        },
+    }
+
+
+if __name__ == "__main__":
+    result = asyncio.run(run_bench())
+    print(json.dumps(result))

@@ -1,0 +1,468 @@
+"""Continuous-batching LLM engine on JAX/XLA.
+
+The role vLLM's AsyncLLM plays for the reference huggingfaceserver
+(python/huggingfaceserver/vllm/vllm_model.py:55, start_engine :83), rebuilt
+TPU-first:
+
+- fixed decode slots (static shapes: one compiled decode program, reused
+  forever); prompts prefill into bucketed-length compiled programs
+- paged KV in HBM (engine/kvcache.py), pages allocated incrementally as
+  sequences grow, newest slot preempted back to the queue on exhaustion
+- sampling fully on device (engine/sampling.py), per-slot params as arrays
+- TP via the ("data","model") mesh (parallel/sharding.py) — weights, KV
+  pages and logits sharded; XLA inserts ICI collectives
+- async streaming: each request owns an asyncio queue fed by the decode loop
+
+Host<->device traffic per step is one [B] token fetch + tiny control arrays.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..logging import logger
+from ..metrics import (
+    ENGINE_BATCH_OCCUPANCY,
+    ENGINE_KV_PAGES_FREE,
+    ENGINE_QUEUE_DEPTH,
+    GENERATED_TOKENS,
+    PROMPT_TOKENS,
+)
+from ..models import llama
+from ..parallel import sharding as shd
+from .kvcache import KVCacheConfig, PageAllocator, init_kv_pages, pages_needed
+from .sampling import SamplingParams, SamplingState, sample_tokens
+from .tokenizer import BaseTokenizer, IncrementalDetokenizer
+
+
+@dataclass
+class EngineConfig:
+    max_batch_size: int = 8
+    page_size: int = 16
+    num_pages: int = 2048
+    max_pages_per_seq: int = 128
+    max_prefill_len: int = 1024
+    prefill_buckets: Tuple[int, ...] = (32, 64, 128, 256, 512, 1024)
+    tp: int = 1
+    dp: int = 1
+    dtype: str = "bfloat16"
+    use_pallas: Optional[bool] = None  # None = auto (TPU yes)
+    # decode steps executed on-device per host round-trip (lax.scan inner
+    # loop).  >1 amortizes host<->device latency — essential when the chip
+    # sits behind a network tunnel; streaming granularity becomes K tokens.
+    steps_per_sync: int = 8
+
+    @property
+    def max_model_len(self) -> int:
+        return self.max_pages_per_seq * self.page_size
+
+    def page_bucket(self, n_pages: int) -> int:
+        """Page-table width bucket (pow2) so decode attention only gathers
+        as many pages as the longest active sequence actually owns."""
+        b = 8
+        while b < n_pages:
+            b *= 2
+        return min(b, self.max_pages_per_seq)
+
+
+@dataclass
+class GenerationOutput:
+    token_id: int
+    text_delta: str
+    finished: bool = False
+    finish_reason: Optional[str] = None
+    num_generated: int = 0
+    num_prompt_tokens: int = 0
+    cumulative_text: str = ""
+
+
+class _Slot:
+    """Host-side state for one decode lane."""
+
+    __slots__ = (
+        "request_id", "prompt_len", "pages", "pos", "generated",
+        "params", "queue", "detok", "stop_texts", "admitted_at",
+    )
+
+    def __init__(self):
+        self.request_id: Optional[str] = None
+
+    def reset(self):
+        self.request_id = None
+
+
+class _QueuedRequest:
+    def __init__(self, request_id, prompt_ids, params, queue):
+        self.request_id = request_id
+        self.prompt_ids = prompt_ids
+        self.params = params
+        self.queue = queue
+
+
+class LLMEngine:
+    """Drive with `await engine.start()`, submit with `generate()`."""
+
+    def __init__(
+        self,
+        model_config: llama.LlamaConfig,
+        engine_config: EngineConfig,
+        tokenizer: BaseTokenizer,
+        params: Optional[Any] = None,
+        rng_seed: int = 0,
+    ):
+        self.model_config = model_config
+        self.config = engine_config
+        self.tokenizer = tokenizer
+        shd.validate_tp(model_config, engine_config.tp)
+        self.mesh = shd.create_mesh(tp=engine_config.tp, dp=engine_config.dp)
+        self._base_rng = jax.random.PRNGKey(rng_seed)
+        self._step_counter = 0
+
+        if params is None:
+            params = llama.init_params(model_config, jax.random.PRNGKey(1))
+        self.params = shd.shard_params(params, model_config, self.mesh)
+
+        cache_cfg = KVCacheConfig(
+            n_layers=model_config.n_layers,
+            n_kv_heads=model_config.n_kv_heads,
+            head_dim=model_config.head_dim,
+            page_size=engine_config.page_size,
+            num_pages=engine_config.num_pages,
+            max_pages_per_seq=engine_config.max_pages_per_seq,
+            dtype=engine_config.dtype,
+        )
+        self.cache_config = cache_cfg
+        self.kv_pages = shd.shard_kv_pages(init_kv_pages(cache_cfg), self.mesh)
+        self.allocator = PageAllocator(cache_cfg.num_pages)
+
+        B = engine_config.max_batch_size
+        self._slots: List[_Slot] = [_Slot() for _ in range(B)]
+        self._waiting: List[_QueuedRequest] = []
+        self._wake = asyncio.Event()
+        self._stopped = False
+        self._task: Optional[asyncio.Task] = None
+        self._build_compiled()
+
+    # ---------------- compiled programs ----------------
+
+    def _build_compiled(self):
+        cfg = self.config
+        mc = self.model_config
+        mesh = self.mesh
+        rep = shd.named(mesh, jax.sharding.PartitionSpec())
+        kv_shard = shd.named(mesh, shd.kv_pages_pspec())
+
+        def _prefill(params, tokens, valid_len, kv_pages, page_ids, state, rng):
+            logits, kv_pages = llama.prefill(
+                params, mc, tokens, valid_len, kv_pages, page_ids, cfg.page_size
+            )
+            first = sample_tokens(logits, state, rng)
+            return first, kv_pages
+
+        def _decode_multi(params, tokens, pos, kv_pages, page_table, active, state, rng):
+            """steps_per_sync decode steps on device; emits [steps, B] tokens.
+            Inactive lanes hold their token/pos (writes go to the null page)."""
+            steps = cfg.steps_per_sync
+            act_i = active.astype(pos.dtype)
+
+            def body(carry, step_rng):
+                tokens, pos, kv_pages = carry
+                logits, kv_pages = llama.decode_step(
+                    params, mc, tokens, pos, kv_pages, page_table, active,
+                    cfg.page_size, use_pallas=cfg.use_pallas,
+                )
+                nxt = sample_tokens(logits, state, step_rng)
+                nxt = jnp.where(active, nxt, tokens)
+                return (nxt, pos + act_i, kv_pages), nxt
+
+            rngs = jax.random.split(rng, steps)
+            (tokens, pos, kv_pages), out = jax.lax.scan(
+                body, (tokens, pos, kv_pages), rngs
+            )
+            return out, kv_pages
+
+        n_kv_args = 3  # kv_pages is arg index 3 in both signatures
+        self._prefill_fn = jax.jit(_prefill, donate_argnums=(n_kv_args,))
+        self._decode_fn = jax.jit(_decode_multi, donate_argnums=(n_kv_args,))
+
+    # ---------------- public API ----------------
+
+    async def start(self):
+        if self._task is None:
+            self._task = asyncio.create_task(self._run_loop())
+            logger.info(
+                "LLM engine started: slots=%d pages=%d page_size=%d tp=%d",
+                self.config.max_batch_size, self.config.num_pages,
+                self.config.page_size, self.config.tp,
+            )
+
+    async def stop(self):
+        self._stopped = True
+        self._wake.set()
+        if self._task is not None:
+            try:
+                await asyncio.wait_for(self._task, timeout=5)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                self._task.cancel()
+            self._task = None
+
+    @property
+    def running(self) -> bool:
+        return self._task is not None and not self._task.done()
+
+    async def generate(
+        self,
+        prompt_ids: List[int],
+        params: SamplingParams,
+        request_id: Optional[str] = None,
+    ) -> AsyncIterator[GenerationOutput]:
+        """Submit a request; yields GenerationOutput per emitted token."""
+        if len(prompt_ids) > self.config.max_prefill_len:
+            raise ValueError(
+                f"prompt length {len(prompt_ids)} exceeds max_prefill_len "
+                f"{self.config.max_prefill_len}"
+            )
+        if len(prompt_ids) + params.max_tokens > self.config.max_model_len:
+            raise ValueError(
+                f"prompt+max_tokens exceeds max_model_len {self.config.max_model_len}"
+            )
+        queue: asyncio.Queue = asyncio.Queue()
+        req = _QueuedRequest(
+            request_id or f"req-{time.monotonic_ns()}", list(prompt_ids), params, queue
+        )
+        self._waiting.append(req)
+        ENGINE_QUEUE_DEPTH.labels(model_name="engine").set(len(self._waiting))
+        self._wake.set()
+        while True:
+            out = await queue.get()
+            if isinstance(out, Exception):
+                raise out
+            yield out
+            if out.finished:
+                return
+
+    # ---------------- engine loop ----------------
+
+    async def _run_loop(self):
+        try:
+            while not self._stopped:
+                did_work = False
+                # admission: prefill waiting requests into free slots
+                while self._waiting and self._free_slot_index() is not None:
+                    req = self._waiting[0]
+                    if not self._try_admit(req):
+                        break
+                    self._waiting.pop(0)
+                    did_work = True
+                ENGINE_QUEUE_DEPTH.labels(model_name="engine").set(len(self._waiting))
+                active = [s for s in self._slots if s.request_id is not None]
+                ENGINE_BATCH_OCCUPANCY.labels(model_name="engine").set(len(active))
+                ENGINE_KV_PAGES_FREE.labels(model_name="engine").set(
+                    self.allocator.free_pages
+                )
+                if active:
+                    self._decode_once()
+                    did_work = True
+                if not did_work:
+                    self._wake.clear()
+                    await self._wake.wait()
+                else:
+                    # yield to the event loop so streams flush between steps
+                    await asyncio.sleep(0)
+        except Exception as e:  # noqa: BLE001 — engine death must surface
+            logger.exception("engine loop crashed")
+            for slot in self._slots:
+                if slot.request_id is not None:
+                    slot.queue.put_nowait(e)
+                    slot.reset()
+            for req in self._waiting:
+                req.queue.put_nowait(e)
+            self._waiting.clear()
+
+    def _free_slot_index(self) -> Optional[int]:
+        for i, slot in enumerate(self._slots):
+            if slot.request_id is None:
+                return i
+        return None
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.config.prefill_buckets:
+            if n <= b:
+                return b
+        return self.config.prefill_buckets[-1]
+
+    def _try_admit(self, req: _QueuedRequest) -> bool:
+        """Prefill `req` into a free slot; False when pages are short."""
+        n_prompt = len(req.prompt_ids)
+        n_pages = pages_needed(n_prompt + 1, self.config.page_size)
+        if not self.allocator.can_allocate(n_pages):
+            return False
+        idx = self._free_slot_index()
+        slot = self._slots[idx]
+        pages = self.allocator.allocate(n_pages)
+
+        bucket = self._bucket_for(n_prompt)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n_prompt] = req.prompt_ids
+        page_ids = np.zeros((1, self.config.max_pages_per_seq), np.int32)
+        page_ids[0, : len(pages)] = pages
+
+        state = SamplingState.from_params([req.params])
+        rng = jax.random.fold_in(self._base_rng, self._next_step())
+        if req.params.seed is not None:
+            rng = jax.random.PRNGKey(req.params.seed)
+        first, self.kv_pages = self._prefill_fn(
+            self.params,
+            jnp.asarray(tokens),
+            jnp.asarray([n_prompt], jnp.int32),
+            self.kv_pages,
+            jnp.asarray(page_ids),
+            state,
+            rng,
+        )
+        first_token = int(np.asarray(first)[0])
+        PROMPT_TOKENS.labels(model_name="engine").inc(n_prompt)
+
+        slot.request_id = req.request_id
+        slot.prompt_len = n_prompt
+        slot.pages = pages
+        slot.pos = n_prompt  # position of the token being decoded next
+        slot.generated = [first_token]
+        slot.params = req.params
+        slot.queue = req.queue
+        slot.detok = IncrementalDetokenizer(self.tokenizer)
+        slot.stop_texts = list(req.params.stop or [])
+        slot.admitted_at = time.perf_counter()
+        self._emit(slot, first_token)
+        return True
+
+    def _ensure_pages(self, slot: _Slot, extra: int = 1) -> bool:
+        """Grow the slot's page list to cover positions slot.pos ..
+        slot.pos+extra-1 (the chunk about to be written).  False on
+        allocator exhaustion."""
+        needed = pages_needed(slot.pos + extra, self.config.page_size)
+        if needed > self.config.max_pages_per_seq:
+            return False
+        while len(slot.pages) < needed:
+            if not self.allocator.can_allocate(1):
+                return False
+            slot.pages.extend(self.allocator.allocate(1))
+        return True
+
+    def _decode_once(self):
+        B = self.config.max_batch_size
+        steps = self.config.steps_per_sync
+        tokens = np.zeros((B,), np.int32)
+        pos = np.zeros((B,), np.int32)
+        active = np.zeros((B,), bool)
+        params_list = [SamplingParams() for _ in range(B)]
+        max_owned = 1
+        for i, slot in enumerate(self._slots):
+            if slot.request_id is None:
+                continue
+            # pages must cover every position this chunk can write
+            if not self._ensure_pages(slot, extra=steps):
+                self._finish(slot, "length")
+                continue
+            tokens[i] = slot.generated[-1]
+            pos[i] = slot.pos
+            active[i] = True
+            params_list[i] = slot.params
+            max_owned = max(max_owned, len(slot.pages))
+        if not active.any():
+            return
+        # bucketed page-table width: attention gathers only ~longest-seq pages
+        width = self.config.page_bucket(max_owned)
+        page_table = np.zeros((B, width), np.int32)
+        for i, slot in enumerate(self._slots):
+            if slot.request_id is not None and active[i]:
+                page_table[i, : len(slot.pages)] = slot.pages
+        state = SamplingState.from_params(params_list)
+        rng = jax.random.fold_in(self._base_rng, self._next_step())
+        chunk, self.kv_pages = self._decode_fn(
+            self.params,
+            jnp.asarray(tokens),
+            jnp.asarray(pos),
+            self.kv_pages,
+            jnp.asarray(page_table),
+            jnp.asarray(active),
+            state,
+            rng,
+        )
+        chunk_np = np.asarray(chunk)  # [steps, B]
+        GENERATED_TOKENS.labels(model_name="engine").inc(
+            int(active.sum()) * steps
+        )
+        for i, slot in enumerate(self._slots):
+            if slot.request_id is None or not active[i]:
+                continue
+            for s in range(steps):
+                if slot.request_id is None:
+                    break  # finished mid-chunk; discard speculative tail
+                token = int(chunk_np[s, i])
+                slot.pos += 1
+                slot.generated.append(token)
+                self._emit(slot, token)
+
+    def _emit(self, slot: _Slot, token: int):
+        """Stream one token; apply stop conditions."""
+        n_gen = len(slot.generated)
+        params = slot.params
+        finish_reason = None
+        is_eos = (
+            token == self.tokenizer.eos_token_id
+            and not params.ignore_eos
+            and n_gen > params.min_tokens
+        )
+        delta = "" if is_eos else slot.detok.push(token)
+        text = slot.detok.text
+        if is_eos:
+            finish_reason = "stop"
+        elif n_gen >= params.max_tokens:
+            finish_reason = "length"
+        else:
+            for stop in slot.stop_texts:
+                if stop and stop in text:
+                    cut = text.index(stop)
+                    delta = delta[: max(0, len(delta) - (len(text) - cut))]
+                    finish_reason = "stop"
+                    break
+        out = GenerationOutput(
+            token_id=token,
+            text_delta=delta,
+            finished=finish_reason is not None,
+            finish_reason=finish_reason,
+            num_generated=n_gen,
+            num_prompt_tokens=slot.prompt_len,
+            cumulative_text=text,
+        )
+        slot.queue.put_nowait(out)
+        if finish_reason is not None:
+            self.allocator.free(slot.pages)
+            slot.reset()
+            self._wake.set()
+
+    def _finish(self, slot: _Slot, reason: str):
+        out = GenerationOutput(
+            token_id=-1,
+            text_delta="",
+            finished=True,
+            finish_reason=reason,
+            num_generated=len(slot.generated),
+            num_prompt_tokens=slot.prompt_len,
+            cumulative_text=slot.detok.text,
+        )
+        slot.queue.put_nowait(out)
+        self.allocator.free(slot.pages)
+        slot.reset()
+
+    def _next_step(self) -> int:
+        self._step_counter += 1
+        return self._step_counter

@@ -1,0 +1,124 @@
+"""Paged KV cache: device arrays + host-side page allocator.
+
+Layout per layer: k/v pages of shape [n_kv_heads, num_pages, page_size,
+head_dim] — head-major so tensor parallelism shards pages over the `model`
+mesh axis with no resharding at attention time.  Sequences own pages through
+a page table [B_slots, max_pages_per_seq]; page 0 is reserved as the null
+page so padded table entries are always valid gathers.
+
+Role parity: replaces vLLM's block allocator + CUDA paged attention cache
+(the reference delegates this entirely to vLLM; see SURVEY.md §2.3) with an
+XLA-native design.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class KVCacheConfig:
+    n_layers: int
+    n_kv_heads: int
+    head_dim: int
+    page_size: int = 16
+    num_pages: int = 1024
+    max_pages_per_seq: int = 128
+    dtype: str = "bfloat16"
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.max_pages_per_seq * self.page_size
+
+    def bytes_per_page(self) -> int:
+        itemsize = 2 if self.dtype in ("bfloat16", "float16") else 4
+        return 2 * self.n_kv_heads * self.page_size * self.head_dim * itemsize
+
+
+def init_kv_pages(config: KVCacheConfig, sharding=None) -> List[jnp.ndarray]:
+    """[n_layers] list of stacked K/V pages:
+    [2, n_kv_heads, num_pages, page_size, head_dim]."""
+    shape = (2, config.n_kv_heads, config.num_pages, config.page_size, config.head_dim)
+    dtype = jnp.dtype(config.dtype)
+    pages = []
+    for _ in range(config.n_layers):
+        arr = jnp.zeros(shape, dtype=dtype)
+        if sharding is not None:
+            arr = jax.device_put(arr, sharding)
+        pages.append(arr)
+    return pages
+
+
+class PageAllocator:
+    """Host-side free-list; page 0 is reserved (null page for padding)."""
+
+    def __init__(self, num_pages: int):
+        self.num_pages = num_pages
+        self._free = list(range(num_pages - 1, 0, -1))  # stack, page 0 reserved
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def can_allocate(self, n: int) -> bool:
+        return len(self._free) >= n
+
+    def allocate(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise MemoryError(f"KV cache exhausted: need {n} pages, {len(self._free)} free")
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, pages: List[int]) -> None:
+        for p in pages:
+            if p != 0:
+                self._free.append(p)
+
+
+def pages_needed(n_tokens: int, page_size: int) -> int:
+    return (n_tokens + page_size - 1) // page_size
+
+
+def write_prompt_kv(
+    kv_pages: jnp.ndarray,  # [2, n_kv, num_pages, ps, d]
+    k: jnp.ndarray,  # [T, n_kv, d]
+    v: jnp.ndarray,  # [T, n_kv, d]
+    page_ids: jnp.ndarray,  # [max_pages_this_seq] int32 (padded with 0)
+    n_tokens: jnp.ndarray,  # scalar int32: valid token count
+    page_size: int,
+) -> jnp.ndarray:
+    """Scatter a prefilled prompt's K/V into its pages.  Writes the full
+    padded T; positions >= n_tokens land on the null page (page 0)."""
+    T = k.shape[0]
+    t = jnp.arange(T, dtype=jnp.int32)
+    valid = t < n_tokens
+    page_of_t = jnp.where(valid, page_ids[t // page_size], 0)
+    slot_of_t = jnp.where(valid, t % page_size, t % page_size)
+    kv = jnp.stack([k, v])  # [2, T, n_kv, d]
+    kv = kv.transpose(0, 2, 1, 3)  # [2, n_kv, T, d]
+    return kv_pages.at[:, :, page_of_t, slot_of_t, :].set(
+        kv, mode="drop", unique_indices=False
+    )
+
+
+def append_token_kv(
+    kv_pages: jnp.ndarray,  # [2, n_kv, num_pages, ps, d]
+    k: jnp.ndarray,  # [B, n_kv, d]
+    v: jnp.ndarray,  # [B, n_kv, d]
+    page_table: jnp.ndarray,  # [B, max_pages_per_seq]
+    pos: jnp.ndarray,  # [B] position being written
+    active: jnp.ndarray,  # [B] bool — inactive slots write to null page
+    page_size: int,
+) -> jnp.ndarray:
+    """Decode-step scatter: one new token per active sequence."""
+    B = k.shape[0]
+    b = jnp.arange(B, dtype=jnp.int32)
+    page = jnp.where(active, page_table[b, pos // page_size], 0)
+    slot = pos % page_size
+    kv = jnp.stack([k, v])  # [2, B, n_kv, d]
+    kv = kv.transpose(0, 2, 1, 3)  # [2, n_kv, B, d]
+    return kv_pages.at[:, :, page, slot, :].set(kv, mode="drop")

@@ -1,0 +1,134 @@
+"""Batched token sampling, fully inside jit.
+
+Per-sequence parameters travel as a struct-of-arrays (`SamplingParams`
+batch) so one compiled program serves any mix of greedy/temperature/top-k/
+top-p/min-p requests — no recompiles per request.
+
+Role parity: vLLM's Sampler (the reference delegates sampling to vLLM).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class SamplingParams:
+    """Host-side per-request sampling config."""
+
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    min_p: float = 0.0
+    repetition_penalty: float = 1.0
+    frequency_penalty: float = 0.0
+    presence_penalty: float = 0.0
+    max_tokens: int = 16
+    min_tokens: int = 0
+    ignore_eos: bool = False
+    stop: Optional[List[str]] = None
+    seed: Optional[int] = None
+    logprobs: Optional[int] = None
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature == 0.0
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class SamplingState:
+    """Device-side struct-of-arrays for a batch of B slots (a jit-traversable
+    pytree)."""
+
+    temperature: jnp.ndarray  # [B] f32 (0 => greedy)
+    top_p: jnp.ndarray  # [B] f32
+    top_k: jnp.ndarray  # [B] i32 (0 => off)
+    min_p: jnp.ndarray  # [B] f32
+
+    @staticmethod
+    def from_params(params_list: List[SamplingParams]) -> "SamplingState":
+        return SamplingState(
+            temperature=jnp.asarray([p.temperature for p in params_list], jnp.float32),
+            top_p=jnp.asarray([p.top_p for p in params_list], jnp.float32),
+            top_k=jnp.asarray([p.top_k for p in params_list], jnp.int32),
+            min_p=jnp.asarray([p.min_p for p in params_list], jnp.float32),
+        )
+
+    @staticmethod
+    def defaults(batch: int) -> "SamplingState":
+        return SamplingState(
+            temperature=jnp.ones((batch,), jnp.float32),
+            top_p=jnp.ones((batch,), jnp.float32),
+            top_k=jnp.zeros((batch,), jnp.int32),
+            min_p=jnp.zeros((batch,), jnp.float32),
+        )
+
+
+def sample_tokens(
+    logits: jnp.ndarray,  # [B, V] f32
+    state: SamplingState,
+    rng: jax.Array,
+) -> jnp.ndarray:
+    """Returns [B] sampled token ids.  temperature==0 rows are greedy."""
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+
+    temp = jnp.maximum(state.temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # top-k: mask logits below the k-th largest (k==0 disables)
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]  # desc
+    k = jnp.clip(state.top_k, 0, V)
+    kth_idx = jnp.clip(k - 1, 0, V - 1)
+    kth_val = jnp.take_along_axis(sorted_logits, kth_idx[:, None], axis=1)
+    topk_mask = jnp.where(
+        (state.top_k > 0)[:, None], scaled < kth_val, jnp.zeros_like(scaled, bool)
+    )
+    scaled = jnp.where(topk_mask, -jnp.inf, scaled)
+
+    # top-p (nucleus): keep smallest prefix of sorted probs with cumsum >= p
+    probs_sorted = jax.nn.softmax(jnp.sort(scaled, axis=-1)[:, ::-1], axis=-1)
+    cumprobs = jnp.cumsum(probs_sorted, axis=-1)
+    cutoff_count = jnp.sum(cumprobs - probs_sorted < state.top_p[:, None], axis=-1)
+    cutoff_idx = jnp.clip(cutoff_count - 1, 0, V - 1)
+    sorted_again = jnp.sort(scaled, axis=-1)[:, ::-1]
+    cutoff_val = jnp.take_along_axis(sorted_again, cutoff_idx[:, None], axis=1)
+    topp_mask = jnp.where(
+        (state.top_p < 1.0)[:, None], scaled < cutoff_val, jnp.zeros_like(scaled, bool)
+    )
+    scaled = jnp.where(topp_mask, -jnp.inf, scaled)
+
+    # min-p: drop tokens with prob < min_p * max_prob
+    probs = jax.nn.softmax(scaled, axis=-1)
+    max_prob = probs.max(axis=-1, keepdims=True)
+    minp_mask = jnp.where(
+        (state.min_p > 0.0)[:, None],
+        probs < state.min_p[:, None] * max_prob,
+        jnp.zeros_like(scaled, bool),
+    )
+    scaled = jnp.where(minp_mask, -jnp.inf, scaled)
+
+    sampled = jax.random.categorical(rng, scaled, axis=-1)
+    return jnp.where(state.temperature <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+def apply_penalties(
+    logits: jnp.ndarray,  # [B, V]
+    output_counts: jnp.ndarray,  # [B, V] int32 — counts of generated tokens
+    repetition_penalty: jnp.ndarray,  # [B]
+    frequency_penalty: jnp.ndarray,  # [B]
+    presence_penalty: jnp.ndarray,  # [B]
+) -> jnp.ndarray:
+    seen = output_counts > 0
+    rp = repetition_penalty[:, None]
+    penalized = jnp.where(logits > 0, logits / rp, logits * rp)
+    logits = jnp.where(seen, penalized, logits)
+    logits = logits - frequency_penalty[:, None] * output_counts
+    logits = logits - presence_penalty[:, None] * seen.astype(logits.dtype)
+    return logits

@@ -1,0 +1,153 @@
+"""Tokenizer facade: HuggingFace tokenizers when a local artifact exists,
+byte-level fallback otherwise (tests/bench run with zero egress).
+
+Incremental detokenization follows the streaming rule: only emit text once
+it is prefix-stable (no dangling UTF-8/byte-pair at the boundary).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+
+class BaseTokenizer:
+    eos_token_id: int = -1
+    bos_token_id: int = -1
+
+    @property
+    def vocab_size(self) -> int:
+        raise NotImplementedError
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        raise NotImplementedError
+
+    def decode(self, ids: Sequence[int]) -> str:
+        raise NotImplementedError
+
+    def apply_chat_template(self, messages: List[dict], add_generation_prompt: bool = True, **kwargs) -> str:
+        """Fallback chat template (chatml-ish); HF tokenizers override."""
+        parts = []
+        for m in messages:
+            role = m.get("role", "user")
+            content = m.get("content") or ""
+            parts.append(f"<|{role}|>\n{content}\n")
+        if add_generation_prompt:
+            parts.append("<|assistant|>\n")
+        return "".join(parts)
+
+
+class ByteTokenizer(BaseTokenizer):
+    """256 byte tokens + BOS/EOS/PAD; reversible on arbitrary text."""
+
+    PAD = 256
+    BOS = 257
+    EOS = 258
+
+    def __init__(self, vocab_size: int = 512):
+        self._vocab_size = max(vocab_size, 259)
+        self.bos_token_id = self.BOS
+        self.eos_token_id = self.EOS
+
+    @property
+    def vocab_size(self) -> int:
+        return self._vocab_size
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        return ([self.BOS] if add_bos else []) + ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer(BaseTokenizer):
+    """tokenizers-backed (tokenizer.json) — no sentencepiece in this image."""
+
+    def __init__(self, model_dir: str):
+        from tokenizers import Tokenizer
+
+        path = os.path.join(model_dir, "tokenizer.json")
+        self._tok = Tokenizer.from_file(path)
+        self.eos_token_id = -1
+        self.bos_token_id = -1
+        self._chat_template = None
+        # read special tokens + chat template from tokenizer_config.json
+        cfg_path = os.path.join(model_dir, "tokenizer_config.json")
+        if os.path.exists(cfg_path):
+            import json
+
+            with open(cfg_path) as f:
+                cfg = json.load(f)
+            self._chat_template = cfg.get("chat_template")
+            eos = cfg.get("eos_token")
+            bos = cfg.get("bos_token")
+            if isinstance(eos, dict):
+                eos = eos.get("content")
+            if isinstance(bos, dict):
+                bos = bos.get("content")
+            if eos:
+                self.eos_token_id = self._tok.token_to_id(eos) or -1
+            if bos:
+                self.bos_token_id = self._tok.token_to_id(bos) or -1
+
+    @property
+    def vocab_size(self) -> int:
+        return self._tok.get_vocab_size()
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = self._tok.encode(text, add_special_tokens=False).ids
+        if add_bos and self.bos_token_id >= 0:
+            return [self.bos_token_id] + ids
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    def apply_chat_template(self, messages, add_generation_prompt=True, **kwargs) -> str:
+        if self._chat_template:
+            try:
+                import jinja2
+
+                env = jinja2.Environment()
+                tmpl = env.from_string(self._chat_template)
+                return tmpl.render(
+                    messages=messages,
+                    add_generation_prompt=add_generation_prompt,
+                    bos_token="",
+                    eos_token="",
+                    **kwargs,
+                )
+            except Exception:
+                pass
+        return super().apply_chat_template(messages, add_generation_prompt, **kwargs)
+
+
+def load_tokenizer(model_dir: Optional[str], vocab_size: int = 512) -> BaseTokenizer:
+    if model_dir and os.path.exists(os.path.join(model_dir, "tokenizer.json")):
+        return HFTokenizer(model_dir)
+    return ByteTokenizer(vocab_size)
+
+
+class IncrementalDetokenizer:
+    """Streams prefix-stable text deltas from a growing id sequence."""
+
+    def __init__(self, tokenizer: BaseTokenizer):
+        self._tok = tokenizer
+        self._ids: List[int] = []
+        self._emitted = ""
+
+    def push(self, token_id: int) -> str:
+        self._ids.append(token_id)
+        text = self._tok.decode(self._ids)
+        # hold back when the tail is an incomplete byte sequence
+        if text.endswith("�"):
+            return ""
+        delta = text[len(self._emitted):]
+        self._emitted = text
+        return delta
+
+    @property
+    def text(self) -> str:
+        return self._emitted

@@ -194,6 +194,13 @@ def _has_engine(model: BaseModel) -> bool:
 
 
 async def _start_engine(model: BaseModel) -> None:
-    result = model.start_engine()
-    if asyncio.iscoroutine(result):
-        await result
+    try:
+        result = model.start_engine()
+        if asyncio.iscoroutine(result):
+            await result
+    except Exception:
+        # a dead engine must be loud and fail readiness, not vanish into an
+        # unawaited task
+        logger.exception("engine startup failed for model %s", model.name)
+        model.ready = False
+        raise

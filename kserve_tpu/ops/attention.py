@@ -1,0 +1,116 @@
+"""Attention ops: causal prefill and paged-KV decode.
+
+Two implementations of decode attention over the paged cache:
+- `paged_attention_xla`: pure-XLA gather + masked softmax (portable, used on
+  CPU test meshes and as the safety net).
+- `paged_attention_pallas` (ops/pallas_paged_attention.py): fused kernel that
+  streams pages HBM->VMEM without materializing the gathered KV (the Ragged
+  Paged Attention approach; see PAPERS.md).
+
+Role parity: replaces vLLM's CUDA PagedAttention, which the reference uses
+through the vLLM engine (SURVEY.md §2.3 "Sequence/context parallel" row).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _gqa_scores(q: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
+    """q:[B,Tq,nq,d] k:[B,Tk,nkv,d] -> scores [B,nq,Tq,Tk] with GQA groups."""
+    B, Tq, nq, d = q.shape
+    nkv = k.shape[2]
+    group = nq // nkv
+    qg = q.reshape(B, Tq, nkv, group, d)
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg.astype(jnp.float32), k.astype(jnp.float32))
+    return scores.reshape(B, nkv * group, Tq, k.shape[1])
+
+
+def _gqa_out(weights: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+    """weights:[B,nq,Tq,Tk] v:[B,Tk,nkv,d] -> [B,Tq,nq,d]."""
+    B, nq, Tq, Tk = weights.shape
+    nkv = v.shape[2]
+    group = nq // nkv
+    wg = weights.reshape(B, nkv, group, Tq, Tk)
+    out = jnp.einsum("bkgts,bskd->btkgd", wg, v.astype(jnp.float32))
+    return out.reshape(B, Tq, nq, v.shape[3])
+
+
+def causal_prefill_attention(
+    q: jnp.ndarray,  # [B, T, nq, d]
+    k: jnp.ndarray,  # [B, T, nkv, d]
+    v: jnp.ndarray,  # [B, T, nkv, d]
+    valid_len: jnp.ndarray,  # [B] int32
+    logit_softcap: float = 0.0,
+) -> jnp.ndarray:
+    """Causal self-attention over the prompt (no cache read)."""
+    B, T, nq, d = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    scores = _gqa_scores(q, k) * scale  # [B,nq,T,T]
+    if logit_softcap > 0.0:
+        scores = jnp.tanh(scores / logit_softcap) * logit_softcap
+    t = jnp.arange(T)
+    causal = t[None, :] <= t[:, None]  # [Tq, Tk]
+    valid = t[None, :] < valid_len[:, None]  # [B, Tk]
+    mask = causal[None, None, :, :] & valid[:, None, None, :]
+    scores = jnp.where(mask, scores, -1e30)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = _gqa_out(weights, v)
+    return out.astype(q.dtype)
+
+
+def paged_attention_xla(
+    q: jnp.ndarray,  # [B, nq, d] — one decode token per sequence
+    kv_pages: jnp.ndarray,  # [2, nkv, num_pages, ps, d]
+    page_table: jnp.ndarray,  # [B, max_pages]
+    seq_lens: jnp.ndarray,  # [B] int32 (length INCLUDING current token)
+    logit_softcap: float = 0.0,
+) -> jnp.ndarray:
+    """Decode attention: gather this batch's pages and do masked softmax.
+    Materializes [B, L, nkv, d]; the Pallas kernel avoids that copy."""
+    B, nq, d = q.shape
+    nkv = kv_pages.shape[1]
+    ps = kv_pages.shape[3]
+    max_pages = page_table.shape[1]
+    L = max_pages * ps
+    # gather: [2, nkv, B, max_pages, ps, d]
+    gathered = kv_pages[:, :, page_table, :, :]
+    k = gathered[0].transpose(1, 2, 3, 0, 4).reshape(B, L, nkv, d)
+    v = gathered[1].transpose(1, 2, 3, 0, 4).reshape(B, L, nkv, d)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    scores = _gqa_scores(q[:, None], k) * scale  # [B,nq,1,L]
+    if logit_softcap > 0.0:
+        scores = jnp.tanh(scores / logit_softcap) * logit_softcap
+    pos = jnp.arange(L, dtype=jnp.int32)
+    mask = pos[None, :] < seq_lens[:, None]  # [B, L]
+    scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = _gqa_out(weights, v)  # [B,1,nq,d]
+    return out[:, 0].astype(q.dtype)
+
+
+def paged_attention(
+    q: jnp.ndarray,
+    kv_pages: jnp.ndarray,
+    page_table: jnp.ndarray,
+    seq_lens: jnp.ndarray,
+    logit_softcap: float = 0.0,
+    use_pallas: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Dispatch to the Pallas kernel on TPU, XLA fallback elsewhere."""
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas:
+        try:
+            from .pallas_paged_attention import paged_attention_pallas
+
+            return paged_attention_pallas(
+                q, kv_pages, page_table, seq_lens, logit_softcap=logit_softcap
+            )
+        except Exception:  # pragma: no cover — kernel unavailable on host
+            pass
+    return paged_attention_xla(q, kv_pages, page_table, seq_lens, logit_softcap)

@@ -1,0 +1,35 @@
+"""Rotary position embeddings (half-rotation layout, Llama/NeoX style).
+
+Computed on the fly from positions (no host-side cache tables) so the same
+function serves prefill ([B,T]) and decode ([B,1]) under one jit.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, theta: float = 10000.0) -> jnp.ndarray:
+    """[head_dim/2] inverse frequencies."""
+    exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta**exponent)
+
+
+def apply_rope(
+    x: jnp.ndarray,  # [B, T, H, D]
+    positions: jnp.ndarray,  # [B, T] int32
+    theta: float = 10000.0,
+    scaling: float = 1.0,
+) -> jnp.ndarray:
+    """Rotate q/k by position-dependent phases.  Half-rotation layout:
+    pairs are (x[..., :D/2], x[..., D/2:]) as in Llama."""
+    head_dim = x.shape[-1]
+    inv_freq = rope_frequencies(head_dim, theta)
+    if scaling != 1.0:
+        inv_freq = inv_freq / scaling
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [B,T,D/2]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B,T,1,D/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,113 @@
+"""Device mesh + sharding rules for the generative engine.
+
+Megatron-style tensor parallelism expressed as PartitionSpecs over a
+("data", "model") mesh; XLA inserts the all-reduces (row-parallel wo/w_down
+contractions) and all-gathers (vocab-sharded logits) over ICI.
+
+Axes:
+- data:  engine decode slots (DP) — batch dimension of decode/prefill
+- model: attention heads / MLP hidden / vocab (TP); KV pages shard their
+  head axis so paged attention never reshards.
+
+The reference reaches TP/DP through vLLM flags wired by the controller
+(SURVEY.md §2.3); here the mesh IS the backend — no NCCL/Ray analogue
+needed inside a slice.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.llama import LlamaConfig
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def create_mesh(
+    tp: int = 1, dp: int = 1, devices: Optional[list] = None
+) -> Mesh:
+    """(dp, tp) mesh. TP should map to ICI-adjacent devices: jax device order
+    within a slice is topology-contiguous, so tp is the fastest-varying axis."""
+    devices = devices if devices is not None else jax.devices()
+    if tp * dp > len(devices):
+        raise ValueError(f"mesh {dp}x{tp} needs {tp*dp} devices, have {len(devices)}")
+    grid = np.asarray(devices[: tp * dp]).reshape(dp, tp)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def validate_tp(config: LlamaConfig, tp: int) -> None:
+    if config.n_heads % tp != 0:
+        raise ValueError(f"n_heads={config.n_heads} not divisible by tp={tp}")
+    if config.n_kv_heads % tp != 0:
+        raise ValueError(
+            f"n_kv_heads={config.n_kv_heads} not divisible by tp={tp}; "
+            "KV-head replication is not implemented yet"
+        )
+    if config.intermediate_size % tp != 0:
+        raise ValueError(f"intermediate_size not divisible by tp={tp}")
+
+
+def param_pspecs(config: LlamaConfig) -> Dict[str, Any]:
+    """PartitionSpec pytree matching models.llama param pytree."""
+    layer = {
+        "attn_norm": P(),
+        "wq": P(None, MODEL_AXIS),  # column parallel (heads)
+        "wk": P(None, MODEL_AXIS),
+        "wv": P(None, MODEL_AXIS),
+        "wo": P(MODEL_AXIS, None),  # row parallel -> psum by XLA
+        "mlp_norm": P(),
+        "w_gate": P(None, MODEL_AXIS),
+        "w_up": P(None, MODEL_AXIS),
+        "w_down": P(MODEL_AXIS, None),
+    }
+    if config.attention_bias:
+        layer.update({"bq": P(MODEL_AXIS), "bk": P(MODEL_AXIS), "bv": P(MODEL_AXIS)})
+    specs: Dict[str, Any] = {
+        "embed": P(MODEL_AXIS, None),  # vocab-sharded
+        "final_norm": P(),
+        "layers": [dict(layer) for _ in range(config.n_layers)],
+    }
+    if not config.tie_word_embeddings:
+        specs["lm_head"] = P(None, MODEL_AXIS)  # logits vocab-sharded -> gather
+    return specs
+
+
+def kv_pages_pspec() -> P:
+    """[2, n_kv, num_pages, ps, d] — shard KV heads over model axis."""
+    return P(None, MODEL_AXIS, None, None, None)
+
+
+def batch_pspecs() -> Dict[str, P]:
+    """Decode-step batch arrays shard their leading (slot) dim over data."""
+    return {
+        "tokens": P(DATA_AXIS),
+        "pos": P(DATA_AXIS),
+        "page_table": P(DATA_AXIS, None),
+        "active": P(DATA_AXIS),
+        "logits": P(DATA_AXIS, None),
+    }
+
+
+def shard_params(params, config: LlamaConfig, mesh: Mesh):
+    """Place a param pytree onto the mesh according to param_pspecs."""
+    specs = param_pspecs(config)
+    return jax.tree.map(
+        lambda arr, spec: jax.device_put(arr, NamedSharding(mesh, spec)),
+        params,
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_kv_pages(kv_pages: List, mesh: Mesh) -> List:
+    sharding = NamedSharding(mesh, kv_pages_pspec())
+    return [jax.device_put(p, sharding) for p in kv_pages]
+
+
+def named(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)

@@ -95,12 +95,16 @@ class RESTServer:
         enable_docs_url: bool = False,
         openai_models: Optional[List] = None,
         enable_latency_logging: bool = True,
+        reuse_port: bool = False,
     ):
         self.dataplane = dataplane
         self.model_repository_extension = model_repository_extension
         self.http_port = http_port
         self.access_log_format = access_log_format
         self.enable_latency_logging = enable_latency_logging
+        # SO_REUSEPORT is for the multiprocess worker mode only — with it on
+        # by default, stale processes silently share (and steal from) the port
+        self.reuse_port = reuse_port
         self._runner: Optional[web.AppRunner] = None
 
     def create_application(self) -> web.Application:
@@ -123,7 +127,9 @@ class RESTServer:
         app = self.create_application()
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, host="0.0.0.0", port=self.http_port, reuse_port=True)
+        site = web.TCPSite(
+            self._runner, host="0.0.0.0", port=self.http_port, reuse_port=self.reuse_port
+        )
         await site.start()
         logger.info("REST server listening on port %s", self.http_port)
 

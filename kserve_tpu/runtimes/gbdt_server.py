@@ -16,7 +16,12 @@ import numpy as np
 from ..errors import InferenceError, InvalidInput
 from ..infer_type import InferRequest, InferResponse
 from ..model import Model
-from ..utils.inference import get_predict_input, get_predict_response, validate_feature_count
+from ..utils.inference import (
+    get_predict_input,
+    get_predict_response,
+    single_input_matrix,
+    validate_feature_count,
+)
 from .artifact import find_model_file
 from .tensorize.lgb_parse import parse_lightgbm_text
 from .tensorize.trees import Link, forest_predict_fn
@@ -51,15 +56,18 @@ class _ForestModel(Model):
     def predict(
         self, payload: Union[Dict, InferRequest], headers=None, response_headers=None
     ) -> Union[Dict, InferResponse]:
-        instances = get_predict_input(payload)
-        validate_feature_count(np.asarray(instances), self._forest.n_features, self.name)
+        instances = single_input_matrix(get_predict_input(payload), self.name)
+        validate_feature_count(instances, self._forest.n_features, self.name)
         try:
             probs = np.asarray(self._proba_fn(instances))
             # Booster.predict parity (reference xgbserver/lgbserver return the
             # booster's transformed output, not argmax classes): sigmoid ->
-            # P(class 1), softmax -> full probability rows, identity -> raw.
+            # P(class 1), softmax -> probability rows (multi:softmax -> argmax
+            # labels, matching xgboost), identity -> raw.
             if self._forest.link == Link.IDENTITY:
                 result = probs[..., 0] if probs.shape[-1] == 1 else probs
+            elif self._forest.output_labels and not self.predict_proba_mode:
+                result = np.argmax(probs, axis=-1)
             elif self._forest.link == Link.SIGMOID and not self.predict_proba_mode:
                 result = probs[..., 1]
             else:

@@ -1,0 +1,352 @@
+"""Generative runtime: the JAX/TPU analogue of the reference
+huggingfaceserver vLLM path.
+
+`JAXGenerativeModel` implements the OpenAI model ABCs on top of
+engine.LLMEngine: completions + chat (templated), streaming via async
+iterators feeding SSE.
+
+Parity: python/huggingfaceserver/huggingfaceserver/vllm/vllm_model.py:55
+(VLLMModel.start_engine :83, create_completion/create_chat_completion :273);
+engine roles swapped from AsyncLLM/CUDA to LLMEngine/XLA.
+
+Entrypoint:
+    python -m kserve_tpu.runtimes.generative_server \
+        --model_name=llm --model_dir=/mnt/models [--tensor_parallel_size=N]
+    # no checkpoint? --model_config=tiny|llama3-1b|llama3-8b --random_weights
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import AsyncIterator, List, Optional, Union
+
+from ..engine.engine import EngineConfig, LLMEngine
+from ..engine.sampling import SamplingParams
+from ..engine.tokenizer import load_tokenizer
+from ..errors import InvalidInput
+from ..logging import logger
+from ..model_server import ModelServer, build_arg_parser
+from ..models import llama
+from ..protocol.openai.openai_model import OpenAIGenerativeModel
+from ..protocol.openai.types import (
+    ChatCompletion,
+    ChatCompletionChoice,
+    ChatCompletionChunk,
+    ChatCompletionChunkChoice,
+    ChatCompletionChunkDelta,
+    ChatCompletionRequest,
+    ChatCompletionResponseMessage,
+    Completion,
+    CompletionChoice,
+    CompletionRequest,
+    UsageInfo,
+    random_uuid,
+)
+
+_NAMED_CONFIGS = {
+    "tiny": llama.LlamaConfig.tiny,
+    "llama3-1b": llama.LlamaConfig.llama3_1b,
+    "llama3-8b": llama.LlamaConfig.llama3_8b,
+}
+
+
+class JAXGenerativeModel(OpenAIGenerativeModel):
+    def __init__(
+        self,
+        name: str,
+        model_dir: Optional[str] = None,
+        model_config: Optional[llama.LlamaConfig] = None,
+        engine_config: Optional[EngineConfig] = None,
+        random_weights: bool = False,
+    ):
+        super().__init__(name)
+        self.model_dir = model_dir
+        self._model_config = model_config
+        self.engine_config = engine_config or EngineConfig()
+        self.random_weights = random_weights
+        self.engine: Optional[LLMEngine] = None
+        self.tokenizer = None
+
+    def load(self) -> bool:
+        """Resolve config/tokenizer/weights; engine starts in start_engine()
+        (inside the server event loop), after which the model turns ready."""
+        if self._model_config is None:
+            cfg_path = os.path.join(self.model_dir or "", "config.json")
+            if not os.path.exists(cfg_path):
+                raise FileNotFoundError(
+                    f"no config.json under {self.model_dir}; pass model_config"
+                )
+            self._model_config = llama.LlamaConfig.from_hf_config(cfg_path)
+        self.tokenizer = load_tokenizer(self.model_dir, self._model_config.vocab_size)
+        if self.random_weights or not self.model_dir:
+            self._params = None  # engine random-initializes
+        else:
+            self._params = llama.load_hf_weights(self.model_dir, self._model_config)
+        return True  # ready flips in start_engine
+
+    async def start_engine(self):
+        self.engine = LLMEngine(
+            self._model_config,
+            self.engine_config,
+            self.tokenizer,
+            params=getattr(self, "_params", None),
+        )
+        self._params = None  # free the host copy
+        await self.engine.start()
+        self.ready = True
+        logger.info("generative model %s ready", self.name)
+
+    def stop(self):
+        if self.engine is not None and self.engine.running:
+            import asyncio
+
+            try:
+                loop = asyncio.get_event_loop()
+                if loop.is_running():
+                    loop.create_task(self.engine.stop())
+            except RuntimeError:
+                pass
+
+    async def healthy(self) -> bool:
+        return self.ready and self.engine is not None and self.engine.running
+
+    # ---------------- helpers ----------------
+
+    def _sampling_from(self, req, max_len_default: int = 16) -> SamplingParams:
+        max_tokens = (
+            getattr(req, "max_completion_tokens", None)
+            or getattr(req, "max_tokens", None)
+            or max_len_default
+        )
+        stop = req.stop
+        if isinstance(stop, str):
+            stop = [stop]
+        return SamplingParams(
+            temperature=req.temperature if req.temperature is not None else 1.0,
+            top_p=req.top_p if req.top_p is not None else 1.0,
+            top_k=req.top_k or 0,
+            min_p=req.min_p or 0.0,
+            max_tokens=max_tokens,
+            min_tokens=req.min_tokens or 0,
+            ignore_eos=bool(req.ignore_eos),
+            stop=stop,
+            seed=req.seed,
+        )
+
+    def _encode_prompt(self, prompt: Union[str, List[int], List[str]]) -> List[List[int]]:
+        if isinstance(prompt, str):
+            return [self.tokenizer.encode(prompt)]
+        if isinstance(prompt, list):
+            if not prompt:
+                raise InvalidInput("empty prompt")
+            if isinstance(prompt[0], int):
+                return [list(prompt)]
+            if isinstance(prompt[0], str):
+                return [self.tokenizer.encode(p) for p in prompt]
+            if isinstance(prompt[0], list):
+                return [list(p) for p in prompt]
+        raise InvalidInput(f"unsupported prompt type {type(prompt).__name__}")
+
+    # ---------------- completions ----------------
+
+    async def create_completion(
+        self, request: CompletionRequest, raw_request=None, context=None
+    ):
+        prompts = self._encode_prompt(request.prompt)
+        params = self._sampling_from(request, max_len_default=16)
+        if request.stream:
+            if len(prompts) > 1 or request.n > 1:
+                raise InvalidInput("streaming supports a single prompt with n=1")
+            return self._stream_completion(request, prompts[0], params)
+        choices = []
+        usage = UsageInfo()
+        idx = 0
+        for prompt_ids in prompts:
+            for _ in range(max(request.n, 1)):
+                text, n_gen, finish = await self._run_one(prompt_ids, params)
+                choices.append(
+                    CompletionChoice(index=idx, text=text, finish_reason=finish)
+                )
+                usage.prompt_tokens += len(prompt_ids)
+                usage.completion_tokens += n_gen
+                idx += 1
+        usage.total_tokens = usage.prompt_tokens + usage.completion_tokens
+        return Completion(model=request.model, choices=choices, usage=usage)
+
+    def _generate(self, prompt_ids, params):
+        """engine.generate with limit errors surfaced as 400s (the checks
+        must run before iteration starts — async generators defer their body
+        to the first __anext__)."""
+        if len(prompt_ids) > self.engine.config.max_prefill_len:
+            raise InvalidInput(
+                f"prompt length {len(prompt_ids)} exceeds max_prefill_len "
+                f"{self.engine.config.max_prefill_len}"
+            )
+        if len(prompt_ids) + params.max_tokens > self.engine.config.max_model_len:
+            raise InvalidInput(
+                f"prompt+max_tokens exceeds max_model_len {self.engine.config.max_model_len}"
+            )
+        return self.engine.generate(prompt_ids, params)
+
+    async def _run_one(self, prompt_ids, params):
+        text = ""
+        n_gen = 0
+        finish = None
+        async for out in self._generate(prompt_ids, params):
+            text += out.text_delta
+            n_gen = out.num_generated
+            finish = out.finish_reason
+        return text, n_gen, finish or "stop"
+
+    async def _stream_completion(
+        self, request: CompletionRequest, prompt_ids, params
+    ) -> AsyncIterator[Completion]:
+        completion_id = random_uuid("cmpl-")
+        n_gen = 0
+        async for out in self._generate(prompt_ids, params):
+            n_gen = out.num_generated
+            chunk = Completion(
+                id=completion_id,
+                model=request.model,
+                choices=[
+                    CompletionChoice(
+                        index=0,
+                        text=out.text_delta,
+                        finish_reason=out.finish_reason,
+                    )
+                ],
+            )
+            if request.stream_options and request.stream_options.include_usage and out.finished:
+                chunk.usage = UsageInfo(
+                    prompt_tokens=len(prompt_ids),
+                    completion_tokens=n_gen,
+                    total_tokens=len(prompt_ids) + n_gen,
+                )
+            yield chunk
+
+    # ---------------- chat ----------------
+
+    def _chat_prompt(self, request: ChatCompletionRequest) -> List[int]:
+        messages = [m.model_dump(exclude_none=True) for m in request.messages]
+        for m in messages:
+            if isinstance(m.get("content"), list):
+                m["content"] = "".join(
+                    p.get("text", "") for p in m["content"] if p.get("type") == "text"
+                )
+        kwargs = request.chat_template_kwargs or {}
+        text = self.tokenizer.apply_chat_template(
+            messages, add_generation_prompt=True, **kwargs
+        )
+        return self.tokenizer.encode(text)
+
+    async def create_chat_completion(
+        self, request: ChatCompletionRequest, raw_request=None, context=None
+    ):
+        prompt_ids = self._chat_prompt(request)
+        params = self._sampling_from(request, max_len_default=256)
+        if request.stream:
+            if request.n > 1:
+                raise InvalidInput("streaming supports n=1")
+            return self._stream_chat(request, prompt_ids, params)
+        choices = []
+        usage = UsageInfo(prompt_tokens=len(prompt_ids) * max(request.n, 1))
+        for i in range(max(request.n, 1)):
+            text, n_gen, finish = await self._run_one(prompt_ids, params)
+            choices.append(
+                ChatCompletionChoice(
+                    index=i,
+                    message=ChatCompletionResponseMessage(role="assistant", content=text),
+                    finish_reason=finish,
+                )
+            )
+            usage.completion_tokens += n_gen
+        usage.total_tokens = usage.prompt_tokens + usage.completion_tokens
+        return ChatCompletion(model=request.model, choices=choices, usage=usage)
+
+    async def _stream_chat(
+        self, request: ChatCompletionRequest, prompt_ids, params
+    ) -> AsyncIterator[ChatCompletionChunk]:
+        chunk_id = random_uuid("chatcmpl-")
+        yield ChatCompletionChunk(
+            id=chunk_id,
+            model=request.model,
+            choices=[
+                ChatCompletionChunkChoice(
+                    index=0, delta=ChatCompletionChunkDelta(role="assistant", content="")
+                )
+            ],
+        )
+        n_gen = 0
+        async for out in self._generate(prompt_ids, params):
+            n_gen = out.num_generated
+            chunk = ChatCompletionChunk(
+                id=chunk_id,
+                model=request.model,
+                choices=[
+                    ChatCompletionChunkChoice(
+                        index=0,
+                        delta=ChatCompletionChunkDelta(content=out.text_delta),
+                        finish_reason=out.finish_reason,
+                    )
+                ],
+            )
+            if (
+                request.stream_options
+                and request.stream_options.include_usage
+                and out.finished
+            ):
+                chunk.usage = UsageInfo(
+                    prompt_tokens=len(prompt_ids),
+                    completion_tokens=n_gen,
+                    total_tokens=len(prompt_ids) + n_gen,
+                )
+            yield chunk
+
+
+def main(argv=None):
+    from ..utils.backend import apply_platform_override
+
+    apply_platform_override()
+    parent = build_arg_parser()
+    parser = argparse.ArgumentParser(parents=[parent], conflict_handler="resolve")
+    parser.add_argument("--model_config", default=None, choices=sorted(_NAMED_CONFIGS))
+    parser.add_argument("--random_weights", action="store_true")
+    parser.add_argument("--tensor_parallel_size", "--tp", default=1, type=int)
+    parser.add_argument("--data_parallel_size", "--dp", default=1, type=int)
+    parser.add_argument("--max_batch_size", default=8, type=int)
+    parser.add_argument("--kv_pages", default=2048, type=int)
+    parser.add_argument("--page_size", default=16, type=int)
+    parser.add_argument("--max_model_len", default=2048, type=int)
+    parser.add_argument("--max_prefill_len", default=1024, type=int)
+    parser.add_argument("--kv_dtype", default="bfloat16", type=str)
+    args = parser.parse_args(argv)
+
+    model_config = _NAMED_CONFIGS[args.model_config]() if args.model_config else None
+    engine_config = EngineConfig(
+        max_batch_size=args.max_batch_size,
+        page_size=args.page_size,
+        num_pages=args.kv_pages,
+        max_pages_per_seq=max(1, args.max_model_len // args.page_size),
+        max_prefill_len=args.max_prefill_len,
+        tp=args.tensor_parallel_size,
+        dp=args.data_parallel_size,
+        dtype=args.kv_dtype,
+    )
+    model = JAXGenerativeModel(
+        args.model_name,
+        model_dir=args.model_dir if os.path.isdir(args.model_dir) else None,
+        model_config=model_config,
+        engine_config=engine_config,
+        random_weights=args.random_weights,
+    )
+    model.load()
+    ModelServer(
+        http_port=args.http_port,
+        grpc_port=args.grpc_port,
+        enable_grpc=args.enable_grpc,
+    ).start([model])
+
+
+if __name__ == "__main__":
+    main()

@@ -34,6 +34,9 @@ def build_model(framework: str, name: str, model_dir: str, predict_proba: bool =
 
 
 def main(argv=None):
+    from ..utils.backend import apply_platform_override
+
+    apply_platform_override()
     parent = build_arg_parser()
     parser = argparse.ArgumentParser(parents=[parent], conflict_handler="resolve")
     parser.add_argument("--framework", required=True, choices=sorted(FRAMEWORKS))

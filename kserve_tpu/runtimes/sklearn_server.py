@@ -16,7 +16,12 @@ from ..errors import InferenceError, InvalidInput
 from ..infer_type import InferRequest, InferResponse
 from ..logging import logger
 from ..model import Model
-from ..utils.inference import get_predict_input, get_predict_response, validate_feature_count
+from ..utils.inference import (
+    get_predict_input,
+    get_predict_response,
+    single_input_matrix,
+    validate_feature_count,
+)
 from .artifact import find_model_file
 from .tensorize.sklearn_convert import Tensorized, UnsupportedEstimator, convert_estimator, map_classes
 
@@ -54,9 +59,9 @@ class SKLearnModel(Model):
     def predict(
         self, payload: Union[Dict, InferRequest], headers=None, response_headers=None
     ) -> Union[Dict, InferResponse]:
-        instances = get_predict_input(payload)
+        instances = single_input_matrix(get_predict_input(payload), self.name)
         validate_feature_count(
-            np.asarray(instances), getattr(self._estimator, "n_features_in_", 0), self.name
+            instances, getattr(self._estimator, "n_features_in_", 0), self.name
         )
         try:
             if self._tensorized is not None:

@@ -12,11 +12,12 @@ Parity role: replaces the reference lgbserver's Booster.predict
 
 from __future__ import annotations
 
+import re
 from typing import Dict, List
 
 import numpy as np
 
-from .trees import Aggregation, ForestArrays, Link, build_forest, threshold_to_f32
+from .trees import Aggregation, ForestArrays, Link, build_forest, threshold_to_f32, tree_depth
 
 
 def _parse_sections(text: str) -> tuple:
@@ -107,31 +108,35 @@ def parse_lightgbm_text(path_or_text: str) -> ForestArrays:
         value = np.concatenate(
             [np.zeros(n_internal, dtype=np.float32), leaf_value.astype(np.float32)]
         )[:, None]
-        # depth via traversal
-        depth = 1
-        stack = [(0, 1)]
-        while stack:
-            node, d = stack.pop()
-            depth = max(depth, d)
-            if feature[node] >= 0:
-                stack.append((left[node], d + 1))
-                stack.append((right[node], d + 1))
-        max_depth = max(max_depth, depth)
+        # children arrays here are already remapped (leaves have feature=-1),
+        # so mask leaf self-loops for the shared depth helper
+        depth_left = np.where(feature >= 0, left, -1)
+        max_depth = max(max_depth, tree_depth(depth_left, right))
         trees.append((feature, threshold, left, right, value))
 
+    # objective line examples: "binary sigmoid:1", "multiclass num_class:3",
+    # "multiclassova num_class:3 sigmoid:1", "regression"
+    link_scale = 1.0
+    m = re.search(r"sigmoid:([0-9.]+)", objective)
+    if m:
+        link_scale = float(m.group(1))
     if objective.startswith("binary"):
         link = Link.SIGMOID
+    elif objective.startswith("multiclassova"):
+        link = Link.SIGMOID_EACH  # one-vs-all: independent sigmoid per class
     elif objective.startswith("multiclass"):
         link = Link.SOFTMAX
+        link_scale = 1.0
     else:
         link = Link.IDENTITY
+        link_scale = 1.0
     n_outputs = max(num_class, 1)
     class_of_tree = None
     if trees_per_iter > 1:
         class_of_tree = np.asarray(
             [i % trees_per_iter for i in range(len(trees))], dtype=np.int32
         )
-    return build_forest(
+    forest = build_forest(
         trees,
         max_depth=max_depth,
         n_features=n_features,
@@ -142,3 +147,5 @@ def parse_lightgbm_text(path_or_text: str) -> ForestArrays:
         class_of_tree=class_of_tree,
         strict_less=False,
     )
+    forest.link_scale = link_scale
+    return forest

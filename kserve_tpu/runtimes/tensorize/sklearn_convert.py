@@ -159,13 +159,16 @@ def _convert_gradient_boosting(est) -> Tensorized:
             trees.append((f, t, l, r, v * lr))
             class_of_tree.append(k)
     max_depth = max(t.get_depth() for row in stages for t in row)
-    # constant init contribution (DummyEstimator): probe at a zero point
+    # constant init contribution (DummyEstimator): probe at a zero point.
+    # _raw_predict_init is private sklearn API — if it moves, refuse to
+    # convert (native fallback) rather than silently dropping the prior.
     zero = np.zeros((1, est.n_features_in_), dtype=np.float64)
     try:
         base = est._raw_predict_init(zero)[0].astype(np.float32)
-    except Exception:
-        base = np.zeros((K,), dtype=np.float32)
-    n_out = K if not is_clf or len(est.classes_) > 2 else 1
+    except AttributeError as e:
+        raise UnsupportedEstimator(
+            f"GradientBoosting init probe failed ({e}); native fallback"
+        )
     forest = build_forest(
         trees,
         max_depth=max_depth,

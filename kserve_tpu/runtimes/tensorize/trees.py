@@ -54,6 +54,20 @@ class Link(Enum):
     SIGMOID = "sigmoid"  # binary logistic
     SOFTMAX = "softmax"  # multiclass
     NORMALIZE = "normalize"  # probability re-normalization (sklearn RF)
+    SIGMOID_EACH = "sigmoid_each"  # one-vs-all: independent sigmoid per class
+
+
+def tree_depth(left: np.ndarray, right: np.ndarray) -> int:
+    """Edge-count depth of a tree given child arrays (leaves: left < 0)."""
+    maxd = 0
+    stack = [(0, 0)]
+    while stack:
+        node, d = stack.pop()
+        maxd = max(maxd, d)
+        if left[node] >= 0:
+            stack.append((left[node], d + 1))
+            stack.append((right[node], d + 1))
+    return maxd
 
 
 @dataclass
@@ -81,6 +95,10 @@ class ForestArrays:
     # decision comparison: True -> go left when x < threshold (lgbm uses <=,
     # sklearn uses <=, xgboost uses <); encoded per-forest
     strict_less: bool = False
+    # margin multiplier applied before the link (LightGBM `sigmoid:K`)
+    link_scale: float = 1.0
+    # framework returns argmax labels, not probabilities (xgboost multi:softmax)
+    output_labels: bool = False
 
     @property
     def n_trees(self) -> int:
@@ -192,10 +210,14 @@ def forest_apply(forest: ForestArrays) -> Callable[[jnp.ndarray], jnp.ndarray]:
     return apply
 
 
-def apply_link(raw: jnp.ndarray, link: Link) -> jnp.ndarray:
+def apply_link(raw: jnp.ndarray, link: Link, scale: float = 1.0) -> jnp.ndarray:
+    if scale != 1.0:
+        raw = raw * scale
     if link == Link.SIGMOID:
         p1 = jax.nn.sigmoid(raw[..., 0])
         return jnp.stack([1.0 - p1, p1], axis=-1)
+    if link == Link.SIGMOID_EACH:
+        return jax.nn.sigmoid(raw)
     if link == Link.SOFTMAX:
         return jax.nn.softmax(raw, axis=-1)
     if link == Link.NORMALIZE:
@@ -212,6 +234,6 @@ def forest_predict_fn(forest: ForestArrays):
         return apply(X)
 
     def proba_fn(X):
-        return apply_link(apply(X), forest.link)
+        return apply_link(apply(X), forest.link, forest.link_scale)
 
     return proba_fn, raw_fn

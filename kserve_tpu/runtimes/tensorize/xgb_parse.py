@@ -19,20 +19,7 @@ from typing import Optional
 
 import numpy as np
 
-from .trees import Aggregation, ForestArrays, Link, build_forest, threshold_to_f32
-
-
-def _tree_depth(left: np.ndarray, right: np.ndarray) -> int:
-    depth = np.zeros(left.shape[0], dtype=np.int32)
-    maxd = 0
-    stack = [(0, 0)]
-    while stack:
-        node, d = stack.pop()
-        maxd = max(maxd, d)
-        if left[node] >= 0:
-            stack.append((left[node], d + 1))
-            stack.append((right[node], d + 1))
-    return maxd
+from .trees import Aggregation, ForestArrays, Link, build_forest, threshold_to_f32, tree_depth
 
 
 _LINKS = {
@@ -72,7 +59,7 @@ def parse_xgboost_json(path_or_dict) -> ForestArrays:
         feature = np.where(is_leaf, -1, split_idx).astype(np.int32)
         threshold = threshold_to_f32(np.where(is_leaf, 0.0, split_cond), strict=True)
         value = np.where(is_leaf, split_cond, 0.0).astype(np.float32)[:, None]
-        max_depth = max(max_depth, _tree_depth(left, right))
+        max_depth = max(max_depth, tree_depth(left, right))
         trees.append((feature, threshold, left, right, value))
 
     link = _LINKS.get(objective, Link.IDENTITY)
@@ -85,7 +72,7 @@ def parse_xgboost_json(path_or_dict) -> ForestArrays:
         base = math.log(base_score / (1.0 - base_score))
     else:
         base = base_score
-    return build_forest(
+    forest = build_forest(
         trees,
         max_depth=max_depth,
         n_features=n_features,
@@ -96,3 +83,6 @@ def parse_xgboost_json(path_or_dict) -> ForestArrays:
         class_of_tree=class_of_tree,
         strict_less=True,
     )
+    # multi:softmax: Booster.predict returns argmax class labels, not probs
+    forest.output_labels = objective == "multi:softmax"
+    return forest

@@ -1,0 +1,34 @@
+"""storage-initializer entrypoint: download (src, dest) pairs before the
+runtime container starts.
+
+Parity: reference python/storage-initializer/scripts/initializer-entrypoint.
+
+Usage: python -m kserve_tpu.storage.initializer <src-uri> <dest-dir> [...]
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..logging import configure_logging, logger
+from .storage import Storage
+
+
+def main(argv=None) -> int:
+    configure_logging()
+    args = list(argv if argv is not None else sys.argv[1:])
+    if len(args) < 2 or len(args) % 2 != 0:
+        print(
+            "usage: initializer <src-uri> <dest-dir> [<src-uri> <dest-dir> ...]",
+            file=sys.stderr,
+        )
+        return 2
+    pairs = list(zip(args[::2], args[1::2]))
+    for src, dest in pairs:
+        logger.info("initializer: %s -> %s", src, dest)
+        Storage.download(src, dest)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

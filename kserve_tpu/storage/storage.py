@@ -1,0 +1,217 @@
+"""Model artifact storage: URI-scheme dispatch + unpack.
+
+Parity: reference python/storage/kserve_storage/kserve_storage.py:47-64
+(scheme table) — gs://, s3://, hdfs/webhdfs, azure blob/file, pvc://,
+local file://, http(s)://, hf://.  Cloud SDKs are not in this image, so
+those providers are import-gated: the scheme is recognized, the download
+raises a clear error unless the SDK is present.  file/pvc/http(s)/hf-local
+paths are fully functional.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+import shutil
+import tarfile
+import tempfile
+import zipfile
+from typing import Callable, Dict, List, Optional
+from urllib.parse import urlparse
+
+from ..logging import logger
+
+_LOCAL_PREFIX = "file://"
+_PVC_PREFIX = "pvc://"
+
+
+class StorageError(RuntimeError):
+    pass
+
+
+def _require(module: str, provider: str):
+    try:
+        return __import__(module)
+    except ImportError as e:
+        raise StorageError(
+            f"{provider} download requires the '{module}' package, which is "
+            f"not installed in this image"
+        ) from e
+
+
+class Storage:
+    """`Storage.download(uri, out_dir)` -> local directory with artifacts."""
+
+    @staticmethod
+    def download(uri: str, out_dir: Optional[str] = None) -> str:
+        if out_dir is None:
+            out_dir = tempfile.mkdtemp()
+        os.makedirs(out_dir, exist_ok=True)
+        logger.info("Downloading %s to %s", uri, out_dir)
+        if uri.startswith(_LOCAL_PREFIX) or uri.startswith("/"):
+            return Storage._download_local(uri, out_dir)
+        if uri.startswith(_PVC_PREFIX):
+            return Storage._download_pvc(uri, out_dir)
+        if uri.startswith(("http://", "https://")):
+            return Storage._download_http(uri, out_dir)
+        if uri.startswith("gs://"):
+            return Storage._download_gcs(uri, out_dir)
+        if uri.startswith(("s3://", "s3a://")):
+            return Storage._download_s3(uri, out_dir)
+        if uri.startswith(("hdfs://", "webhdfs://")):
+            return Storage._download_hdfs(uri, out_dir)
+        if uri.startswith("hf://"):
+            return Storage._download_hf(uri, out_dir)
+        if re.match(r"https?://(.+?)\.blob\.core\.windows\.net/(.+)", uri):
+            return Storage._download_azure_blob(uri, out_dir)
+        raise StorageError(
+            f"Cannot recognize storage type for {uri!r}; supported prefixes: "
+            "[file://, pvc://, gs://, s3://, hdfs://, webhdfs://, hf://, http(s)://]"
+        )
+
+    @staticmethod
+    def download_files(uris: List[str], out_dirs: List[str]) -> List[str]:
+        if len(uris) != len(out_dirs):
+            raise StorageError("uris and out_dirs length mismatch")
+        return [Storage.download(u, d) for u, d in zip(uris, out_dirs)]
+
+    # ---------------- local-capable providers ----------------
+
+    @staticmethod
+    def _download_local(uri: str, out_dir: str) -> str:
+        path = uri[len(_LOCAL_PREFIX):] if uri.startswith(_LOCAL_PREFIX) else uri
+        if not os.path.exists(path):
+            raise StorageError(f"local path {path} does not exist")
+        if os.path.isdir(path):
+            for entry in sorted(glob.glob(os.path.join(path, "*"))):
+                dest = os.path.join(out_dir, os.path.basename(entry))
+                if os.path.isdir(entry):
+                    shutil.copytree(entry, dest, dirs_exist_ok=True)
+                else:
+                    shutil.copy2(entry, dest)
+                    _maybe_unpack(dest, out_dir)
+        else:
+            dest = os.path.join(out_dir, os.path.basename(path))
+            shutil.copy2(path, dest)
+            _maybe_unpack(dest, out_dir)
+        return out_dir
+
+    @staticmethod
+    def _download_pvc(uri: str, out_dir: str) -> str:
+        # pvc://{name}/{path} — the PVC is mounted at /mnt/pvc/{name} by the
+        # storage-initializer injector (controlplane/webhook.py)
+        rest = uri[len(_PVC_PREFIX):]
+        pvc_name, _, subpath = rest.partition("/")
+        local = os.path.join("/mnt", "pvc", pvc_name, subpath)
+        return Storage._download_local(local, out_dir)
+
+    @staticmethod
+    def _download_http(uri: str, out_dir: str) -> str:
+        import httpx
+
+        name = os.path.basename(urlparse(uri).path) or "model"
+        dest = os.path.join(out_dir, name)
+        with httpx.stream("GET", uri, follow_redirects=True, timeout=600) as r:
+            if r.status_code != 200:
+                raise StorageError(f"GET {uri} -> HTTP {r.status_code}")
+            with open(dest, "wb") as f:
+                for chunk in r.iter_bytes():
+                    f.write(chunk)
+        _maybe_unpack(dest, out_dir)
+        return out_dir
+
+    @staticmethod
+    def _download_hf(uri: str, out_dir: str) -> str:
+        """hf://{org}/{repo}[:revision] via huggingface_hub when present;
+        honors HF_HUB_OFFLINE caches."""
+        try:
+            from huggingface_hub import snapshot_download
+        except ImportError as e:
+            raise StorageError(
+                "hf:// download requires huggingface_hub, not installed"
+            ) from e
+        spec = uri[len("hf://"):]
+        repo, _, revision = spec.partition(":")
+        snapshot_download(
+            repo_id=repo, revision=revision or None, local_dir=out_dir
+        )
+        return out_dir
+
+    # ---------------- SDK-gated providers ----------------
+
+    @staticmethod
+    def _download_gcs(uri: str, out_dir: str) -> str:
+        gcs = _require("google.cloud.storage", "gs://")
+        from google.cloud import storage as gcs_storage  # type: ignore
+
+        parsed = urlparse(uri)
+        bucket_name, prefix = parsed.netloc, parsed.path.lstrip("/")
+        client = gcs_storage.Client()
+        bucket = client.bucket(bucket_name)
+        count = 0
+        for blob in bucket.list_blobs(prefix=prefix):
+            if blob.name.endswith("/"):
+                continue
+            rel = os.path.relpath(blob.name, prefix) if blob.name != prefix else os.path.basename(blob.name)
+            dest = os.path.join(out_dir, rel)
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            blob.download_to_filename(dest)
+            _maybe_unpack(dest, out_dir)
+            count += 1
+        if count == 0:
+            raise StorageError(f"no objects under {uri}")
+        return out_dir
+
+    @staticmethod
+    def _download_s3(uri: str, out_dir: str) -> str:
+        _require("boto3", "s3://")
+        import boto3  # type: ignore
+
+        parsed = urlparse(uri)
+        bucket, prefix = parsed.netloc, parsed.path.lstrip("/")
+        kwargs = {}
+        if os.getenv("AWS_ENDPOINT_URL"):
+            kwargs["endpoint_url"] = os.getenv("AWS_ENDPOINT_URL")
+        s3 = boto3.client("s3", **kwargs)
+        paginator = s3.get_paginator("list_objects_v2")
+        count = 0
+        for page in paginator.paginate(Bucket=bucket, Prefix=prefix):
+            for obj in page.get("Contents", []):
+                key = obj["Key"]
+                if key.endswith("/"):
+                    continue
+                rel = os.path.relpath(key, prefix) if key != prefix else os.path.basename(key)
+                dest = os.path.join(out_dir, rel)
+                os.makedirs(os.path.dirname(dest), exist_ok=True)
+                s3.download_file(bucket, key, dest)
+                _maybe_unpack(dest, out_dir)
+                count += 1
+        if count == 0:
+            raise StorageError(f"no objects under {uri}")
+        return out_dir
+
+    @staticmethod
+    def _download_hdfs(uri: str, out_dir: str) -> str:
+        _require("hdfs", "hdfs://")
+        raise StorageError("hdfs provider not yet implemented in this build")
+
+    @staticmethod
+    def _download_azure_blob(uri: str, out_dir: str) -> str:
+        _require("azure.storage.blob", "azure blob")
+        raise StorageError("azure provider not yet implemented in this build")
+
+
+def _maybe_unpack(path: str, out_dir: str) -> None:
+    """Unpack model archives in place (tar/tgz/zip), mirroring the reference
+    behavior of exploding archives into the model mount."""
+    if path.endswith((".tar", ".tar.gz", ".tgz")):
+        with tarfile.open(path) as tar:
+            tar.extractall(out_dir, filter="data")
+        os.remove(path)
+    elif path.endswith(".zip"):
+        with zipfile.ZipFile(path) as z:
+            z.extractall(out_dir)
+        os.remove(path)

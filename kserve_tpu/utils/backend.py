@@ -1,0 +1,27 @@
+"""JAX backend selection helper.
+
+This image ships an `axon` PJRT plugin that force-selects itself via
+JAX_PLATFORMS at import time, so the usual env vars are unreliable.  Calling
+jax.config.update("jax_platforms", ...) before backend init is the only
+selector that always wins; runtimes call `apply_platform_override()` first
+thing so `JAX_PLATFORM_NAME=cpu` behaves as users expect.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..logging import logger
+
+
+def apply_platform_override() -> None:
+    want = os.environ.get("JAX_PLATFORM_NAME", "").strip().lower()
+    if not want:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", want)
+        logger.info("JAX platform forced to %s via JAX_PLATFORM_NAME", want)
+    except Exception as e:  # pragma: no cover — backend already initialized
+        logger.warning("could not force JAX platform %s: %s", want, e)

@@ -14,6 +14,19 @@ from ..errors import InvalidInput
 from ..infer_type import InferInput, InferOutput, InferRequest, InferResponse
 
 
+def single_input_matrix(instances, model_name: str) -> np.ndarray:
+    """Predictive runtimes take exactly one input tensor; 400 otherwise."""
+    if isinstance(instances, list):
+        raise InvalidInput(
+            f"model {model_name} expects a single input tensor, got "
+            f"{len(instances)} named inputs"
+        )
+    try:
+        return np.asarray(instances)
+    except (ValueError, TypeError) as e:
+        raise InvalidInput(f"malformed instances for model {model_name}: {e}")
+
+
 def validate_feature_count(instances: np.ndarray, n_features: int, model_name: str) -> None:
     """400 (not an XLA shape error) when the input width doesn't match."""
     if n_features and instances.ndim >= 2 and instances.shape[-1] != n_features:
@@ -39,7 +52,10 @@ def get_predict_input(payload: Union[Dict, InferRequest]) -> Union[np.ndarray, L
         ):
             # column-style records -> 2-D array in key order of first record
             keys = list(instances[0].keys())
-            return np.asarray([[row[k] for k in keys] for row in instances])
+            try:
+                return np.asarray([[row[k] for k in keys] for row in instances])
+            except (KeyError, TypeError) as e:
+                raise InvalidInput(f"inconsistent record keys in instances: {e}")
         return np.asarray(instances)
     raise InvalidInput(f"unsupported payload type {type(payload).__name__}")
 

@@ -4,14 +4,22 @@ tests run without TPU hardware (the driver separately dry-runs multichip)."""
 import os
 import sys
 
-# NOTE: in this image the axon TPU plugin ignores JAX_PLATFORMS; the legacy
-# JAX_PLATFORM_NAME (or jax.config.update) is what actually forces CPU.
+# NOTE: in this image the axon TPU plugin ignores JAX_PLATFORMS, and pytest
+# plugins import jax before this conftest runs, so env vars alone are too
+# late.  jax.config.update works any time before backend init, which hasn't
+# happened at collection time.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
 if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
     )
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", "tests must run on the virtual CPU mesh"
+assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

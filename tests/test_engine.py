@@ -1,0 +1,161 @@
+"""LLM engine tests: streaming generation, continuous batching, stop
+conditions, greedy determinism — tiny model, 8-device CPU mesh (tp=2)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from kserve_tpu.engine.engine import EngineConfig, GenerationOutput, LLMEngine
+from kserve_tpu.engine.sampling import SamplingParams
+from kserve_tpu.engine.tokenizer import ByteTokenizer, IncrementalDetokenizer
+from kserve_tpu.models.llama import LlamaConfig
+
+from conftest import async_test
+
+
+def make_engine(tp=1, **cfg_overrides):
+    model_config = LlamaConfig.tiny(dtype="float32")
+    cfg = dict(
+        max_batch_size=4,
+        page_size=8,
+        num_pages=64,
+        max_pages_per_seq=8,
+        max_prefill_len=32,
+        prefill_buckets=(16, 32),
+        tp=tp,
+        dtype="float32",
+        use_pallas=False,
+    )
+    cfg.update(cfg_overrides)
+    tokenizer = ByteTokenizer(model_config.vocab_size)
+    return LLMEngine(model_config, EngineConfig(**cfg), tokenizer)
+
+
+async def collect(engine, prompt, params):
+    outs = []
+    async for out in engine.generate(prompt, params):
+        outs.append(out)
+    return outs
+
+
+class TestEngine:
+    @async_test
+    async def test_generate_streams_tokens(self):
+        engine = make_engine()
+        await engine.start()
+        try:
+            outs = await collect(
+                engine, [1, 2, 3, 4], SamplingParams(max_tokens=8, temperature=0.0)
+            )
+            assert len(outs) == 8
+            assert outs[-1].finished
+            assert outs[-1].finish_reason in ("stop", "length")
+            assert all(isinstance(o.token_id, int) for o in outs)
+        finally:
+            await engine.stop()
+
+    @async_test
+    async def test_greedy_is_deterministic(self):
+        engine = make_engine()
+        await engine.start()
+        try:
+            a = await collect(engine, [5, 6, 7], SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True))
+            b = await collect(engine, [5, 6, 7], SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True))
+            assert [o.token_id for o in a] == [o.token_id for o in b]
+        finally:
+            await engine.stop()
+
+    @async_test
+    async def test_concurrent_requests_batched(self):
+        engine = make_engine()
+        await engine.start()
+        try:
+            results = await asyncio.gather(
+                collect(engine, [1, 2], SamplingParams(max_tokens=5, temperature=0.0, ignore_eos=True)),
+                collect(engine, [3, 4], SamplingParams(max_tokens=5, temperature=0.0, ignore_eos=True)),
+                collect(engine, [5, 6], SamplingParams(max_tokens=5, temperature=0.0, ignore_eos=True)),
+            )
+            for outs in results:
+                assert len(outs) == 5
+                assert outs[-1].finished
+        finally:
+            await engine.stop()
+
+    @async_test
+    async def test_batching_matches_solo_greedy(self):
+        """Tokens from a batched run must equal a solo run (slot isolation)."""
+        engine = make_engine()
+        await engine.start()
+        try:
+            solo = await collect(engine, [9, 8, 7], SamplingParams(max_tokens=5, temperature=0.0, ignore_eos=True))
+            batched = await asyncio.gather(
+                collect(engine, [9, 8, 7], SamplingParams(max_tokens=5, temperature=0.0, ignore_eos=True)),
+                collect(engine, [1, 1, 1, 1, 1], SamplingParams(max_tokens=5, temperature=0.0, ignore_eos=True)),
+            )
+            assert [o.token_id for o in solo] == [o.token_id for o in batched[0]]
+        finally:
+            await engine.stop()
+
+    @async_test
+    async def test_tp2_matches_tp1_greedy(self):
+        e1 = make_engine(tp=1)
+        e2 = make_engine(tp=2)
+        # same weights: both engines seed params identically (PRNGKey(1))
+        await e1.start()
+        await e2.start()
+        try:
+            a = await collect(e1, [4, 4, 4], SamplingParams(max_tokens=5, temperature=0.0, ignore_eos=True))
+            b = await collect(e2, [4, 4, 4], SamplingParams(max_tokens=5, temperature=0.0, ignore_eos=True))
+            assert [o.token_id for o in a] == [o.token_id for o in b]
+        finally:
+            await e1.stop()
+            await e2.stop()
+
+    @async_test
+    async def test_max_tokens_respected(self):
+        engine = make_engine()
+        await engine.start()
+        try:
+            outs = await collect(engine, [1], SamplingParams(max_tokens=3, temperature=0.0, ignore_eos=True))
+            assert len(outs) == 3
+            assert outs[-1].finish_reason == "length"
+        finally:
+            await engine.stop()
+
+    @async_test
+    async def test_prompt_too_long_rejected(self):
+        engine = make_engine()
+        await engine.start()
+        try:
+            with pytest.raises(ValueError):
+                async for _ in engine.generate(list(range(100)), SamplingParams()):
+                    pass
+        finally:
+            await engine.stop()
+
+    @async_test
+    async def test_more_requests_than_slots(self):
+        engine = make_engine(max_batch_size=2)
+        await engine.start()
+        try:
+            results = await asyncio.gather(
+                *[
+                    collect(engine, [i + 1], SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True))
+                    for i in range(5)
+                ]
+            )
+            assert all(len(r) == 4 for r in results)
+        finally:
+            await engine.stop()
+
+
+class TestDetokenizer:
+    def test_incremental_utf8(self):
+        tok = ByteTokenizer()
+        detok = IncrementalDetokenizer(tok)
+        text = "héllo ✓"
+        deltas = [detok.push(t) for t in text.encode("utf-8")]
+        assert "".join(deltas) == text
+        # multibyte chars must not emit partial replacement chars
+        assert "�" not in "".join(deltas)

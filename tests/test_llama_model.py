@@ -1,0 +1,180 @@
+"""Llama forward parity against HuggingFace transformers (torch CPU oracle),
+plus paged decode == prefill consistency."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from kserve_tpu.engine.kvcache import KVCacheConfig, init_kv_pages
+from kserve_tpu.models.llama import LlamaConfig, decode_step, init_params, prefill
+
+
+def make_cache(config, num_pages=32, page_size=8, max_pages=8):
+    cache_cfg = KVCacheConfig(
+        n_layers=config.n_layers,
+        n_kv_heads=config.n_kv_heads,
+        head_dim=config.head_dim,
+        page_size=page_size,
+        num_pages=num_pages,
+        max_pages_per_seq=max_pages,
+        dtype="float32",
+    )
+    return cache_cfg, init_kv_pages(cache_cfg)
+
+
+class TestPrefillDecodeConsistency:
+    def test_decode_matches_prefill_logits(self):
+        """Prefilling [t0..tn] must give the same last-token logits as
+        prefilling [t0..tn-1] then decoding tn through the paged cache."""
+        config = LlamaConfig.tiny(dtype="float32")
+        params = init_params(config, jax.random.PRNGKey(0))
+        cache_cfg, pages = make_cache(config)
+        rng = np.random.RandomState(0)
+        prompt = rng.randint(0, config.vocab_size, size=14)
+
+        # full prefill of the whole prompt
+        page_ids = jnp.asarray([[1, 2, 3, 4, 0, 0, 0, 0]], jnp.int32)
+        tokens = jnp.asarray(prompt[None, :], jnp.int32)
+        full_logits, _ = prefill(
+            params, config, tokens, jnp.asarray([14]), pages, page_ids, cache_cfg.page_size
+        )
+
+        # prefill first 13, decode the 14th
+        _, pages2 = prefill(
+            params,
+            config,
+            jnp.asarray(prompt[None, :13], jnp.int32),
+            jnp.asarray([13]),
+            init_kv_pages(cache_cfg),
+            page_ids,
+            cache_cfg.page_size,
+        )
+        dec_logits, _ = decode_step(
+            params,
+            config,
+            jnp.asarray([prompt[13]], jnp.int32),
+            jnp.asarray([13], jnp.int32),
+            pages2,
+            page_ids,
+            jnp.asarray([True]),
+            cache_cfg.page_size,
+            use_pallas=False,
+        )
+        np.testing.assert_allclose(
+            np.asarray(full_logits), np.asarray(dec_logits), rtol=2e-4, atol=2e-4
+        )
+
+    def test_batched_decode_slots_independent(self):
+        """Two sequences decoding in the same batch must not interfere."""
+        config = LlamaConfig.tiny(dtype="float32")
+        params = init_params(config, jax.random.PRNGKey(0))
+        cache_cfg, pages = make_cache(config)
+        rng = np.random.RandomState(1)
+        p1 = rng.randint(0, config.vocab_size, size=10)
+        p2 = rng.randint(0, config.vocab_size, size=7)
+
+        # prefill both into separate pages, decode together
+        page_ids = jnp.asarray(
+            [[1, 2, 0, 0, 0, 0, 0, 0], [3, 4, 0, 0, 0, 0, 0, 0]], jnp.int32
+        )
+        padded = np.zeros((2, 10), np.int32)
+        padded[0, :10] = p1
+        padded[1, :7] = p2
+        _, pages = prefill(
+            params, config, jnp.asarray(padded), jnp.asarray([10, 7]), pages,
+            page_ids, cache_cfg.page_size,
+        )
+        batch_logits, _ = decode_step(
+            params, config,
+            jnp.asarray([5, 9], jnp.int32), jnp.asarray([10, 7], jnp.int32),
+            pages, page_ids, jnp.asarray([True, True]), cache_cfg.page_size,
+            use_pallas=False,
+        )
+
+        # solo decode of sequence 2 only
+        cache_cfg2, solo_pages = make_cache(config)
+        solo_page_ids = jnp.asarray([[3, 4, 0, 0, 0, 0, 0, 0]], jnp.int32)
+        _, solo_pages = prefill(
+            params, config, jnp.asarray(padded[1:2, :7]), jnp.asarray([7]),
+            solo_pages, solo_page_ids, cache_cfg.page_size,
+        )
+        solo_logits, _ = decode_step(
+            params, config, jnp.asarray([9], jnp.int32), jnp.asarray([7], jnp.int32),
+            solo_pages, solo_page_ids, jnp.asarray([True]), cache_cfg.page_size,
+            use_pallas=False,
+        )
+        np.testing.assert_allclose(
+            np.asarray(batch_logits[1]), np.asarray(solo_logits[0]), rtol=2e-4, atol=2e-4
+        )
+
+
+@pytest.mark.parametrize("n_kv_heads", [4, 2])
+class TestHFParity:
+    def test_logits_match_transformers(self, n_kv_heads):
+        torch = pytest.importorskip("torch")
+        from transformers import LlamaConfig as HFConfig
+        from transformers import LlamaForCausalLM
+
+        hf_config = HFConfig(
+            vocab_size=128,
+            hidden_size=32,
+            intermediate_size=64,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=n_kv_heads,
+            max_position_embeddings=64,
+            rope_theta=10000.0,
+            tie_word_embeddings=False,
+        )
+        torch.manual_seed(0)
+        hf_model = LlamaForCausalLM(hf_config).eval()
+
+        config = LlamaConfig.from_hf_config(hf_config.to_dict())
+        config.dtype = "float32"
+        params = _params_from_hf(hf_model, config)
+
+        prompt = np.array([[1, 5, 9, 33, 77, 100, 2, 64]], dtype=np.int64)
+        with torch.no_grad():
+            ref = hf_model(torch.from_numpy(prompt)).logits.numpy()  # [1,T,V]
+
+        cache_cfg, pages = make_cache(config)
+        page_ids = jnp.asarray([[1, 2, 0, 0, 0, 0, 0, 0]], jnp.int32)
+        got_last, _ = prefill(
+            params, config, jnp.asarray(prompt, jnp.int32), jnp.asarray([8]),
+            pages, page_ids, cache_cfg.page_size,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_last)[0], ref[0, -1], rtol=2e-3, atol=2e-3
+        )
+
+
+def _params_from_hf(hf_model, config):
+    """torch state_dict -> functional param pytree (transpose Linear)."""
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    params = {
+        "embed": jnp.asarray(sd["model.embed_tokens.weight"], jnp.float32),
+        "final_norm": jnp.asarray(sd["model.norm.weight"], jnp.float32),
+        "layers": [],
+    }
+    if "lm_head.weight" in sd:
+        params["lm_head"] = jnp.asarray(sd["lm_head.weight"].T, jnp.float32)
+    mapping = {
+        "attn_norm": ("input_layernorm.weight", False),
+        "wq": ("self_attn.q_proj.weight", True),
+        "wk": ("self_attn.k_proj.weight", True),
+        "wv": ("self_attn.v_proj.weight", True),
+        "wo": ("self_attn.o_proj.weight", True),
+        "mlp_norm": ("post_attention_layernorm.weight", False),
+        "w_gate": ("mlp.gate_proj.weight", True),
+        "w_up": ("mlp.up_proj.weight", True),
+        "w_down": ("mlp.down_proj.weight", True),
+    }
+    for i in range(config.n_layers):
+        layer = {}
+        for ours, (suffix, transpose) in mapping.items():
+            w = sd[f"model.layers.{i}.{suffix}"]
+            layer[ours] = jnp.asarray(w.T if transpose else w, jnp.float32)
+        params["layers"].append(layer)
+    return params

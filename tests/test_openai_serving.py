@@ -1,0 +1,170 @@
+"""OpenAI protocol over the real engine: REST in-proc tests (tiny model)."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from kserve_tpu import ModelRepository
+from kserve_tpu.engine.engine import EngineConfig
+from kserve_tpu.models.llama import LlamaConfig
+from kserve_tpu.protocol.model_repository_extension import ModelRepositoryExtension
+from kserve_tpu.protocol.openai.dataplane import OpenAIDataPlane
+from kserve_tpu.protocol.rest.server import RESTServer
+from kserve_tpu.runtimes.generative_server import JAXGenerativeModel
+
+from conftest import async_test
+
+
+def make_model(name="tinyllm"):
+    return JAXGenerativeModel(
+        name,
+        model_config=LlamaConfig.tiny(dtype="float32"),
+        engine_config=EngineConfig(
+            max_batch_size=2,
+            page_size=8,
+            num_pages=64,
+            max_pages_per_seq=8,
+            max_prefill_len=32,
+            prefill_buckets=(16, 32),
+            dtype="float32",
+            use_pallas=False,
+        ),
+        random_weights=True,
+    )
+
+
+async def make_client(model):
+    model.load()
+    await model.start_engine()
+    repo = ModelRepository()
+    repo.update(model)
+    dataplane = OpenAIDataPlane(repo)
+    server = RESTServer(dataplane, ModelRepositoryExtension(repo))
+    client = TestClient(TestServer(server.create_application()))
+    await client.start_server()
+    return client
+
+
+class TestOpenAIServing:
+    @async_test
+    async def test_models_list(self):
+        model = make_model()
+        client = await make_client(model)
+        try:
+            res = await client.get("/openai/v1/models")
+            body = await res.json()
+            assert body["data"][0]["id"] == "tinyllm"
+        finally:
+            await client.close()
+            await model.engine.stop()
+
+    @async_test
+    async def test_completion(self):
+        model = make_model()
+        client = await make_client(model)
+        try:
+            res = await client.post(
+                "/openai/v1/completions",
+                json={
+                    "model": "tinyllm",
+                    "prompt": "hello",
+                    "max_tokens": 5,
+                    "temperature": 0,
+                    "ignore_eos": True,
+                },
+            )
+            assert res.status == 200
+            body = await res.json()
+            assert body["object"] == "text_completion"
+            assert body["usage"]["completion_tokens"] == 5
+            assert body["choices"][0]["finish_reason"] == "length"
+        finally:
+            await client.close()
+            await model.engine.stop()
+
+    @async_test
+    async def test_chat_completion(self):
+        model = make_model()
+        client = await make_client(model)
+        try:
+            res = await client.post(
+                "/openai/v1/chat/completions",
+                json={
+                    "model": "tinyllm",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 4,
+                    "temperature": 0,
+                    "ignore_eos": True,
+                },
+            )
+            assert res.status == 200
+            body = await res.json()
+            assert body["object"] == "chat.completion"
+            assert body["choices"][0]["message"]["role"] == "assistant"
+            assert body["usage"]["completion_tokens"] == 4
+        finally:
+            await client.close()
+            await model.engine.stop()
+
+    @async_test
+    async def test_chat_streaming_sse(self):
+        model = make_model()
+        client = await make_client(model)
+        try:
+            res = await client.post(
+                "/openai/v1/chat/completions",
+                json={
+                    "model": "tinyllm",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 4,
+                    "temperature": 0,
+                    "ignore_eos": True,
+                    "stream": True,
+                    "stream_options": {"include_usage": True},
+                },
+            )
+            assert res.status == 200
+            assert res.headers["Content-Type"].startswith("text/event-stream")
+            raw = (await res.read()).decode()
+            events = [
+                json.loads(line[len("data: "):])
+                for line in raw.strip().split("\n\n")
+                if line.startswith("data: ") and "[DONE]" not in line
+            ]
+            assert raw.strip().endswith("data: [DONE]")
+            assert events[0]["choices"][0]["delta"]["role"] == "assistant"
+            finals = [e for e in events if e["choices"][0].get("finish_reason")]
+            assert finals and finals[-1]["usage"]["completion_tokens"] == 4
+        finally:
+            await client.close()
+            await model.engine.stop()
+
+    @async_test
+    async def test_unknown_model_404(self):
+        model = make_model()
+        client = await make_client(model)
+        try:
+            res = await client.post(
+                "/openai/v1/completions",
+                json={"model": "ghost", "prompt": "x"},
+            )
+            assert res.status == 404
+        finally:
+            await client.close()
+            await model.engine.stop()
+
+    @async_test
+    async def test_invalid_body_400(self):
+        model = make_model()
+        client = await make_client(model)
+        try:
+            res = await client.post(
+                "/openai/v1/chat/completions",
+                json={"model": "tinyllm"},  # missing messages
+            )
+            assert res.status == 400
+        finally:
+            await client.close()
+            await model.engine.stop()

@@ -1,0 +1,67 @@
+"""Storage layer tests: local/pvc/archive/unrecognized paths."""
+
+import os
+import tarfile
+import zipfile
+
+import pytest
+
+from kserve_tpu.storage.storage import Storage, StorageError
+
+
+class TestLocalStorage:
+    def test_download_dir(self, tmp_path):
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "model.joblib").write_bytes(b"weights")
+        (src / "meta.json").write_text("{}")
+        out = tmp_path / "out"
+        result = Storage.download(f"file://{src}", str(out))
+        assert sorted(os.listdir(result)) == ["meta.json", "model.joblib"]
+
+    def test_download_bare_path(self, tmp_path):
+        src = tmp_path / "model.bin"
+        src.write_bytes(b"x")
+        out = Storage.download(str(src), str(tmp_path / "out"))
+        assert os.path.exists(os.path.join(out, "model.bin"))
+
+    def test_missing_path(self, tmp_path):
+        with pytest.raises(StorageError):
+            Storage.download(f"file://{tmp_path}/nope", str(tmp_path / "out"))
+
+    def test_tar_unpacked(self, tmp_path):
+        inner = tmp_path / "model.txt"
+        inner.write_text("tree")
+        tar_path = tmp_path / "model.tar.gz"
+        with tarfile.open(tar_path, "w:gz") as tar:
+            tar.add(inner, arcname="model.txt")
+        out = Storage.download(str(tar_path), str(tmp_path / "out"))
+        assert os.path.exists(os.path.join(out, "model.txt"))
+        assert not os.path.exists(os.path.join(out, "model.tar.gz"))
+
+    def test_zip_unpacked(self, tmp_path):
+        zip_path = tmp_path / "model.zip"
+        with zipfile.ZipFile(zip_path, "w") as z:
+            z.writestr("model.txt", "zipped")
+        out = Storage.download(str(zip_path), str(tmp_path / "out"))
+        assert os.path.exists(os.path.join(out, "model.txt"))
+
+    def test_unknown_scheme(self, tmp_path):
+        with pytest.raises(StorageError):
+            Storage.download("ftp://example.com/model", str(tmp_path))
+
+    def test_gated_provider_message(self, tmp_path):
+        with pytest.raises(StorageError) as e:
+            Storage.download("s3://bucket/model", str(tmp_path))
+        assert "boto3" in str(e.value)
+
+    def test_download_files_multi(self, tmp_path):
+        a = tmp_path / "a.bin"
+        a.write_bytes(b"a")
+        b = tmp_path / "b.bin"
+        b.write_bytes(b"b")
+        outs = Storage.download_files(
+            [str(a), str(b)], [str(tmp_path / "oa"), str(tmp_path / "ob")]
+        )
+        assert os.path.exists(os.path.join(outs[0], "a.bin"))
+        assert os.path.exists(os.path.join(outs[1], "b.bin"))
