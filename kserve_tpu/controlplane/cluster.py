@@ -1,0 +1,236 @@
+"""In-memory fake apiserver + controller manager.
+
+The envtest analogue (SURVEY.md §4: controller-integration tier): objects
+live in a dict store with apply/get/list/delete semantics; the
+ControllerManager watches the store and runs the reconcilers, writing
+desired objects and status back — so controller tests assert synthesized
+Deployments/Services/HTTPRoutes exactly the way the reference asserts
+envtest objects, without a cluster.
+
+Parity role: cmd/manager/main.go wiring + envtest bootstrap
+(pkg/controller/v1alpha2/llmisvc/fixture/envtest.go).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .crds import (
+    ClusterServingRuntime,
+    InferenceGraph,
+    InferenceService,
+    LLMInferenceService,
+    LLMInferenceServiceConfig,
+    ServingRuntime,
+    TrainedModel,
+)
+from .default_runtimes import default_runtimes
+from .llmisvc import LLMISVCReconciler
+from .reconciler import InferenceServiceReconciler
+from .registry import RuntimeRegistry
+
+Key = Tuple[str, str, str]  # (kind, namespace, name)
+
+
+class FakeCluster:
+    """Dict-backed object store with server-side-apply-ish semantics."""
+
+    def __init__(self):
+        self._objects: Dict[Key, dict] = {}
+        self._generation = 0
+
+    @staticmethod
+    def _key(obj: dict) -> Key:
+        meta = obj.get("metadata", {})
+        return (obj.get("kind", ""), meta.get("namespace", ""), meta.get("name", ""))
+
+    def apply(self, obj: dict) -> dict:
+        self._generation += 1
+        key = self._key(obj)
+        existing = self._objects.get(key)
+        if existing is not None and "status" in existing and "status" not in obj:
+            obj = dict(obj)
+            obj["status"] = existing["status"]
+        self._objects[key] = obj
+        return obj
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> Optional[dict]:
+        return self._objects.get((kind, namespace, name))
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> List[dict]:
+        return [
+            obj
+            for (k, ns, _), obj in sorted(self._objects.items())
+            if k == kind and (namespace is None or ns == namespace)
+        ]
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> bool:
+        return self._objects.pop((kind, namespace, name), None) is not None
+
+    def update_status(self, kind: str, name: str, namespace: str, status: dict) -> None:
+        obj = self.get(kind, name, namespace)
+        if obj is not None:
+            obj["status"] = status
+
+
+class ControllerManager:
+    """Runs all reconcilers against the cluster until convergence."""
+
+    def __init__(self, cluster: Optional[FakeCluster] = None,
+                 install_default_runtimes: bool = True,
+                 ingress_domain: str = "example.com"):
+        self.cluster = cluster or FakeCluster()
+        self.registry = RuntimeRegistry()
+        if install_default_runtimes:
+            for rt in default_runtimes():
+                self.registry.add(rt)
+                self.cluster.apply(rt.model_dump())
+        self.isvc_reconciler = InferenceServiceReconciler(
+            self.registry, ingress_domain=ingress_domain
+        )
+        self.llm_reconciler = LLMISVCReconciler(ingress_domain=ingress_domain)
+
+    # ---------------- apply entrypoints (the kubectl surface) ----------------
+
+    def apply(self, obj) -> dict:
+        """kubectl-apply analogue: validates typed CRDs, stores, reconciles."""
+        if isinstance(obj, dict):
+            obj = self._parse(obj)
+        stored = self.cluster.apply(obj.model_dump())
+        if isinstance(obj, (ServingRuntime, ClusterServingRuntime)):
+            self.registry.add(obj)
+        elif isinstance(obj, LLMInferenceServiceConfig):
+            self.llm_reconciler.presets[obj.metadata.name] = obj
+        else:
+            self.reconcile_object(obj)
+        return stored
+
+    _KINDS = {
+        "InferenceService": InferenceService,
+        "ServingRuntime": ServingRuntime,
+        "ClusterServingRuntime": ClusterServingRuntime,
+        "LLMInferenceService": LLMInferenceService,
+        "LLMInferenceServiceConfig": LLMInferenceServiceConfig,
+        "TrainedModel": TrainedModel,
+        "InferenceGraph": InferenceGraph,
+    }
+
+    def _parse(self, obj: dict):
+        kind = obj.get("kind")
+        cls = self._KINDS.get(kind)
+        if cls is None:
+            raise ValueError(f"unknown kind {kind!r}")
+        return cls.model_validate(obj)
+
+    def reconcile_object(self, obj) -> None:
+        if isinstance(obj, InferenceService):
+            desired, status = self.isvc_reconciler.reconcile(obj)
+        elif isinstance(obj, LLMInferenceService):
+            desired, status = self.llm_reconciler.reconcile(obj)
+        elif isinstance(obj, TrainedModel):
+            desired, status = self._reconcile_trained_model(obj)
+        elif isinstance(obj, InferenceGraph):
+            desired, status = self._reconcile_graph(obj)
+        else:
+            return
+        for d in desired:
+            self.cluster.apply(d)
+        self._prune_owned(obj, desired)
+        self.cluster.update_status(
+            obj.kind, obj.metadata.name, obj.metadata.namespace, status
+        )
+
+    def _prune_owned(self, owner_obj, desired: List[dict]) -> None:
+        """Garbage-collect children owned by this object that are no longer
+        desired (the apiserver's ownerReference GC, done eagerly)."""
+        desired_keys = {FakeCluster._key(d) for d in desired}
+        owner_ns = owner_obj.metadata.namespace
+        for key, obj in list(self.cluster._objects.items()):
+            if obj.get("metadata", {}).get("namespace") != owner_ns:
+                continue  # ownerReferences are namespace-local
+            refs = obj.get("metadata", {}).get("ownerReferences", [])
+            for ref in refs:
+                if (
+                    ref.get("kind") == owner_obj.kind
+                    and ref.get("name") == owner_obj.metadata.name
+                    and key not in desired_keys
+                ):
+                    del self.cluster._objects[key]
+                    break
+
+    def reconcile_all(self) -> None:
+        for kind in ("InferenceService", "LLMInferenceService", "TrainedModel", "InferenceGraph"):
+            for obj in self.cluster.list(kind):
+                self.reconcile_object(self._parse(obj))
+
+    # ---------------- small controllers ----------------
+
+    def _reconcile_trained_model(self, tm: TrainedModel):
+        """Multi-model serving: append the model entry to the parent ISVC's
+        modelconfig ConfigMap, which the agent sidecar watches
+        (parity: pkg/controller/v1alpha1/trainedmodel + modelconfig)."""
+        from .objects import make_object, set_condition
+
+        parent = tm.spec.inferenceService
+        cm_name = f"modelconfig-{parent}-0"
+        cm = self.cluster.get("ConfigMap", cm_name, tm.metadata.namespace)
+        import json
+
+        entries = []
+        if cm is not None:
+            entries = json.loads(cm["data"].get("models.json", "[]"))
+        entries = [e for e in entries if e.get("modelName") != tm.metadata.name]
+        entries.append(
+            {
+                "modelName": tm.metadata.name,
+                "modelSpec": tm.spec.model,
+            }
+        )
+        cm = make_object("v1", "ConfigMap", cm_name, tm.metadata.namespace)
+        cm["data"] = {"models.json": json.dumps(entries, sort_keys=True)}
+        status: dict = {}
+        set_condition(status, "Ready", True, reason="ModelConfigUpdated")
+        return [cm], status
+
+    def _reconcile_graph(self, graph: InferenceGraph):
+        """Deploy the router service executing the graph spec
+        (parity: pkg/controller/v1alpha1/inferencegraph)."""
+        import json
+
+        from .objects import make_object, set_condition
+
+        name = graph.metadata.name
+        namespace = graph.metadata.namespace
+        spec_json = json.dumps(graph.spec.model_dump(exclude_none=True), sort_keys=True)
+        deployment = make_object(
+            "apps/v1", "Deployment", name, namespace,
+            labels={"app": name},
+            spec={
+                "replicas": graph.spec.minReplicas or 1,
+                "selector": {"matchLabels": {"app": name}},
+                "template": {
+                    "metadata": {"labels": {"app": name}},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "router",
+                                "image": "kserve-tpu/router:latest",
+                                "command": ["python", "-m", "kserve_tpu.graph.router"],
+                                "args": ["--graph-json", spec_json, "--port", "8080"],
+                                "ports": [{"containerPort": 8080}],
+                            }
+                        ]
+                    },
+                },
+            },
+        )
+        service = make_object(
+            "v1", "Service", name, namespace, labels={"app": name},
+            spec={"selector": {"app": name},
+                  "ports": [{"name": "http", "port": 80, "targetPort": 8080}]},
+        )
+        status: dict = {
+            "url": f"http://{name}.{namespace}.{self.isvc_reconciler.ingress_domain}"
+        }
+        set_condition(status, "Ready", True, reason="RouterDeployed")
+        return [deployment, service], status

@@ -1,0 +1,366 @@
+"""CRD schemas (pydantic) for the TPU-native control plane.
+
+API groups mirror the reference's:
+- serving.kserve.io/v1beta1   InferenceService (predictor/transformer/
+  explainer components, per-framework predictor shortcuts, canary)
+- serving.kserve.io/v1alpha1  ServingRuntime/ClusterServingRuntime,
+  TrainedModel, InferenceGraph, LocalModelCache, ClusterStorageContainer
+- serving.kserve.io/v1alpha2  LLMInferenceService (generative spec with
+  ParallelismSpec over TPU mesh axes, prefill/decode disaggregation, router)
+
+Parity: pkg/apis/serving/{v1beta1,v1alpha1,v1alpha2} — field semantics kept,
+GPU-isms replaced by TPU topology (accelerator selectors become
+google.com/tpu resources + gke-tpu-topology node selectors; ParallelismSpec
+maps to mesh axes instead of vLLM flags).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Literal, Optional, Union
+
+from pydantic import BaseModel, ConfigDict, Field
+
+GROUP = "serving.kserve.io"
+V1BETA1 = f"{GROUP}/v1beta1"
+V1ALPHA1 = f"{GROUP}/v1alpha1"
+V1ALPHA2 = f"{GROUP}/v1alpha2"
+
+DEPLOYMENT_MODE_ANNOTATION = f"{GROUP}/deploymentMode"
+AUTOSCALER_CLASS_ANNOTATION = f"{GROUP}/autoscalerClass"
+STOP_ANNOTATION = f"{GROUP}/stop"
+
+TPU_RESOURCE = "google.com/tpu"
+TPU_TOPOLOGY_SELECTOR = "cloud.google.com/gke-tpu-topology"
+TPU_ACCELERATOR_SELECTOR = "cloud.google.com/gke-tpu-accelerator"
+
+
+class K8sModel(BaseModel):
+    model_config = ConfigDict(extra="allow", populate_by_name=True)
+
+
+class ObjectMeta(K8sModel):
+    name: str = ""
+    namespace: str = "default"
+    labels: Dict[str, str] = Field(default_factory=dict)
+    annotations: Dict[str, str] = Field(default_factory=dict)
+    uid: str = ""
+
+
+# ---------------- v1beta1: InferenceService ----------------
+
+
+class ModelFormat(K8sModel):
+    name: str
+    version: Optional[str] = None
+
+
+class StorageSpec(K8sModel):
+    path: Optional[str] = None
+    key: Optional[str] = None
+    storageUri: Optional[str] = None
+    parameters: Dict[str, str] = Field(default_factory=dict)
+
+
+class ModelSpec(K8sModel):
+    """Predictor `model` block: format-driven runtime selection."""
+
+    modelFormat: ModelFormat
+    runtime: Optional[str] = None
+    storageUri: Optional[str] = None
+    storage: Optional[StorageSpec] = None
+    protocolVersion: Optional[str] = None
+    resources: Dict[str, Dict[str, str]] = Field(default_factory=dict)
+    runtimeVersion: Optional[str] = None
+    args: List[str] = Field(default_factory=list)
+    env: List[Dict[str, Any]] = Field(default_factory=list)
+
+
+class FrameworkSpec(K8sModel):
+    """Legacy per-framework predictor shortcut (sklearn:, xgboost:, ...)."""
+
+    storageUri: Optional[str] = None
+    runtimeVersion: Optional[str] = None
+    protocolVersion: Optional[str] = None
+    resources: Dict[str, Dict[str, str]] = Field(default_factory=dict)
+    args: List[str] = Field(default_factory=list)
+    env: List[Dict[str, Any]] = Field(default_factory=list)
+
+
+class WorkerSpec(K8sModel):
+    """Multi-host predictor (TPU pod slices). tensorParallelSize counts
+    chips per host-group; pipelineParallelSize counts host groups."""
+
+    size: Optional[int] = None
+    tensorParallelSize: Optional[int] = None
+    pipelineParallelSize: Optional[int] = None
+    containers: List[Dict[str, Any]] = Field(default_factory=list)
+
+
+class ComponentExtensionSpec(K8sModel):
+    minReplicas: Optional[int] = None
+    maxReplicas: Optional[int] = None
+    scaleTarget: Optional[int] = None
+    scaleMetric: Optional[str] = None  # concurrency|rps|cpu|memory|tokens-per-second
+    containerConcurrency: Optional[int] = None
+    timeout: Optional[int] = None
+    canaryTrafficPercent: Optional[int] = None
+    batcher: Optional[Dict[str, Any]] = None
+    logger: Optional[Dict[str, Any]] = None
+
+
+class PredictorSpec(ComponentExtensionSpec):
+    model: Optional[ModelSpec] = None
+    sklearn: Optional[FrameworkSpec] = None
+    xgboost: Optional[FrameworkSpec] = None
+    lightgbm: Optional[FrameworkSpec] = None
+    huggingface: Optional[FrameworkSpec] = None
+    containers: List[Dict[str, Any]] = Field(default_factory=list)
+    workerSpec: Optional[WorkerSpec] = None
+    serviceAccountName: Optional[str] = None
+    nodeSelector: Dict[str, str] = Field(default_factory=dict)
+    tolerations: List[Dict[str, Any]] = Field(default_factory=list)
+    volumes: List[Dict[str, Any]] = Field(default_factory=list)
+
+    _FRAMEWORKS = ("sklearn", "xgboost", "lightgbm", "huggingface")
+
+    def resolved_model(self) -> Optional[ModelSpec]:
+        """Normalize framework shortcuts into the ModelSpec form."""
+        if self.model is not None:
+            return self.model
+        for fw in self._FRAMEWORKS:
+            spec = getattr(self, fw)
+            if spec is not None:
+                return ModelSpec(
+                    modelFormat=ModelFormat(name=fw),
+                    storageUri=spec.storageUri,
+                    runtimeVersion=spec.runtimeVersion,
+                    protocolVersion=spec.protocolVersion,
+                    resources=spec.resources,
+                    args=spec.args,
+                    env=spec.env,
+                )
+        return None
+
+
+class TransformerSpec(ComponentExtensionSpec):
+    containers: List[Dict[str, Any]] = Field(default_factory=list)
+
+
+class ExplainerSpec(ComponentExtensionSpec):
+    art: Optional[Dict[str, Any]] = None
+    containers: List[Dict[str, Any]] = Field(default_factory=list)
+
+
+class InferenceServiceSpec(K8sModel):
+    predictor: PredictorSpec
+    transformer: Optional[TransformerSpec] = None
+    explainer: Optional[ExplainerSpec] = None
+
+
+class InferenceService(K8sModel):
+    apiVersion: str = V1BETA1
+    kind: Literal["InferenceService"] = "InferenceService"
+    metadata: ObjectMeta
+    spec: InferenceServiceSpec
+    status: Dict[str, Any] = Field(default_factory=dict)
+
+
+# ---------------- v1alpha1: ServingRuntime ----------------
+
+
+class SupportedModelFormat(K8sModel):
+    name: str
+    version: Optional[str] = None
+    autoSelect: bool = False
+    priority: Optional[int] = None
+
+
+class ServingRuntimeSpec(K8sModel):
+    supportedModelFormats: List[SupportedModelFormat] = Field(default_factory=list)
+    containers: List[Dict[str, Any]] = Field(default_factory=list)
+    protocolVersions: List[str] = Field(default_factory=list)
+    multiModel: bool = False
+    disabled: bool = False
+    nodeSelector: Dict[str, str] = Field(default_factory=dict)
+    tolerations: List[Dict[str, Any]] = Field(default_factory=list)
+    volumes: List[Dict[str, Any]] = Field(default_factory=list)
+    workerSpec: Optional[Dict[str, Any]] = None
+
+
+class ServingRuntime(K8sModel):
+    apiVersion: str = V1ALPHA1
+    kind: Literal["ServingRuntime"] = "ServingRuntime"
+    metadata: ObjectMeta
+    spec: ServingRuntimeSpec
+
+
+class ClusterServingRuntime(K8sModel):
+    apiVersion: str = V1ALPHA1
+    kind: Literal["ClusterServingRuntime"] = "ClusterServingRuntime"
+    metadata: ObjectMeta
+    spec: ServingRuntimeSpec
+
+
+# ---------------- v1alpha1: TrainedModel / InferenceGraph / LocalModelCache ----------------
+
+
+class TrainedModelSpec(K8sModel):
+    inferenceService: str
+    model: Dict[str, Any] = Field(default_factory=dict)  # framework/storageUri/memory
+
+
+class TrainedModel(K8sModel):
+    apiVersion: str = V1ALPHA1
+    kind: Literal["TrainedModel"] = "TrainedModel"
+    metadata: ObjectMeta
+    spec: TrainedModelSpec
+    status: Dict[str, Any] = Field(default_factory=dict)
+
+
+class InferenceStep(K8sModel):
+    name: Optional[str] = None
+    serviceName: Optional[str] = None
+    serviceUrl: Optional[str] = None
+    nodeName: Optional[str] = None
+    data: Optional[str] = None
+    weight: Optional[int] = None
+    condition: Optional[str] = None
+    dependency: Optional[str] = None  # Soft | Hard
+
+
+class InferenceRouter(K8sModel):
+    routerType: Literal["Sequence", "Splitter", "Ensemble", "Switch"]
+    steps: List[InferenceStep] = Field(default_factory=list)
+
+
+class InferenceGraphSpec(K8sModel):
+    nodes: Dict[str, InferenceRouter]
+    resources: Dict[str, Any] = Field(default_factory=dict)
+    minReplicas: Optional[int] = None
+    maxReplicas: Optional[int] = None
+    timeout: Optional[int] = None
+
+
+class InferenceGraph(K8sModel):
+    apiVersion: str = V1ALPHA1
+    kind: Literal["InferenceGraph"] = "InferenceGraph"
+    metadata: ObjectMeta
+    spec: InferenceGraphSpec
+    status: Dict[str, Any] = Field(default_factory=dict)
+
+
+class LocalModelCacheSpec(K8sModel):
+    sourceModelUri: str
+    modelSize: Optional[str] = None
+    nodeGroups: List[str] = Field(default_factory=list)
+
+
+class LocalModelCache(K8sModel):
+    apiVersion: str = V1ALPHA1
+    kind: Literal["LocalModelCache"] = "LocalModelCache"
+    metadata: ObjectMeta
+    spec: LocalModelCacheSpec
+    status: Dict[str, Any] = Field(default_factory=dict)
+
+
+class ClusterStorageContainerSpec(K8sModel):
+    container: Dict[str, Any] = Field(default_factory=dict)
+    supportedUriFormats: List[Dict[str, str]] = Field(default_factory=list)
+
+
+class ClusterStorageContainer(K8sModel):
+    apiVersion: str = V1ALPHA1
+    kind: Literal["ClusterStorageContainer"] = "ClusterStorageContainer"
+    metadata: ObjectMeta
+    spec: ClusterStorageContainerSpec
+
+
+# ---------------- v1alpha2: LLMInferenceService ----------------
+
+
+class ParallelismSpec(K8sModel):
+    """Mesh-axis sizes (parity: llm_inference_service_types.go:679-703, but
+    expressed as JAX mesh axes rather than vLLM flags)."""
+
+    tensor: Optional[int] = None  # ICI TP within a slice
+    data: Optional[int] = None  # engine replicas (DP)
+    dataLocal: Optional[int] = None
+    pipeline: Optional[int] = None  # across host groups (DCN)
+    expert: bool = False  # MoE expert sharding
+    sequence: Optional[int] = None  # ring-attention SP for long context
+
+    def tp(self) -> int:
+        return self.tensor or 1
+
+    def dp(self) -> int:
+        return self.data or 1
+
+
+class LLMModelSpec(K8sModel):
+    uri: str
+    name: Optional[str] = None
+    loraAdapters: List[Dict[str, Any]] = Field(default_factory=list)
+
+
+class KVCacheOffloadingSpec(K8sModel):
+    """HBM -> host RAM KV tiering (parity: llm_inference_service_types.go:188)."""
+
+    enabled: bool = False
+    hostMemoryGi: Optional[int] = None
+    evictionPolicy: Literal["lru", "arc"] = "lru"
+
+
+class WorkloadSpec(K8sModel):
+    replicas: Optional[int] = None
+    parallelism: Optional[ParallelismSpec] = None
+    template: Optional[Dict[str, Any]] = None  # pod template override
+    worker: Optional[Dict[str, Any]] = None  # multi-host worker template
+    kvCacheOffloading: Optional[KVCacheOffloadingSpec] = None
+    maxBatchSize: Optional[int] = None
+    maxModelLen: Optional[int] = None
+
+
+class SchedulerSpec(K8sModel):
+    """EPP-style endpoint-picker scheduler."""
+
+    enabled: bool = True
+    template: Optional[Dict[str, Any]] = None
+
+
+class RouterSpec(K8sModel):
+    gateway: Optional[Dict[str, Any]] = None
+    route: Optional[Dict[str, Any]] = None
+    ingress: Optional[Dict[str, Any]] = None
+    scheduler: Optional[SchedulerSpec] = None
+
+
+class TracingSpec(K8sModel):
+    enabled: bool = False
+    otlpEndpoint: Optional[str] = None
+    samplingRate: Optional[str] = None
+
+
+class LLMInferenceServiceSpec(K8sModel):
+    model: LLMModelSpec
+    workload: Optional[WorkloadSpec] = None
+    prefill: Optional[WorkloadSpec] = None  # P/D disaggregation
+    router: Optional[RouterSpec] = None
+    tracing: Optional[TracingSpec] = None
+    baseRefs: List[Dict[str, str]] = Field(default_factory=list)
+
+
+class LLMInferenceService(K8sModel):
+    apiVersion: str = V1ALPHA2
+    kind: Literal["LLMInferenceService"] = "LLMInferenceService"
+    metadata: ObjectMeta
+    spec: LLMInferenceServiceSpec
+    status: Dict[str, Any] = Field(default_factory=dict)
+
+
+class LLMInferenceServiceConfig(K8sModel):
+    """Well-known preset merged via baseRefs (parity: config_loader.go)."""
+
+    apiVersion: str = V1ALPHA2
+    kind: Literal["LLMInferenceServiceConfig"] = "LLMInferenceServiceConfig"
+    metadata: ObjectMeta
+    spec: Dict[str, Any] = Field(default_factory=dict)

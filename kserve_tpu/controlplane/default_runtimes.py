@@ -1,0 +1,81 @@
+"""Built-in ClusterServingRuntimes (the analogue of config/runtimes/*.yaml).
+
+Two TPU-first runtimes replace the reference's fifteen CUDA-era images:
+- kserve-tpu-predictive: sklearn/xgboost/lightgbm via the XLA tensorizer
+  (one image, --framework flag; parity config/runtimes/kserve-*server.yaml)
+- kserve-tpu-generative: the JAX LLM engine (parity
+  config/runtimes/kserve-huggingfaceserver.yaml, vLLM flags -> engine flags)
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .crds import (
+    ClusterServingRuntime,
+    ObjectMeta,
+    ServingRuntimeSpec,
+    SupportedModelFormat,
+)
+
+PREDICTIVE_IMAGE = "kserve-tpu/predictive:latest"
+GENERATIVE_IMAGE = "kserve-tpu/generative:latest"
+
+
+def default_runtimes() -> List[ClusterServingRuntime]:
+    predictive = ClusterServingRuntime(
+        metadata=ObjectMeta(name="kserve-tpu-predictive", namespace=""),
+        spec=ServingRuntimeSpec(
+            supportedModelFormats=[
+                SupportedModelFormat(name="sklearn", version="1", autoSelect=True, priority=1),
+                SupportedModelFormat(name="xgboost", version="2", autoSelect=True, priority=1),
+                SupportedModelFormat(name="lightgbm", version="4", autoSelect=True, priority=1),
+            ],
+            protocolVersions=["v1", "v2", "grpc-v2"],
+            containers=[
+                {
+                    "name": "kserve-container",
+                    "image": PREDICTIVE_IMAGE,
+                    "command": ["python", "-m", "kserve_tpu.runtimes.predictive_server"],
+                    "args": [
+                        "--model_name={{.Name}}",
+                        "--model_dir=/mnt/models",
+                        "--http_port=8080",
+                        "--grpc_port=8081",
+                    ],
+                    "resources": {
+                        "requests": {"cpu": "1", "memory": "2Gi"},
+                        "limits": {"cpu": "1", "memory": "2Gi"},
+                    },
+                }
+            ],
+        ),
+    )
+    generative = ClusterServingRuntime(
+        metadata=ObjectMeta(name="kserve-tpu-generative", namespace=""),
+        spec=ServingRuntimeSpec(
+            supportedModelFormats=[
+                SupportedModelFormat(name="huggingface", autoSelect=True, priority=2),
+                SupportedModelFormat(name="llama", autoSelect=True, priority=2),
+            ],
+            protocolVersions=["v2", "openai"],
+            containers=[
+                {
+                    "name": "kserve-container",
+                    "image": GENERATIVE_IMAGE,
+                    "command": ["python", "-m", "kserve_tpu.runtimes.generative_server"],
+                    "args": [
+                        "--model_name={{.Name}}",
+                        "--model_dir=/mnt/models",
+                        "--http_port=8080",
+                        "--grpc_port=8081",
+                    ],
+                    "resources": {
+                        "requests": {"cpu": "4", "memory": "16Gi"},
+                        "limits": {"cpu": "8", "memory": "32Gi"},
+                    },
+                }
+            ],
+        ),
+    )
+    return [predictive, generative]

@@ -1,0 +1,286 @@
+"""LLMInferenceService reconciler: the generative control plane.
+
+Parity map (pkg/controller/v1alpha2/llmisvc/):
+- preset merge via baseRefs           (config_loader.go/config_merge.go)
+- workload: decode (+ prefill) deployments, single- or multi-host
+  (workload.go:49, workload_single_node.go, workload_multi_node.go) —
+  multi-host groups use a headless peer service + host-count annotations
+  (LeaderWorkerSet analogue) and jax.distributed coordinator env instead
+  of Ray bootstrap
+- parallelism -> TPU slice plan       (replaces vllm --tensor-parallel-size
+  flag templating in config-llm-template.yaml:166-200)
+- scheduler: endpoint-picker deployment + InferencePool-style selector
+  (scheduler.go:73-521)
+- router: HTTPRoute with optional P/D split (router.go:67)
+- scaling: KEDA tokens/sec trigger    (scaling.go:135-440)
+- tracing: OTEL env injection         (tracing.go:34-120)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .crds import (
+    LLMInferenceService,
+    LLMInferenceServiceConfig,
+    ParallelismSpec,
+    WorkloadSpec,
+)
+from .objects import make_object, set_condition, set_owner, strategic_merge
+from .topology import plan_slice
+from .webhook import PodMutator
+
+GENERATIVE_IMAGE = "kserve-tpu/generative:latest"
+
+
+class LLMISVCReconciler:
+    def __init__(self, presets: Optional[Dict[str, LLMInferenceServiceConfig]] = None,
+                 mutator: Optional[PodMutator] = None,
+                 ingress_domain: str = "example.com"):
+        self.presets = presets or {}
+        self.mutator = mutator or PodMutator()
+        self.ingress_domain = ingress_domain
+
+    def reconcile(self, llm: LLMInferenceService) -> Tuple[List[dict], dict]:
+        spec = self._merge_presets(llm)
+        status: dict = dict(llm.status)
+        objects: List[dict] = []
+
+        decode_objs = self._workload(llm, spec.workload or WorkloadSpec(), role="decode",
+                                     model_uri=spec.model.uri)
+        objects.extend(decode_objs)
+        if spec.prefill is not None:
+            objects.extend(
+                self._workload(llm, spec.prefill, role="prefill", model_uri=spec.model.uri)
+            )
+            set_condition(status, "PrefillWorkloadReady", True, reason="Reconciled")
+        set_condition(status, "WorkloadReady", True, reason="Reconciled")
+
+        if spec.router is not None:
+            objects.extend(self._scheduler(llm, spec))
+            objects.append(self._route(llm, spec))
+            set_condition(status, "RouterReady", True, reason="Reconciled")
+
+        scaler = self._scaling(llm, spec.workload or WorkloadSpec())
+        if scaler is not None:
+            objects.append(scaler)
+
+        if spec.tracing and spec.tracing.enabled:
+            self._inject_tracing(objects, spec)
+
+        owner = {
+            "apiVersion": llm.apiVersion,
+            "kind": llm.kind,
+            "metadata": llm.metadata.model_dump(),
+        }
+        for obj in objects:
+            set_owner(obj, owner)
+        status["url"] = (
+            f"http://{llm.metadata.name}.{llm.metadata.namespace}.{self.ingress_domain}"
+        )
+        set_condition(status, "Ready", True, reason="Reconciled")
+        return objects, status
+
+    # ---------------- presets ----------------
+
+    def _merge_presets(self, llm: LLMInferenceService):
+        """baseRefs presets merge lowest-to-highest precedence, the live spec
+        wins last (parity: config_merge.go)."""
+        merged: dict = {}
+        for ref in llm.spec.baseRefs:
+            preset = self.presets.get(ref.get("name", ""))
+            if preset is None:
+                raise ValueError(f"unknown baseRef preset {ref.get('name')!r}")
+            merged = strategic_merge(merged, preset.spec)
+        merged = strategic_merge(merged, llm.spec.model_dump(exclude_none=True))
+        from .crds import LLMInferenceServiceSpec
+
+        return LLMInferenceServiceSpec.model_validate(merged)
+
+    # ---------------- workload ----------------
+
+    def _workload(self, llm, workload: WorkloadSpec, role: str, model_uri: str) -> List[dict]:
+        name = f"{llm.metadata.name}-kserve-{role}" if role == "prefill" else f"{llm.metadata.name}-kserve"
+        namespace = llm.metadata.namespace
+        par = workload.parallelism or ParallelismSpec()
+        plan = plan_slice(
+            tp=par.tp(),
+            dp_local=par.dataLocal or 1,
+            num_slices=par.pipeline or 1,
+            sequence=par.sequence or 1,
+        )
+        args = [
+            f"--model_name={llm.spec.model.name or llm.metadata.name}",
+            "--model_dir=/mnt/models",
+            f"--tensor_parallel_size={par.tp()}",
+            f"--data_parallel_size={par.dp()}",
+        ]
+        if par.sequence:
+            args.append(f"--sequence_parallel_size={par.sequence}")
+        if workload.maxBatchSize:
+            args.append(f"--max_batch_size={workload.maxBatchSize}")
+        if workload.maxModelLen:
+            args.append(f"--max_model_len={workload.maxModelLen}")
+        if role == "prefill":
+            args.append("--role=prefill")
+        if workload.kvCacheOffloading and workload.kvCacheOffloading.enabled:
+            args.append("--kv_offload=host")
+            if workload.kvCacheOffloading.hostMemoryGi:
+                args.append(
+                    f"--kv_offload_gib={workload.kvCacheOffloading.hostMemoryGi}"
+                )
+        container = {
+            "name": "main",
+            "image": GENERATIVE_IMAGE,
+            "command": ["python", "-m", "kserve_tpu.runtimes.generative_server"],
+            "args": args,
+            "ports": [{"containerPort": 8080, "name": "http"}],
+        }
+        pod_spec: dict = {"containers": [container]}
+        if workload.template:
+            pod_spec = strategic_merge(pod_spec, workload.template)
+        from .crds import ModelSpec, ModelFormat
+
+        pod_spec = self.mutator.mutate(
+            pod_spec,
+            isvc_metadata=llm.metadata.model_dump(),
+            model=ModelSpec(modelFormat=ModelFormat(name="huggingface"), storageUri=model_uri),
+            slice_plan=plan,
+        )
+        labels = {
+            "app": name,
+            "serving.kserve.io/llminferenceservice": llm.metadata.name,
+            "kserve.io/component": role,
+        }
+        replicas = (workload.replicas or 1) * plan.hosts * plan.num_slices
+        deployment = make_object(
+            "apps/v1", "Deployment", name, namespace, labels=dict(labels),
+            spec={
+                "replicas": replicas,
+                "selector": {"matchLabels": {"app": name}},
+                "template": {"metadata": {"labels": dict(labels)}, "spec": pod_spec},
+            },
+        )
+        objects = [deployment]
+        if plan.hosts > 1:
+            deployment["metadata"]["annotations"] = {
+                "serving.kserve.io/tpu-slice-hosts": str(plan.hosts),
+            }
+            # jax.distributed coordination across the slice's hosts — write
+            # into the FINAL pod spec (strategic_merge deep-copied the
+            # original container dict)
+            final = deployment["spec"]["template"]["spec"]["containers"][0]
+            final["env"] = final.get("env", []) + [
+                {"name": "COORDINATOR_ADDRESS", "value": f"{name}-peers.{namespace}:8476"},
+                {"name": "NUM_PROCESSES", "value": str(plan.hosts)},
+            ]
+            objects.append(
+                make_object(
+                    "v1", "Service", f"{name}-peers", namespace, labels=dict(labels),
+                    spec={"clusterIP": "None", "selector": {"app": name},
+                          "ports": [{"name": "coord", "port": 8476}]},
+                )
+            )
+        objects.append(
+            make_object(
+                "v1", "Service", name, namespace, labels=dict(labels),
+                spec={"selector": {"app": name},
+                      "ports": [{"name": "http", "port": 80, "targetPort": 8080}]},
+            )
+        )
+        return objects
+
+    # ---------------- scheduler / router / scaling / tracing ----------------
+
+    def _scheduler(self, llm, spec) -> List[dict]:
+        if spec.router.scheduler is None or not spec.router.scheduler.enabled:
+            return []
+        name = f"{llm.metadata.name}-epp"
+        namespace = llm.metadata.namespace
+        pool_selector = {
+            "serving.kserve.io/llminferenceservice": llm.metadata.name,
+            "kserve.io/component": "decode",
+        }
+        epp = make_object(
+            "apps/v1", "Deployment", name, namespace,
+            spec={
+                "replicas": 1,
+                "selector": {"matchLabels": {"app": name}},
+                "template": {
+                    "metadata": {"labels": {"app": name}},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "epp",
+                                "image": "kserve-tpu/scheduler:latest",
+                                "args": [
+                                    f"--pool-selector=serving.kserve.io/llminferenceservice={llm.metadata.name}",
+                                    "--strategy=prefix-cache,queue-depth",
+                                ],
+                                "ports": [{"containerPort": 9002, "name": "grpc-ext-proc"}],
+                            }
+                        ]
+                    },
+                },
+            },
+        )
+        pool = make_object(
+            "inference.networking.k8s.io/v1", "InferencePool",
+            f"{llm.metadata.name}-pool", namespace,
+            spec={
+                "selector": pool_selector,
+                "targetPortNumber": 8080,
+                "extensionRef": {"name": name},
+            },
+        )
+        return [epp, pool]
+
+    def _route(self, llm, spec) -> dict:
+        name = llm.metadata.name
+        namespace = llm.metadata.namespace
+        backend = f"{name}-kserve"
+        return make_object(
+            "gateway.networking.k8s.io/v1", "HTTPRoute", name, namespace,
+            spec={
+                "hostnames": [f"{name}.{namespace}.{self.ingress_domain}"],
+                "rules": [
+                    {
+                        "matches": [{"path": {"type": "PathPrefix", "value": "/"}}],
+                        "backendRefs": [{"name": backend, "port": 80}],
+                    }
+                ],
+            },
+        )
+
+    def _scaling(self, llm, workload: WorkloadSpec) -> Optional[dict]:
+        name = f"{llm.metadata.name}-kserve"
+        return make_object(
+            "keda.sh/v1alpha1", "ScaledObject", name, llm.metadata.namespace,
+            spec={
+                "scaleTargetRef": {"name": name},
+                "minReplicaCount": workload.replicas or 1,
+                "maxReplicaCount": max((workload.replicas or 1) * 4, 4),
+                "triggers": [
+                    {
+                        "type": "prometheus",
+                        "metadata": {
+                            "query": f'rate(engine_generated_tokens_total{{pod=~"{name}.*"}}[1m])',
+                            "threshold": "1000",
+                        },
+                    }
+                ],
+            },
+        )
+
+    def _inject_tracing(self, objects: List[dict], spec) -> None:
+        env = [
+            {"name": "OTEL_EXPORTER_OTLP_ENDPOINT",
+             "value": spec.tracing.otlpEndpoint or "http://otel-collector:4317"},
+            {"name": "OTEL_TRACES_SAMPLER", "value": "parentbased_traceidratio"},
+            {"name": "OTEL_TRACES_SAMPLER_ARG", "value": spec.tracing.samplingRate or "0.1"},
+        ]
+        for obj in objects:
+            if obj["kind"] != "Deployment":
+                continue
+            for c in obj["spec"]["template"]["spec"].get("containers", []):
+                c["env"] = c.get("env", []) + env

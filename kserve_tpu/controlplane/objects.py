@@ -1,0 +1,164 @@
+"""Kubernetes-object plumbing for the control plane.
+
+Objects are plain dicts (apiVersion/kind/metadata/spec/status) —
+the same wire format kubectl sees — with helpers for ownership, conditions
+and strategic-merge-patch semantics (dict deep-merge; lists of named objects
+merged by their `name` key; scalar lists replaced).
+
+Parity role: the apimachinery/strategicpatch behavior the reference leans on
+in MergePodSpec (pkg/controller/v1beta1/inferenceservice/utils/utils.go:267)
+re-implemented for dict-shaped objects.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional
+
+# list fields merged by a key rather than replaced (k8s patchMergeKey table)
+_MERGE_KEYS = {
+    "containers": "name",
+    "initContainers": "name",
+    "volumes": "name",
+    "env": "name",
+    "envFrom": None,
+    "volumeMounts": "mountPath",
+    "ports": "containerPort",
+    "imagePullSecrets": "name",
+    "tolerations": None,
+}
+
+
+def deep_copy(obj):
+    return copy.deepcopy(obj)
+
+
+def strategic_merge(base: Any, override: Any, field: Optional[str] = None) -> Any:
+    """k8s strategic-merge-patch over dicts: maps merge recursively, named
+    lists merge by key, everything else is replaced by the override."""
+    if override is None:
+        return deep_copy(base)
+    if base is None:
+        return deep_copy(override)
+    if isinstance(base, dict) and isinstance(override, dict):
+        out = deep_copy(base)
+        for k, v in override.items():
+            out[k] = strategic_merge(base.get(k), v, field=k)
+        return out
+    if isinstance(base, list) and isinstance(override, list):
+        merge_key = _MERGE_KEYS.get(field) if field in _MERGE_KEYS else None
+        if merge_key is None:
+            return deep_copy(override)
+        out: List = []
+        base_by_key = {
+            item.get(merge_key): item for item in base if isinstance(item, dict)
+        }
+        seen = set()
+        for item in override:
+            key = item.get(merge_key) if isinstance(item, dict) else None
+            if key is not None and key in base_by_key:
+                out.append(strategic_merge(base_by_key[key], item))
+                seen.add(key)
+            else:
+                out.append(deep_copy(item))
+        for item in base:
+            key = item.get(merge_key) if isinstance(item, dict) else None
+            if key is None or key not in seen:
+                if item not in out:
+                    out.append(deep_copy(item))
+        return out
+    return deep_copy(override)
+
+
+def merge_container(runtime_container: dict, isvc_container: dict) -> dict:
+    """Runtime/user container merge: strategic merge + args CONCATENATED
+    (user args extend runtime flags; parity with MergeRuntimeContainers,
+    utils.go:253-263)."""
+    merged = strategic_merge(runtime_container, isvc_container)
+    merged["args"] = list(runtime_container.get("args", [])) + list(
+        isvc_container.get("args", [])
+    )
+    if not merged["args"]:
+        del merged["args"]
+    return merged
+
+
+def replace_placeholders(obj: Any, metadata: Dict[str, Any]) -> Any:
+    """Substitute Go-template-style placeholders ({{.Name}}, {{.Namespace}},
+    {{.Labels.x}}, {{.Annotations.x}}) from object metadata anywhere in the
+    object tree (parity: ReplacePlaceholders, utils.go:325)."""
+    if isinstance(obj, dict):
+        return {k: replace_placeholders(v, metadata) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [replace_placeholders(v, metadata) for v in obj]
+    if isinstance(obj, str):
+        out = obj
+        out = out.replace("{{.Name}}", str(metadata.get("name", "")))
+        out = out.replace("{{.Namespace}}", str(metadata.get("namespace", "")))
+        for source, prefix in (("labels", "{{.Labels."), ("annotations", "{{.Annotations.")):
+            start = out.find(prefix)
+            while start != -1:
+                end = out.find("}}", start)
+                if end == -1:
+                    break
+                key = out[start + len(prefix): end]
+                val = str((metadata.get(source) or {}).get(key, ""))
+                out = out[:start] + val + out[end + 2:]
+                start = out.find(prefix)
+        return out
+    return obj
+
+
+# ---------------- object helpers ----------------
+
+
+def make_object(api_version: str, kind: str, name: str, namespace: str = "default",
+                labels: Optional[dict] = None, annotations: Optional[dict] = None,
+                spec: Optional[dict] = None) -> dict:
+    return {
+        "apiVersion": api_version,
+        "kind": kind,
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "labels": labels or {},
+            "annotations": annotations or {},
+        },
+        "spec": spec or {},
+    }
+
+
+def set_owner(obj: dict, owner: dict) -> dict:
+    obj.setdefault("metadata", {})["ownerReferences"] = [
+        {
+            "apiVersion": owner["apiVersion"],
+            "kind": owner["kind"],
+            "name": owner["metadata"]["name"],
+            "uid": owner["metadata"].get("uid", ""),
+            "controller": True,
+            "blockOwnerDeletion": True,
+        }
+    ]
+    return obj
+
+
+def set_condition(status: dict, cond_type: str, ok: bool, reason: str = "", message: str = "") -> None:
+    conds = status.setdefault("conditions", [])
+    entry = {
+        "type": cond_type,
+        "status": "True" if ok else "False",
+        "reason": reason,
+        "message": message,
+    }
+    for i, c in enumerate(conds):
+        if c["type"] == cond_type:
+            conds[i] = entry
+            return
+    conds.append(entry)
+
+
+def get_condition(status: dict, cond_type: str) -> Optional[dict]:
+    for c in status.get("conditions", []):
+        if c["type"] == cond_type:
+            return c
+    return None

@@ -1,0 +1,326 @@
+"""InferenceService reconciler: ISVC -> Deployments/Services/autoscalers/
+routes + status conditions.
+
+Structure mirrors the reference's controller decomposition:
+- component loop predictor/transformer/explainer
+  (controller.go:285-307)
+- runtime resolve + container merge + placeholder substitution
+  (components/predictor.go:184,325; utils.go:305,486,325)
+- raw Deployment/Service/HPA synthesis (reconcilers/raw, deployment,
+  service, hpa) — Standard mode only; serverless semantics (scale-to-zero)
+  come from the KEDA-style autoscaler object instead of Knative
+- TPU worker math replaces computeRayNodeAndGPUs (predictor.go:686): a
+  WorkerSpec tensorParallelSize x pipelineParallelSize becomes a slice plan
+  with google.com/tpu resources + topology selectors, multi-host groups as
+  a headless-service StatefulSet-style group (LWS analogue)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .crds import (
+    AUTOSCALER_CLASS_ANNOTATION,
+    DEPLOYMENT_MODE_ANNOTATION,
+    STOP_ANNOTATION,
+    InferenceService,
+    ModelSpec,
+    PredictorSpec,
+    TPU_RESOURCE,
+)
+from .objects import (
+    make_object,
+    merge_container,
+    replace_placeholders,
+    set_condition,
+    set_owner,
+    strategic_merge,
+)
+from .registry import RuntimeRegistry, RuntimeSelectionError
+from .topology import SlicePlan, plan_slice
+from .webhook import PodMutator
+
+DEFAULT_DEPLOYMENT_MODE = "Standard"  # reference: Serverless|RawDeployment|ModelMesh
+COMPONENTS = ("predictor", "transformer", "explainer")
+
+
+class ReconcileError(Exception):
+    pass
+
+
+def isvc_object(isvc: InferenceService) -> dict:
+    return {
+        "apiVersion": isvc.apiVersion,
+        "kind": isvc.kind,
+        "metadata": isvc.metadata.model_dump(),
+    }
+
+
+class InferenceServiceReconciler:
+    def __init__(self, registry: RuntimeRegistry, mutator: Optional[PodMutator] = None,
+                 ingress_domain: str = "example.com"):
+        self.registry = registry
+        self.mutator = mutator or PodMutator()
+        self.ingress_domain = ingress_domain
+
+    # ---------------- top level ----------------
+
+    def reconcile(self, isvc: InferenceService) -> Tuple[List[dict], dict]:
+        """Returns (desired objects, status)."""
+        status: dict = dict(isvc.status)
+        annotations = isvc.metadata.annotations
+        if annotations.get(STOP_ANNOTATION, "").lower() == "true":
+            set_condition(status, "Stopped", True, reason="ForceStopped")
+            set_condition(status, "Ready", False, reason="Stopped")
+            return [], status
+        mode = annotations.get(DEPLOYMENT_MODE_ANNOTATION, DEFAULT_DEPLOYMENT_MODE)
+        status["deploymentMode"] = mode
+
+        objects: List[dict] = []
+        component_urls: Dict[str, str] = {}
+        for component in COMPONENTS:
+            spec = getattr(isvc.spec, component, None)
+            if spec is None:
+                continue
+            objs, url = self._reconcile_component(isvc, component, spec)
+            objects.extend(objs)
+            component_urls[component] = url
+            set_condition(status, f"{component.capitalize()}Ready", True, reason="Reconciled")
+
+        objects.append(self._route(isvc, component_urls))
+        status["components"] = {
+            c: {"url": u} for c, u in component_urls.items()
+        }
+        status["url"] = (
+            f"http://{isvc.metadata.name}.{isvc.metadata.namespace}.{self.ingress_domain}"
+        )
+        set_condition(status, "IngressReady", True, reason="Reconciled")
+        set_condition(status, "Ready", True, reason="Reconciled")
+        for obj in objects:
+            set_owner(obj, isvc_object(isvc))
+        return objects, status
+
+    # ---------------- components ----------------
+
+    def _component_name(self, isvc: InferenceService, component: str) -> str:
+        return f"{isvc.metadata.name}-{component}"
+
+    def _reconcile_component(self, isvc, component: str, spec) -> Tuple[List[dict], str]:
+        name = self._component_name(isvc, component)
+        namespace = isvc.metadata.namespace
+        if component == "predictor":
+            pod_spec, plan = self._predictor_pod_spec(isvc, spec)
+        else:
+            if not spec.containers:
+                raise ReconcileError(f"{component} requires a container")
+            container = dict(spec.containers[0])
+            container.setdefault("name", "kserve-container")
+            if component == "transformer":
+                container.setdefault("args", [])
+                predictor_host = f"{self._component_name(isvc, 'predictor')}.{namespace}"
+                container["args"] = list(container["args"]) + [
+                    f"--predictor_host={predictor_host}",
+                ]
+            pod_spec, plan = {"containers": [container]}, None
+        pod_spec = self.mutator.mutate(
+            pod_spec,
+            isvc_metadata=isvc.metadata.model_dump(),
+            model=spec.resolved_model() if component == "predictor" else None,
+            component_spec=spec,
+            slice_plan=plan,
+        )
+        objects = self._raw_objects(isvc, name, spec, pod_spec, plan)
+        url = f"http://{name}.{namespace}.{self.ingress_domain}"
+        return objects, url
+
+    def _predictor_pod_spec(self, isvc, spec: PredictorSpec) -> Tuple[dict, Optional[SlicePlan]]:
+        model = spec.resolved_model()
+        if model is None:
+            # bring-your-own container predictor
+            if not spec.containers:
+                raise ReconcileError("predictor requires model or containers")
+            container = dict(spec.containers[0])
+            container.setdefault("name", "kserve-container")
+            return {"containers": [container]}, None
+        runtime = self.registry.select(model, isvc.metadata.namespace)
+        rt_containers = runtime.spec.containers
+        target = "kserve-container"
+        rt_container = next(
+            (c for c in rt_containers if c.get("name") == target), None
+        )
+        if rt_container is None:
+            raise ReconcileError(f"failed to find {target} in ServingRuntime containers")
+        isvc_container = {
+            "name": target,
+            "args": model.args,
+            "env": model.env,
+            "resources": model.resources,
+        }
+        merged = merge_container(rt_container, isvc_container)
+        merged = replace_placeholders(merged, isvc.metadata.model_dump())
+        pod_spec: dict = {
+            "containers": [merged]
+            + [c for c in rt_containers if c.get("name") != target],
+            "nodeSelector": dict(runtime.spec.nodeSelector),
+            "tolerations": list(runtime.spec.tolerations),
+            "volumes": list(runtime.spec.volumes),
+        }
+        pod_spec = strategic_merge(
+            pod_spec,
+            {
+                "nodeSelector": spec.nodeSelector,
+                "tolerations": spec.tolerations,
+                "volumes": spec.volumes,
+                **({"serviceAccountName": spec.serviceAccountName} if spec.serviceAccountName else {}),
+            },
+        )
+        plan = self._tpu_plan(spec, model)
+        if plan is not None:
+            # TP size flows into the engine flags
+            tp = (
+                spec.workerSpec.tensorParallelSize
+                if spec.workerSpec and spec.workerSpec.tensorParallelSize
+                else plan.chips
+            )
+            merged["args"] = merged.get("args", []) + [f"--tensor_parallel_size={tp}"]
+        return pod_spec, plan
+
+    def _tpu_plan(self, spec: PredictorSpec, model: ModelSpec) -> Optional[SlicePlan]:
+        """Worker math: tensorParallelSize chips of TP per group,
+        pipelineParallelSize groups (parity computeRayNodeAndGPUs/
+        computeMpNodeAndGPUs, but slices instead of Ray nodes)."""
+        requests = (model.resources or {}).get("requests", {})
+        if spec.workerSpec is not None:
+            tp = spec.workerSpec.tensorParallelSize or 1
+            pp = spec.workerSpec.pipelineParallelSize or spec.workerSpec.size or 1
+            return plan_slice(tp=tp, num_slices=pp)
+        if TPU_RESOURCE in requests:
+            return plan_slice(tp=int(requests[TPU_RESOURCE]))
+        return None
+
+    # ---------------- raw-mode object synthesis ----------------
+
+    def _raw_objects(self, isvc, name: str, spec, pod_spec: dict,
+                     plan: Optional[SlicePlan]) -> List[dict]:
+        namespace = isvc.metadata.namespace
+        labels = {
+            "app": name,
+            "serving.kserve.io/inferenceservice": isvc.metadata.name,
+        }
+        replicas = spec.minReplicas if spec.minReplicas is not None else 1
+        deployment = make_object(
+            "apps/v1", "Deployment", name, namespace, labels=dict(labels),
+            spec={
+                "replicas": replicas,
+                "selector": {"matchLabels": {"app": name}},
+                "template": {
+                    "metadata": {"labels": dict(labels)},
+                    "spec": pod_spec,
+                },
+            },
+        )
+        service = make_object(
+            "v1", "Service", name, namespace, labels=dict(labels),
+            spec={
+                "selector": {"app": name},
+                "ports": [
+                    {"name": "http", "port": 80, "targetPort": 8080},
+                    {"name": "grpc", "port": 8081, "targetPort": 8081},
+                ],
+            },
+        )
+        objects = [deployment, service]
+        if plan is not None and plan.hosts > 1:
+            # multi-host slice: headless service for deterministic peer
+            # addressing + a worker group (LeaderWorkerSet analogue)
+            headless = make_object(
+                "v1", "Service", f"{name}-peers", namespace, labels=dict(labels),
+                spec={"clusterIP": "None", "selector": {"app": name},
+                      "ports": [{"name": "coord", "port": 8476}]},
+            )
+            deployment["spec"]["replicas"] = plan.hosts * plan.num_slices
+            deployment["metadata"]["annotations"] = {
+                "serving.kserve.io/tpu-slice-hosts": str(plan.hosts),
+                "serving.kserve.io/tpu-num-slices": str(plan.num_slices),
+            }
+            objects.append(headless)
+        objects.append(self._autoscaler(isvc, name, spec))
+        return [o for o in objects if o is not None]
+
+    def _autoscaler(self, isvc, name: str, spec) -> Optional[dict]:
+        klass = isvc.metadata.annotations.get(AUTOSCALER_CLASS_ANNOTATION, "hpa")
+        if klass == "none" or spec.maxReplicas is None:
+            return None
+        namespace = isvc.metadata.namespace
+        if klass == "keda":
+            metric = spec.scaleMetric or "tokens-per-second"
+            prometheus_query = {
+                "tokens-per-second": f'rate(engine_generated_tokens_total{{pod=~"{name}.*"}}[1m])',
+                "concurrency": f'sum(engine_batch_occupancy{{pod=~"{name}.*"}})',
+                "rps": f'rate(request_predict_seconds_count{{pod=~"{name}.*"}}[1m])',
+            }.get(metric, metric)
+            return make_object(
+                "keda.sh/v1alpha1", "ScaledObject", name, namespace,
+                spec={
+                    "scaleTargetRef": {"name": name},
+                    "minReplicaCount": spec.minReplicas or 0,
+                    "maxReplicaCount": spec.maxReplicas,
+                    "triggers": [
+                        {
+                            "type": "prometheus",
+                            "metadata": {
+                                "query": prometheus_query,
+                                "threshold": str(spec.scaleTarget or 100),
+                            },
+                        }
+                    ],
+                },
+            )
+        metric = spec.scaleMetric or "cpu"
+        hpa_metric = (
+            {"type": "Resource",
+             "resource": {"name": metric,
+                          "target": {"type": "Utilization",
+                                     "averageUtilization": spec.scaleTarget or 80}}}
+        )
+        return make_object(
+            "autoscaling/v2", "HorizontalPodAutoscaler", name, namespace,
+            spec={
+                "scaleTargetRef": {"apiVersion": "apps/v1", "kind": "Deployment", "name": name},
+                "minReplicas": max(spec.minReplicas or 1, 1),
+                "maxReplicas": spec.maxReplicas,
+                "metrics": [hpa_metric],
+            },
+        )
+
+    def _route(self, isvc, component_urls: Dict[str, str]) -> dict:
+        """Gateway-API HTTPRoute: traffic enters at transformer when present,
+        else predictor; :predict/:explain split to explainer (parity:
+        ingress_reconciler.go semantics on HTTPRoute instead of Istio VS)."""
+        name = isvc.metadata.name
+        namespace = isvc.metadata.namespace
+        entry = "transformer" if "transformer" in component_urls else "predictor"
+        rules = [
+            {
+                "matches": [{"path": {"type": "PathPrefix", "value": "/"}}],
+                "backendRefs": [
+                    {"name": self._component_name(isvc, entry), "port": 80}
+                ],
+            }
+        ]
+        if "explainer" in component_urls:
+            rules.insert(0, {
+                "matches": [
+                    {"path": {"type": "RegularExpression", "value": r"^/v1/models/[^/]+:explain$"}}
+                ],
+                "backendRefs": [
+                    {"name": self._component_name(isvc, "explainer"), "port": 80}
+                ],
+            })
+        return make_object(
+            "gateway.networking.k8s.io/v1", "HTTPRoute", name, namespace,
+            spec={
+                "hostnames": [f"{name}.{namespace}.{self.ingress_domain}"],
+                "rules": rules,
+            },
+        )

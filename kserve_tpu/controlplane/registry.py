@@ -1,0 +1,116 @@
+"""ServingRuntime registry: selection by model format + validation.
+
+Parity: GetServingRuntime / auto-selection (utils/utils.go:305 and the
+sorting by priority), plus the ServingRuntime validating webhook's
+duplicate-priority check (pkg/webhook/admission/servingruntime/).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+from .crds import (
+    ClusterServingRuntime,
+    ModelSpec,
+    ServingRuntime,
+    ServingRuntimeSpec,
+    SupportedModelFormat,
+)
+
+Runtime = Union[ServingRuntime, ClusterServingRuntime]
+
+
+class RuntimeSelectionError(Exception):
+    pass
+
+
+def _format_matches(fmt: SupportedModelFormat, model: ModelSpec) -> bool:
+    if fmt.name != model.modelFormat.name:
+        return False
+    if model.modelFormat.version and fmt.version:
+        return fmt.version == model.modelFormat.version
+    return True
+
+
+def _protocol_ok(spec: ServingRuntimeSpec, model: ModelSpec) -> bool:
+    if not model.protocolVersion:
+        return True
+    protocols = spec.protocolVersions or ["v1"]
+    return model.protocolVersion in protocols
+
+
+class RuntimeRegistry:
+    """Holds namespaced ServingRuntimes and ClusterServingRuntimes."""
+
+    def __init__(self):
+        self._namespaced: dict = {}  # (namespace, name) -> ServingRuntime
+        self._cluster: dict = {}  # name -> ClusterServingRuntime
+
+    def add(self, runtime: Runtime) -> None:
+        self.validate(runtime)
+        if isinstance(runtime, ClusterServingRuntime):
+            self._cluster[runtime.metadata.name] = runtime
+        else:
+            self._namespaced[(runtime.metadata.namespace, runtime.metadata.name)] = runtime
+
+    def get(self, name: str, namespace: str) -> Runtime:
+        """Namespace-scoped first, then cluster-scoped (parity utils.go:305)."""
+        rt = self._namespaced.get((namespace, name))
+        if rt is not None:
+            return rt
+        rt = self._cluster.get(name)
+        if rt is not None:
+            return rt
+        raise RuntimeSelectionError(
+            f"No ServingRuntimes or ClusterServingRuntimes with the name: {name}"
+        )
+
+    def select(self, model: ModelSpec, namespace: str) -> Runtime:
+        """Explicit runtime if named, else best auto-select match: highest
+        priority among enabled runtimes supporting (format, version,
+        protocol); namespaced runtimes beat cluster ones."""
+        if model.runtime:
+            rt = self.get(model.runtime, namespace)
+            if rt.spec.disabled:
+                raise RuntimeSelectionError(f"runtime {model.runtime} is disabled")
+            if not any(_format_matches(f, model) for f in rt.spec.supportedModelFormats):
+                raise RuntimeSelectionError(
+                    f"runtime {model.runtime} does not support model format "
+                    f"{model.modelFormat.name}"
+                )
+            return rt
+        candidates: List[Tuple[int, int, Runtime]] = []
+        pools = (
+            (1, [rt for (ns, _), rt in self._namespaced.items() if ns == namespace]),
+            (0, list(self._cluster.values())),
+        )
+        for scope_rank, pool in pools:
+            for rt in pool:
+                if rt.spec.disabled:
+                    continue
+                if not _protocol_ok(rt.spec, model):
+                    continue
+                for fmt in rt.spec.supportedModelFormats:
+                    if fmt.autoSelect and _format_matches(fmt, model):
+                        candidates.append((scope_rank, fmt.priority or 0, rt))
+        if not candidates:
+            raise RuntimeSelectionError(
+                f"no runtime found to support model format "
+                f"{model.modelFormat.name}/{model.modelFormat.version or '*'}"
+            )
+        candidates.sort(key=lambda t: (t[0], t[1]), reverse=True)
+        return candidates[0][2]
+
+    @staticmethod
+    def validate(runtime: Runtime) -> None:
+        """Reject duplicate model-format entries with the same priority
+        (parity: servingruntime validating webhook)."""
+        seen: dict = {}
+        for fmt in runtime.spec.supportedModelFormats:
+            key = (fmt.name, fmt.version)
+            if key in seen and seen[key] == fmt.priority:
+                raise RuntimeSelectionError(
+                    f"runtime {runtime.metadata.name}: duplicate modelFormat "
+                    f"{fmt.name} with identical priority"
+                )
+            seen[key] = fmt.priority

@@ -1,0 +1,112 @@
+"""TPU topology math: ParallelismSpec -> slice shape, chips, node selectors.
+
+The analogue of the reference's GPU/node computations
+(computeRayNodeAndGPUs / computeMpNodeAndGPUs, components/predictor.go:686,
+761) and of InjectGKEAcceleratorSelector (accelerator_injector.go:32), but
+TPU-first: the scheduling unit is a slice (topology like 2x4), chips-per-host
+is fixed per generation, and TP must fit inside a slice's ICI domain.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+# generation -> (gke accelerator name, chips per host, allowed slice shapes)
+# topology string "XxY" (v5e is 2-D); chips = X*Y
+TPU_GENERATIONS = {
+    "v5e": {
+        "accelerator": "tpu-v5-lite-podslice",
+        "chips_per_host": 4,
+        "topologies": ["1x1", "2x2", "2x4", "4x4", "4x8", "8x8", "8x16", "16x16"],
+    },
+    "v5p": {
+        "accelerator": "tpu-v5p-slice",
+        "chips_per_host": 4,
+        "topologies": ["2x2x1", "2x2x2", "2x4x4", "4x4x4", "4x4x8", "4x8x8", "8x8x8"],
+    },
+    "v6e": {
+        "accelerator": "tpu-v6e-slice",
+        "chips_per_host": 4,
+        "topologies": ["1x1", "2x2", "2x4", "4x4", "4x8", "8x8", "8x16", "16x16"],
+    },
+}
+
+
+class TopologyError(ValueError):
+    pass
+
+
+def _chips(topology: str) -> int:
+    n = 1
+    for part in topology.split("x"):
+        n *= int(part)
+    return n
+
+
+@dataclass
+class SlicePlan:
+    generation: str
+    topology: str  # e.g. "2x4"
+    chips: int  # chips in the slice (= tp * dp_local)
+    hosts: int  # k8s pods (hosts) making up the slice
+    chips_per_host: int
+    num_slices: int  # data-parallel slice replicas
+
+    def node_selectors(self) -> Dict[str, str]:
+        gen = TPU_GENERATIONS[self.generation]
+        return {
+            "cloud.google.com/gke-tpu-accelerator": gen["accelerator"],
+            "cloud.google.com/gke-tpu-topology": self.topology,
+        }
+
+    def tpu_resource_per_host(self) -> int:
+        return min(self.chips, self.chips_per_host)
+
+
+def plan_slice(
+    tp: int,
+    dp_local: int = 1,
+    num_slices: int = 1,
+    generation: str = "v5e",
+    sequence: int = 1,
+) -> SlicePlan:
+    """Choose the smallest slice whose chip count covers tp*dp_local*sequence.
+    TP (and SP) ride ICI so they must fit inside one slice; DP across slices
+    is num_slices (DCN/k8s replicas)."""
+    gen = TPU_GENERATIONS.get(generation)
+    if gen is None:
+        raise TopologyError(
+            f"unknown TPU generation {generation!r}; known: {sorted(TPU_GENERATIONS)}"
+        )
+    chips_needed = max(1, tp) * max(1, dp_local) * max(1, sequence)
+    for topo in gen["topologies"]:
+        if _chips(topo) >= chips_needed:
+            chips = _chips(topo)
+            hosts = max(1, chips // gen["chips_per_host"])
+            return SlicePlan(
+                generation=generation,
+                topology=topo,
+                chips=chips,
+                hosts=hosts,
+                chips_per_host=gen["chips_per_host"],
+                num_slices=num_slices,
+            )
+    raise TopologyError(
+        f"no {generation} slice topology fits {chips_needed} chips "
+        f"(tp={tp} x dp_local={dp_local} x sp={sequence})"
+    )
+
+
+def inject_tpu_resources(pod_spec: dict, plan: SlicePlan) -> dict:
+    """Set google.com/tpu requests/limits on every container that asks for
+    accelerators (or the first container), plus slice node selectors.
+    Parity role: accelerator_injector.go:32 (GPU selector injection)."""
+    pod_spec.setdefault("nodeSelector", {}).update(plan.node_selectors())
+    containers = pod_spec.get("containers", [])
+    if containers:
+        resources = containers[0].setdefault("resources", {})
+        n = str(plan.tpu_resource_per_host())
+        resources.setdefault("requests", {})["google.com/tpu"] = n
+        resources.setdefault("limits", {})["google.com/tpu"] = n
+    return pod_spec

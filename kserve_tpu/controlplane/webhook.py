@@ -1,0 +1,125 @@
+"""Pod mutation: the admission-webhook logic applied to component pod specs.
+
+Mutator chain (parity: pkg/webhook/admission/pod/mutator.go:131):
+1. TPU slice resources + topology node selectors
+   (accelerator_injector.go:32 analogue — GPU selector becomes
+   google.com/tpu + gke-tpu-topology)
+2. storage-initializer init container for storageUri
+   (storage_initializer_injector.go:716); pvc:// mounts the claim directly
+3. agent sidecar when the ISVC uses multi-model serving or payload logging
+   (agent_injector.go:177)
+4. batcher sidecar flags (batcher_injector.go:79)
+5. metrics-aggregation annotations (metrics_aggregate_injector.go)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from .crds import ModelSpec
+from .topology import SlicePlan, inject_tpu_resources
+
+STORAGE_INITIALIZER_IMAGE = "kserve-tpu/storage-initializer:latest"
+AGENT_IMAGE = "kserve-tpu/agent:latest"
+MODEL_MOUNT_PATH = "/mnt/models"
+PVC_MOUNT_PATH = "/mnt/pvc"
+
+
+class PodMutator:
+    def __init__(
+        self,
+        storage_initializer_image: str = STORAGE_INITIALIZER_IMAGE,
+        agent_image: str = AGENT_IMAGE,
+    ):
+        self.storage_initializer_image = storage_initializer_image
+        self.agent_image = agent_image
+
+    def mutate(
+        self,
+        pod_spec: dict,
+        isvc_metadata: dict,
+        model: Optional[ModelSpec] = None,
+        component_spec: Any = None,
+        slice_plan: Optional[SlicePlan] = None,
+    ) -> dict:
+        if slice_plan is not None:
+            pod_spec = inject_tpu_resources(pod_spec, slice_plan)
+        if model is not None and (model.storageUri or model.storage):
+            uri = model.storageUri or (model.storage.storageUri if model.storage else None)
+            if uri:
+                pod_spec = self.inject_storage_initializer(pod_spec, uri)
+        if component_spec is not None:
+            batcher = getattr(component_spec, "batcher", None)
+            logger_spec = getattr(component_spec, "logger", None)
+            if batcher or logger_spec:
+                pod_spec = self.inject_agent(pod_spec, batcher, logger_spec)
+        return pod_spec
+
+    def inject_storage_initializer(self, pod_spec: dict, storage_uri: str) -> dict:
+        """pvc:// mounts the claim read-only; other schemes get a download
+        init container sharing an emptyDir with the runtime container."""
+        volumes = pod_spec.setdefault("volumes", [])
+        containers = pod_spec.get("containers", [])
+        if not containers:
+            return pod_spec
+        if storage_uri.startswith("pvc://"):
+            rest = storage_uri[len("pvc://"):]
+            claim, _, subpath = rest.partition("/")
+            volumes.append(
+                {"name": "model-pvc",
+                 "persistentVolumeClaim": {"claimName": claim, "readOnly": True}}
+            )
+            mount = {
+                "name": "model-pvc",
+                "mountPath": MODEL_MOUNT_PATH,
+                "readOnly": True,
+            }
+            if subpath:
+                mount["subPath"] = subpath
+            containers[0].setdefault("volumeMounts", []).append(mount)
+            return pod_spec
+        volumes.append({"name": "model-dir", "emptyDir": {}})
+        init = {
+            "name": "storage-initializer",
+            "image": self.storage_initializer_image,
+            "command": ["python", "-m", "kserve_tpu.storage.initializer"],
+            "args": [storage_uri, MODEL_MOUNT_PATH],
+            "volumeMounts": [{"name": "model-dir", "mountPath": MODEL_MOUNT_PATH}],
+            "resources": {
+                "requests": {"cpu": "100m", "memory": "500Mi"},
+                "limits": {"cpu": "1", "memory": "4Gi"},
+            },
+        }
+        pod_spec.setdefault("initContainers", []).append(init)
+        containers[0].setdefault("volumeMounts", []).append(
+            {"name": "model-dir", "mountPath": MODEL_MOUNT_PATH, "readOnly": True}
+        )
+        return pod_spec
+
+    def inject_agent(self, pod_spec: dict, batcher: Optional[dict],
+                     logger_spec: Optional[dict]) -> dict:
+        """Agent sidecar proxies the runtime container: request/response
+        logging and/or micro-batching (reference runs these in the Go agent;
+        here the native sidecar binary lives in native/)."""
+        args = ["--component_port=8080", "--port=9081"]
+        if batcher:
+            args.append("--enable-batcher")
+            if batcher.get("maxBatchSize"):
+                args.append(f"--max-batchsize={batcher['maxBatchSize']}")
+            if batcher.get("maxLatency"):
+                args.append(f"--max-latency={batcher['maxLatency']}")
+        if logger_spec:
+            args.append("--enable-logger")
+            if logger_spec.get("url"):
+                args.append(f"--log-url={logger_spec['url']}")
+            if logger_spec.get("mode"):
+                args.append(f"--log-mode={logger_spec['mode']}")
+        pod_spec.setdefault("containers", []).append(
+            {
+                "name": "kserve-agent",
+                "image": self.agent_image,
+                "args": args,
+                "ports": [{"containerPort": 9081, "name": "agent"}],
+            }
+        )
+        return pod_spec

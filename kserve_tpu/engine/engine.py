@@ -59,6 +59,15 @@ class EngineConfig:
     # sits behind a network tunnel; streaming granularity becomes K tokens.
     steps_per_sync: int = 8
 
+    def __post_init__(self):
+        # prefill buckets must reach max_prefill_len or long prompts would
+        # overflow the bucket array
+        buckets = sorted(
+            {b for b in self.prefill_buckets if b <= self.max_prefill_len}
+            | {self.max_prefill_len}
+        )
+        self.prefill_buckets = tuple(buckets)
+
     @property
     def max_model_len(self) -> int:
         return self.max_pages_per_seq * self.page_size
@@ -166,21 +175,24 @@ class LLMEngine:
             first = sample_tokens(logits, state, rng)
             return first, kv_pages
 
-        def _decode_multi(params, tokens, pos, kv_pages, page_table, active, state, rng):
+        def _decode_multi(params, tokens, pos, kv_pages, page_table, active,
+                          capacity, state, rng):
             """steps_per_sync decode steps on device; emits [steps, B] tokens.
-            Inactive lanes hold their token/pos (writes go to the null page)."""
+            Lanes past their page capacity (or inactive) hold token/pos and
+            write to the null page — a clamped page-table index would
+            otherwise corrupt a neighbouring sequence's last page."""
             steps = cfg.steps_per_sync
-            act_i = active.astype(pos.dtype)
 
             def body(carry, step_rng):
                 tokens, pos, kv_pages = carry
+                live = active & (pos < capacity)
                 logits, kv_pages = llama.decode_step(
-                    params, mc, tokens, pos, kv_pages, page_table, active,
+                    params, mc, tokens, pos, kv_pages, page_table, live,
                     cfg.page_size, use_pallas=cfg.use_pallas,
                 )
                 nxt = sample_tokens(logits, state, step_rng)
-                nxt = jnp.where(active, nxt, tokens)
-                return (nxt, pos + act_i, kv_pages), nxt
+                nxt = jnp.where(live, nxt, tokens)
+                return (nxt, pos + live.astype(pos.dtype), kv_pages), nxt
 
             rngs = jax.random.split(rng, steps)
             (tokens, pos, kv_pages), out = jax.lax.scan(
@@ -234,19 +246,31 @@ class LLMEngine:
                 f"prompt+max_tokens exceeds max_model_len {self.config.max_model_len}"
             )
         queue: asyncio.Queue = asyncio.Queue()
-        req = _QueuedRequest(
-            request_id or f"req-{time.monotonic_ns()}", list(prompt_ids), params, queue
-        )
+        rid = request_id or f"req-{time.monotonic_ns()}"
+        req = _QueuedRequest(rid, list(prompt_ids), params, queue)
         self._waiting.append(req)
         ENGINE_QUEUE_DEPTH.labels(model_name="engine").set(len(self._waiting))
         self._wake.set()
-        while True:
-            out = await queue.get()
-            if isinstance(out, Exception):
-                raise out
-            yield out
-            if out.finished:
-                return
+        try:
+            while True:
+                out = await queue.get()
+                if isinstance(out, Exception):
+                    raise out
+                yield out
+                if out.finished:
+                    return
+        finally:
+            # client went away (generator closed / task cancelled): release
+            # the slot and pages instead of decoding to max_tokens for nobody
+            self.cancel(rid)
+
+    def cancel(self, request_id: str) -> None:
+        self._waiting = [r for r in self._waiting if r.request_id != request_id]
+        for slot in self._slots:
+            if slot.request_id == request_id:
+                self.allocator.free(slot.pages)
+                slot.reset()
+                self._wake.set()
 
     # ---------------- engine loop ----------------
 
@@ -362,18 +386,22 @@ class LLMEngine:
         tokens = np.zeros((B,), np.int32)
         pos = np.zeros((B,), np.int32)
         active = np.zeros((B,), bool)
+        capacity = np.zeros((B,), np.int32)
         params_list = [SamplingParams() for _ in range(B)]
         max_owned = 1
         for i, slot in enumerate(self._slots):
             if slot.request_id is None:
                 continue
-            # pages must cover every position this chunk can write
-            if not self._ensure_pages(slot, extra=steps):
+            # grow pages toward this chunk's writes; a lane may cover only
+            # part of the chunk (capacity masks the rest on device)
+            grow = min(steps, self.config.max_model_len - slot.pos)
+            if grow <= 0 or not self._ensure_pages(slot, extra=grow):
                 self._finish(slot, "length")
                 continue
             tokens[i] = slot.generated[-1]
             pos[i] = slot.pos
             active[i] = True
+            capacity[i] = len(slot.pages) * self.config.page_size
             params_list[i] = slot.params
             max_owned = max(max_owned, len(slot.pages))
         if not active.any():
@@ -393,6 +421,7 @@ class LLMEngine:
             self.kv_pages,
             jnp.asarray(page_table),
             jnp.asarray(active),
+            jnp.asarray(capacity),
             state,
             rng,
         )
@@ -403,13 +432,16 @@ class LLMEngine:
         for i, slot in enumerate(self._slots):
             if slot.request_id is None or not active[i]:
                 continue
-            for s in range(steps):
+            lane_steps = min(steps, int(capacity[i]) - int(pos[i]))
+            for s in range(lane_steps):
                 if slot.request_id is None:
                     break  # finished mid-chunk; discard speculative tail
                 token = int(chunk_np[s, i])
                 slot.pos += 1
                 slot.generated.append(token)
                 self._emit(slot, token)
+            if slot.request_id is not None and slot.pos >= self.config.max_model_len:
+                self._finish(slot, "length")
 
     def _emit(self, slot: _Slot, token: int):
         """Stream one token; apply stop conditions."""

@@ -1,0 +1,203 @@
+"""InferenceGraph router: a standalone HTTP service executing a graph spec.
+
+Node semantics (parity: cmd/router/main.go — graphHandler :405, weighted
+pick :179, condition eval :195, ensemble fan-out :218, step exec :385):
+- Sequence: steps run in order; `data: $request` re-sends the original
+  request, `$response` pipes the previous step's output; a step may name
+  another graph node (`nodeName`) instead of a service.
+- Splitter: one step chosen by weight.
+- Ensemble: all steps fan out concurrently; responses merged keyed by step
+  name/index.
+- Switch: first step whose `condition` matches the request payload runs.
+Conditions use a dotted-path==value syntax evaluated against the JSON body
+(the reference uses gjson path conditions).
+
+Usage: python -m kserve_tpu.graph.router --graph-json '<spec>' --port 8080
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+from typing import Any, Dict, Optional
+
+import httpx
+from aiohttp import web
+
+from ..logging import configure_logging, logger
+
+DEFAULT_TIMEOUT = 60.0
+
+
+class GraphExecutionError(Exception):
+    def __init__(self, message: str, status: int = 500):
+        super().__init__(message)
+        self.status = status
+
+
+def eval_condition(condition: str, payload: Any) -> bool:
+    """`path.to.field==value` (or bare `path` for existence) against JSON."""
+    if not condition:
+        return True
+    if "==" in condition:
+        path, _, expected = condition.partition("==")
+    else:
+        path, expected = condition, None
+    node = payload
+    for part in path.strip().split("."):
+        if isinstance(node, dict) and part in node:
+            node = node[part]
+        elif isinstance(node, list):
+            try:
+                node = node[int(part)]
+            except (ValueError, IndexError):
+                return False
+        else:
+            return False
+    if expected is None:
+        return True
+    expected = expected.strip()
+    if isinstance(node, bool):
+        return str(node).lower() == expected.lower()
+    if isinstance(node, (int, float)):
+        try:
+            return float(node) == float(expected)
+        except ValueError:
+            return False
+    return str(node) == expected.strip('"')
+
+
+class GraphRouter:
+    def __init__(self, graph_spec: dict, timeout: float = DEFAULT_TIMEOUT,
+                 retries: int = 1, client: Optional[httpx.AsyncClient] = None):
+        self.nodes: Dict[str, dict] = graph_spec["nodes"]
+        self.timeout = graph_spec.get("timeout") or timeout
+        self.retries = retries
+        self._client = client or httpx.AsyncClient(timeout=self.timeout)
+
+    async def close(self):
+        await self._client.aclose()
+
+    def _step_url(self, step: dict) -> str:
+        if step.get("serviceUrl"):
+            return step["serviceUrl"]
+        if step.get("serviceName"):
+            # ISVC predictor service; default v1 predict path
+            model = step.get("name") or step["serviceName"]
+            return f"http://{step['serviceName']}/v1/models/{model}:predict"
+        raise GraphExecutionError(f"step has neither serviceUrl nor serviceName: {step}")
+
+    async def _call_step(self, step: dict, payload: Any, headers: Dict[str, str]) -> Any:
+        if step.get("nodeName"):
+            return await self.execute_node(step["nodeName"], payload, headers)
+        url = self._step_url(step)
+        last_exc: Optional[Exception] = None
+        for _ in range(self.retries + 1):
+            try:
+                response = await self._client.post(url, json=payload, headers=headers)
+                if response.status_code == 200:
+                    return response.json()
+                last_exc = GraphExecutionError(
+                    f"step {step.get('name') or url} returned {response.status_code}: "
+                    f"{response.text[:200]}",
+                    status=response.status_code,
+                )
+                if step.get("dependency") == "Soft":
+                    break
+            except httpx.HTTPError as e:
+                last_exc = GraphExecutionError(f"step call failed: {e}", status=503)
+        if step.get("dependency") == "Soft":
+            logger.warning("soft-dependency step failed, continuing: %s", last_exc)
+            return None
+        raise last_exc
+
+    async def execute_node(self, node_name: str, payload: Any, headers: Dict[str, str]) -> Any:
+        node = self.nodes.get(node_name)
+        if node is None:
+            raise GraphExecutionError(f"graph node {node_name!r} not found", status=404)
+        router_type = node["routerType"]
+        steps = node.get("steps", [])
+        if router_type == "Sequence":
+            request_payload = payload
+            current = payload
+            for step in steps:
+                data = step.get("data", "$request" if step is steps[0] else "$response")
+                step_input = request_payload if data == "$request" else current
+                result = await self._call_step(step, step_input, headers)
+                if result is not None:
+                    current = result
+            return current
+        if router_type == "Splitter":
+            total = sum(s.get("weight", 0) for s in steps)
+            if total <= 0:
+                raise GraphExecutionError("splitter steps need positive weights", 422)
+            pick = random.uniform(0, total)
+            acc = 0.0
+            chosen = steps[-1]
+            for s in steps:
+                acc += s.get("weight", 0)
+                if pick <= acc:
+                    chosen = s
+                    break
+            return await self._call_step(chosen, payload, headers)
+        if router_type == "Ensemble":
+            results = await asyncio.gather(
+                *[self._call_step(s, payload, headers) for s in steps],
+                return_exceptions=True,
+            )
+            merged: Dict[str, Any] = {}
+            for i, (step, result) in enumerate(zip(steps, results)):
+                key = step.get("name") or step.get("serviceName") or str(i)
+                if isinstance(result, Exception):
+                    raise result
+                merged[key] = result
+            return merged
+        if router_type == "Switch":
+            for step in steps:
+                if eval_condition(step.get("condition", ""), payload):
+                    return await self._call_step(step, payload, headers)
+            raise GraphExecutionError("no switch branch matched the request", status=404)
+        raise GraphExecutionError(f"unknown routerType {router_type!r}", status=422)
+
+    # ---------------- http surface ----------------
+
+    async def handle(self, request: web.Request) -> web.Response:
+        try:
+            payload = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON body"}, status=400)
+        headers = {
+            k: v for k, v in request.headers.items()
+            if k.lower() in ("x-request-id", "authorization", "content-type")
+        }
+        try:
+            result = await self.execute_node("root", payload, headers)
+        except GraphExecutionError as e:
+            return web.json_response({"error": str(e)}, status=e.status)
+        return web.json_response(result)
+
+    def create_application(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/", self.handle)
+        async def healthz(_request: web.Request) -> web.Response:
+            return web.json_response({"status": "ok"})
+
+        app.router.add_get("/healthz", healthz)
+        return app
+
+
+def main(argv=None):
+    configure_logging()
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--graph-json", required=True)
+    parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument("--timeout", type=float, default=DEFAULT_TIMEOUT)
+    args = parser.parse_args(argv)
+    router = GraphRouter(json.loads(args.graph_json), timeout=args.timeout)
+    web.run_app(router.create_application(), port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -159,18 +159,19 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
             if len(prompts) > 1 or request.n > 1:
                 raise InvalidInput("streaming supports a single prompt with n=1")
             return self._stream_completion(request, prompts[0], params)
+        import asyncio
+
+        runs = [
+            prompt_ids for prompt_ids in prompts for _ in range(max(request.n, 1))
+        ]
+        # concurrent submission: the engine batches all of them in one pass
+        results = await asyncio.gather(*[self._run_one(p, params) for p in runs])
         choices = []
         usage = UsageInfo()
-        idx = 0
-        for prompt_ids in prompts:
-            for _ in range(max(request.n, 1)):
-                text, n_gen, finish = await self._run_one(prompt_ids, params)
-                choices.append(
-                    CompletionChoice(index=idx, text=text, finish_reason=finish)
-                )
-                usage.prompt_tokens += len(prompt_ids)
-                usage.completion_tokens += n_gen
-                idx += 1
+        for idx, (prompt_ids, (text, n_gen, finish)) in enumerate(zip(runs, results)):
+            choices.append(CompletionChoice(index=idx, text=text, finish_reason=finish))
+            usage.prompt_tokens += len(prompt_ids)
+            usage.completion_tokens += n_gen
         usage.total_tokens = usage.prompt_tokens + usage.completion_tokens
         return Completion(model=request.model, choices=choices, usage=usage)
 
@@ -249,10 +250,15 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
             if request.n > 1:
                 raise InvalidInput("streaming supports n=1")
             return self._stream_chat(request, prompt_ids, params)
+        import asyncio
+
+        n = max(request.n, 1)
+        results = await asyncio.gather(
+            *[self._run_one(prompt_ids, params) for _ in range(n)]
+        )
         choices = []
-        usage = UsageInfo(prompt_tokens=len(prompt_ids) * max(request.n, 1))
-        for i in range(max(request.n, 1)):
-            text, n_gen, finish = await self._run_one(prompt_ids, params)
+        usage = UsageInfo(prompt_tokens=len(prompt_ids) * n)
+        for i, (text, n_gen, finish) in enumerate(results):
             choices.append(
                 ChatCompletionChoice(
                     index=i,

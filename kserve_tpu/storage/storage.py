@@ -55,6 +55,9 @@ class Storage:
             return Storage._download_local(uri, out_dir)
         if uri.startswith(_PVC_PREFIX):
             return Storage._download_pvc(uri, out_dir)
+        if re.match(r"https?://(.+?)\.blob\.core\.windows\.net/(.+)", uri):
+            # must precede the generic http(s) branch or it is unreachable
+            return Storage._download_azure_blob(uri, out_dir)
         if uri.startswith(("http://", "https://")):
             return Storage._download_http(uri, out_dir)
         if uri.startswith("gs://"):
@@ -65,8 +68,6 @@ class Storage:
             return Storage._download_hdfs(uri, out_dir)
         if uri.startswith("hf://"):
             return Storage._download_hf(uri, out_dir)
-        if re.match(r"https?://(.+?)\.blob\.core\.windows\.net/(.+)", uri):
-            return Storage._download_azure_blob(uri, out_dir)
         raise StorageError(
             f"Cannot recognize storage type for {uri!r}; supported prefixes: "
             "[file://, pvc://, gs://, s3://, hdfs://, webhdfs://, hf://, http(s)://]"

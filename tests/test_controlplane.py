@@ -1,0 +1,324 @@
+"""Control-plane tests: runtime selection, merge semantics, TPU topology,
+ISVC/LLMISVC reconciliation against the fake cluster (envtest analogue)."""
+
+import pytest
+
+from kserve_tpu.controlplane.cluster import ControllerManager, FakeCluster
+from kserve_tpu.controlplane.crds import (
+    ClusterServingRuntime,
+    InferenceService,
+    LLMInferenceService,
+    ModelFormat,
+    ModelSpec,
+    ObjectMeta,
+    ServingRuntime,
+    ServingRuntimeSpec,
+    SupportedModelFormat,
+)
+from kserve_tpu.controlplane.objects import (
+    merge_container,
+    replace_placeholders,
+    strategic_merge,
+)
+from kserve_tpu.controlplane.registry import RuntimeRegistry, RuntimeSelectionError
+from kserve_tpu.controlplane.topology import TopologyError, plan_slice
+
+
+class TestStrategicMerge:
+    def test_dict_deep_merge(self):
+        base = {"a": {"b": 1, "c": 2}, "x": 1}
+        override = {"a": {"c": 3}}
+        assert strategic_merge(base, override) == {"a": {"b": 1, "c": 3}, "x": 1}
+
+    def test_named_list_merge(self):
+        base = {"containers": [{"name": "main", "image": "old", "env": [{"name": "A", "value": "1"}]}]}
+        override = {"containers": [{"name": "main", "image": "new"}]}
+        merged = strategic_merge(base, override)
+        assert merged["containers"][0]["image"] == "new"
+        assert merged["containers"][0]["env"] == [{"name": "A", "value": "1"}]
+
+    def test_env_merge_by_name(self):
+        base = {"env": [{"name": "A", "value": "1"}, {"name": "B", "value": "2"}]}
+        override = {"env": [{"name": "B", "value": "override"}]}
+        merged = strategic_merge(base, override)
+        by_name = {e["name"]: e["value"] for e in merged["env"]}
+        assert by_name == {"A": "1", "B": "override"}
+
+    def test_scalar_list_replaced(self):
+        assert strategic_merge({"cmd": [1, 2]}, {"cmd": [3]}) == {"cmd": [3]}
+
+    def test_container_args_concatenated(self):
+        rt = {"name": "c", "args": ["--a=1"], "image": "img"}
+        isvc = {"name": "c", "args": ["--b=2"]}
+        merged = merge_container(rt, isvc)
+        assert merged["args"] == ["--a=1", "--b=2"]
+        assert merged["image"] == "img"
+
+    def test_placeholders(self):
+        obj = {"args": ["--model_name={{.Name}}", "--ns={{.Namespace}}", "--t={{.Labels.tier}}"]}
+        meta = {"name": "iris", "namespace": "prod", "labels": {"tier": "gold"}}
+        out = replace_placeholders(obj, meta)
+        assert out["args"] == ["--model_name=iris", "--ns=prod", "--t=gold"]
+
+
+class TestRuntimeRegistry:
+    def _runtime(self, name, fmt="sklearn", priority=1, auto=True, cluster=False,
+                 namespace="default", disabled=False):
+        cls = ClusterServingRuntime if cluster else ServingRuntime
+        return cls(
+            metadata=ObjectMeta(name=name, namespace=namespace),
+            spec=ServingRuntimeSpec(
+                supportedModelFormats=[
+                    SupportedModelFormat(name=fmt, autoSelect=auto, priority=priority)
+                ],
+                disabled=disabled,
+                containers=[{"name": "kserve-container", "image": "img"}],
+            ),
+        )
+
+    def test_namespaced_beats_cluster(self):
+        reg = RuntimeRegistry()
+        reg.add(self._runtime("cluster-rt", cluster=True, priority=10))
+        reg.add(self._runtime("ns-rt", priority=1))
+        model = ModelSpec(modelFormat=ModelFormat(name="sklearn"))
+        assert reg.select(model, "default").metadata.name == "ns-rt"
+
+    def test_priority_order(self):
+        reg = RuntimeRegistry()
+        reg.add(self._runtime("low", cluster=True, priority=1))
+        reg.add(self._runtime("high", cluster=True, priority=5))
+        model = ModelSpec(modelFormat=ModelFormat(name="sklearn"))
+        assert reg.select(model, "default").metadata.name == "high"
+
+    def test_explicit_runtime_must_support_format(self):
+        reg = RuntimeRegistry()
+        reg.add(self._runtime("xgb-rt", fmt="xgboost", cluster=True))
+        model = ModelSpec(modelFormat=ModelFormat(name="sklearn"), runtime="xgb-rt")
+        with pytest.raises(RuntimeSelectionError):
+            reg.select(model, "default")
+
+    def test_disabled_skipped(self):
+        reg = RuntimeRegistry()
+        reg.add(self._runtime("off", cluster=True, disabled=True))
+        model = ModelSpec(modelFormat=ModelFormat(name="sklearn"))
+        with pytest.raises(RuntimeSelectionError):
+            reg.select(model, "default")
+
+    def test_duplicate_priority_rejected(self):
+        rt = ServingRuntime(
+            metadata=ObjectMeta(name="dup"),
+            spec=ServingRuntimeSpec(
+                supportedModelFormats=[
+                    SupportedModelFormat(name="sklearn", priority=1),
+                    SupportedModelFormat(name="sklearn", priority=1),
+                ]
+            ),
+        )
+        with pytest.raises(RuntimeSelectionError):
+            RuntimeRegistry().add(rt)
+
+
+class TestTopology:
+    def test_single_chip(self):
+        plan = plan_slice(tp=1)
+        assert plan.topology == "1x1" and plan.hosts == 1
+
+    def test_tp8_v5e(self):
+        plan = plan_slice(tp=8)
+        assert plan.topology == "2x4"
+        assert plan.chips == 8
+        assert plan.hosts == 2
+        sel = plan.node_selectors()
+        assert sel["cloud.google.com/gke-tpu-topology"] == "2x4"
+        assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+
+    def test_sp_multiplies_chips(self):
+        plan = plan_slice(tp=4, sequence=4)
+        assert plan.chips >= 16
+
+    def test_too_big_raises(self):
+        with pytest.raises(TopologyError):
+            plan_slice(tp=4096)
+
+
+def make_isvc(name="iris", **model_kwargs):
+    return {
+        "apiVersion": "serving.kserve.io/v1beta1",
+        "kind": "InferenceService",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "predictor": {
+                "model": {
+                    "modelFormat": {"name": "sklearn"},
+                    "storageUri": "gs://bucket/iris",
+                    **model_kwargs,
+                },
+                "minReplicas": 1,
+                "maxReplicas": 3,
+            }
+        },
+    }
+
+
+class TestISVCReconcile:
+    def test_end_to_end_objects(self):
+        mgr = ControllerManager()
+        mgr.apply(make_isvc())
+        dep = mgr.cluster.get("Deployment", "iris-predictor")
+        assert dep is not None
+        pod = dep["spec"]["template"]["spec"]
+        container = pod["containers"][0]
+        assert container["name"] == "kserve-container"
+        assert "--model_name=iris" in container["args"]
+        # storage-initializer injected for gs:// uri
+        assert pod["initContainers"][0]["name"] == "storage-initializer"
+        assert pod["initContainers"][0]["args"][0] == "gs://bucket/iris"
+        # service + route + autoscaler
+        assert mgr.cluster.get("Service", "iris-predictor") is not None
+        route = mgr.cluster.get("HTTPRoute", "iris")
+        assert route["spec"]["rules"][0]["backendRefs"][0]["name"] == "iris-predictor"
+        hpa = mgr.cluster.get("HorizontalPodAutoscaler", "iris-predictor")
+        assert hpa["spec"]["maxReplicas"] == 3
+        # status
+        isvc = mgr.cluster.get("InferenceService", "iris")
+        conds = {c["type"]: c["status"] for c in isvc["status"]["conditions"]}
+        assert conds["Ready"] == "True"
+        assert isvc["status"]["url"].startswith("http://iris.default.")
+
+    def test_pvc_storage_mounts_claim(self):
+        mgr = ControllerManager()
+        mgr.apply(make_isvc(storageUri="pvc://my-claim/models/iris"))
+        pod = mgr.cluster.get("Deployment", "iris-predictor")["spec"]["template"]["spec"]
+        assert "initContainers" not in pod
+        assert pod["volumes"][0]["persistentVolumeClaim"]["claimName"] == "my-claim"
+
+    def test_stop_annotation_removes_workload(self):
+        mgr = ControllerManager()
+        isvc = make_isvc()
+        isvc["metadata"]["annotations"] = {"serving.kserve.io/stop": "true"}
+        mgr.apply(isvc)
+        status = mgr.cluster.get("InferenceService", "iris")["status"]
+        conds = {c["type"]: c["status"] for c in status["conditions"]}
+        assert conds["Stopped"] == "True"
+        assert mgr.cluster.get("Deployment", "iris-predictor") is None
+
+    def test_unknown_format_fails(self):
+        mgr = ControllerManager()
+        isvc = make_isvc()
+        isvc["spec"]["predictor"]["model"]["modelFormat"]["name"] = "tensorflow"
+        with pytest.raises(RuntimeSelectionError):
+            mgr.apply(isvc)
+
+    def test_transformer_chain(self):
+        mgr = ControllerManager()
+        isvc = make_isvc()
+        isvc["spec"]["transformer"] = {
+            "containers": [{"name": "kserve-container", "image": "my-transformer"}]
+        }
+        mgr.apply(isvc)
+        tr = mgr.cluster.get("Deployment", "iris-transformer")
+        args = tr["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--predictor_host=iris-predictor.default" in args
+        route = mgr.cluster.get("HTTPRoute", "iris")
+        assert route["spec"]["rules"][0]["backendRefs"][0]["name"] == "iris-transformer"
+
+
+class TestLLMISVCReconcile:
+    def _llm(self, **spec_extra):
+        spec = {
+            "model": {"uri": "hf://meta-llama/Llama-3.2-1B", "name": "llama"},
+            "workload": {
+                "replicas": 1,
+                "parallelism": {"tensor": 4},
+                "maxBatchSize": 16,
+            },
+            "router": {"scheduler": {"enabled": True}},
+        }
+        spec.update(spec_extra)
+        return {
+            "apiVersion": "serving.kserve.io/v1alpha2",
+            "kind": "LLMInferenceService",
+            "metadata": {"name": "llama", "namespace": "default"},
+            "spec": spec,
+        }
+
+    def test_decode_workload_tpu(self):
+        mgr = ControllerManager()
+        mgr.apply(self._llm())
+        dep = mgr.cluster.get("Deployment", "llama-kserve")
+        pod = dep["spec"]["template"]["spec"]
+        container = pod["containers"][0]
+        assert "--tensor_parallel_size=4" in container["args"]
+        assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x2"
+        assert container["resources"]["limits"]["google.com/tpu"] == "4"
+        # scheduler + pool + route + scaler
+        assert mgr.cluster.get("Deployment", "llama-epp") is not None
+        assert mgr.cluster.get("InferencePool", "llama-pool") is not None
+        assert mgr.cluster.get("HTTPRoute", "llama") is not None
+        scaled = mgr.cluster.get("ScaledObject", "llama-kserve")
+        assert "engine_generated_tokens_total" in scaled["spec"]["triggers"][0]["metadata"]["query"]
+
+    def test_prefill_decode_disaggregation(self):
+        mgr = ControllerManager()
+        mgr.apply(self._llm(prefill={"replicas": 2, "parallelism": {"tensor": 8}}))
+        prefill = mgr.cluster.get("Deployment", "llama-kserve-prefill")
+        assert prefill is not None
+        args = prefill["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--role=prefill" in args
+        # tp=8 on v5e -> 2x4 slice, 2 hosts per slice x 2 replicas
+        assert prefill["spec"]["replicas"] == 4
+
+    def test_multihost_gets_coordinator(self):
+        mgr = ControllerManager()
+        mgr.apply(self._llm(workload={"replicas": 1, "parallelism": {"tensor": 8}}))
+        dep = mgr.cluster.get("Deployment", "llama-kserve")
+        env = {e["name"]: e["value"] for e in
+               dep["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env["COORDINATOR_ADDRESS"].startswith("llama-kserve-peers.default")
+        assert env["NUM_PROCESSES"] == "2"
+        assert mgr.cluster.get("Service", "llama-kserve-peers") is not None
+
+
+class TestTrainedModelAndGraph:
+    def test_trained_model_updates_modelconfig(self):
+        import json
+
+        mgr = ControllerManager()
+        mgr.apply(make_isvc(name="mms"))
+        tm = {
+            "apiVersion": "serving.kserve.io/v1alpha1",
+            "kind": "TrainedModel",
+            "metadata": {"name": "modelA", "namespace": "default"},
+            "spec": {
+                "inferenceService": "mms",
+                "model": {"framework": "sklearn", "storageUri": "gs://b/a", "memory": "128Mi"},
+            },
+        }
+        mgr.apply(tm)
+        cm = mgr.cluster.get("ConfigMap", "modelconfig-mms-0")
+        entries = json.loads(cm["data"]["models.json"])
+        assert entries[0]["modelName"] == "modelA"
+
+    def test_graph_router_deployment(self):
+        mgr = ControllerManager()
+        graph = {
+            "apiVersion": "serving.kserve.io/v1alpha1",
+            "kind": "InferenceGraph",
+            "metadata": {"name": "pipeline", "namespace": "default"},
+            "spec": {
+                "nodes": {
+                    "root": {
+                        "routerType": "Sequence",
+                        "steps": [
+                            {"serviceName": "step1"},
+                            {"serviceName": "step2", "data": "$response"},
+                        ],
+                    }
+                }
+            },
+        }
+        mgr.apply(graph)
+        dep = mgr.cluster.get("Deployment", "pipeline")
+        assert dep is not None
+        args = dep["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert args[0] == "--graph-json"

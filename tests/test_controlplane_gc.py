@@ -1,0 +1,30 @@
+"""Owner-reference garbage collection on re-reconcile."""
+
+from kserve_tpu.controlplane.cluster import ControllerManager
+
+from test_controlplane import make_isvc
+
+
+def test_removing_transformer_prunes_deployment():
+    mgr = ControllerManager()
+    isvc = make_isvc()
+    isvc["spec"]["transformer"] = {
+        "containers": [{"name": "kserve-container", "image": "t"}]
+    }
+    mgr.apply(isvc)
+    assert mgr.cluster.get("Deployment", "iris-transformer") is not None
+    # re-apply without the transformer
+    mgr.apply(make_isvc())
+    assert mgr.cluster.get("Deployment", "iris-transformer") is None
+    assert mgr.cluster.get("Deployment", "iris-predictor") is not None
+
+
+def test_stop_annotation_prunes_all():
+    mgr = ControllerManager()
+    mgr.apply(make_isvc())
+    assert mgr.cluster.get("Deployment", "iris-predictor") is not None
+    stopped = make_isvc()
+    stopped["metadata"]["annotations"] = {"serving.kserve.io/stop": "true"}
+    mgr.apply(stopped)
+    assert mgr.cluster.get("Deployment", "iris-predictor") is None
+    assert mgr.cluster.get("HTTPRoute", "iris") is None

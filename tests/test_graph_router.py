@@ -1,0 +1,180 @@
+"""InferenceGraph router tests: node semantics against stub model servers."""
+
+import asyncio
+import json
+
+import httpx
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from kserve_tpu.graph.router import GraphRouter, GraphExecutionError, eval_condition
+
+from conftest import async_test
+
+
+class StubTransport(httpx.AsyncBaseTransport):
+    """Routes step calls to in-memory handlers keyed by host."""
+
+    def __init__(self, handlers):
+        self.handlers = handlers
+        self.calls = []
+
+    async def handle_async_request(self, request):
+        host = request.url.host
+        self.calls.append(host)
+        handler = self.handlers.get(host)
+        if handler is None:
+            return httpx.Response(404, json={"error": "no backend"})
+        body = json.loads(request.content) if request.content else {}
+        status, payload = handler(body)
+        return httpx.Response(status, json=payload)
+
+
+def make_router(nodes, handlers, retries=0):
+    transport = StubTransport(handlers)
+    client = httpx.AsyncClient(transport=transport)
+    return GraphRouter({"nodes": nodes}, retries=retries, client=client), transport
+
+
+class TestConditions:
+    def test_equality(self):
+        assert eval_condition("class==cat", {"class": "cat"})
+        assert not eval_condition("class==dog", {"class": "cat"})
+
+    def test_nested_and_numeric(self):
+        assert eval_condition("pred.0.score==0.9", {"pred": [{"score": 0.9}]})
+
+    def test_existence(self):
+        assert eval_condition("instances", {"instances": []})
+        assert not eval_condition("missing", {})
+
+
+class TestNodes:
+    @async_test
+    async def test_sequence_pipes_response(self):
+        router, transport = make_router(
+            {"root": {"routerType": "Sequence", "steps": [
+                {"serviceName": "a", "name": "m"},
+                {"serviceName": "b", "name": "m", "data": "$response"},
+            ]}},
+            {
+                "a": lambda body: (200, {"stage": "a", "got": body}),
+                "b": lambda body: (200, {"stage": "b", "got": body}),
+            },
+        )
+        out = await router.execute_node("root", {"x": 1}, {})
+        assert out["stage"] == "b"
+        assert out["got"]["stage"] == "a"  # b received a's output
+
+    @async_test
+    async def test_sequence_request_data(self):
+        router, _ = make_router(
+            {"root": {"routerType": "Sequence", "steps": [
+                {"serviceName": "a", "name": "m"},
+                {"serviceName": "b", "name": "m", "data": "$request"},
+            ]}},
+            {
+                "a": lambda body: (200, {"stage": "a"}),
+                "b": lambda body: (200, {"stage": "b", "got": body}),
+            },
+        )
+        out = await router.execute_node("root", {"x": 1}, {})
+        assert out["got"] == {"x": 1}  # original request, not a's output
+
+    @async_test
+    async def test_ensemble_merges(self):
+        router, _ = make_router(
+            {"root": {"routerType": "Ensemble", "steps": [
+                {"serviceName": "a", "name": "first"},
+                {"serviceName": "b", "name": "second"},
+            ]}},
+            {
+                "a": lambda body: (200, {"p": 1}),
+                "b": lambda body: (200, {"p": 2}),
+            },
+        )
+        out = await router.execute_node("root", {}, {})
+        assert out == {"first": {"p": 1}, "second": {"p": 2}}
+
+    @async_test
+    async def test_switch_picks_branch(self):
+        router, transport = make_router(
+            {"root": {"routerType": "Switch", "steps": [
+                {"serviceName": "cat-svc", "name": "m", "condition": "kind==cat"},
+                {"serviceName": "dog-svc", "name": "m", "condition": "kind==dog"},
+            ]}},
+            {
+                "cat-svc": lambda body: (200, {"svc": "cat"}),
+                "dog-svc": lambda body: (200, {"svc": "dog"}),
+            },
+        )
+        out = await router.execute_node("root", {"kind": "dog"}, {})
+        assert out["svc"] == "dog"
+        with pytest.raises(GraphExecutionError):
+            await router.execute_node("root", {"kind": "bird"}, {})
+
+    @async_test
+    async def test_splitter_respects_weights(self):
+        router, transport = make_router(
+            {"root": {"routerType": "Splitter", "steps": [
+                {"serviceName": "w100", "name": "m", "weight": 100},
+                {"serviceName": "w0", "name": "m", "weight": 0},
+            ]}},
+            {
+                "w100": lambda body: (200, {"svc": "w100"}),
+                "w0": lambda body: (200, {"svc": "w0"}),
+            },
+        )
+        for _ in range(10):
+            out = await router.execute_node("root", {}, {})
+            assert out["svc"] == "w100"
+
+    @async_test
+    async def test_nested_node_step(self):
+        router, _ = make_router(
+            {
+                "root": {"routerType": "Sequence", "steps": [{"nodeName": "inner"}]},
+                "inner": {"routerType": "Sequence", "steps": [{"serviceName": "a", "name": "m"}]},
+            },
+            {"a": lambda body: (200, {"svc": "inner-a"})},
+        )
+        out = await router.execute_node("root", {}, {})
+        assert out["svc"] == "inner-a"
+
+    @async_test
+    async def test_hard_dependency_fails_soft_continues(self):
+        nodes = {"root": {"routerType": "Sequence", "steps": [
+            {"serviceName": "bad", "name": "m", "dependency": "Soft"},
+            {"serviceName": "good", "name": "m"},
+        ]}}
+        router, _ = make_router(
+            nodes,
+            {
+                "bad": lambda body: (500, {"error": "boom"}),
+                "good": lambda body: (200, {"svc": "good", "got": body}),
+            },
+        )
+        out = await router.execute_node("root", {"x": 1}, {})
+        assert out["svc"] == "good"
+
+        nodes_hard = {"root": {"routerType": "Sequence", "steps": [
+            {"serviceName": "bad", "name": "m"},
+        ]}}
+        router2, _ = make_router(nodes_hard, {"bad": lambda body: (500, {"error": "x"})})
+        with pytest.raises(GraphExecutionError):
+            await router2.execute_node("root", {}, {})
+
+    @async_test
+    async def test_http_surface(self):
+        router, _ = make_router(
+            {"root": {"routerType": "Sequence", "steps": [{"serviceName": "a", "name": "m"}]}},
+            {"a": lambda body: (200, {"ok": True})},
+        )
+        client = TestClient(TestServer(router.create_application()))
+        async with client:
+            res = await client.post("/", json={"x": 1})
+            assert res.status == 200
+            assert (await res.json())["ok"] is True
+            bad = await client.post("/", data=b"not json")
+            assert bad.status == 400
