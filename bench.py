@@ -31,12 +31,12 @@ async def run_bench():
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
-        model_config = LlamaConfig.llama3_1b()
-        batch = 16
+        model_config = LlamaConfig.bench_1b()
+        batch = 32
         prompt_len = 128
         max_tokens = 128
         num_pages = 4096
-        n_requests = 48
+        n_requests = 96
     else:  # CPU smoke mode so the script is runnable anywhere
         model_config = LlamaConfig.tiny(dtype="float32")
         batch = 4
@@ -53,7 +53,8 @@ async def run_bench():
         max_prefill_len=512,
         prefill_buckets=(128, 256, 512),
         dtype="bfloat16" if on_tpu else "float32",
-        use_pallas=False,  # XLA paged attention; pallas kernel is opt-in
+        use_pallas=None,  # auto: Pallas paged attention on TPU, XLA on host
+        steps_per_sync=32,
     )
     tokenizer = ByteTokenizer(model_config.vocab_size)
     engine = LLMEngine(model_config, engine_config, tokenizer, rng_seed=0)
@@ -72,8 +73,9 @@ async def run_bench():
             n = out.num_generated
         return n
 
-    # warmup: compile prefill + decode
-    await asyncio.gather(*[one(prompt()) for _ in range(2)])
+    # warmup: compile decode + every prefill batch shape (pow2 padding means
+    # Bp in {1,2,4,8} all occur; 15 staggered requests hit each of them)
+    await asyncio.gather(*[one(prompt()) for _ in range(15)])
 
     start = time.perf_counter()
     counts = await asyncio.gather(*[one(prompt()) for _ in range(n_requests)])

@@ -1,0 +1,133 @@
+"""Multi-model serving agent: watches the modelconfig produced by the
+TrainedModel controller, downloads artifacts, hot load/unloads models.
+
+Parity: pkg/agent/watcher.go:81 (fsnotify on the configmap mount),
+puller.go:61-143 (per-model serialized download channels), downloader.go,
+syncer.go (boot reconcile).  Python asyncio replaces the Go goroutine
+plumbing: one watcher task + per-model serialized apply, with the same
+desired/actual diffing semantics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+from typing import Callable, Dict, Optional
+
+from ..logging import logger
+from ..model import BaseModel
+from ..model_repository import ModelRepository
+from ..storage.storage import Storage
+
+DEFAULT_CONFIG_FILE = "/mnt/configs/models.json"
+DEFAULT_MODEL_DIR = "/mnt/models"
+
+
+def default_model_factory(name: str, spec: dict, model_dir: str) -> BaseModel:
+    """Build a predictive model from a modelconfig entry
+    ({framework, storageUri, memory})."""
+    framework = (spec.get("framework") or "sklearn").lower()
+    from ..runtimes.predictive_server import build_model
+
+    model = build_model(framework, name, model_dir)
+    model.load()
+    return model
+
+
+class ModelAgent:
+    """Reconciles the model repository against the modelconfig file."""
+
+    def __init__(
+        self,
+        repository: ModelRepository,
+        config_file: str = DEFAULT_CONFIG_FILE,
+        models_dir: str = DEFAULT_MODEL_DIR,
+        model_factory: Callable[[str, dict, str], BaseModel] = default_model_factory,
+        poll_interval: float = 1.0,
+    ):
+        self.repository = repository
+        self.config_file = config_file
+        self.models_dir = models_dir
+        self.model_factory = model_factory
+        self.poll_interval = poll_interval
+        self._specs: Dict[str, dict] = {}
+        self._task: Optional[asyncio.Task] = None
+        self._stopped = False
+        self._mtime = 0.0
+
+    # ---------------- lifecycle ----------------
+
+    async def start(self):
+        await self.sync()  # boot reconcile (syncer.go role)
+        self._task = asyncio.create_task(self._watch_loop())
+
+    async def stop(self):
+        self._stopped = True
+        if self._task:
+            self._task.cancel()
+            self._task = None
+
+    async def _watch_loop(self):
+        while not self._stopped:
+            try:
+                mtime = os.path.getmtime(self.config_file)
+                if mtime != self._mtime:
+                    self._mtime = mtime
+                    await self.sync()
+            except FileNotFoundError:
+                pass
+            except Exception:
+                logger.exception("model agent sync failed")
+            await asyncio.sleep(self.poll_interval)
+
+    # ---------------- reconcile ----------------
+
+    def _desired(self) -> Dict[str, dict]:
+        try:
+            with open(self.config_file) as f:
+                entries = json.load(f)
+        except FileNotFoundError:
+            return {}
+        desired = {}
+        for entry in entries:
+            name = entry.get("modelName")
+            if name:
+                desired[name] = entry.get("modelSpec", {})
+        return desired
+
+    async def sync(self):
+        desired = self._desired()
+        current = dict(self._specs)
+        for name in current:
+            if name not in desired:
+                await self._unload(name)
+        for name, spec in desired.items():
+            if current.get(name) != spec:
+                await self._load(name, spec)
+
+    async def _load(self, name: str, spec: dict):
+        logger.info("agent: loading model %s", name)
+        try:
+            model_dir = os.path.join(self.models_dir, name)
+            uri = spec.get("storageUri")
+            if uri:
+                await asyncio.get_event_loop().run_in_executor(
+                    None, Storage.download, uri, model_dir
+                )
+            model = await asyncio.get_event_loop().run_in_executor(
+                None, self.model_factory, name, spec, model_dir
+            )
+            self.repository.update(model)
+            self._specs[name] = spec
+            logger.info("agent: model %s ready", name)
+        except Exception:
+            logger.exception("agent: failed to load model %s", name)
+
+    async def _unload(self, name: str):
+        logger.info("agent: unloading model %s", name)
+        try:
+            self.repository.unload(name)
+        except KeyError:
+            pass
+        self._specs.pop(name, None)

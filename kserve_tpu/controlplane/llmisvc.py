@@ -254,12 +254,22 @@ class LLMISVCReconciler:
 
     def _scaling(self, llm, workload: WorkloadSpec) -> Optional[dict]:
         name = f"{llm.metadata.name}-kserve"
+        # KEDA counts pods; a slice replica is hosts*num_slices pods, so the
+        # bounds must be whole-slice multiples or the autoscaler would tear
+        # a multi-host slice apart
+        par = workload.parallelism or ParallelismSpec()
+        plan = plan_slice(
+            tp=par.tp(), dp_local=par.dataLocal or 1,
+            num_slices=par.pipeline or 1, sequence=par.sequence or 1,
+        )
+        pods_per_replica = plan.hosts * plan.num_slices
         return make_object(
             "keda.sh/v1alpha1", "ScaledObject", name, llm.metadata.namespace,
             spec={
                 "scaleTargetRef": {"name": name},
-                "minReplicaCount": workload.replicas or 1,
-                "maxReplicaCount": max((workload.replicas or 1) * 4, 4),
+                "minReplicaCount": (workload.replicas or 1) * pods_per_replica,
+                "maxReplicaCount": max((workload.replicas or 1) * 4, 4) * pods_per_replica,
+                "podsPerReplica": pods_per_replica,
                 "triggers": [
                     {
                         "type": "prometheus",

@@ -232,13 +232,15 @@ class InferenceServiceReconciler:
         objects = [deployment, service]
         if plan is not None and plan.hosts > 1:
             # multi-host slice: headless service for deterministic peer
-            # addressing + a worker group (LeaderWorkerSet analogue)
+            # addressing + a worker group (LeaderWorkerSet analogue).
+            # replicas are slice-replica count x hosts-per-slice (minReplicas
+            # counts slice replicas, pods count hosts)
             headless = make_object(
                 "v1", "Service", f"{name}-peers", namespace, labels=dict(labels),
                 spec={"clusterIP": "None", "selector": {"app": name},
                       "ports": [{"name": "coord", "port": 8476}]},
             )
-            deployment["spec"]["replicas"] = plan.hosts * plan.num_slices
+            deployment["spec"]["replicas"] = replicas * plan.hosts * plan.num_slices
             deployment["metadata"]["annotations"] = {
                 "serving.kserve.io/tpu-slice-hosts": str(plan.hosts),
                 "serving.kserve.io/tpu-num-slices": str(plan.num_slices),

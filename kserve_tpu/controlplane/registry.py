@@ -108,9 +108,10 @@ class RuntimeRegistry:
         seen: dict = {}
         for fmt in runtime.spec.supportedModelFormats:
             key = (fmt.name, fmt.version)
-            if key in seen and seen[key] == fmt.priority:
+            priorities = seen.setdefault(key, set())
+            if fmt.priority in priorities:
                 raise RuntimeSelectionError(
                     f"runtime {runtime.metadata.name}: duplicate modelFormat "
                     f"{fmt.name} with identical priority"
                 )
-            seen[key] = fmt.priority
+            priorities.add(fmt.priority)

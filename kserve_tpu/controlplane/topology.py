@@ -99,14 +99,19 @@ def plan_slice(
 
 
 def inject_tpu_resources(pod_spec: dict, plan: SlicePlan) -> dict:
-    """Set google.com/tpu requests/limits on every container that asks for
-    accelerators (or the first container), plus slice node selectors.
+    """Set google.com/tpu requests/limits on the serving container, plus
+    slice node selectors.  Values are FORCED to chips-per-host: a user may
+    have written the slice-total chip count (that's what sized the plan), but
+    the kubelet schedules per host — leaving the total in place would make
+    every multi-host pod unschedulable.
     Parity role: accelerator_injector.go:32 (GPU selector injection)."""
     pod_spec.setdefault("nodeSelector", {}).update(plan.node_selectors())
     containers = pod_spec.get("containers", [])
     if containers:
         resources = containers[0].setdefault("resources", {})
         n = str(plan.tpu_resource_per_host())
-        resources.setdefault("requests", {})["google.com/tpu"] = n
-        resources.setdefault("limits", {})["google.com/tpu"] = n
+        resources.setdefault("requests", {})
+        resources.setdefault("limits", {})
+        resources["requests"]["google.com/tpu"] = n
+        resources["limits"]["google.com/tpu"] = n
     return pod_spec

@@ -58,6 +58,9 @@ class EngineConfig:
     # loop).  >1 amortizes host<->device latency — essential when the chip
     # sits behind a network tunnel; streaming granularity becomes K tokens.
     steps_per_sync: int = 8
+    # waiting requests prefilled together in one compiled call (padded to the
+    # largest length bucket among them; batch padded to pow2)
+    prefill_batch: int = 8
 
     def __post_init__(self):
         # prefill buckets must reach max_prefill_len or long prompts would
@@ -157,6 +160,8 @@ class LLMEngine:
         self._wake = asyncio.Event()
         self._stopped = False
         self._task: Optional[asyncio.Task] = None
+        self._pipeline_busy = False
+        self._deferred_free: List[int] = []
         self._build_compiled()
 
     # ---------------- compiled programs ----------------
@@ -176,7 +181,7 @@ class LLMEngine:
             return first, kv_pages
 
         def _decode_multi(params, tokens, pos, kv_pages, page_table, active,
-                          capacity, state, rng):
+                          capacity, counters, state, rng):
             """steps_per_sync decode steps on device; emits [steps, B] tokens.
             Lanes past their page capacity (or inactive) hold token/pos and
             write to the null page — a clamped page-table index would
@@ -184,19 +189,24 @@ class LLMEngine:
             steps = cfg.steps_per_sync
 
             def body(carry, step_rng):
-                tokens, pos, kv_pages = carry
+                tokens, pos, counters, kv_pages = carry
                 live = active & (pos < capacity)
                 logits, kv_pages = llama.decode_step(
                     params, mc, tokens, pos, kv_pages, page_table, live,
                     cfg.page_size, use_pallas=cfg.use_pallas,
                 )
-                nxt = sample_tokens(logits, state, step_rng)
+                nxt = sample_tokens(logits, state, step_rng, counters)
                 nxt = jnp.where(live, nxt, tokens)
-                return (nxt, pos + live.astype(pos.dtype), kv_pages), nxt
+                return (
+                    nxt,
+                    pos + live.astype(pos.dtype),
+                    counters + live.astype(counters.dtype),
+                    kv_pages,
+                ), nxt
 
             rngs = jax.random.split(rng, steps)
-            (tokens, pos, kv_pages), out = jax.lax.scan(
-                body, (tokens, pos, kv_pages), rngs
+            (tokens, pos, counters, kv_pages), out = jax.lax.scan(
+                body, (tokens, pos, counters, kv_pages), rngs
             )
             return out, kv_pages
 
@@ -268,7 +278,7 @@ class LLMEngine:
         self._waiting = [r for r in self._waiting if r.request_id != request_id]
         for slot in self._slots:
             if slot.request_id == request_id:
-                self.allocator.free(slot.pages)
+                self._free_pages(slot.pages)
                 slot.reset()
                 self._wake.set()
 
@@ -278,12 +288,11 @@ class LLMEngine:
         try:
             while not self._stopped:
                 did_work = False
-                # admission: prefill waiting requests into free slots
+                # admission: prefill waiting requests into free slots,
+                # batched so one compiled call covers many prompts
                 while self._waiting and self._free_slot_index() is not None:
-                    req = self._waiting[0]
-                    if not self._try_admit(req):
+                    if not self._admit_batch():
                         break
-                    self._waiting.pop(0)
                     did_work = True
                 ENGINE_QUEUE_DEPTH.labels(model_name="engine").set(len(self._waiting))
                 active = [s for s in self._slots if s.request_id is not None]
@@ -292,7 +301,7 @@ class LLMEngine:
                     self.allocator.free_pages
                 )
                 if active:
-                    self._decode_once()
+                    await self._decode_once()
                     did_work = True
                 if not did_work:
                     self._wake.clear()
@@ -322,56 +331,76 @@ class LLMEngine:
                 return b
         return self.config.prefill_buckets[-1]
 
-    def _try_admit(self, req: _QueuedRequest) -> bool:
-        """Prefill `req` into a free slot; False when pages are short."""
-        n_prompt = len(req.prompt_ids)
-        n_pages = pages_needed(n_prompt + 1, self.config.page_size)
-        if not self.allocator.can_allocate(n_pages):
+    def _admit_batch(self) -> bool:
+        """Prefill up to `prefill_batch` waiting requests in ONE compiled
+        call (padded to the widest length bucket among them); False when no
+        request can be admitted (no slots / no pages)."""
+        admitted: List[tuple] = []  # (slot_index, request, pages)
+        free = [i for i, s in enumerate(self._slots) if s.request_id is None]
+        while (
+            self._waiting
+            and free
+            and len(admitted) < self.config.prefill_batch
+        ):
+            req = self._waiting[0]
+            n_pages = pages_needed(len(req.prompt_ids) + 1, self.config.page_size)
+            if not self.allocator.can_allocate(n_pages):
+                break
+            self._waiting.pop(0)
+            admitted.append((free.pop(0), req, self.allocator.allocate(n_pages)))
+        if not admitted:
             return False
-        idx = self._free_slot_index()
-        slot = self._slots[idx]
-        pages = self.allocator.allocate(n_pages)
 
-        bucket = self._bucket_for(n_prompt)
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :n_prompt] = req.prompt_ids
-        page_ids = np.zeros((1, self.config.max_pages_per_seq), np.int32)
-        page_ids[0, : len(pages)] = pages
-
-        state = SamplingState.from_params([req.params])
+        bucket = self._bucket_for(max(len(r.prompt_ids) for _, r, _ in admitted))
+        # pad the batch dim to pow2 so the compile cache stays small
+        Bp = 1
+        while Bp < len(admitted):
+            Bp *= 2
+        tokens = np.zeros((Bp, bucket), np.int32)
+        valid = np.zeros((Bp,), np.int32)
+        page_ids = np.zeros((Bp, self.config.max_pages_per_seq), np.int32)
+        params_list = [SamplingParams() for _ in range(Bp)]
+        for j, (_, req, pages) in enumerate(admitted):
+            n = len(req.prompt_ids)
+            tokens[j, :n] = req.prompt_ids
+            valid[j] = n
+            page_ids[j, : len(pages)] = pages
+            params_list[j] = req.params
+        state = SamplingState.from_params(params_list)
         rng = jax.random.fold_in(self._base_rng, self._next_step())
-        if req.params.seed is not None:
-            rng = jax.random.PRNGKey(req.params.seed)
         first, self.kv_pages = self._prefill_fn(
             self.params,
             jnp.asarray(tokens),
-            jnp.asarray([n_prompt], jnp.int32),
+            jnp.asarray(valid),
             self.kv_pages,
             jnp.asarray(page_ids),
             state,
             rng,
         )
-        first_token = int(np.asarray(first)[0])
-        PROMPT_TOKENS.labels(model_name="engine").inc(n_prompt)
-
-        slot.request_id = req.request_id
-        slot.prompt_len = n_prompt
-        slot.pages = pages
-        slot.pos = n_prompt  # position of the token being decoded next
-        slot.generated = [first_token]
-        slot.params = req.params
-        slot.queue = req.queue
-        slot.detok = IncrementalDetokenizer(self.tokenizer)
-        slot.stop_texts = list(req.params.stop or [])
-        slot.admitted_at = time.perf_counter()
-        self._emit(slot, first_token)
+        first_np = np.asarray(first)
+        now = time.perf_counter()
+        for j, (idx, req, pages) in enumerate(admitted):
+            n_prompt = len(req.prompt_ids)
+            first_token = int(first_np[j])
+            PROMPT_TOKENS.labels(model_name="engine").inc(n_prompt)
+            slot = self._slots[idx]
+            slot.request_id = req.request_id
+            slot.prompt_len = n_prompt
+            slot.pages = pages
+            slot.pos = n_prompt  # position of the token being decoded next
+            slot.generated = [first_token]
+            slot.params = req.params
+            slot.queue = req.queue
+            slot.detok = IncrementalDetokenizer(self.tokenizer)
+            slot.stop_texts = list(req.params.stop or [])
+            slot.admitted_at = now
+            self._emit(slot, first_token)
         return True
 
-    def _ensure_pages(self, slot: _Slot, extra: int = 1) -> bool:
-        """Grow the slot's page list to cover positions slot.pos ..
-        slot.pos+extra-1 (the chunk about to be written).  False on
-        allocator exhaustion."""
-        needed = pages_needed(slot.pos + extra, self.config.page_size)
+    def _ensure_pages_at(self, slot: _Slot, base: int, extra: int) -> bool:
+        """Grow the slot's page list to cover positions base..base+extra-1.
+        False on allocator exhaustion or per-seq page limit."""
+        needed = pages_needed(base + extra, self.config.page_size)
         if needed > self.config.max_pages_per_seq:
             return False
         while len(slot.pages) < needed:
@@ -380,7 +409,24 @@ class LLMEngine:
             slot.pages.extend(self.allocator.allocate(1))
         return True
 
-    def _decode_once(self):
+    def _free_pages(self, pages: List[int]) -> None:
+        """Page frees are deferred while a chained chunk is in flight — a
+        reused page could otherwise be written by the stale lanes of the
+        in-flight program."""
+        if self._pipeline_busy:
+            self._deferred_free.extend(pages)
+        else:
+            self.allocator.free(pages)
+
+    def _flush_deferred_frees(self) -> None:
+        if self._deferred_free:
+            self.allocator.free(self._deferred_free)
+            self._deferred_free = []
+
+    def _prepare_chunk(self, prev: Optional[dict]) -> Optional[dict]:
+        """Build host-side inputs for a decode chunk.  `prev` chains the
+        chunk after an in-flight one: positions advance speculatively by
+        min(steps, prev capacity) without reading prev's tokens."""
         B = self.config.max_batch_size
         steps = self.config.steps_per_sync
         tokens = np.zeros((B,), np.int32)
@@ -392,47 +438,79 @@ class LLMEngine:
         for i, slot in enumerate(self._slots):
             if slot.request_id is None:
                 continue
+            if prev is not None:
+                if not prev["active"][i]:
+                    continue
+                base = min(int(prev["pos"][i]) + steps, int(prev["capacity"][i]))
+            else:
+                base = slot.pos
+                tokens[i] = slot.generated[-1]
             # grow pages toward this chunk's writes; a lane may cover only
             # part of the chunk (capacity masks the rest on device)
-            grow = min(steps, self.config.max_model_len - slot.pos)
-            if grow <= 0 or not self._ensure_pages(slot, extra=grow):
-                self._finish(slot, "length")
+            grow = min(steps, self.config.max_model_len - base)
+            if grow <= 0 or not self._ensure_pages_at(slot, base, grow):
+                if prev is None:
+                    self._finish(slot, "length")
                 continue
-            tokens[i] = slot.generated[-1]
-            pos[i] = slot.pos
+            pos[i] = base
             active[i] = True
             capacity[i] = len(slot.pages) * self.config.page_size
             params_list[i] = slot.params
             max_owned = max(max_owned, len(slot.pages))
         if not active.any():
-            return
+            return None
         # bucketed page-table width: attention gathers only ~longest-seq pages
         width = self.config.page_bucket(max_owned)
         page_table = np.zeros((B, width), np.int32)
         for i, slot in enumerate(self._slots):
             if slot.request_id is not None and active[i]:
                 page_table[i, : len(slot.pages)] = slot.pages
-        state = SamplingState.from_params(params_list)
+        counters = np.zeros((B,), np.int32)
+        for i, slot in enumerate(self._slots):
+            if slot.request_id is not None and active[i]:
+                # tokens generated when this chunk starts (for seeded lanes)
+                counters[i] = int(pos[i]) - slot.prompt_len + 1
+        return {
+            "tokens": tokens,
+            "pos": pos,
+            "active": active,
+            "capacity": capacity,
+            "page_table": page_table,
+            "counters": counters,
+            "state": SamplingState.from_params(params_list),
+        }
+
+    def _dispatch_chunk(self, meta: dict, tokens_dev=None):
+        """Launch one decode chunk (async); tokens_dev chains the previous
+        chunk's device-resident last tokens, skipping a host round-trip."""
         rng = jax.random.fold_in(self._base_rng, self._next_step())
+        tokens = tokens_dev if tokens_dev is not None else jnp.asarray(meta["tokens"])
         chunk, self.kv_pages = self._decode_fn(
             self.params,
-            jnp.asarray(tokens),
-            jnp.asarray(pos),
+            tokens,
+            jnp.asarray(meta["pos"]),
             self.kv_pages,
-            jnp.asarray(page_table),
-            jnp.asarray(active),
-            jnp.asarray(capacity),
-            state,
+            jnp.asarray(meta["page_table"]),
+            jnp.asarray(meta["active"]),
+            jnp.asarray(meta["capacity"]),
+            jnp.asarray(meta["counters"]),
+            meta["state"],
             rng,
         )
+        return chunk
+
+    def _route_chunk(self, meta: dict, chunk) -> bool:
+        """Read a finished chunk and stream its tokens.  True when any slot
+        finished (the pipeline must drain: chained lanes are stale)."""
+        steps = self.config.steps_per_sync
         chunk_np = np.asarray(chunk)  # [steps, B]
-        GENERATED_TOKENS.labels(model_name="engine").inc(
-            int(active.sum()) * steps
-        )
+        active = meta["active"]
+        GENERATED_TOKENS.labels(model_name="engine").inc(int(active.sum()) * steps)
+        finished_any = False
         for i, slot in enumerate(self._slots):
             if slot.request_id is None or not active[i]:
                 continue
-            lane_steps = min(steps, int(capacity[i]) - int(pos[i]))
+            lane_steps = min(steps, int(meta["capacity"][i]) - int(meta["pos"][i]))
             for s in range(lane_steps):
                 if slot.request_id is None:
                     break  # finished mid-chunk; discard speculative tail
@@ -440,8 +518,59 @@ class LLMEngine:
                 slot.pos += 1
                 slot.generated.append(token)
                 self._emit(slot, token)
-            if slot.request_id is not None and slot.pos >= self.config.max_model_len:
+            if slot.request_id is None:
+                finished_any = True
+            elif slot.pos >= self.config.max_model_len:
                 self._finish(slot, "length")
+                finished_any = True
+        return finished_any
+
+    async def _decode_once(self):
+        """Decode with a depth-2 dispatch pipeline: chunk N+1 launches
+        (chained on N's device tokens) before N's tokens are fetched, so the
+        host round-trip hides behind device compute."""
+        meta = self._prepare_chunk(prev=None)
+        if meta is None:
+            return
+        chunk = self._dispatch_chunk(meta)
+        while True:
+            meta2 = None
+            chunk2 = None
+            # chain when admission couldn't run anyway (no waiting work, or
+            # no free slot to admit into) and no lane is guaranteed to finish
+            # inside the in-flight chunk (a predictable max_tokens finish
+            # would force a drain, wasting the whole chained chunk)
+            admission_blocked = (
+                not self._waiting or self._free_slot_index() is None
+            )
+            predictable_finish = any(
+                s.request_id is not None
+                and meta["active"][i]
+                and len(s.generated) + self.config.steps_per_sync
+                >= s.params.max_tokens
+                for i, s in enumerate(self._slots)
+            )
+            if admission_blocked and not predictable_finish and not self._stopped:
+                meta2 = self._prepare_chunk(prev=meta)
+            if meta2 is not None:
+                chunk2 = self._dispatch_chunk(meta2, tokens_dev=chunk[-1])
+                self._pipeline_busy = True
+            finished_any = self._route_chunk(meta, chunk)
+            # flush streams while the chained chunk runs on device
+            await asyncio.sleep(0)
+            if chunk2 is None:
+                break
+            meta, chunk = meta2, chunk2
+            if finished_any or self._stopped or (
+                self._waiting and self._free_slot_index() is not None
+            ):
+                # in-flight chunk has stale lanes (or admission can now
+                # proceed); drain and re-plan
+                self._pipeline_busy = False
+                self._route_chunk(meta, chunk)
+                break
+        self._pipeline_busy = False
+        self._flush_deferred_frees()
 
     def _emit(self, slot: _Slot, token: int):
         """Stream one token; apply stop conditions."""
@@ -477,7 +606,7 @@ class LLMEngine:
         )
         slot.queue.put_nowait(out)
         if finish_reason is not None:
-            self.allocator.free(slot.pages)
+            self._free_pages(slot.pages)
             slot.reset()
             self._wake.set()
 
@@ -492,7 +621,7 @@ class LLMEngine:
             cumulative_text=slot.detok.text,
         )
         slot.queue.put_nowait(out)
-        self.allocator.free(slot.pages)
+        self._free_pages(slot.pages)
         slot.reset()
 
     def _next_step(self) -> int:

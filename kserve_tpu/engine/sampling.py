@@ -50,6 +50,7 @@ class SamplingState:
     top_p: jnp.ndarray  # [B] f32
     top_k: jnp.ndarray  # [B] i32 (0 => off)
     min_p: jnp.ndarray  # [B] f32
+    seed: jnp.ndarray  # [B] i32 (-1 => draw from the shared batch rng)
 
     @staticmethod
     def from_params(params_list: List[SamplingParams]) -> "SamplingState":
@@ -58,6 +59,9 @@ class SamplingState:
             top_p=jnp.asarray([p.top_p for p in params_list], jnp.float32),
             top_k=jnp.asarray([p.top_k for p in params_list], jnp.int32),
             min_p=jnp.asarray([p.min_p for p in params_list], jnp.float32),
+            seed=jnp.asarray(
+                [p.seed if p.seed is not None else -1 for p in params_list], jnp.int32
+            ),
         )
 
     @staticmethod
@@ -67,6 +71,7 @@ class SamplingState:
             top_p=jnp.ones((batch,), jnp.float32),
             top_k=jnp.zeros((batch,), jnp.int32),
             min_p=jnp.zeros((batch,), jnp.float32),
+            seed=jnp.full((batch,), -1, jnp.int32),
         )
 
 
@@ -74,8 +79,12 @@ def sample_tokens(
     logits: jnp.ndarray,  # [B, V] f32
     state: SamplingState,
     rng: jax.Array,
+    counters: Optional[jnp.ndarray] = None,  # [B] i32: tokens generated so far
 ) -> jnp.ndarray:
-    """Returns [B] sampled token ids.  temperature==0 rows are greedy."""
+    """Returns [B] sampled token ids.  temperature==0 rows are greedy.
+    Rows with state.seed >= 0 draw from their own PRNG stream
+    (PRNGKey(seed) folded with the row's token counter) so a client-supplied
+    seed reproduces output regardless of batching."""
     B, V = logits.shape
     greedy = jnp.argmax(logits, axis=-1)
 
@@ -114,7 +123,14 @@ def sample_tokens(
     )
     scaled = jnp.where(minp_mask, -jnp.inf, scaled)
 
-    sampled = jax.random.categorical(rng, scaled, axis=-1)
+    if counters is None:
+        counters = jnp.zeros((B,), jnp.int32)
+    batch_keys = jax.random.split(rng, B)
+    seeded_keys = jax.vmap(
+        lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c)
+    )(jnp.maximum(state.seed, 0), counters)
+    keys = jnp.where((state.seed >= 0)[:, None], seeded_keys, batch_keys)
+    sampled = jax.vmap(lambda k, row: jax.random.categorical(k, row))(keys, scaled)
     return jnp.where(state.temperature <= 0.0, greedy, sampled).astype(jnp.int32)
 
 

@@ -1,0 +1,246 @@
+"""BERT-family encoder in functional JAX: embeddings, rerank (cross-encoder),
+sequence classification, fill-mask.
+
+Role parity: the reference huggingfaceserver encoder path
+(python/huggingfaceserver/huggingfaceserver/encoder_model.py:71 — BERT-style
+tasks at :402-687) runs torch on CPU/GPU; here the whole encoder is one
+jitted XLA program with bucketed sequence lengths.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.norms import layer_norm
+
+Params = Dict[str, Any]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    num_labels: int = 2
+    dtype: str = "float32"
+
+    @staticmethod
+    def tiny(**overrides) -> "BertConfig":
+        base = dict(
+            vocab_size=128,
+            hidden_size=32,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            intermediate_size=64,
+            max_position_embeddings=64,
+        )
+        base.update(overrides)
+        return BertConfig(**base)
+
+    @staticmethod
+    def from_hf_config(path_or_dict) -> "BertConfig":
+        if isinstance(path_or_dict, str):
+            with open(path_or_dict) as f:
+                cfg = json.load(f)
+        else:
+            cfg = dict(path_or_dict)
+        return BertConfig(
+            vocab_size=cfg["vocab_size"],
+            hidden_size=cfg["hidden_size"],
+            num_hidden_layers=cfg["num_hidden_layers"],
+            num_attention_heads=cfg["num_attention_heads"],
+            intermediate_size=cfg["intermediate_size"],
+            max_position_embeddings=cfg.get("max_position_embeddings", 512),
+            type_vocab_size=cfg.get("type_vocab_size", 2),
+            layer_norm_eps=cfg.get("layer_norm_eps", 1e-12),
+        )
+
+
+def init_params(config: BertConfig, rng: jax.Array, scale: float = 0.02) -> Params:
+    h = config.hidden_size
+    keys = iter(jax.random.split(rng, 8 * config.num_hidden_layers + 8))
+
+    def dense(shape):
+        return jax.random.normal(next(keys), shape, jnp.float32) * scale
+
+    def ln():
+        return {"weight": jnp.ones((h,)), "bias": jnp.zeros((h,))}
+
+    layers = []
+    for _ in range(config.num_hidden_layers):
+        layers.append(
+            {
+                "q": {"w": dense((h, h)), "b": jnp.zeros((h,))},
+                "k": {"w": dense((h, h)), "b": jnp.zeros((h,))},
+                "v": {"w": dense((h, h)), "b": jnp.zeros((h,))},
+                "o": {"w": dense((h, h)), "b": jnp.zeros((h,))},
+                "attn_ln": ln(),
+                "ffn_in": {"w": dense((h, config.intermediate_size)),
+                           "b": jnp.zeros((config.intermediate_size,))},
+                "ffn_out": {"w": dense((config.intermediate_size, h)), "b": jnp.zeros((h,))},
+                "ffn_ln": ln(),
+            }
+        )
+    return {
+        "word_embeddings": dense((config.vocab_size, h)),
+        "position_embeddings": dense((config.max_position_embeddings, h)),
+        "token_type_embeddings": dense((config.type_vocab_size, h)),
+        "embed_ln": ln(),
+        "layers": layers,
+        "pooler": {"w": dense((h, h)), "b": jnp.zeros((h,))},
+        "classifier": {"w": dense((h, config.num_labels)), "b": jnp.zeros((config.num_labels,))},
+        "mlm_transform": {"w": dense((h, h)), "b": jnp.zeros((h,))},
+        "mlm_ln": ln(),
+        "mlm_bias": jnp.zeros((config.vocab_size,)),
+    }
+
+
+def encode(
+    params: Params,
+    config: BertConfig,
+    input_ids: jnp.ndarray,  # [B, T]
+    attention_mask: jnp.ndarray,  # [B, T]
+    token_type_ids: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Encoder stack -> hidden states [B, T, H]."""
+    B, T = input_ids.shape
+    h = config.hidden_size
+    nh = config.num_attention_heads
+    hd = h // nh
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros_like(input_ids)
+    x = (
+        params["word_embeddings"][input_ids]
+        + params["position_embeddings"][jnp.arange(T)][None]
+        + params["token_type_embeddings"][token_type_ids]
+    )
+    x = layer_norm(x, params["embed_ln"]["weight"], params["embed_ln"]["bias"],
+                   config.layer_norm_eps)
+    mask_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, -1e30)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    for layer in params["layers"]:
+        q = (x @ layer["q"]["w"] + layer["q"]["b"]).reshape(B, T, nh, hd)
+        k = (x @ layer["k"]["w"] + layer["k"]["b"]).reshape(B, T, nh, hd)
+        v = (x @ layer["v"]["w"] + layer["v"]["b"]).reshape(B, T, nh, hd)
+        scores = jnp.einsum("bthd,bshd->bhts", q, k) * scale + mask_bias
+        weights = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhts,bshd->bthd", weights, v).reshape(B, T, h)
+        attn = attn @ layer["o"]["w"] + layer["o"]["b"]
+        x = layer_norm(x + attn, layer["attn_ln"]["weight"], layer["attn_ln"]["bias"],
+                       config.layer_norm_eps)
+        ffn = jax.nn.gelu(x @ layer["ffn_in"]["w"] + layer["ffn_in"]["b"], approximate=False)
+        ffn = ffn @ layer["ffn_out"]["w"] + layer["ffn_out"]["b"]
+        x = layer_norm(x + ffn, layer["ffn_ln"]["weight"], layer["ffn_ln"]["bias"],
+                       config.layer_norm_eps)
+    return x
+
+
+def embed(params, config, input_ids, attention_mask, normalize: bool = True) -> jnp.ndarray:
+    """Mean-pooled sentence embeddings [B, H]."""
+    hidden = encode(params, config, input_ids, attention_mask)
+    mask = attention_mask[..., None].astype(hidden.dtype)
+    pooled = (hidden * mask).sum(axis=1) / jnp.clip(mask.sum(axis=1), 1e-9)
+    if normalize:
+        pooled = pooled / jnp.clip(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12)
+    return pooled
+
+
+def classify(params, config, input_ids, attention_mask, token_type_ids=None) -> jnp.ndarray:
+    """Sequence classification logits [B, num_labels] (CLS + pooler)."""
+    hidden = encode(params, config, input_ids, attention_mask, token_type_ids)
+    cls = jnp.tanh(hidden[:, 0] @ params["pooler"]["w"] + params["pooler"]["b"])
+    return cls @ params["classifier"]["w"] + params["classifier"]["b"]
+
+
+def fill_mask_logits(params, config, input_ids, attention_mask) -> jnp.ndarray:
+    """MLM logits [B, T, vocab] (transform + tied decoder)."""
+    hidden = encode(params, config, input_ids, attention_mask)
+    t = jax.nn.gelu(
+        hidden @ params["mlm_transform"]["w"] + params["mlm_transform"]["b"],
+        approximate=False,
+    )
+    t = layer_norm(t, params["mlm_ln"]["weight"], params["mlm_ln"]["bias"],
+                   config.layer_norm_eps)
+    return t @ params["word_embeddings"].T + params["mlm_bias"]
+
+
+# ---------------- HF checkpoint loading ----------------
+
+
+def load_hf_weights(model_dir: str, config: BertConfig) -> Params:
+    """Local BERT safetensors checkpoint -> param pytree (torch-free)."""
+    from safetensors import safe_open
+
+    tensors: Dict[str, np.ndarray] = {}
+    for f in sorted(os.listdir(model_dir)):
+        if f.endswith(".safetensors"):
+            with safe_open(os.path.join(model_dir, f), framework="numpy") as sf:
+                for name in sf.keys():
+                    tensors[name.removeprefix("bert.")] = sf.get_tensor(name)
+
+    def t(name, transpose=False):
+        arr = tensors[name]
+        return jnp.asarray(arr.T if transpose else arr, jnp.float32)
+
+    def maybe(name, default, transpose=False):
+        if name in tensors:
+            return t(name, transpose)
+        return default
+
+    params: Params = {
+        "word_embeddings": t("embeddings.word_embeddings.weight"),
+        "position_embeddings": t("embeddings.position_embeddings.weight"),
+        "token_type_embeddings": t("embeddings.token_type_embeddings.weight"),
+        "embed_ln": {"weight": t("embeddings.LayerNorm.weight"),
+                     "bias": t("embeddings.LayerNorm.bias")},
+        "layers": [],
+        "pooler": {
+            "w": maybe("pooler.dense.weight", jnp.zeros((config.hidden_size, config.hidden_size)), True),
+            "b": maybe("pooler.dense.bias", jnp.zeros((config.hidden_size,))),
+        },
+        "classifier": {
+            "w": maybe("classifier.weight", jnp.zeros((config.hidden_size, config.num_labels)), True),
+            "b": maybe("classifier.bias", jnp.zeros((config.num_labels,))),
+        },
+        "mlm_transform": {
+            "w": maybe("cls.predictions.transform.dense.weight",
+                       jnp.zeros((config.hidden_size, config.hidden_size)), True),
+            "b": maybe("cls.predictions.transform.dense.bias", jnp.zeros((config.hidden_size,))),
+        },
+        "mlm_ln": {
+            "weight": maybe("cls.predictions.transform.LayerNorm.weight",
+                            jnp.ones((config.hidden_size,))),
+            "bias": maybe("cls.predictions.transform.LayerNorm.bias",
+                          jnp.zeros((config.hidden_size,))),
+        },
+        "mlm_bias": maybe("cls.predictions.bias", jnp.zeros((config.vocab_size,))),
+    }
+    for i in range(config.num_hidden_layers):
+        p = f"encoder.layer.{i}."
+        params["layers"].append(
+            {
+                "q": {"w": t(p + "attention.self.query.weight", True), "b": t(p + "attention.self.query.bias")},
+                "k": {"w": t(p + "attention.self.key.weight", True), "b": t(p + "attention.self.key.bias")},
+                "v": {"w": t(p + "attention.self.value.weight", True), "b": t(p + "attention.self.value.bias")},
+                "o": {"w": t(p + "attention.output.dense.weight", True), "b": t(p + "attention.output.dense.bias")},
+                "attn_ln": {"weight": t(p + "attention.output.LayerNorm.weight"),
+                            "bias": t(p + "attention.output.LayerNorm.bias")},
+                "ffn_in": {"w": t(p + "intermediate.dense.weight", True), "b": t(p + "intermediate.dense.bias")},
+                "ffn_out": {"w": t(p + "output.dense.weight", True), "b": t(p + "output.dense.bias")},
+                "ffn_ln": {"weight": t(p + "output.LayerNorm.weight"),
+                           "bias": t(p + "output.LayerNorm.bias")},
+            }
+        )
+    return params

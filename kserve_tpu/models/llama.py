@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..engine.kvcache import append_token_kv, write_prompt_kv
+from ..engine.kvcache import append_token_kv, write_prompt_kv_batch
 from ..ops.attention import causal_prefill_attention, paged_attention
 from ..ops.norms import rms_norm
 from ..ops.rotary import apply_rope
@@ -94,6 +94,24 @@ class LlamaConfig:
             n_heads=32,
             n_kv_heads=8,
             head_dim=64,
+            rope_theta=500000.0,
+            max_position_embeddings=8192,
+            tie_word_embeddings=True,
+        )
+
+    @staticmethod
+    def bench_1b() -> "LlamaConfig":
+        """1B-class flagship with MXU-native head_dim=128 (the Pallas paged
+        attention kernel requires 128-aligned heads; llama3_1b's d=64 takes
+        the XLA fallback path until the packed-row kernel variant lands)."""
+        return LlamaConfig(
+            vocab_size=128256,
+            hidden_size=2048,
+            intermediate_size=8192,
+            n_layers=16,
+            n_heads=16,
+            n_kv_heads=8,
+            head_dim=128,
             rope_theta=500000.0,
             max_position_embeddings=8192,
             tie_word_embeddings=True,
@@ -223,11 +241,8 @@ def prefill(
         residual = x
         h = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
         x = residual + _mlp(layer, h)
-        # scatter each row's K/V into its pages
-        for b in range(B):
-            pages = write_prompt_kv(
-                pages, k[b], v[b], page_ids[b], valid_len[b], page_size
-            )
+        # scatter the whole batch's K/V into its pages in one op
+        pages = write_prompt_kv_batch(pages, k, v, page_ids, valid_len, page_size)
         new_pages.append(pages)
     last = jnp.maximum(valid_len - 1, 0)
     x_last = x[jnp.arange(B), last]  # [B, h]

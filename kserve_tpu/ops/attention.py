@@ -65,7 +65,7 @@ def causal_prefill_attention(
 
 def paged_attention_xla(
     q: jnp.ndarray,  # [B, nq, d] — one decode token per sequence
-    kv_pages: jnp.ndarray,  # [2, nkv, num_pages, ps, d]
+    kv_pages: jnp.ndarray,  # [2, num_pages, nkv, ps, d]
     page_table: jnp.ndarray,  # [B, max_pages]
     seq_lens: jnp.ndarray,  # [B] int32 (length INCLUDING current token)
     logit_softcap: float = 0.0,
@@ -73,14 +73,14 @@ def paged_attention_xla(
     """Decode attention: gather this batch's pages and do masked softmax.
     Materializes [B, L, nkv, d]; the Pallas kernel avoids that copy."""
     B, nq, d = q.shape
-    nkv = kv_pages.shape[1]
+    nkv = kv_pages.shape[2]
     ps = kv_pages.shape[3]
     max_pages = page_table.shape[1]
     L = max_pages * ps
-    # gather: [2, nkv, B, max_pages, ps, d]
-    gathered = kv_pages[:, :, page_table, :, :]
-    k = gathered[0].transpose(1, 2, 3, 0, 4).reshape(B, L, nkv, d)
-    v = gathered[1].transpose(1, 2, 3, 0, 4).reshape(B, L, nkv, d)
+    # gather: [2, B, max_pages, nkv, ps, d]
+    gathered = kv_pages[:, page_table]
+    k = gathered[0].transpose(0, 1, 3, 2, 4).reshape(B, L, nkv, d)
+    v = gathered[1].transpose(0, 1, 3, 2, 4).reshape(B, L, nkv, d)
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     scores = _gqa_scores(q[:, None], k) * scale  # [B,nq,1,L]
     if logit_softcap > 0.0:
@@ -101,16 +101,15 @@ def paged_attention(
     logit_softcap: float = 0.0,
     use_pallas: Optional[bool] = None,
 ) -> jnp.ndarray:
-    """Dispatch to the Pallas kernel on TPU, XLA fallback elsewhere."""
+    """Dispatch to the Pallas kernel on TPU (head_dim 128-aligned), XLA
+    fallback elsewhere."""
+    d = q.shape[-1]
     if use_pallas is None:
-        use_pallas = jax.default_backend() == "tpu"
-    if use_pallas:
-        try:
-            from .pallas_paged_attention import paged_attention_pallas
+        use_pallas = jax.default_backend() == "tpu" and d % 128 == 0
+    if use_pallas and d % 128 == 0:
+        from .pallas_paged_attention import paged_attention_pallas
 
-            return paged_attention_pallas(
-                q, kv_pages, page_table, seq_lens, logit_softcap=logit_softcap
-            )
-        except Exception:  # pragma: no cover — kernel unavailable on host
-            pass
+        return paged_attention_pallas(
+            q, kv_pages, page_table, seq_lens, logit_softcap=logit_softcap
+        )
     return paged_attention_xla(q, kv_pages, page_table, seq_lens, logit_softcap)

@@ -1,0 +1,162 @@
+"""Fused paged-attention decode kernel (Pallas/TPU).
+
+One grid step per sequence: the kernel walks the sequence's page list
+(scalar-prefetched page table), streams each page's K/V from HBM into a
+double-buffered VMEM scratch with async DMA, and folds it into an online-
+softmax accumulator — no [B, L, nkv, d] gather ever materializes, so HBM
+traffic is exactly one read of the live KV plus the output write.
+
+This is the Ragged Paged Attention design point (see PAPERS.md) specialized
+to decode (query length 1 per sequence).  The page-major cache layout
+([2, num_pages, nkv, ps, d]) makes each DMA cover all local KV heads.
+
+Numerics match ops/attention.paged_attention_xla (tests compare both paths
+in interpret mode; bench exercises the compiled kernel on hardware).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _decode_kernel(
+    # scalar prefetch
+    page_table_ref,  # [B, max_pages] int32 (SMEM)
+    seq_lens_ref,  # [B] int32 (SMEM)
+    # inputs
+    q_ref,  # [1, nq, d] VMEM block for this sequence
+    kv_hbm_ref,  # [2, num_pages, nkv, ps, d] in HBM (ANY)
+    # output
+    out_ref,  # [1, nq, d] VMEM
+    # scratch
+    kv_bufs,  # [2(buffer), 2(k/v), nkv, ps, d] VMEM
+    sems,  # DMA semaphores [2]
+    *,
+    page_size: int,
+    num_kv_heads: int,
+    head_dim: int,
+    scale: float,
+    logit_softcap: float,
+):
+    b = pl.program_id(0)
+    seq_len = seq_lens_ref[b]
+    num_pages = (seq_len + page_size - 1) // page_size
+    nq = q_ref.shape[1]
+    group = nq // num_kv_heads
+
+    def start_copy(i, slot):
+        # two leading-dim DMAs (K then V): strided [:, page] slices are not
+        # DMA-able, [kv, page] prefixes are
+        page = page_table_ref[b, i]
+        pltpu.make_async_copy(
+            kv_hbm_ref.at[0, page], kv_bufs.at[slot, 0], sems.at[slot, 0]
+        ).start()
+        pltpu.make_async_copy(
+            kv_hbm_ref.at[1, page], kv_bufs.at[slot, 1], sems.at[slot, 1]
+        ).start()
+
+    @pl.when(num_pages > 0)
+    def _():
+        start_copy(0, 0)
+
+    # q laid out per kv-head group: [nkv, group, d] in f32
+    q = q_ref[0].astype(jnp.float32).reshape(num_kv_heads, group, head_dim)
+
+    def body(i, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(i, 2)
+        pltpu.make_async_copy(
+            kv_hbm_ref.at[0, 0], kv_bufs.at[slot, 0], sems.at[slot, 0]
+        ).wait()
+        pltpu.make_async_copy(
+            kv_hbm_ref.at[1, 0], kv_bufs.at[slot, 1], sems.at[slot, 1]
+        ).wait()
+
+        @pl.when(i + 1 < num_pages)
+        def _():
+            start_copy(i + 1, 1 - slot)
+
+        k = kv_bufs[slot, 0].astype(jnp.float32)  # [nkv, ps, d]
+        v = kv_bufs[slot, 1].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [nkv, group, ps]
+        if logit_softcap > 0.0:
+            s = jnp.tanh(s / logit_softcap) * logit_softcap
+        token_pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, page_size), 2
+        )
+        s = jnp.where(token_pos < seq_len, s, -1e30)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))  # [nkv, group, 1]
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # [nkv, group, d]
+        acc_new = acc * alpha + pv
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((num_kv_heads, group, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((num_kv_heads, group, 1), jnp.float32)
+    acc0 = jnp.zeros((num_kv_heads, group, head_dim), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, num_pages, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)
+    out_ref[0] = out.reshape(nq, head_dim).astype(out_ref.dtype)
+
+
+def paged_attention_pallas(
+    q: jnp.ndarray,  # [B, nq, d]
+    kv_pages: jnp.ndarray,  # [2, num_pages, nkv, ps, d]
+    page_table: jnp.ndarray,  # [B, max_pages] int32
+    seq_lens: jnp.ndarray,  # [B] int32
+    logit_softcap: float = 0.0,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    B, nq, d = q.shape
+    _, num_pages_total, nkv, ps, _ = kv_pages.shape
+    if d % 128 != 0 and not interpret:
+        # Lane tiling pads head_dim to 128 and Mosaic rejects both DMA
+        # slices of the padded trailing dim and the shape-cast that would
+        # unpack a token-packed row.  TODO(round2): packed-q compute for
+        # d=64 models; callers fall back to the XLA path meanwhile.
+        raise ValueError(
+            f"pallas paged attention requires head_dim % 128 == 0, got {d}"
+        )
+    scale = float(1.0 / (d ** 0.5))
+    kernel = functools.partial(
+        _decode_kernel,
+        page_size=ps,
+        num_kv_heads=nkv,
+        head_dim=d,
+        scale=scale,
+        logit_softcap=logit_softcap,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, nq, d), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+        ],
+        out_specs=pl.BlockSpec((1, nq, d), lambda b, *_: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM(tuple((2, 2) + kv_pages.shape[2:]), kv_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, nq, d), q.dtype),
+        interpret=interpret,
+    )(page_table, seq_lens, q, kv_pages)

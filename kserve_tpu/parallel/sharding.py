@@ -78,8 +78,8 @@ def param_pspecs(config: LlamaConfig) -> Dict[str, Any]:
 
 
 def kv_pages_pspec() -> P:
-    """[2, n_kv, num_pages, ps, d] — shard KV heads over model axis."""
-    return P(None, MODEL_AXIS, None, None, None)
+    """[2, num_pages, n_kv, ps, d] — shard KV heads over model axis."""
+    return P(None, None, MODEL_AXIS, None, None)
 
 
 def batch_pspecs() -> Dict[str, P]:

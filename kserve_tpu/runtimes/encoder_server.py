@@ -1,0 +1,240 @@
+"""Encoder runtime: embeddings / rerank / fill-mask / classification on the
+JAX BERT stack.
+
+Parity: python/huggingfaceserver/huggingfaceserver/encoder_model.py:71
+(tasks :402-687) — OpenAI embeddings + rerank, V1/V2 predict for
+classification and fill-mask.  Sequence lengths are bucketed so each bucket
+compiles once.
+
+Entrypoint:
+    python -m kserve_tpu.runtimes.encoder_server --model_name=bert \
+        --model_dir=/mnt/models --task=embedding
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import os
+from typing import Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.tokenizer import load_tokenizer
+from ..errors import InferenceError, InvalidInput
+from ..infer_type import InferRequest
+from ..model import Model
+from ..model_server import ModelServer, build_arg_parser
+from ..models import bert
+from ..protocol.openai.openai_model import OpenAIEncoderModel
+from ..protocol.openai.types import (
+    Embedding,
+    EmbeddingObject,
+    EmbeddingRequest,
+    Rerank,
+    RerankRequest,
+    RerankResult,
+    RerankResultDocument,
+    UsageInfo,
+)
+from ..utils.inference import get_predict_response
+
+TASKS = ("embedding", "rerank", "classification", "fill_mask")
+_BUCKETS = (16, 32, 64, 128, 256, 512)
+
+
+class JAXEncoderModel(Model, OpenAIEncoderModel):
+    """Speaks both protocol families: OpenAI embeddings/rerank AND the
+    V1/V2 predict pipeline (classification / fill-mask)."""
+
+    def __init__(
+        self,
+        name: str,
+        model_dir: Optional[str] = None,
+        config: Optional[bert.BertConfig] = None,
+        task: str = "embedding",
+        random_weights: bool = False,
+        max_length: int = 512,
+    ):
+        super().__init__(name)
+        if task not in TASKS:
+            raise ValueError(f"unknown task {task!r}; expected one of {TASKS}")
+        self.model_dir = model_dir
+        self.config = config
+        self.task = task
+        self.random_weights = random_weights
+        self.max_length = max_length
+        self.tokenizer = None
+        self._params = None
+        self._embed_fn = None
+        self._classify_fn = None
+        self._mlm_fn = None
+
+    def load(self) -> bool:
+        if self.config is None:
+            cfg_path = os.path.join(self.model_dir or "", "config.json")
+            if not os.path.exists(cfg_path):
+                raise FileNotFoundError(f"no config.json under {self.model_dir}")
+            self.config = bert.BertConfig.from_hf_config(cfg_path)
+        self.tokenizer = load_tokenizer(self.model_dir, self.config.vocab_size)
+        if self.random_weights or not self.model_dir:
+            self._params = bert.init_params(self.config, jax.random.PRNGKey(0))
+        else:
+            self._params = bert.load_hf_weights(self.model_dir, self.config)
+        cfg = self.config
+
+        self._embed_fn = jax.jit(lambda p, ids, mask: bert.embed(p, cfg, ids, mask))
+        self._classify_fn = jax.jit(
+            lambda p, ids, mask, types: bert.classify(p, cfg, ids, mask, types)
+        )
+        self._mlm_fn = jax.jit(lambda p, ids, mask: bert.fill_mask_logits(p, cfg, ids, mask))
+        self.ready = True
+        return True
+
+    # ---------------- tokenization ----------------
+
+    def _bucket(self, n: int) -> int:
+        for b in _BUCKETS:
+            if n <= b and b <= self.max_length:
+                return b
+        return self.max_length
+
+    def _batch_encode(self, texts: List[str], pairs: Optional[List[str]] = None):
+        encoded = []
+        type_ids = []
+        for i, text in enumerate(texts):
+            ids = self.tokenizer.encode(text, add_bos=False)[: self.max_length]
+            types = [0] * len(ids)
+            if pairs is not None:
+                second = self.tokenizer.encode(pairs[i], add_bos=False)
+                room = self.max_length - len(ids)
+                ids = ids + second[:room]
+                types = types + [1] * len(second[:room])
+            encoded.append(ids)
+            type_ids.append(types)
+        longest = self._bucket(max(len(e) for e in encoded))
+        B = len(encoded)
+        input_ids = np.zeros((B, longest), np.int32)
+        mask = np.zeros((B, longest), np.int32)
+        types_arr = np.zeros((B, longest), np.int32)
+        for i, (ids, types) in enumerate(zip(encoded, type_ids)):
+            n = min(len(ids), longest)
+            input_ids[i, :n] = ids[:n]
+            mask[i, :n] = 1
+            types_arr[i, :n] = types[:n]
+        return jnp.asarray(input_ids), jnp.asarray(mask), jnp.asarray(types_arr)
+
+    # ---------------- OpenAI verbs ----------------
+
+    async def create_embedding(self, request: EmbeddingRequest, raw_request=None, context=None) -> Embedding:
+        inputs = request.input
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        if not inputs or not isinstance(inputs[0], str):
+            raise InvalidInput("embedding input must be a string or list of strings")
+        ids, mask, _ = self._batch_encode(list(inputs))
+        vectors = np.asarray(self._embed_fn(self._params, ids, mask))
+        if request.dimensions:
+            vectors = vectors[:, : request.dimensions]
+        data = []
+        for i, vec in enumerate(vectors):
+            if request.encoding_format == "base64":
+                payload = base64.b64encode(vec.astype(np.float32).tobytes()).decode()
+            else:
+                payload = [float(x) for x in vec]
+            data.append(EmbeddingObject(index=i, embedding=payload))
+        n_tokens = int(np.asarray(mask).sum())
+        return Embedding(
+            data=data,
+            model=request.model,
+            usage=UsageInfo(prompt_tokens=n_tokens, total_tokens=n_tokens),
+        )
+
+    async def create_rerank(self, request: RerankRequest, raw_request=None, context=None) -> Rerank:
+        if not request.documents:
+            raise InvalidInput("rerank requires documents")
+        ids, mask, types = self._batch_encode(
+            [request.query] * len(request.documents), request.documents
+        )
+        logits = np.asarray(self._classify_fn(self._params, ids, mask, types))
+        # cross-encoder convention: single-logit score, else positive class
+        scores = logits[:, 0] if logits.shape[1] == 1 else logits[:, -1]
+        order = np.argsort(-scores)
+        if request.top_n:
+            order = order[: request.top_n]
+        results = [
+            RerankResult(
+                index=int(i),
+                relevance_score=float(scores[i]),
+                document=RerankResultDocument(text=request.documents[i])
+                if request.return_documents
+                else None,
+            )
+            for i in order
+        ]
+        n_tokens = int(np.asarray(mask).sum())
+        return Rerank(results=results, model=request.model,
+                      usage=UsageInfo(prompt_tokens=n_tokens, total_tokens=n_tokens))
+
+    # ---------------- V1/V2 predict (classification / fill-mask) ----------------
+
+    async def predict(self, payload, headers=None, response_headers=None):
+        if isinstance(payload, InferRequest):
+            texts = payload.inputs[0].as_string()
+        else:
+            texts = payload.get("instances") or payload.get("inputs")
+        if not isinstance(texts, list) or not texts or not isinstance(texts[0], str):
+            raise InvalidInput("expected a list of strings")
+        try:
+            ids, mask, types = self._batch_encode(texts)
+            if self.task == "fill_mask":
+                logits = np.asarray(self._mlm_fn(self._params, ids, mask))
+                result = np.argmax(logits, axis=-1)
+            else:
+                logits = np.asarray(self._classify_fn(self._params, ids, mask, types))
+                result = np.argmax(logits, axis=-1)
+            return get_predict_response(payload, result, self.name)
+        except InvalidInput:
+            raise
+        except Exception as e:
+            raise InferenceError(str(e))
+
+
+def main(argv=None):
+    from ..utils.backend import apply_platform_override
+
+    apply_platform_override()
+    parent = build_arg_parser()
+    parser = argparse.ArgumentParser(parents=[parent], conflict_handler="resolve")
+    parser.add_argument("--task", default="embedding", choices=TASKS)
+    parser.add_argument("--random_weights", action="store_true")
+    parser.add_argument("--max_length", default=512, type=int)
+    parser.add_argument(
+        "--model_config", default=None, choices=("tiny", "bert-base")
+    )
+    args = parser.parse_args(argv)
+    named = {
+        "tiny": bert.BertConfig.tiny,
+        "bert-base": bert.BertConfig,
+    }
+    config = named[args.model_config]() if args.model_config else None
+    model_dir = args.model_dir if os.path.isdir(args.model_dir) else None
+    if config is None and model_dir is None:
+        config = bert.BertConfig()  # random-weight default: bert-base shapes
+    model = JAXEncoderModel(
+        args.model_name,
+        model_dir=model_dir,
+        config=config,
+        task=args.task,
+        random_weights=args.random_weights,
+        max_length=args.max_length,
+    )
+    model.load()
+    ModelServer(http_port=args.http_port, grpc_port=args.grpc_port,
+                enable_grpc=args.enable_grpc).start([model])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,530 @@
+// kserve-tpu agent sidecar: reverse proxy with micro-batching and payload
+// logging, in front of a model-server container.
+//
+// Role parity (reference implements these in Go):
+//   - pkg/batcher/handler.go       — coalesce V1 `instances` across callers,
+//     fire on max-batchsize or max-latency, split predictions back
+//   - pkg/logger                    — async request/response logging as
+//     CloudEvents JSON to a collector URL (fire-and-forget worker)
+//   - pkg/agent (proxy wrapper)     — health endpoint + passthrough proxy
+//
+// Build:  g++ -O2 -std=c++17 -pthread -o kserve-tpu-agent agent.cpp
+// Run:    ./kserve-tpu-agent --port 9081 --component_port 8080 ...
+//             [--enable-batcher --max-batchsize 32 --max-latency 50] \
+//             [--enable-logger --log-url http://collector:8080/]
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <iostream>
+#include <memory>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Options {
+  int port = 9081;
+  int component_port = 8080;
+  std::string component_host = "127.0.0.1";
+  bool enable_batcher = false;
+  int max_batchsize = 32;
+  int max_latency_ms = 50;  // flush deadline for a partial batch
+  bool enable_logger = false;
+  std::string log_url;
+  std::string log_mode = "all";  // all | request | response
+};
+
+Options g_opts;
+
+// ---------------------------------------------------------------- sockets
+
+int connect_to(const std::string& host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    hostent* he = ::gethostbyname(host.c_str());
+    if (!he) { ::close(fd); return -1; }
+    std::memcpy(&addr.sin_addr, he->h_addr, he->h_length);
+  }
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+bool send_all(int fd, const std::string& data) {
+  size_t off = 0;
+  while (off < data.size()) {
+    ssize_t n = ::send(fd, data.data() + off, data.size() - off, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    off += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+// Minimal HTTP/1.1 message reader (Content-Length framing; no chunked).
+struct HttpMessage {
+  std::string start_line;
+  std::vector<std::pair<std::string, std::string>> headers;
+  std::string body;
+
+  std::string header(const std::string& name) const {
+    for (const auto& h : headers) {
+      if (strcasecmp(h.first.c_str(), name.c_str()) == 0) return h.second;
+    }
+    return "";
+  }
+};
+
+bool read_http(int fd, HttpMessage* msg) {
+  std::string buf;
+  char tmp[8192];
+  size_t header_end = std::string::npos;
+  while (header_end == std::string::npos) {
+    ssize_t n = ::recv(fd, tmp, sizeof(tmp), 0);
+    if (n <= 0) return false;
+    buf.append(tmp, static_cast<size_t>(n));
+    header_end = buf.find("\r\n\r\n");
+    if (buf.size() > (1u << 26)) return false;  // 64MB header guard
+  }
+  std::istringstream head(buf.substr(0, header_end));
+  std::getline(head, msg->start_line);
+  if (!msg->start_line.empty() && msg->start_line.back() == '\r')
+    msg->start_line.pop_back();
+  std::string line;
+  size_t content_length = 0;
+  while (std::getline(head, line)) {
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    auto colon = line.find(':');
+    if (colon == std::string::npos) continue;
+    std::string name = line.substr(0, colon);
+    std::string value = line.substr(colon + 1);
+    while (!value.empty() && value.front() == ' ') value.erase(value.begin());
+    if (strcasecmp(name.c_str(), "content-length") == 0)
+      content_length = std::stoul(value);
+    msg->headers.emplace_back(name, value);
+  }
+  msg->body = buf.substr(header_end + 4);
+  while (msg->body.size() < content_length) {
+    ssize_t n = ::recv(fd, tmp, sizeof(tmp), 0);
+    if (n <= 0) return false;
+    msg->body.append(tmp, static_cast<size_t>(n));
+  }
+  msg->body.resize(content_length);
+  return true;
+}
+
+std::string build_request(const std::string& method, const std::string& path,
+                          const std::string& body,
+                          const std::string& content_type = "application/json") {
+  std::ostringstream out;
+  out << method << " " << path << " HTTP/1.1\r\n"
+      << "Host: " << g_opts.component_host << "\r\n"
+      << "Content-Type: " << content_type << "\r\n"
+      << "Content-Length: " << body.size() << "\r\n"
+      << "Connection: close\r\n\r\n"
+      << body;
+  return out.str();
+}
+
+std::string build_response(int status, const std::string& reason,
+                           const std::string& body,
+                           const std::string& content_type = "application/json") {
+  std::ostringstream out;
+  out << "HTTP/1.1 " << status << " " << reason << "\r\n"
+      << "Content-Type: " << content_type << "\r\n"
+      << "Content-Length: " << body.size() << "\r\n"
+      << "Connection: close\r\n\r\n"
+      << body;
+  return out.str();
+}
+
+// Forward a request to the component; returns full HttpMessage response.
+bool call_component(const std::string& method, const std::string& path,
+                    const std::string& body, HttpMessage* response) {
+  int fd = connect_to(g_opts.component_host, g_opts.component_port);
+  if (fd < 0) return false;
+  bool ok = send_all(fd, build_request(method, path, body)) &&
+            read_http(fd, response);
+  ::close(fd);
+  return ok;
+}
+
+// ------------------------------------------------------------- tiny JSON
+
+// Splits the elements of the top-level JSON array `text` (quote/bracket
+// aware); returns false on malformed input.
+bool split_json_array(const std::string& text, std::vector<std::string>* out) {
+  size_t i = 0;
+  while (i < text.size() && isspace(text[i])) i++;
+  if (i >= text.size() || text[i] != '[') return false;
+  i++;
+  int depth = 0;
+  bool in_string = false;
+  size_t start = i;
+  for (; i < text.size(); i++) {
+    char c = text[i];
+    if (in_string) {
+      if (c == '\\') i++;
+      else if (c == '"') in_string = false;
+      continue;
+    }
+    if (c == '"') in_string = true;
+    else if (c == '[' || c == '{') depth++;
+    else if (c == ']' || c == '}') {
+      if (c == ']' && depth == 0) {
+        std::string el = text.substr(start, i - start);
+        // trim
+        size_t a = el.find_first_not_of(" \t\r\n");
+        size_t b = el.find_last_not_of(" \t\r\n");
+        if (a != std::string::npos) out->push_back(el.substr(a, b - a + 1));
+        return true;
+      }
+      depth--;
+    } else if (c == ',' && depth == 0) {
+      std::string el = text.substr(start, i - start);
+      size_t a = el.find_first_not_of(" \t\r\n");
+      size_t b = el.find_last_not_of(" \t\r\n");
+      if (a != std::string::npos) out->push_back(el.substr(a, b - a + 1));
+      start = i + 1;
+    }
+  }
+  return false;
+}
+
+// Extracts the JSON array value of `key` ("instances"/"predictions") from an
+// object body; returns the raw "[...]" substring.
+bool extract_array(const std::string& body, const std::string& key,
+                   std::string* out) {
+  std::string quoted = "\"" + key + "\"";
+  size_t pos = body.find(quoted);
+  if (pos == std::string::npos) return false;
+  pos = body.find('[', pos + quoted.size());
+  if (pos == std::string::npos) return false;
+  int depth = 0;
+  bool in_string = false;
+  for (size_t i = pos; i < body.size(); i++) {
+    char c = body[i];
+    if (in_string) {
+      if (c == '\\') i++;
+      else if (c == '"') in_string = false;
+      continue;
+    }
+    if (c == '"') in_string = true;
+    else if (c == '[') depth++;
+    else if (c == ']') {
+      depth--;
+      if (depth == 0) {
+        *out = body.substr(pos, i - pos + 1);
+        return true;
+      }
+    }
+  }
+  return false;
+}
+
+// ---------------------------------------------------------------- logger
+
+class PayloadLogger {
+ public:
+  void start() {
+    worker_ = std::thread([this] { run(); });
+  }
+  void log(const std::string& type, const std::string& path,
+           const std::string& payload) {
+    if (!g_opts.enable_logger) return;
+    if (g_opts.log_mode == "request" && type != "request") return;
+    if (g_opts.log_mode == "response" && type != "response") return;
+    std::lock_guard<std::mutex> lk(mu_);
+    queue_.push_back(make_cloudevent(type, path, payload));
+    cv_.notify_one();
+  }
+
+ private:
+  static std::string make_cloudevent(const std::string& type,
+                                     const std::string& path,
+                                     const std::string& payload) {
+    static std::atomic<uint64_t> seq{0};
+    std::ostringstream out;
+    out << "{\"specversion\":\"1.0\",\"id\":\"" << seq++
+        << "\",\"source\":\"kserve-tpu-agent\",\"type\":"
+        << "\"org.kubeflow.serving.inference." << type << "\","
+        << "\"datacontenttype\":\"application/json\",\"path\":\"" << path
+        << "\",\"data\":" << (payload.empty() ? "null" : payload) << "}";
+    return out.str();
+  }
+
+  void run() {
+    for (;;) {
+      std::string event;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [this] { return !queue_.empty(); });
+        event = std::move(queue_.front());
+        queue_.pop_front();
+      }
+      deliver(event);
+    }
+  }
+
+  void deliver(const std::string& event) {
+    // log-url format: http://host:port/path
+    std::string url = g_opts.log_url;
+    if (url.rfind("http://", 0) != 0) {
+      std::cerr << "[agent] log event: " << event << "\n";
+      return;
+    }
+    std::string rest = url.substr(7);
+    auto slash = rest.find('/');
+    std::string hostport = slash == std::string::npos ? rest : rest.substr(0, slash);
+    std::string path = slash == std::string::npos ? "/" : rest.substr(slash);
+    auto colon = hostport.find(':');
+    std::string host = colon == std::string::npos ? hostport : hostport.substr(0, colon);
+    int port = colon == std::string::npos ? 80 : std::stoi(hostport.substr(colon + 1));
+    int fd = connect_to(host, port);
+    if (fd < 0) return;
+    std::ostringstream req;
+    req << "POST " << path << " HTTP/1.1\r\nHost: " << host
+        << "\r\nContent-Type: application/cloudevents+json\r\nContent-Length: "
+        << event.size() << "\r\nConnection: close\r\n\r\n" << event;
+    send_all(fd, req.str());
+    HttpMessage ignored;
+    read_http(fd, &ignored);
+    ::close(fd);
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<std::string> queue_;
+  std::thread worker_;
+};
+
+PayloadLogger g_logger;
+
+// ---------------------------------------------------------------- batcher
+
+// One pending caller inside a batch.
+struct BatchEntry {
+  std::vector<std::string> instances;
+  std::string result;        // this caller's predictions slice (JSON array)
+  int status = 0;
+  std::mutex mu;
+  std::condition_variable cv;
+  bool done = false;
+};
+
+class Batcher {
+ public:
+  // Queues the caller's instances; blocks until the batch round-trips.
+  // Returns (status, body-for-caller).
+  std::pair<int, std::string> submit(const std::string& path,
+                                     std::vector<std::string> instances) {
+    auto entry = std::make_shared<BatchEntry>();
+    entry->instances = std::move(instances);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (path_.empty()) path_ = path;
+      pending_.push_back(entry);
+      pending_count_ += entry->instances.size();
+      if (static_cast<int>(pending_count_) >= g_opts.max_batchsize) {
+        flush_locked();
+      } else if (!timer_armed_) {
+        timer_armed_ = true;
+        std::thread([this] {
+          std::this_thread::sleep_for(
+              std::chrono::milliseconds(g_opts.max_latency_ms));
+          std::lock_guard<std::mutex> lk(mu_);
+          timer_armed_ = false;
+          if (!pending_.empty()) flush_locked();
+        }).detach();
+      }
+    }
+    std::unique_lock<std::mutex> lk(entry->mu);
+    entry->cv.wait(lk, [&] { return entry->done; });
+    if (entry->status != 200) {
+      return {entry->status == 0 ? 502 : entry->status,
+              "{\"error\": \"batched predict failed\"}"};
+    }
+    return {200, "{\"predictions\": " + entry->result + "}"};
+  }
+
+ private:
+  void flush_locked() {
+    auto batch = std::move(pending_);
+    pending_.clear();
+    pending_count_ = 0;
+    std::string path = path_;
+    std::thread([this, batch = std::move(batch), path] {
+      execute(batch, path);
+    }).detach();
+  }
+
+  void execute(const std::vector<std::shared_ptr<BatchEntry>>& batch,
+               const std::string& path) {
+    std::ostringstream merged;
+    merged << "{\"instances\": [";
+    bool first = true;
+    for (const auto& e : batch) {
+      for (const auto& inst : e->instances) {
+        if (!first) merged << ",";
+        merged << inst;
+        first = false;
+      }
+    }
+    merged << "]}";
+    HttpMessage response;
+    bool ok = call_component("POST", path, merged.str(), &response);
+    std::vector<std::string> predictions;
+    std::string preds_arr;
+    int status = 0;
+    if (ok) {
+      status = 200;
+      if (response.start_line.find("200") == std::string::npos ||
+          !extract_array(response.body, "predictions", &preds_arr) ||
+          !split_json_array(preds_arr, &predictions)) {
+        status = 502;
+      }
+    }
+    size_t offset = 0;
+    for (const auto& e : batch) {
+      std::lock_guard<std::mutex> lk(e->mu);
+      if (status == 200 && offset + e->instances.size() <= predictions.size()) {
+        std::ostringstream slice;
+        slice << "[";
+        for (size_t i = 0; i < e->instances.size(); i++) {
+          if (i) slice << ",";
+          slice << predictions[offset + i];
+        }
+        slice << "]";
+        e->result = slice.str();
+        e->status = 200;
+        offset += e->instances.size();
+      } else {
+        e->status = status == 200 ? 502 : status;
+      }
+      e->done = true;
+      e->cv.notify_one();
+    }
+  }
+
+  std::mutex mu_;
+  std::vector<std::shared_ptr<BatchEntry>> pending_;
+  size_t pending_count_ = 0;
+  std::string path_;
+  bool timer_armed_ = false;
+};
+
+Batcher g_batcher;
+
+// ------------------------------------------------------------ connection
+
+void handle_connection(int client_fd) {
+  HttpMessage request;
+  if (!read_http(client_fd, &request)) {
+    ::close(client_fd);
+    return;
+  }
+  std::istringstream sl(request.start_line);
+  std::string method, path, version;
+  sl >> method >> path >> version;
+
+  std::string response_str;
+  if (path == "/healthz" || path == "/") {
+    response_str = build_response(200, "OK", "{\"status\": \"ok\"}");
+  } else {
+    bool is_predict = method == "POST" &&
+                      path.find(":predict") != std::string::npos;
+    g_logger.log("request", path, is_predict ? request.body : "");
+    std::string instances_arr;
+    std::vector<std::string> instances;
+    if (g_opts.enable_batcher && is_predict &&
+        extract_array(request.body, "instances", &instances_arr) &&
+        split_json_array(instances_arr, &instances)) {
+      auto [status, body] = g_batcher.submit(path, std::move(instances));
+      response_str = build_response(status, status == 200 ? "OK" : "Bad Gateway", body);
+      g_logger.log("response", path, body);
+    } else {
+      HttpMessage upstream;
+      if (call_component(method, path, request.body, &upstream)) {
+        int status = 200;
+        auto sp = upstream.start_line.find(' ');
+        if (sp != std::string::npos) status = std::atoi(upstream.start_line.c_str() + sp + 1);
+        response_str = build_response(status, "OK", upstream.body,
+                                      upstream.header("Content-Type").empty()
+                                          ? "application/json"
+                                          : upstream.header("Content-Type"));
+        g_logger.log("response", path, is_predict ? upstream.body : "");
+      } else {
+        response_str = build_response(502, "Bad Gateway",
+                                      "{\"error\": \"component unreachable\"}");
+      }
+    }
+  }
+  send_all(client_fd, response_str);
+  ::close(client_fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  for (int i = 1; i < argc; i++) {
+    std::string arg = argv[i];
+    auto next = [&]() -> std::string { return i + 1 < argc ? argv[++i] : ""; };
+    if (arg == "--port") g_opts.port = std::stoi(next());
+    else if (arg == "--component_port") g_opts.component_port = std::stoi(next());
+    else if (arg == "--component_host") g_opts.component_host = next();
+    else if (arg == "--enable-batcher") g_opts.enable_batcher = true;
+    else if (arg == "--max-batchsize") g_opts.max_batchsize = std::stoi(next());
+    else if (arg == "--max-latency") g_opts.max_latency_ms = std::stoi(next());
+    else if (arg == "--enable-logger") g_opts.enable_logger = true;
+    else if (arg == "--log-url") g_opts.log_url = next();
+    else if (arg == "--log-mode") g_opts.log_mode = next();
+    else {
+      std::cerr << "unknown flag: " << arg << "\n";
+      return 2;
+    }
+  }
+  g_logger.start();
+
+  int server_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  ::setsockopt(server_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons(g_opts.port);
+  if (::bind(server_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    std::cerr << "bind failed on port " << g_opts.port << "\n";
+    return 1;
+  }
+  ::listen(server_fd, 128);
+  std::cerr << "[agent] listening on :" << g_opts.port << " -> "
+            << g_opts.component_host << ":" << g_opts.component_port
+            << (g_opts.enable_batcher ? " [batcher]" : "")
+            << (g_opts.enable_logger ? " [logger]" : "") << "\n";
+  for (;;) {
+    int client = ::accept(server_fd, nullptr, nullptr);
+    if (client < 0) continue;
+    std::thread(handle_connection, client).detach();
+  }
+}

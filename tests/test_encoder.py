@@ -1,0 +1,131 @@
+"""BERT encoder tests: HF parity + embeddings/rerank serving."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kserve_tpu.models import bert
+from kserve_tpu.protocol.openai.types import EmbeddingRequest, RerankRequest
+from kserve_tpu.runtimes.encoder_server import JAXEncoderModel
+
+from conftest import async_test
+
+
+class TestBertHFParity:
+    def test_encoder_matches_transformers(self):
+        torch = pytest.importorskip("torch")
+        from transformers import BertConfig as HFConfig, BertModel
+
+        hf_config = HFConfig(
+            vocab_size=128, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=64, hidden_act="gelu",
+        )
+        torch.manual_seed(0)
+        hf = BertModel(hf_config).eval()
+
+        config = bert.BertConfig.from_hf_config(hf_config.to_dict())
+        params = _params_from_hf(hf, config)
+        ids = np.array([[2, 45, 67, 89, 3, 0, 0, 0]], np.int64)
+        mask = np.array([[1, 1, 1, 1, 1, 0, 0, 0]], np.int64)
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(ids), attention_mask=torch.from_numpy(mask))
+        got = bert.encode(params, config, jnp.asarray(ids, jnp.int32), jnp.asarray(mask, jnp.int32))
+        np.testing.assert_allclose(
+            np.asarray(got)[0, :5], ref.last_hidden_state.numpy()[0, :5],
+            rtol=2e-4, atol=2e-4,
+        )
+
+
+def _params_from_hf(hf_model, config):
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+
+    def t(name, transpose=False):
+        arr = sd[name]
+        return jnp.asarray(arr.T if transpose else arr, jnp.float32)
+
+    params = {
+        "word_embeddings": t("embeddings.word_embeddings.weight"),
+        "position_embeddings": t("embeddings.position_embeddings.weight"),
+        "token_type_embeddings": t("embeddings.token_type_embeddings.weight"),
+        "embed_ln": {"weight": t("embeddings.LayerNorm.weight"), "bias": t("embeddings.LayerNorm.bias")},
+        "layers": [],
+        "pooler": {"w": t("pooler.dense.weight", True), "b": t("pooler.dense.bias")},
+        "classifier": {"w": jnp.zeros((config.hidden_size, 2)), "b": jnp.zeros((2,))},
+        "mlm_transform": {"w": jnp.zeros((config.hidden_size, config.hidden_size)),
+                          "b": jnp.zeros((config.hidden_size,))},
+        "mlm_ln": {"weight": jnp.ones((config.hidden_size,)), "bias": jnp.zeros((config.hidden_size,))},
+        "mlm_bias": jnp.zeros((config.vocab_size,)),
+    }
+    for i in range(config.num_hidden_layers):
+        p = f"encoder.layer.{i}."
+        params["layers"].append({
+            "q": {"w": t(p + "attention.self.query.weight", True), "b": t(p + "attention.self.query.bias")},
+            "k": {"w": t(p + "attention.self.key.weight", True), "b": t(p + "attention.self.key.bias")},
+            "v": {"w": t(p + "attention.self.value.weight", True), "b": t(p + "attention.self.value.bias")},
+            "o": {"w": t(p + "attention.output.dense.weight", True), "b": t(p + "attention.output.dense.bias")},
+            "attn_ln": {"weight": t(p + "attention.output.LayerNorm.weight"),
+                        "bias": t(p + "attention.output.LayerNorm.bias")},
+            "ffn_in": {"w": t(p + "intermediate.dense.weight", True), "b": t(p + "intermediate.dense.bias")},
+            "ffn_out": {"w": t(p + "output.dense.weight", True), "b": t(p + "output.dense.bias")},
+            "ffn_ln": {"weight": t(p + "output.LayerNorm.weight"), "bias": t(p + "output.LayerNorm.bias")},
+        })
+    return params
+
+
+class TestEncoderServing:
+    @pytest.fixture(scope="class")
+    def model(self):
+        m = JAXEncoderModel(
+            "enc", config=bert.BertConfig.tiny(), random_weights=True, max_length=64
+        )
+        m.load()
+        return m
+
+    @async_test
+    async def test_embedding(self, model):
+        res = await model.create_embedding(
+            EmbeddingRequest(model="enc", input=["hello world", "goodbye"])
+        )
+        assert len(res.data) == 2
+        vec = np.asarray(res.data[0].embedding)
+        assert vec.shape == (model.config.hidden_size,)
+        np.testing.assert_allclose(np.linalg.norm(vec), 1.0, rtol=1e-4)
+        assert res.usage.prompt_tokens > 0
+
+    @async_test
+    async def test_embedding_base64(self, model):
+        res = await model.create_embedding(
+            EmbeddingRequest(model="enc", input="hi", encoding_format="base64")
+        )
+        import base64
+
+        raw = base64.b64decode(res.data[0].embedding)
+        assert len(raw) == model.config.hidden_size * 4
+
+    @async_test
+    async def test_embedding_deterministic(self, model):
+        a = await model.create_embedding(EmbeddingRequest(model="enc", input="same text"))
+        b = await model.create_embedding(EmbeddingRequest(model="enc", input="same text"))
+        np.testing.assert_allclose(a.data[0].embedding, b.data[0].embedding, rtol=1e-6)
+
+    @async_test
+    async def test_rerank(self, model):
+        res = await model.create_rerank(
+            RerankRequest(
+                model="enc",
+                query="what is tpu",
+                documents=["tpus are accelerators", "bananas are yellow", "tpu serving"],
+                top_n=2,
+            )
+        )
+        assert len(res.results) == 2
+        assert res.results[0].relevance_score >= res.results[1].relevance_score
+        assert res.results[0].document is not None
+
+    @async_test
+    async def test_classification_predict(self, model):
+        out = await model({"instances": ["good movie", "bad movie"]})
+        assert len(out["predictions"]) == 2

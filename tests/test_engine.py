@@ -159,3 +159,33 @@ class TestDetokenizer:
         assert "".join(deltas) == text
         # multibyte chars must not emit partial replacement chars
         assert "�" not in "".join(deltas)
+
+
+class TestSeededSampling:
+    @async_test
+    async def test_seed_reproducible_across_batching(self):
+        """Same seed + temperature>0 must reproduce tokens even when the
+        batch composition differs (per-lane PRNG streams)."""
+        engine = make_engine()
+        await engine.start()
+        try:
+            p = SamplingParams(max_tokens=6, temperature=1.0, seed=42, ignore_eos=True)
+            solo = await collect(engine, [7, 8, 9], p)
+            batched = await asyncio.gather(
+                collect(engine, [7, 8, 9], p),
+                collect(engine, [1, 2], SamplingParams(max_tokens=6, temperature=1.0, ignore_eos=True)),
+            )
+            assert [o.token_id for o in solo] == [o.token_id for o in batched[0]]
+        finally:
+            await engine.stop()
+
+    @async_test
+    async def test_different_seeds_differ(self):
+        engine = make_engine()
+        await engine.start()
+        try:
+            a = await collect(engine, [7, 8, 9], SamplingParams(max_tokens=8, temperature=1.0, seed=1, ignore_eos=True))
+            b = await collect(engine, [7, 8, 9], SamplingParams(max_tokens=8, temperature=1.0, seed=2, ignore_eos=True))
+            assert [o.token_id for o in a] != [o.token_id for o in b]
+        finally:
+            await engine.stop()

@@ -1,0 +1,86 @@
+"""Multi-model agent tests: modelconfig sync drives hot load/unload."""
+
+import asyncio
+import json
+
+import pytest
+
+from kserve_tpu.agent.watcher import ModelAgent
+from kserve_tpu.model import BaseModel
+from kserve_tpu.model_repository import ModelRepository
+
+from conftest import async_test
+
+
+class StubModel(BaseModel):
+    def __init__(self, name):
+        super().__init__(name)
+        self.ready = True
+
+
+def stub_factory(name, spec, model_dir):
+    return StubModel(name)
+
+
+def write_config(path, entries):
+    path.write_text(json.dumps(entries))
+
+
+@async_test
+async def test_sync_loads_and_unloads(tmp_path):
+    cfg = tmp_path / "models.json"
+    write_config(cfg, [
+        {"modelName": "a", "modelSpec": {"framework": "sklearn"}},
+        {"modelName": "b", "modelSpec": {"framework": "xgboost"}},
+    ])
+    repo = ModelRepository()
+    agent = ModelAgent(repo, config_file=str(cfg), models_dir=str(tmp_path),
+                       model_factory=stub_factory, poll_interval=0.05)
+    await agent.sync()
+    assert set(repo.get_models()) == {"a", "b"}
+
+    write_config(cfg, [{"modelName": "b", "modelSpec": {"framework": "xgboost"}}])
+    await agent.sync()
+    assert set(repo.get_models()) == {"b"}
+
+
+@async_test
+async def test_watch_picks_up_changes(tmp_path):
+    cfg = tmp_path / "models.json"
+    write_config(cfg, [])
+    repo = ModelRepository()
+    agent = ModelAgent(repo, config_file=str(cfg), models_dir=str(tmp_path),
+                       model_factory=stub_factory, poll_interval=0.05)
+    await agent.start()
+    try:
+        write_config(cfg, [{"modelName": "late", "modelSpec": {}}])
+        import os
+        os.utime(cfg, (0, 12345))  # force mtime change
+        for _ in range(40):
+            if "late" in repo.get_models():
+                break
+            await asyncio.sleep(0.05)
+        assert "late" in repo.get_models()
+    finally:
+        await agent.stop()
+
+
+@async_test
+async def test_spec_change_reloads(tmp_path):
+    cfg = tmp_path / "models.json"
+    write_config(cfg, [{"modelName": "m", "modelSpec": {"v": 1}}])
+    repo = ModelRepository()
+    loads = []
+
+    def counting_factory(name, spec, model_dir):
+        loads.append(spec)
+        return StubModel(name)
+
+    agent = ModelAgent(repo, config_file=str(cfg), models_dir=str(tmp_path),
+                       model_factory=counting_factory)
+    await agent.sync()
+    await agent.sync()  # no change -> no reload
+    assert len(loads) == 1
+    write_config(cfg, [{"modelName": "m", "modelSpec": {"v": 2}}])
+    await agent.sync()
+    assert len(loads) == 2
