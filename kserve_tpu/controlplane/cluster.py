@@ -21,11 +21,13 @@ from .crds import (
     InferenceService,
     LLMInferenceService,
     LLMInferenceServiceConfig,
+    LocalModelCache,
     ServingRuntime,
     TrainedModel,
 )
 from .default_runtimes import default_runtimes
 from .llmisvc import LLMISVCReconciler
+from .localmodel import LocalModelCacheReconciler
 from .reconciler import InferenceServiceReconciler
 from .registry import RuntimeRegistry
 
@@ -89,6 +91,9 @@ class ControllerManager:
             self.registry, ingress_domain=ingress_domain
         )
         self.llm_reconciler = LLMISVCReconciler(ingress_domain=ingress_domain)
+        # node-group membership comes from Node labels in a live cluster;
+        # tests/operators set it directly
+        self.localmodel_reconciler = LocalModelCacheReconciler()
 
     # ---------------- apply entrypoints (the kubectl surface) ----------------
 
@@ -113,6 +118,7 @@ class ControllerManager:
         "LLMInferenceServiceConfig": LLMInferenceServiceConfig,
         "TrainedModel": TrainedModel,
         "InferenceGraph": InferenceGraph,
+        "LocalModelCache": LocalModelCache,
     }
 
     def _parse(self, obj: dict):
@@ -127,6 +133,27 @@ class ControllerManager:
             desired, status = self.isvc_reconciler.reconcile(obj)
         elif isinstance(obj, LLMInferenceService):
             desired, status = self.llm_reconciler.reconcile(obj)
+        elif isinstance(obj, LocalModelCache):
+            # only THIS cache's jobs (named f"{cache}-{node}") feed status —
+            # other caches' jobs on the same nodes must not bleed in
+            prefix = f"{obj.metadata.name}-"
+            job_status = {}
+            for job in self.cluster.list("Job"):
+                if not job["metadata"]["name"].startswith(prefix):
+                    continue
+                node = job["spec"]["template"]["spec"].get("nodeName")
+                if node and job.get("status", {}).get("phase"):
+                    job_status[node] = job["status"]["phase"]
+            desired, status = self.localmodel_reconciler.reconcile(obj, job_status)
+            from .objects import set_owner
+
+            owner = {
+                "apiVersion": obj.apiVersion,
+                "kind": obj.kind,
+                "metadata": obj.metadata.model_dump(),
+            }
+            for d in desired:
+                set_owner(d, owner)
         elif isinstance(obj, TrainedModel):
             desired, status = self._reconcile_trained_model(obj)
         elif isinstance(obj, InferenceGraph):
@@ -145,8 +172,11 @@ class ControllerManager:
         desired (the apiserver's ownerReference GC, done eagerly)."""
         desired_keys = {FakeCluster._key(d) for d in desired}
         owner_ns = owner_obj.metadata.namespace
+        # cluster-scoped owners (LocalModelCache) own children across
+        # namespaces; namespaced owners only own within their namespace
+        cluster_scoped = owner_obj.kind == "LocalModelCache"
         for key, obj in list(self.cluster._objects.items()):
-            if obj.get("metadata", {}).get("namespace") != owner_ns:
+            if not cluster_scoped and obj.get("metadata", {}).get("namespace") != owner_ns:
                 continue  # ownerReferences are namespace-local
             refs = obj.get("metadata", {}).get("ownerReferences", [])
             for ref in refs:
@@ -159,7 +189,13 @@ class ControllerManager:
                     break
 
     def reconcile_all(self) -> None:
-        for kind in ("InferenceService", "LLMInferenceService", "TrainedModel", "InferenceGraph"):
+        for kind in (
+            "InferenceService",
+            "LLMInferenceService",
+            "TrainedModel",
+            "InferenceGraph",
+            "LocalModelCache",
+        ):
             for obj in self.cluster.list(kind):
                 self.reconcile_object(self._parse(obj))
 

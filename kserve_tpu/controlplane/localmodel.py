@@ -1,0 +1,131 @@
+"""LocalModelCache controllers: pre-warm model artifacts onto TPU node pools.
+
+Parity: pkg/controller/v1alpha1/localmodel (cluster scope: PV/PVC per node
+group, download Jobs orchestrated across nodes, per-node copy status) and
+pkg/controller/v1alpha1/localmodelnode (per-node agent verifying/deleting
+local copies).  Jobs run the same storage initializer image the webhook
+injects; nodes mount the cache via hostPath-backed PVs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .crds import LocalModelCache
+from .objects import make_object, set_condition
+
+CACHE_BASE_PATH = "/mnt/models-cache"
+STORAGE_INITIALIZER_IMAGE = "kserve-tpu/storage-initializer:latest"
+
+
+class LocalModelCacheReconciler:
+    """Cluster-scoped: one PV/PVC per (cache, node-group) + a download Job
+    per matching node; status tracks per-node copy state."""
+
+    def __init__(self, node_groups: Optional[Dict[str, List[str]]] = None):
+        # node group name -> node names (the NodeGroup CRD's resolved view;
+        # tests inject it, a live deployment lists Nodes by selector)
+        self.node_groups = node_groups or {}
+
+    def reconcile(self, cache: LocalModelCache, job_status: Optional[Dict[str, str]] = None
+                  ) -> Tuple[List[dict], dict]:
+        """job_status: node -> Succeeded|Failed|Running (observed cluster
+        state); desired objects + status."""
+        job_status = job_status or {}
+        name = cache.metadata.name
+        objects: List[dict] = []
+        node_copies = []
+        for group in cache.spec.nodeGroups:
+            pv_name = f"{name}-{group}"
+            pv = make_object(
+                "v1", "PersistentVolume", pv_name, "",
+                spec={
+                    "capacity": {"storage": cache.spec.modelSize or "50Gi"},
+                    "accessModes": ["ReadWriteOnce"],
+                    "hostPath": {"path": f"{CACHE_BASE_PATH}/{name}"},
+                    "storageClassName": "local-model-cache",
+                },
+            )
+            pvc = make_object(
+                "v1", "PersistentVolumeClaim", pv_name, "kserve-localmodel-jobs",
+                spec={
+                    "volumeName": pv_name,
+                    "accessModes": ["ReadWriteOnce"],
+                    "resources": {"requests": {"storage": cache.spec.modelSize or "50Gi"}},
+                    "storageClassName": "local-model-cache",
+                },
+            )
+            objects.extend([pv, pvc])
+            for node in self.node_groups.get(group, []):
+                job = make_object(
+                    "batch/v1", "Job", f"{name}-{node}", "kserve-localmodel-jobs",
+                    spec={
+                        "template": {
+                            "spec": {
+                                "nodeName": node,
+                                "restartPolicy": "Never",
+                                "containers": [
+                                    {
+                                        "name": "download",
+                                        "image": STORAGE_INITIALIZER_IMAGE,
+                                        "command": [
+                                            "python", "-m", "kserve_tpu.storage.initializer",
+                                        ],
+                                        "args": [
+                                            cache.spec.sourceModelUri,
+                                            f"{CACHE_BASE_PATH}/{name}",
+                                        ],
+                                        "volumeMounts": [
+                                            {"name": "cache", "mountPath": CACHE_BASE_PATH}
+                                        ],
+                                    }
+                                ],
+                                "volumes": [
+                                    {"name": "cache",
+                                     "persistentVolumeClaim": {"claimName": pv_name}}
+                                ],
+                            }
+                        },
+                        "backoffLimit": 3,
+                    },
+                )
+                objects.append(job)
+                node_copies.append(
+                    {"nodeName": node,
+                     "status": job_status.get(node, "Pending")}
+                )
+        status: dict = {
+            "copies": {
+                "total": len(node_copies),
+                "available": sum(1 for c in node_copies if c["status"] == "Succeeded"),
+            },
+            "nodeStatus": {c["nodeName"]: c["status"] for c in node_copies},
+        }
+        all_done = node_copies and all(c["status"] == "Succeeded" for c in node_copies)
+        set_condition(status, "Ready", bool(all_done),
+                      reason="AllCopiesReady" if all_done else "Downloading")
+        return objects, status
+
+
+class LocalModelNodeAgent:
+    """Per-node reconcile (the DaemonSet agent's logic): verify cached model
+    dirs exist, delete models no longer desired.  Parity:
+    localmodelnode/controller.go downloadModels:347 / deleteModels:450."""
+
+    def __init__(self, cache_base: str = CACHE_BASE_PATH):
+        self.cache_base = cache_base
+
+    def reconcile(self, desired_models: List[str]) -> dict:
+        import os
+        import shutil
+
+        os.makedirs(self.cache_base, exist_ok=True)
+        actual = set(os.listdir(self.cache_base))
+        desired = set(desired_models)
+        removed = []
+        for stale in sorted(actual - desired):
+            shutil.rmtree(os.path.join(self.cache_base, stale), ignore_errors=True)
+            removed.append(stale)
+        missing = sorted(desired - actual)
+        present = sorted(desired & actual)
+        return {"present": present, "missing": missing, "removed": removed}

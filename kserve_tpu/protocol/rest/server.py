@@ -119,8 +119,10 @@ class RESTServer:
         # OpenAI + timeseries heads are registered lazily so pure-predictive
         # servers never import transformers/pydantic generative types.
         from ..openai.endpoints import register_openai_routes
+        from ..timeseries import TimeSeriesEndpoints
 
         register_openai_routes(app, self.dataplane)
+        TimeSeriesEndpoints(self.dataplane.model_registry).register(app)
         return app
 
     async def start(self) -> None:

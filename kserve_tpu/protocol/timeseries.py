@@ -1,0 +1,77 @@
+"""Time-series protocol head: /timeseries/v1/forecast.
+
+Parity: reference python/kserve/kserve/protocol/rest/timeseries/ (the
+OpenAI-pattern mirror for forecasting runtimes — typed request/response,
+model ABC, aiohttp routes)."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from aiohttp import web
+from pydantic import BaseModel, ConfigDict, Field, ValidationError
+
+from ..errors import InvalidInput, ModelNotFound, ModelNotReady
+from ..model import BaseModel as ServableModel
+
+
+class TimeSeries(BaseModel):
+    model_config = ConfigDict(extra="allow")
+    timestamps: List[str] = Field(default_factory=list)
+    values: List[float] = Field(default_factory=list)
+    id: Optional[str] = None
+
+
+class ForecastRequest(BaseModel):
+    model_config = ConfigDict(extra="allow")
+    model: str
+    inputs: List[TimeSeries]
+    horizon: int = 1
+    quantiles: Optional[List[float]] = None
+    parameters: Dict[str, object] = Field(default_factory=dict)
+
+
+class Forecast(BaseModel):
+    id: Optional[str] = None
+    values: List[float] = Field(default_factory=list)
+    quantile_values: Optional[Dict[str, List[float]]] = None
+
+
+class ForecastResponse(BaseModel):
+    model: str = ""
+    forecasts: List[Forecast] = Field(default_factory=list)
+
+
+class TimeSeriesModel(ServableModel):
+    """Forecasting runtimes implement create_forecast."""
+
+    async def create_forecast(self, request: ForecastRequest, context=None) -> ForecastResponse:
+        raise NotImplementedError()
+
+
+class TimeSeriesEndpoints:
+    def __init__(self, model_registry):
+        self._registry = model_registry
+
+    async def forecast(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError as e:
+            raise InvalidInput(f"invalid JSON body: {e}")
+        try:
+            params = ForecastRequest.model_validate(body)
+        except ValidationError as e:
+            raise InvalidInput(str(e))
+        model = self._registry.get_model(params.model)
+        if model is None:
+            raise ModelNotFound(params.model)
+        if not await self._registry.is_model_ready(params.model):
+            raise ModelNotReady(params.model)
+        if not isinstance(model, TimeSeriesModel):
+            raise InvalidInput(f"model {params.model} does not support forecasting")
+        result = await model.create_forecast(params)
+        return web.json_response(result.model_dump(exclude_none=True))
+
+    def register(self, app: web.Application) -> None:
+        app.router.add_post("/timeseries/v1/forecast", self.forecast)

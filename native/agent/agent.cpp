@@ -10,8 +10,8 @@
 //
 // Build:  g++ -O2 -std=c++17 -pthread -o kserve-tpu-agent agent.cpp
 // Run:    ./kserve-tpu-agent --port 9081 --component_port 8080 ...
-//             [--enable-batcher --max-batchsize 32 --max-latency 50] \
-//             [--enable-logger --log-url http://collector:8080/]
+//             [--enable-batcher --max-batchsize 32 --max-latency 50] ...
+//         [--enable-logger --log-url http://collector:8080/]
 
 #include <arpa/inet.h>
 #include <netdb.h>
@@ -380,7 +380,25 @@ class Batcher {
   }
 
   void execute(const std::vector<std::shared_ptr<BatchEntry>>& batch,
-               const std::string& path) {
+               const std::string& path);
+
+  std::mutex mu_;
+  std::vector<std::shared_ptr<BatchEntry>> pending_;
+  size_t pending_count_ = 0;
+  std::string path_;
+  bool timer_armed_ = false;
+};
+
+// qpext parity (qpext/cmd/qpext/main.go:312): one scrape endpoint exposing
+// both the sidecar's own counters and the component's /metrics.
+std::atomic<uint64_t> g_requests_total{0};
+std::atomic<uint64_t> g_batches_total{0};
+std::atomic<uint64_t> g_batched_requests_total{0};
+
+void Batcher::execute(const std::vector<std::shared_ptr<BatchEntry>>& batch,
+                      const std::string& path) {
+    g_batches_total++;
+    g_batched_requests_total += batch.size();
     std::ostringstream merged;
     merged << "{\"instances\": [";
     bool first = true;
@@ -425,16 +443,29 @@ class Batcher {
       e->done = true;
       e->cv.notify_one();
     }
-  }
-
-  std::mutex mu_;
-  std::vector<std::shared_ptr<BatchEntry>> pending_;
-  size_t pending_count_ = 0;
-  std::string path_;
-  bool timer_armed_ = false;
-};
+}
 
 Batcher g_batcher;
+
+// ----------------------------------------------------------- metrics merge
+
+std::string merged_metrics() {
+  std::ostringstream out;
+  out << "# TYPE agent_requests_total counter\n"
+      << "agent_requests_total " << g_requests_total.load() << "\n"
+      << "# TYPE agent_batches_total counter\n"
+      << "agent_batches_total " << g_batches_total.load() << "\n"
+      << "# TYPE agent_batched_requests_total counter\n"
+      << "agent_batched_requests_total " << g_batched_requests_total.load()
+      << "\n";
+  HttpMessage upstream;
+  if (call_component("GET", "/metrics", "", &upstream) &&
+      upstream.start_line.find("200") != std::string::npos) {
+    out << upstream.body;
+    if (!upstream.body.empty() && upstream.body.back() != '\n') out << "\n";
+  }
+  return out.str();
+}
 
 // ------------------------------------------------------------ connection
 
@@ -451,7 +482,11 @@ void handle_connection(int client_fd) {
   std::string response_str;
   if (path == "/healthz" || path == "/") {
     response_str = build_response(200, "OK", "{\"status\": \"ok\"}");
+  } else if (path == "/metrics") {
+    response_str = build_response(200, "OK", merged_metrics(),
+                                  "text/plain; version=0.0.4");
   } else {
+    g_requests_total++;
     bool is_predict = method == "POST" &&
                       path.find(":predict") != std::string::npos;
     g_logger.log("request", path, is_predict ? request.body : "");

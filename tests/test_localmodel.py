@@ -1,0 +1,59 @@
+"""LocalModelCache controller + node agent tests."""
+
+from kserve_tpu.controlplane.crds import LocalModelCache, LocalModelCacheSpec, ObjectMeta
+from kserve_tpu.controlplane.localmodel import (
+    LocalModelCacheReconciler,
+    LocalModelNodeAgent,
+)
+
+
+def make_cache():
+    return LocalModelCache(
+        metadata=ObjectMeta(name="llama-cache", namespace=""),
+        spec=LocalModelCacheSpec(
+            sourceModelUri="hf://meta-llama/Llama-3.2-1B",
+            modelSize="20Gi",
+            nodeGroups=["tpu-v5e"],
+        ),
+    )
+
+
+class TestLocalModelCache:
+    def test_creates_pv_pvc_and_jobs_per_node(self):
+        rec = LocalModelCacheReconciler({"tpu-v5e": ["node-a", "node-b"]})
+        objects, status = rec.reconcile(make_cache())
+        kinds = [(o["kind"], o["metadata"]["name"]) for o in objects]
+        assert ("PersistentVolume", "llama-cache-tpu-v5e") in kinds
+        assert ("PersistentVolumeClaim", "llama-cache-tpu-v5e") in kinds
+        jobs = [o for o in objects if o["kind"] == "Job"]
+        assert {j["metadata"]["name"] for j in jobs} == {
+            "llama-cache-node-a", "llama-cache-node-b",
+        }
+        job = jobs[0]
+        pod = job["spec"]["template"]["spec"]
+        assert pod["nodeName"] in ("node-a", "node-b")
+        assert pod["containers"][0]["args"][0] == "hf://meta-llama/Llama-3.2-1B"
+        assert status["copies"] == {"total": 2, "available": 0}
+        conds = {c["type"]: c["status"] for c in status["conditions"]}
+        assert conds["Ready"] == "False"
+
+    def test_ready_when_all_jobs_succeed(self):
+        rec = LocalModelCacheReconciler({"tpu-v5e": ["node-a", "node-b"]})
+        _, status = rec.reconcile(
+            make_cache(), job_status={"node-a": "Succeeded", "node-b": "Succeeded"}
+        )
+        assert status["copies"]["available"] == 2
+        conds = {c["type"]: c["status"] for c in status["conditions"]}
+        assert conds["Ready"] == "True"
+
+
+class TestNodeAgent:
+    def test_deletes_stale_reports_missing(self, tmp_path):
+        (tmp_path / "keep-me").mkdir()
+        (tmp_path / "stale").mkdir()
+        agent = LocalModelNodeAgent(cache_base=str(tmp_path))
+        result = agent.reconcile(["keep-me", "not-here-yet"])
+        assert result["present"] == ["keep-me"]
+        assert result["missing"] == ["not-here-yet"]
+        assert result["removed"] == ["stale"]
+        assert not (tmp_path / "stale").exists()

@@ -1,0 +1,69 @@
+"""Time-series protocol head tests."""
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from kserve_tpu import ModelRepository
+from kserve_tpu.protocol.model_repository_extension import ModelRepositoryExtension
+from kserve_tpu.protocol.openai.dataplane import OpenAIDataPlane
+from kserve_tpu.protocol.rest.server import RESTServer
+from kserve_tpu.protocol.timeseries import (
+    Forecast,
+    ForecastRequest,
+    ForecastResponse,
+    TimeSeriesModel,
+)
+
+from conftest import async_test
+
+
+class NaiveForecaster(TimeSeriesModel):
+    """Repeats the last observed value over the horizon."""
+
+    def __init__(self):
+        super().__init__("naive")
+        self.ready = True
+
+    async def create_forecast(self, request: ForecastRequest, context=None):
+        forecasts = [
+            Forecast(id=series.id, values=[series.values[-1]] * request.horizon)
+            for series in request.inputs
+        ]
+        return ForecastResponse(model=self.name, forecasts=forecasts)
+
+
+def make_client():
+    repo = ModelRepository()
+    repo.update(NaiveForecaster())
+    server = RESTServer(OpenAIDataPlane(repo), ModelRepositoryExtension(repo))
+    return TestClient(TestServer(server.create_application()))
+
+
+@async_test
+async def test_forecast():
+    async with make_client() as client:
+        res = await client.post(
+            "/timeseries/v1/forecast",
+            json={
+                "model": "naive",
+                "horizon": 3,
+                "inputs": [
+                    {"id": "s1", "timestamps": ["t1", "t2"], "values": [1.0, 2.0]},
+                    {"id": "s2", "timestamps": ["t1"], "values": [5.0]},
+                ],
+            },
+        )
+        assert res.status == 200
+        body = await res.json()
+        assert body["forecasts"][0]["values"] == [2.0, 2.0, 2.0]
+        assert body["forecasts"][1]["values"] == [5.0, 5.0, 5.0]
+
+
+@async_test
+async def test_forecast_errors():
+    async with make_client() as client:
+        missing = await client.post(
+            "/timeseries/v1/forecast", json={"model": "ghost", "inputs": []}
+        )
+        assert missing.status == 404
+        bad = await client.post("/timeseries/v1/forecast", json={"horizon": 1})
+        assert bad.status == 400
