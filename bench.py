@@ -53,7 +53,7 @@ async def run_bench():
         max_prefill_len=512,
         prefill_buckets=(128, 256, 512),
         dtype="bfloat16" if on_tpu else "float32",
-        use_pallas=None,  # auto: Pallas paged attention on TPU, XLA on host
+        use_pallas=None,  # default: XLA paged attention (see ops/attention.py)
         steps_per_sync=32,
     )
     tokenizer = ByteTokenizer(model_config.vocab_size)

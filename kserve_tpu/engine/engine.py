@@ -53,7 +53,11 @@ class EngineConfig:
     tp: int = 1
     dp: int = 1
     dtype: str = "bfloat16"
-    use_pallas: Optional[bool] = None  # None = auto (TPU yes)
+    # None/False = XLA gather attention (current default everywhere — the
+    # Pallas kernel breaks KV-cache aliasing at the custom-call boundary and
+    # is slower end-to-end until the layout contract is fixed); True opts in
+    # (requires head_dim % 128 == 0, else the call raises)
+    use_pallas: Optional[bool] = None
     # decode steps executed on-device per host round-trip (lax.scan inner
     # loop).  >1 amortizes host<->device latency — essential when the chip
     # sits behind a network tunnel; streaming granularity becomes K tokens.

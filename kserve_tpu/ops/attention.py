@@ -101,12 +101,17 @@ def paged_attention(
     logit_softcap: float = 0.0,
     use_pallas: Optional[bool] = None,
 ) -> jnp.ndarray:
-    """Dispatch to the Pallas kernel on TPU (head_dim 128-aligned), XLA
-    fallback elsewhere."""
+    """Dispatch.  Default is the XLA gather path: with page-table width
+    bucketing it is faster end-to-end at short/medium context AND it keeps
+    XLA's buffer aliasing intact — the Pallas custom-call currently forces
+    per-layer KV-cache copies (layout mismatch at the custom-call boundary;
+    measured 922 vs 1577 tok/s at 4k pages).  Opt in to the kernel
+    (use_pallas=True) for long-context decode where gather width dominates;
+    fixing the layout contract is a round-2 item."""
     d = q.shape[-1]
-    if use_pallas is None:
-        use_pallas = jax.default_backend() == "tpu" and d % 128 == 0
-    if use_pallas and d % 128 == 0:
+    if use_pallas:
+        # loud, not silent: an explicit opt-in with an unsupported head_dim
+        # must not quietly benchmark the XLA path
         from .pallas_paged_attention import paged_attention_pallas
 
         return paged_attention_pallas(
