@@ -16,12 +16,25 @@ from ..logging import logger
 
 def apply_platform_override() -> None:
     want = os.environ.get("JAX_PLATFORM_NAME", "").strip().lower()
-    if not want:
-        return
     try:
         import jax
 
-        jax.config.update("jax_platforms", want)
-        logger.info("JAX platform forced to %s via JAX_PLATFORM_NAME", want)
+        if want:
+            jax.config.update("jax_platforms", want)
+            logger.info("JAX platform forced to %s via JAX_PLATFORM_NAME", want)
+        # Initialize the backend NOW: the ambient JAX_PLATFORMS=axon names a
+        # plugin that intermittently fails to register when jax first
+        # initializes late inside a server process.  Initializing early —
+        # with an auto-select retry — makes runtime startup deterministic.
+        try:
+            jax.devices()
+        except RuntimeError as e:
+            if not want:
+                logger.warning("backend init failed (%s); retrying auto-select", e)
+                jax.config.update("jax_platforms", "")
+                jax.devices()
+            else:
+                raise
+        logger.info("JAX backend: %s (%d devices)", jax.default_backend(), len(jax.devices()))
     except Exception as e:  # pragma: no cover — backend already initialized
-        logger.warning("could not force JAX platform %s: %s", want, e)
+        logger.warning("could not configure JAX platform: %s", e)
