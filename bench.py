@@ -32,11 +32,11 @@ async def run_bench():
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
         model_config = LlamaConfig.bench_1b()
-        batch = 32
+        batch = 48
         prompt_len = 128
         max_tokens = 128
         num_pages = 4096
-        n_requests = 96
+        n_requests = 144
     else:  # CPU smoke mode so the script is runnable anywhere
         model_config = LlamaConfig.tiny(dtype="float32")
         batch = 4
