@@ -109,6 +109,10 @@ class RESTServer:
 
     def create_application(self) -> web.Application:
         middlewares = [error_middleware]
+        from ...tracing import get_tracer, tracing_middleware
+
+        if get_tracer() is not None:
+            middlewares.append(tracing_middleware)
         if self.enable_latency_logging:
             middlewares.append(timing_middleware)
         app = web.Application(middlewares=middlewares, client_max_size=1024**3)

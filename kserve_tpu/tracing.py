@@ -1,0 +1,95 @@
+"""OpenTelemetry tracing for the data plane.
+
+Parity: the reference's LLMISVC tracing (llmisvc/tracing.go:34-120 injects
+OTEL_* env + --tracing into containers; vLLM then emits spans).  Here the
+serving process itself emits spans: an aiohttp middleware opens one span per
+request, annotated with model name / route / status.
+
+The image ships only the OTel API package; spans are no-ops unless an SDK is
+installed in the serving image and OTEL_EXPORTER_OTLP_ENDPOINT is set (which
+the LLMISVC reconciler does when `tracing.enabled`).  `set_tracer_for_tests`
+lets tests inject a recording tracer without the SDK.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from aiohttp import web
+
+from .logging import logger
+
+_tracer = None
+_configured = False
+
+
+def setup_tracing(service_name: str = "kserve-tpu") -> None:
+    """Configure the global tracer: OTLP exporter when the SDK + endpoint
+    exist, API no-op tracer otherwise."""
+    global _tracer, _configured
+    if _configured:
+        return
+    _configured = True
+    try:
+        from opentelemetry import trace
+    except ImportError:
+        logger.info("opentelemetry API not installed; tracing disabled")
+        return
+    endpoint = os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT")
+    if endpoint:
+        try:
+            from opentelemetry.sdk.resources import Resource
+            from opentelemetry.sdk.trace import TracerProvider
+            from opentelemetry.sdk.trace.export import BatchSpanProcessor
+            from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
+                OTLPSpanExporter,
+            )
+
+            provider = TracerProvider(
+                resource=Resource.create({"service.name": service_name})
+            )
+            provider.add_span_processor(BatchSpanProcessor(OTLPSpanExporter()))
+            trace.set_tracer_provider(provider)
+            logger.info("OTLP tracing enabled -> %s", endpoint)
+        except ImportError:
+            logger.warning(
+                "OTEL_EXPORTER_OTLP_ENDPOINT set but opentelemetry-sdk not "
+                "installed; spans are no-ops"
+            )
+    _tracer = trace.get_tracer("kserve_tpu")
+
+
+def set_tracer_for_tests(tracer) -> None:
+    global _tracer, _configured
+    _tracer = tracer
+    _configured = True
+
+
+def get_tracer():
+    if not _configured:
+        setup_tracing()
+    return _tracer
+
+
+@web.middleware
+async def tracing_middleware(request: web.Request, handler):
+    tracer = get_tracer()
+    if tracer is None:
+        return await handler(request)
+    with tracer.start_as_current_span(
+        f"{request.method} {request.path}",
+        attributes={
+            "http.method": request.method,
+            "http.target": request.path,
+        },
+    ) as span:
+        response = await handler(request)
+        try:
+            span.set_attribute("http.status_code", response.status)
+            model = request.match_info.get("model_name")
+            if model:
+                span.set_attribute("kserve.model", model)
+        except Exception:  # pragma: no cover — recording API variations
+            pass
+        return response

@@ -1,0 +1,72 @@
+"""Tracing middleware tests with a recording fake tracer (no SDK in image)."""
+
+from contextlib import contextmanager
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+import kserve_tpu.tracing as tracing
+from kserve_tpu import ModelRepository
+from kserve_tpu.protocol.model_repository_extension import ModelRepositoryExtension
+from kserve_tpu.protocol.openai.dataplane import OpenAIDataPlane
+from kserve_tpu.protocol.rest.server import RESTServer
+
+from conftest import async_test
+from test_rest_server import DummyModel
+
+
+class FakeSpan:
+    def __init__(self, name, attributes):
+        self.name = name
+        self.attributes = dict(attributes or {})
+
+    def set_attribute(self, key, value):
+        self.attributes[key] = value
+
+
+class FakeTracer:
+    def __init__(self):
+        self.spans = []
+
+    @contextmanager
+    def start_as_current_span(self, name, attributes=None):
+        span = FakeSpan(name, attributes)
+        self.spans.append(span)
+        yield span
+
+
+@pytest.fixture
+def fake_tracer():
+    tracer = FakeTracer()
+    tracing.set_tracer_for_tests(tracer)
+    yield tracer
+    tracing.set_tracer_for_tests(None)
+    tracing._configured = False
+
+
+@async_test
+async def test_spans_recorded_per_request(fake_tracer):
+    repo = ModelRepository()
+    repo.update(DummyModel())
+    server = RESTServer(OpenAIDataPlane(repo), ModelRepositoryExtension(repo))
+    async with TestClient(TestServer(server.create_application())) as client:
+        res = await client.post(
+            "/v1/models/dummy:predict", json={"instances": [[1, 2]]}
+        )
+        assert res.status == 200
+    span = next(s for s in fake_tracer.spans if ":predict" in s.name)
+    assert span.attributes["http.method"] == "POST"
+    assert span.attributes["http.status_code"] == 200
+    assert span.attributes["kserve.model"] == "dummy"
+
+
+@async_test
+async def test_no_tracer_means_no_overhead():
+    tracing.set_tracer_for_tests(None)
+    repo = ModelRepository()
+    repo.update(DummyModel())
+    server = RESTServer(OpenAIDataPlane(repo), ModelRepositoryExtension(repo))
+    async with TestClient(TestServer(server.create_application())) as client:
+        res = await client.post("/v1/models/dummy:predict", json={"instances": [[1]]})
+        assert res.status == 200
+    tracing._configured = False
