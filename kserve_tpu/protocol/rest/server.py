@@ -108,11 +108,14 @@ class RESTServer:
         self._runner: Optional[web.AppRunner] = None
 
     def create_application(self) -> web.Application:
-        middlewares = [error_middleware]
         from ...tracing import get_tracer, tracing_middleware
 
+        middlewares = []
+        # tracing wraps OUTSIDE error mapping so spans observe the final
+        # mapped status (a 404 must be a clean span, not an exception span)
         if get_tracer() is not None:
             middlewares.append(tracing_middleware)
+        middlewares.append(error_middleware)
         if self.enable_latency_logging:
             middlewares.append(timing_middleware)
         app = web.Application(middlewares=middlewares, client_max_size=1024**3)

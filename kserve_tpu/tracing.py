@@ -37,26 +37,30 @@ def setup_tracing(service_name: str = "kserve-tpu") -> None:
         logger.info("opentelemetry API not installed; tracing disabled")
         return
     endpoint = os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT")
-    if endpoint:
-        try:
-            from opentelemetry.sdk.resources import Resource
-            from opentelemetry.sdk.trace import TracerProvider
-            from opentelemetry.sdk.trace.export import BatchSpanProcessor
-            from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
-                OTLPSpanExporter,
-            )
+    if not endpoint:
+        # zero-overhead default: no endpoint -> no tracer -> middleware is
+        # never installed (the API's proxy tracer would silently cost a
+        # discarded span per request otherwise)
+        return
+    try:
+        from opentelemetry.sdk.resources import Resource
+        from opentelemetry.sdk.trace import TracerProvider
+        from opentelemetry.sdk.trace.export import BatchSpanProcessor
+        from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
+            OTLPSpanExporter,
+        )
 
-            provider = TracerProvider(
-                resource=Resource.create({"service.name": service_name})
-            )
-            provider.add_span_processor(BatchSpanProcessor(OTLPSpanExporter()))
-            trace.set_tracer_provider(provider)
-            logger.info("OTLP tracing enabled -> %s", endpoint)
-        except ImportError:
-            logger.warning(
-                "OTEL_EXPORTER_OTLP_ENDPOINT set but opentelemetry-sdk not "
-                "installed; spans are no-ops"
-            )
+        provider = TracerProvider(
+            resource=Resource.create({"service.name": service_name})
+        )
+        provider.add_span_processor(BatchSpanProcessor(OTLPSpanExporter()))
+        trace.set_tracer_provider(provider)
+        logger.info("OTLP tracing enabled -> %s", endpoint)
+    except ImportError:
+        logger.warning(
+            "OTEL_EXPORTER_OTLP_ENDPOINT set but opentelemetry-sdk not "
+            "installed; spans are no-ops"
+        )
     _tracer = trace.get_tracer("kserve_tpu")
 
 
@@ -77,8 +81,14 @@ async def tracing_middleware(request: web.Request, handler):
     tracer = get_tracer()
     if tracer is None:
         return await handler(request)
+    # low-cardinality span name: the route TEMPLATE, not the raw path
+    # (N models must not mean N span names; raw path stays in http.target)
+    try:
+        route = request.match_info.route.resource.canonical
+    except AttributeError:
+        route = request.path
     with tracer.start_as_current_span(
-        f"{request.method} {request.path}",
+        f"{request.method} {route}",
         attributes={
             "http.method": request.method,
             "http.target": request.path,

@@ -39,34 +39,55 @@ class FakeTracer:
 def fake_tracer():
     tracer = FakeTracer()
     tracing.set_tracer_for_tests(tracer)
-    yield tracer
-    tracing.set_tracer_for_tests(None)
-    tracing._configured = False
+    try:
+        yield tracer
+    finally:
+        tracing.set_tracer_for_tests(None)
+        tracing._configured = False
+
+
+def make_server():
+    repo = ModelRepository()
+    repo.update(DummyModel())
+    return RESTServer(OpenAIDataPlane(repo), ModelRepositoryExtension(repo))
 
 
 @async_test
-async def test_spans_recorded_per_request(fake_tracer):
-    repo = ModelRepository()
-    repo.update(DummyModel())
-    server = RESTServer(OpenAIDataPlane(repo), ModelRepositoryExtension(repo))
+async def test_spans_use_route_template_and_final_status(fake_tracer):
+    server = make_server()
     async with TestClient(TestServer(server.create_application())) as client:
         res = await client.post(
             "/v1/models/dummy:predict", json={"instances": [[1, 2]]}
         )
         assert res.status == 200
-    span = next(s for s in fake_tracer.spans if ":predict" in s.name)
-    assert span.attributes["http.method"] == "POST"
-    assert span.attributes["http.status_code"] == 200
-    assert span.attributes["kserve.model"] == "dummy"
+        # a mapped application error must record the FINAL status, not an
+        # exception (tracing sits outside error mapping)
+        missing = await client.post(
+            "/v1/models/ghost:predict", json={"instances": [[1]]}
+        )
+        assert missing.status == 404
+    ok_span = fake_tracer.spans[0]
+    # route template, not the raw path: one name for all models
+    assert ok_span.name == "POST /v1/models/{model_name}:predict"
+    assert ok_span.attributes["http.target"] == "/v1/models/dummy:predict"
+    assert ok_span.attributes["http.status_code"] == 200
+    assert ok_span.attributes["kserve.model"] == "dummy"
+    err_span = fake_tracer.spans[1]
+    assert err_span.name == ok_span.name
+    assert err_span.attributes["http.status_code"] == 404
 
 
 @async_test
-async def test_no_tracer_means_no_overhead():
+async def test_disabled_tracing_installs_no_middleware():
     tracing.set_tracer_for_tests(None)
-    repo = ModelRepository()
-    repo.update(DummyModel())
-    server = RESTServer(OpenAIDataPlane(repo), ModelRepositoryExtension(repo))
-    async with TestClient(TestServer(server.create_application())) as client:
-        res = await client.post("/v1/models/dummy:predict", json={"instances": [[1]]})
-        assert res.status == 200
-    tracing._configured = False
+    try:
+        server = make_server()
+        app = server.create_application()
+        assert tracing.tracing_middleware not in app.middlewares
+        async with TestClient(TestServer(app)) as client:
+            res = await client.post(
+                "/v1/models/dummy:predict", json={"instances": [[1]]}
+            )
+            assert res.status == 200
+    finally:
+        tracing._configured = False
