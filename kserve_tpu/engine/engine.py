@@ -38,7 +38,7 @@ from ..metrics import (
 from ..models import llama
 from ..parallel import sharding as shd
 from .kvcache import KVCacheConfig, PageAllocator, init_kv_pages, pages_needed
-from .sampling import SamplingParams, SamplingState, sample_tokens
+from .sampling import SamplingParams, SamplingState, apply_penalties, sample_tokens
 from .tokenizer import BaseTokenizer, IncrementalDetokenizer
 
 
@@ -103,7 +103,7 @@ class _Slot:
     """Host-side state for one decode lane."""
 
     __slots__ = (
-        "request_id", "prompt_len", "pages", "pos", "generated",
+        "request_id", "prompt_len", "prompt_ids", "pages", "pos", "generated",
         "params", "queue", "detok", "stop_texts", "admitted_at",
     )
 
@@ -166,6 +166,11 @@ class LLMEngine:
         self._task: Optional[asyncio.Task] = None
         self._pipeline_busy = False
         self._deferred_free: List[int] = []
+        # device-resident [B, V] penalty state; row-level updates on batch
+        # composition changes (dirty_rows None => full rebuild needed)
+        self._penalty_counts = None
+        self._penalty_prompt = None
+        self._penalty_dirty_rows: Optional[set] = None
         self._build_compiled()
 
     # ---------------- compiled programs ----------------
@@ -181,42 +186,97 @@ class LLMEngine:
             logits, kv_pages = llama.prefill(
                 params, mc, tokens, valid_len, kv_pages, page_ids, cfg.page_size
             )
+            # vLLM-parity: repetition_penalty counts prompt tokens as "seen"
+            # for the very first sampled token.  Rows with default penalties
+            # are bit-identical to the unpenalized math.
+            Bp, V = logits.shape
+            pos_valid = (
+                jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :]
+                < valid_len[:, None]
+            )
+            in_prompt = (
+                jnp.zeros((Bp, V), bool)
+                .at[jnp.arange(Bp)[:, None], tokens]
+                .max(pos_valid)
+            )
+            logits = apply_penalties(
+                logits,
+                jnp.zeros((Bp, V), jnp.int32),
+                state.repetition_penalty,
+                state.frequency_penalty,
+                state.presence_penalty,
+                in_prompt,
+            )
             first = sample_tokens(logits, state, rng)
             return first, kv_pages
 
-        def _decode_multi(params, tokens, pos, kv_pages, page_table, active,
-                          capacity, counters, state, rng):
+        def _make_decode(with_penalties: bool):
             """steps_per_sync decode steps on device; emits [steps, B] tokens.
             Lanes past their page capacity (or inactive) hold token/pos and
             write to the null page — a clamped page-table index would
-            otherwise corrupt a neighbouring sequence's last page."""
-            steps = cfg.steps_per_sync
+            otherwise corrupt a neighbouring sequence's last page.
 
-            def body(carry, step_rng):
-                tokens, pos, counters, kv_pages = carry
-                live = active & (pos < capacity)
-                logits, kv_pages = llama.decode_step(
-                    params, mc, tokens, pos, kv_pages, page_table, live,
-                    cfg.page_size, use_pallas=cfg.use_pallas,
-                )
-                nxt = sample_tokens(logits, state, step_rng, counters)
-                nxt = jnp.where(live, nxt, tokens)
-                return (
-                    nxt,
-                    pos + live.astype(pos.dtype),
-                    counters + live.astype(counters.dtype),
-                    kv_pages,
-                ), nxt
+            The penalized variant additionally threads a [B, V] output-count
+            carry (plus a static [B, V] prompt mask) through the scan and
+            returns the updated counts; it is compiled separately so requests
+            without penalties never pay the per-step [B, V] scatter/gather."""
 
-            rngs = jax.random.split(rng, steps)
-            (tokens, pos, counters, kv_pages), out = jax.lax.scan(
-                body, (tokens, pos, counters, kv_pages), rngs
-            )
-            return out, kv_pages
+            def fn(params, tokens, pos, kv_pages, page_table, active,
+                   capacity, counters, state, rng, *penalty_args):
+                steps = cfg.steps_per_sync
+                B = tokens.shape[0]
 
-        n_kv_args = 3  # kv_pages is arg index 3 in both signatures
+                def body(carry, step_rng):
+                    if with_penalties:
+                        tokens, pos, counters, kv_pages, counts = carry
+                    else:
+                        tokens, pos, counters, kv_pages = carry
+                    live = active & (pos < capacity)
+                    logits, kv_pages = llama.decode_step(
+                        params, mc, tokens, pos, kv_pages, page_table, live,
+                        cfg.page_size, use_pallas=cfg.use_pallas,
+                    )
+                    if with_penalties:
+                        logits = apply_penalties(
+                            logits, counts,
+                            state.repetition_penalty,
+                            state.frequency_penalty,
+                            state.presence_penalty,
+                            penalty_args[0],
+                        )
+                    nxt = sample_tokens(logits, state, step_rng, counters)
+                    nxt = jnp.where(live, nxt, tokens)
+                    new_carry = (
+                        nxt,
+                        pos + live.astype(pos.dtype),
+                        counters + live.astype(counters.dtype),
+                        kv_pages,
+                    )
+                    if with_penalties:
+                        counts = counts.at[jnp.arange(B), nxt].add(
+                            live.astype(counts.dtype)
+                        )
+                        new_carry = new_carry + (counts,)
+                    return new_carry, nxt
+
+                init = (tokens, pos, counters, kv_pages)
+                if with_penalties:
+                    init = init + (penalty_args[1],)
+                rngs = jax.random.split(rng, steps)
+                carry, out = jax.lax.scan(body, init, rngs)
+                if with_penalties:
+                    return out, carry[3], carry[4]
+                return out, carry[3]
+
+            return fn
+
+        n_kv_args = 3  # kv_pages is arg index 3 in all three signatures
         self._prefill_fn = jax.jit(_prefill, donate_argnums=(n_kv_args,))
-        self._decode_fn = jax.jit(_decode_multi, donate_argnums=(n_kv_args,))
+        self._decode_fn = jax.jit(_make_decode(False), donate_argnums=(n_kv_args,))
+        # arg 10 = prompt mask (kept across chunks), arg 11 = counts (donated)
+        self._decode_penalized_fn = jax.jit(
+            _make_decode(True), donate_argnums=(n_kv_args, 11)
+        )
 
     # ---------------- public API ----------------
 
@@ -280,10 +340,11 @@ class LLMEngine:
 
     def cancel(self, request_id: str) -> None:
         self._waiting = [r for r in self._waiting if r.request_id != request_id]
-        for slot in self._slots:
+        for i, slot in enumerate(self._slots):
             if slot.request_id == request_id:
                 self._free_pages(slot.pages)
                 slot.reset()
+                self._mark_penalty_dirty(i)
                 self._wake.set()
 
     # ---------------- engine loop ----------------
@@ -390,6 +451,7 @@ class LLMEngine:
             slot = self._slots[idx]
             slot.request_id = req.request_id
             slot.prompt_len = n_prompt
+            slot.prompt_ids = req.prompt_ids
             slot.pages = pages
             slot.pos = n_prompt  # position of the token being decoded next
             slot.generated = [first_token]
@@ -398,6 +460,7 @@ class LLMEngine:
             slot.detok = IncrementalDetokenizer(self.tokenizer)
             slot.stop_texts = list(req.params.stop or [])
             slot.admitted_at = now
+            self._mark_penalty_dirty(idx)
             self._emit(slot, first_token)
         return True
 
@@ -474,6 +537,16 @@ class LLMEngine:
             if slot.request_id is not None and active[i]:
                 # tokens generated when this chunk starts (for seeded lanes)
                 counters[i] = int(pos[i]) - slot.prompt_len + 1
+        # penalized chunks use device-resident [B, V] count/prompt arrays,
+        # rebuilt from the host-side slot lists only when batch composition
+        # changed; such chunks are never pipeline-chained so the counts are
+        # always accurate at dispatch time
+        penalized = any(
+            slot.request_id is not None and active[i] and slot.params.has_penalties
+            for i, slot in enumerate(self._slots)
+        )
+        if penalized:
+            self._refresh_penalty_state(active)
         return {
             "tokens": tokens,
             "pos": pos,
@@ -482,14 +555,55 @@ class LLMEngine:
             "page_table": page_table,
             "counters": counters,
             "state": SamplingState.from_params(params_list),
+            "penalized": penalized,
         }
+
+    def _refresh_penalty_state(self, active: np.ndarray) -> None:
+        """Bring the device [B, V] count/prompt arrays up to date.  Rows for
+        lanes that stayed resident are already correct on device (the
+        penalized decode returns updated counts); only rows touched by
+        admission/finish/cancel are re-uploaded — O(changed rows), not O(B)."""
+        V = self.model_config.vocab_size
+        B = self.config.max_batch_size
+
+        def row_data(i):
+            counts_row = np.zeros((V,), np.int32)
+            prompt_row = np.zeros((V,), bool)
+            slot = self._slots[i]
+            if slot.request_id is not None and active[i]:
+                np.add.at(counts_row, slot.generated, 1)
+                prompt_row[slot.prompt_ids] = True
+            return counts_row, prompt_row
+
+        if self._penalty_counts is None or self._penalty_dirty_rows is None:
+            rows = [row_data(i) for i in range(B)]
+            self._penalty_counts = jnp.asarray(np.stack([r[0] for r in rows]))
+            self._penalty_prompt = jnp.asarray(np.stack([r[1] for r in rows]))
+        elif self._penalty_dirty_rows:
+            idx = sorted(self._penalty_dirty_rows)
+            rows = [row_data(i) for i in idx]
+            at = jnp.asarray(idx)
+            self._penalty_counts = self._penalty_counts.at[at].set(
+                jnp.asarray(np.stack([r[0] for r in rows]))
+            )
+            self._penalty_prompt = self._penalty_prompt.at[at].set(
+                jnp.asarray(np.stack([r[1] for r in rows]))
+            )
+        self._penalty_dirty_rows = set()
+
+    def _mark_penalty_dirty(self, slot_index: Optional[int]) -> None:
+        """Record a batch-composition change; None invalidates everything."""
+        if slot_index is None:
+            self._penalty_dirty_rows = None
+        elif self._penalty_dirty_rows is not None:
+            self._penalty_dirty_rows.add(slot_index)
 
     def _dispatch_chunk(self, meta: dict, tokens_dev=None):
         """Launch one decode chunk (async); tokens_dev chains the previous
         chunk's device-resident last tokens, skipping a host round-trip."""
         rng = jax.random.fold_in(self._base_rng, self._next_step())
         tokens = tokens_dev if tokens_dev is not None else jnp.asarray(meta["tokens"])
-        chunk, self.kv_pages = self._decode_fn(
+        args = (
             self.params,
             tokens,
             jnp.asarray(meta["pos"]),
@@ -501,6 +615,16 @@ class LLMEngine:
             meta["state"],
             rng,
         )
+        if meta.get("penalized"):
+            chunk, self.kv_pages, self._penalty_counts = self._decode_penalized_fn(
+                *args, self._penalty_prompt, self._penalty_counts
+            )
+        else:
+            chunk, self.kv_pages = self._decode_fn(*args)
+            if self._penalty_counts is not None:
+                # a non-penalized chunk advances lanes without updating the
+                # device counts; they are stale for every resident row now
+                self._mark_penalty_dirty(None)
         return chunk
 
     def _route_chunk(self, meta: dict, chunk) -> bool:
@@ -554,7 +678,12 @@ class LLMEngine:
                 >= s.params.max_tokens
                 for i, s in enumerate(self._slots)
             )
-            if admission_blocked and not predictable_finish and not self._stopped:
+            if (
+                admission_blocked
+                and not predictable_finish
+                and not meta.get("penalized")
+                and not self._stopped
+            ):
                 meta2 = self._prepare_chunk(prev=meta)
             if meta2 is not None:
                 chunk2 = self._dispatch_chunk(meta2, tokens_dev=chunk[-1])
@@ -612,6 +741,7 @@ class LLMEngine:
         if finish_reason is not None:
             self._free_pages(slot.pages)
             slot.reset()
+            self._mark_penalty_dirty(self._slots.index(slot))
             self._wake.set()
 
     def _finish(self, slot: _Slot, reason: str):
@@ -627,6 +757,7 @@ class LLMEngine:
         slot.queue.put_nowait(out)
         self._free_pages(slot.pages)
         slot.reset()
+        self._mark_penalty_dirty(self._slots.index(slot))
 
     def _next_step(self) -> int:
         self._step_counter += 1

@@ -39,6 +39,16 @@ class SamplingParams:
     def greedy(self) -> bool:
         return self.temperature == 0.0
 
+    @property
+    def has_penalties(self) -> bool:
+        """True when this request needs the penalized decode path (which
+        carries a [B, V] output-count array; the fast path skips it)."""
+        return (
+            self.repetition_penalty != 1.0
+            or self.frequency_penalty != 0.0
+            or self.presence_penalty != 0.0
+        )
+
 
 @jax.tree_util.register_dataclass
 @dataclass
@@ -51,6 +61,9 @@ class SamplingState:
     top_k: jnp.ndarray  # [B] i32 (0 => off)
     min_p: jnp.ndarray  # [B] f32
     seed: jnp.ndarray  # [B] i32 (-1 => draw from the shared batch rng)
+    repetition_penalty: jnp.ndarray  # [B] f32 (1.0 => off)
+    frequency_penalty: jnp.ndarray  # [B] f32 (0.0 => off)
+    presence_penalty: jnp.ndarray  # [B] f32 (0.0 => off)
 
     @staticmethod
     def from_params(params_list: List[SamplingParams]) -> "SamplingState":
@@ -62,6 +75,15 @@ class SamplingState:
             seed=jnp.asarray(
                 [p.seed if p.seed is not None else -1 for p in params_list], jnp.int32
             ),
+            repetition_penalty=jnp.asarray(
+                [p.repetition_penalty for p in params_list], jnp.float32
+            ),
+            frequency_penalty=jnp.asarray(
+                [p.frequency_penalty for p in params_list], jnp.float32
+            ),
+            presence_penalty=jnp.asarray(
+                [p.presence_penalty for p in params_list], jnp.float32
+            ),
         )
 
     @staticmethod
@@ -72,6 +94,9 @@ class SamplingState:
             top_k=jnp.zeros((batch,), jnp.int32),
             min_p=jnp.zeros((batch,), jnp.float32),
             seed=jnp.full((batch,), -1, jnp.int32),
+            repetition_penalty=jnp.ones((batch,), jnp.float32),
+            frequency_penalty=jnp.zeros((batch,), jnp.float32),
+            presence_penalty=jnp.zeros((batch,), jnp.float32),
         )
 
 
@@ -140,11 +165,16 @@ def apply_penalties(
     repetition_penalty: jnp.ndarray,  # [B]
     frequency_penalty: jnp.ndarray,  # [B]
     presence_penalty: jnp.ndarray,  # [B]
+    prompt_mask: Optional[jnp.ndarray] = None,  # [B, V] bool — in-prompt tokens
 ) -> jnp.ndarray:
-    seen = output_counts > 0
+    """vLLM-parity penalty semantics: repetition_penalty applies to tokens
+    seen in the prompt OR the output; frequency/presence (OpenAI) apply to
+    generated output only."""
+    seen_out = output_counts > 0
+    seen_rep = seen_out if prompt_mask is None else (seen_out | prompt_mask)
     rp = repetition_penalty[:, None]
     penalized = jnp.where(logits > 0, logits / rp, logits * rp)
-    logits = jnp.where(seen, penalized, logits)
+    logits = jnp.where(seen_rep, penalized, logits)
     logits = logits - frequency_penalty[:, None] * output_counts
-    logits = logits - presence_penalty[:, None] * seen.astype(logits.dtype)
+    logits = logits - presence_penalty[:, None] * seen_out.astype(logits.dtype)
     return logits

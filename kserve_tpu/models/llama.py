@@ -44,6 +44,7 @@ class LlamaConfig:
     n_kv_heads: int = 32
     head_dim: Optional[int] = None
     rope_theta: float = 10000.0
+    rope_scaling: Optional[dict] = None  # HF rope_scaling (llama3/linear)
     rms_norm_eps: float = 1e-5
     max_position_embeddings: int = 4096
     tie_word_embeddings: bool = False
@@ -126,6 +127,18 @@ class LlamaConfig:
                 cfg = json.load(f)
         else:
             cfg = dict(path_or_dict)
+        rope_scaling = cfg.get("rope_scaling")
+        if rope_scaling is not None:
+            # Validate eagerly: Llama-3.1/3.2 checkpoints rely on rope_type
+            # "llama3" at every position; silently dropping an unsupported
+            # variant would load but produce wrong logits.
+            from ..ops.rotary import rope_frequencies
+
+            rope_frequencies(
+                cfg.get("head_dim") or cfg["hidden_size"] // cfg["num_attention_heads"],
+                cfg.get("rope_theta", 10000.0),
+                rope_scaling,
+            )
         return LlamaConfig(
             vocab_size=cfg["vocab_size"],
             hidden_size=cfg["hidden_size"],
@@ -135,6 +148,7 @@ class LlamaConfig:
             n_kv_heads=cfg.get("num_key_value_heads", cfg["num_attention_heads"]),
             head_dim=cfg.get("head_dim"),
             rope_theta=cfg.get("rope_theta", 10000.0),
+            rope_scaling=rope_scaling,
             rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
             max_position_embeddings=cfg.get("max_position_embeddings", 4096),
             tie_word_embeddings=cfg.get("tie_word_embeddings", False),
@@ -233,8 +247,8 @@ def prefill(
         residual = x
         h = rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
         q, k, v = _qkv(layer, h, config)
-        q = apply_rope(q, positions, config.rope_theta)
-        k = apply_rope(k, positions, config.rope_theta)
+        q = apply_rope(q, positions, config.rope_theta, config.rope_scaling)
+        k = apply_rope(k, positions, config.rope_theta, config.rope_scaling)
         attn = causal_prefill_attention(q, k, v, valid_len, config.logit_softcap)
         attn = attn.reshape(B, T, -1) @ layer["wo"]
         x = residual + attn
@@ -270,8 +284,8 @@ def decode_step(
         residual = x
         h = rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
         q, k, v = _qkv(layer, h, config)
-        q = apply_rope(q, positions, config.rope_theta)
-        k = apply_rope(k, positions, config.rope_theta)
+        q = apply_rope(q, positions, config.rope_theta, config.rope_scaling)
+        k = apply_rope(k, positions, config.rope_theta, config.rope_scaling)
         pages = append_token_kv(
             pages, k[:, 0], v[:, 0], page_table, pos, active, page_size
         )

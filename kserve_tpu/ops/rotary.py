@@ -2,31 +2,66 @@
 
 Computed on the fly from positions (no host-side cache tables) so the same
 function serves prefill ([B,T]) and decode ([B,1]) under one jit.
+
+Supports the HF `rope_scaling` variants needed for real checkpoints:
+- "llama3" (Llama-3.1/3.2): low/high-frequency wavelength scaling applied
+  at ALL positions (config.json rope_type "llama3")
+- "linear": uniform inv_freq / factor
+Unsupported types raise instead of being silently dropped.
 """
 
 from __future__ import annotations
 
+import math
+from typing import Optional
+
 import jax.numpy as jnp
 
 
-def rope_frequencies(head_dim: int, theta: float = 10000.0) -> jnp.ndarray:
-    """[head_dim/2] inverse frequencies."""
+def rope_frequencies(
+    head_dim: int,
+    theta: float = 10000.0,
+    rope_scaling: Optional[dict] = None,
+) -> jnp.ndarray:
+    """[head_dim/2] inverse frequencies, with optional HF rope_scaling."""
     exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
-    return 1.0 / (theta**exponent)
+    inv_freq = 1.0 / (theta**exponent)
+    if not rope_scaling:
+        return inv_freq
+    rope_type = rope_scaling.get("rope_type") or rope_scaling.get("type") or "default"
+    if rope_type == "default":
+        return inv_freq
+    if rope_type == "linear":
+        return inv_freq / float(rope_scaling["factor"])
+    if rope_type == "llama3":
+        # Per-frequency interpolation: wavelengths shorter than
+        # orig_ctx/high_freq_factor are kept, longer than
+        # orig_ctx/low_freq_factor are divided by `factor`, and the band in
+        # between is linearly blended.  The clip form below is exactly
+        # equivalent to the three-way where() in HF modeling_rope_utils.
+        factor = float(rope_scaling["factor"])
+        low = float(rope_scaling["low_freq_factor"])
+        high = float(rope_scaling["high_freq_factor"])
+        orig_ctx = float(rope_scaling["original_max_position_embeddings"])
+        wavelen = 2.0 * math.pi / inv_freq
+        smooth = jnp.clip((orig_ctx / wavelen - low) / (high - low), 0.0, 1.0)
+        return (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+    raise ValueError(
+        f"unsupported rope_scaling type {rope_type!r}; supported: "
+        "default, linear, llama3"
+    )
 
 
 def apply_rope(
     x: jnp.ndarray,  # [B, T, H, D]
     positions: jnp.ndarray,  # [B, T] int32
     theta: float = 10000.0,
-    scaling: float = 1.0,
+    rope_scaling: Optional[dict] = None,
 ) -> jnp.ndarray:
     """Rotate q/k by position-dependent phases.  Half-rotation layout:
     pairs are (x[..., :D/2], x[..., D/2:]) as in Llama."""
     head_dim = x.shape[-1]
-    inv_freq = rope_frequencies(head_dim, theta)
-    if scaling != 1.0:
-        inv_freq = inv_freq / scaling
+    inv_freq = rope_frequencies(head_dim, theta, rope_scaling)
     angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [B,T,D/2]
     cos = jnp.cos(angles)[:, :, None, :]  # [B,T,1,D/2]
     sin = jnp.sin(angles)[:, :, None, :]

@@ -127,6 +127,9 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
             top_p=req.top_p if req.top_p is not None else 1.0,
             top_k=req.top_k or 0,
             min_p=req.min_p or 0.0,
+            repetition_penalty=getattr(req, "repetition_penalty", None) or 1.0,
+            frequency_penalty=getattr(req, "frequency_penalty", None) or 0.0,
+            presence_penalty=getattr(req, "presence_penalty", None) or 0.0,
             max_tokens=max_tokens,
             min_tokens=req.min_tokens or 0,
             ignore_eos=bool(req.ignore_eos),

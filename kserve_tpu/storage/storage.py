@@ -1,11 +1,11 @@
 """Model artifact storage: URI-scheme dispatch + unpack.
 
 Parity: reference python/storage/kserve_storage/kserve_storage.py:47-64
-(scheme table) — gs://, s3://, hdfs/webhdfs, azure blob/file, pvc://,
-local file://, http(s)://, hf://.  Cloud SDKs are not in this image, so
-those providers are import-gated: the scheme is recognized, the download
-raises a clear error unless the SDK is present.  file/pvc/http(s)/hf-local
-paths are fully functional.
+(scheme table) — gs://, s3://, hdfs/webhdfs, azure blob, pvc://,
+local file://, http(s)://, hf://.  file/pvc/http(s)/hf-local paths are
+fully functional; azure blob and (web)hdfs speak the providers' REST APIs
+directly via httpx (no SDK needed); gs:// and s3:// are import-gated on
+their SDKs (not in this image) with a clear error when absent.
 """
 
 from __future__ import annotations
@@ -20,7 +20,7 @@ import tarfile
 import tempfile
 import zipfile
 from typing import Callable, Dict, List, Optional
-from urllib.parse import urlparse
+from urllib.parse import quote, urlparse
 
 from ..logging import logger
 
@@ -40,6 +40,32 @@ def _require(module: str, provider: str):
             f"{provider} download requires the '{module}' package, which is "
             f"not installed in this image"
         ) from e
+
+
+def _safe_rel(key: str, prefix: str) -> str:
+    """Relative path of object `key` under listing `prefix`, refusing any
+    result that would escape the output directory.
+
+    Listing-prefix matching in object stores is string-based, so
+    ``relpath('models/foobar', 'models/foo')`` would yield ``../foobar`` and
+    write outside out_dir — strip the prefix by string instead (as the
+    reference kserve_storage does) and reject anything that still normalizes
+    to a parent/absolute path.
+    """
+    if key == prefix:
+        return os.path.basename(key)
+    if prefix and key.startswith(prefix):
+        # strip by string and keep the remainder (as the reference
+        # kserve_storage does): 'models/foo-a/x.bin' under 'models/foo'
+        # becomes '-a/x.bin', preserving nesting and avoiding basename
+        # collisions between sibling objects
+        rel = key[len(prefix):].lstrip("/")
+    else:
+        rel = key
+    norm = os.path.normpath(rel)
+    if not norm or norm == "." or norm.startswith("..") or os.path.isabs(norm):
+        raise StorageError(f"unsafe object path {key!r} under prefix {prefix!r}")
+    return norm
 
 
 class Storage:
@@ -156,7 +182,7 @@ class Storage:
         for blob in bucket.list_blobs(prefix=prefix):
             if blob.name.endswith("/"):
                 continue
-            rel = os.path.relpath(blob.name, prefix) if blob.name != prefix else os.path.basename(blob.name)
+            rel = _safe_rel(blob.name, prefix)
             dest = os.path.join(out_dir, rel)
             os.makedirs(os.path.dirname(dest), exist_ok=True)
             blob.download_to_filename(dest)
@@ -184,7 +210,7 @@ class Storage:
                 key = obj["Key"]
                 if key.endswith("/"):
                     continue
-                rel = os.path.relpath(key, prefix) if key != prefix else os.path.basename(key)
+                rel = _safe_rel(key, prefix)
                 dest = os.path.join(out_dir, rel)
                 os.makedirs(os.path.dirname(dest), exist_ok=True)
                 s3.download_file(bucket, key, dest)
@@ -196,13 +222,136 @@ class Storage:
 
     @staticmethod
     def _download_hdfs(uri: str, out_dir: str) -> str:
-        _require("hdfs", "hdfs://")
-        raise StorageError("hdfs provider not yet implemented in this build")
+        """hdfs:// and webhdfs:// via the WebHDFS REST API (httpx — no SDK).
+
+        Parity: reference python/storage/kserve_storage/kserve_storage.py
+        _download_hdfs (which uses the `hdfs` client lib against the same
+        REST endpoints). hdfs://host:port/path is treated as
+        webhdfs on the same host (port defaults to 9870); auth is the simple
+        `user.name` query parameter from $HDFS_USER when set.
+        """
+        import httpx
+
+        parsed = urlparse(uri)
+        host = parsed.hostname or "localhost"
+        if uri.startswith("hdfs://"):
+            # an hdfs:// URI's port is the NameNode RPC port (e.g. 8020),
+            # not the WebHDFS HTTP port — never reuse it for REST calls
+            port = int(os.getenv("HDFS_WEBHDFS_PORT", "9870"))
+        else:
+            port = parsed.port or int(os.getenv("HDFS_WEBHDFS_PORT", "9870"))
+        base = f"http://{host}:{port}/webhdfs/v1"
+        params: Dict[str, str] = {}
+        if os.getenv("HDFS_USER"):
+            params["user.name"] = os.environ["HDFS_USER"]
+
+        client = httpx.Client(follow_redirects=True, timeout=600)
+
+        def list_status(path: str) -> List[dict]:
+            r = client.get(base + path, params={**params, "op": "LISTSTATUS"})
+            if r.status_code != 200:
+                raise StorageError(f"webhdfs LISTSTATUS {path} -> HTTP {r.status_code}")
+            return r.json()["FileStatuses"]["FileStatus"]
+
+        def fetch_file(path: str, dest: str) -> None:
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            with client.stream("GET", base + path, params={**params, "op": "OPEN"}) as r:
+                if r.status_code != 200:
+                    raise StorageError(f"webhdfs OPEN {path} -> HTTP {r.status_code}")
+                with open(dest, "wb") as f:
+                    for chunk in r.iter_bytes():
+                        f.write(chunk)
+            _maybe_unpack(dest, out_dir)
+
+        root = parsed.path or "/"
+        count = 0
+        stack = [(root, "")]
+        try:
+            while stack:
+                path, rel = stack.pop()
+                for st in list_status(path):
+                    name = st["pathSuffix"] or os.path.basename(path)
+                    sub_rel = os.path.join(rel, name) if rel else name
+                    sub_path = path.rstrip("/") + "/" + st["pathSuffix"] if st["pathSuffix"] else path
+                    if st["type"] == "DIRECTORY":
+                        stack.append((sub_path, sub_rel))
+                    else:
+                        fetch_file(sub_path, os.path.join(out_dir, _safe_rel(sub_rel, "")))
+                        count += 1
+        finally:
+            client.close()
+        if count == 0:
+            raise StorageError(f"no files under {uri}")
+        return out_dir
 
     @staticmethod
     def _download_azure_blob(uri: str, out_dir: str) -> str:
-        _require("azure.storage.blob", "azure blob")
-        raise StorageError("azure provider not yet implemented in this build")
+        """Azure Blob via the Blob service REST API (httpx — no SDK).
+
+        Parity: reference kserve_storage._download_azure. Handles public
+        containers anonymously and private ones with a SAS token from
+        $AZURE_STORAGE_SAS_TOKEN. $KSERVE_AZURE_BLOB_ENDPOINT overrides the
+        account endpoint (for emulators/local fakes, azurite-style).
+        """
+        import xml.etree.ElementTree as ET
+
+        import httpx
+
+        m = re.match(r"https?://(.+?)\.blob\.core\.windows\.net/([^/]+)/?(.*)", uri)
+        if not m:
+            raise StorageError(f"unrecognized azure blob uri {uri!r}")
+        account, container, prefix = m.group(1), m.group(2), m.group(3)
+        endpoint = os.getenv(
+            "KSERVE_AZURE_BLOB_ENDPOINT",
+            f"https://{account}.blob.core.windows.net",
+        ).rstrip("/")
+        sas = os.getenv("AZURE_STORAGE_SAS_TOKEN", "").lstrip("?")
+
+        client = httpx.Client(follow_redirects=True, timeout=600)
+
+        def list_blobs() -> List[str]:
+            names: List[str] = []
+            marker = ""
+            while True:
+                params = {"restype": "container", "comp": "list", "prefix": prefix}
+                if marker:
+                    params["marker"] = marker
+                url = f"{endpoint}/{container}" + (f"?{sas}" if sas else "")
+                r = client.get(url, params=params)
+                if r.status_code != 200:
+                    raise StorageError(f"azure list {container} -> HTTP {r.status_code}")
+                tree = ET.fromstring(r.text)
+                for blob in tree.iter("Blob"):
+                    name = blob.findtext("Name")
+                    if name and not name.endswith("/"):
+                        names.append(name)
+                marker = tree.findtext("NextMarker") or ""
+                if not marker:
+                    return names
+
+        count = 0
+        try:
+            for name in list_blobs():
+                rel = _safe_rel(name, prefix)
+                dest = os.path.join(out_dir, rel)
+                os.makedirs(os.path.dirname(dest), exist_ok=True)
+                # blob names may contain '?', '#', '%' — escape everything
+                # except the path separators
+                quoted = quote(name, safe="/")
+                url = f"{endpoint}/{container}/{quoted}" + (f"?{sas}" if sas else "")
+                with client.stream("GET", url) as r:
+                    if r.status_code != 200:
+                        raise StorageError(f"azure GET {name} -> HTTP {r.status_code}")
+                    with open(dest, "wb") as f:
+                        for chunk in r.iter_bytes():
+                            f.write(chunk)
+                _maybe_unpack(dest, out_dir)
+                count += 1
+        finally:
+            client.close()
+        if count == 0:
+            raise StorageError(f"no blobs under {uri}")
+        return out_dir
 
 
 def _maybe_unpack(path: str, out_dir: str) -> None:

@@ -23,8 +23,10 @@
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
+#include <map>
 #include <functional>
 #include <iostream>
 #include <memory>
@@ -96,6 +98,8 @@ struct HttpMessage {
   }
 };
 
+constexpr size_t kMaxBodyBytes = 256u << 20;  // refuse >256MB payloads
+
 bool read_http(int fd, HttpMessage* msg) {
   std::string buf;
   char tmp[8192];
@@ -119,9 +123,20 @@ bool read_http(int fd, HttpMessage* msg) {
     if (colon == std::string::npos) continue;
     std::string name = line.substr(0, colon);
     std::string value = line.substr(colon + 1);
-    while (!value.empty() && value.front() == ' ') value.erase(value.begin());
-    if (strcasecmp(name.c_str(), "content-length") == 0)
-      content_length = std::stoul(value);
+    while (!value.empty() && (value.front() == ' ' || value.front() == '\t'))
+      value.erase(value.begin());
+    while (!value.empty() && (value.back() == ' ' || value.back() == '\t'))
+      value.pop_back();  // RFC 9110 optional trailing whitespace
+    if (strcasecmp(name.c_str(), "content-length") == 0) {
+      errno = 0;
+      char* end = nullptr;
+      unsigned long long parsed = std::strtoull(value.c_str(), &end, 10);
+      if (errno != 0 || end == value.c_str() || *end != '\0' ||
+          parsed > kMaxBodyBytes) {
+        return false;  // malformed or oversized: drop the connection
+      }
+      content_length = static_cast<size_t>(parsed);
+    }
     msg->headers.emplace_back(name, value);
   }
   msg->body = buf.substr(header_end + 4);
@@ -336,26 +351,33 @@ struct BatchEntry {
 class Batcher {
  public:
   // Queues the caller's instances; blocks until the batch round-trips.
-  // Returns (status, body-for-caller).
+  // Returns (status, body-for-caller). Batches are kept per-path so a
+  // multi-model pod never merges (or misroutes) requests across models.
   std::pair<int, std::string> submit(const std::string& path,
                                      std::vector<std::string> instances) {
     auto entry = std::make_shared<BatchEntry>();
     entry->instances = std::move(instances);
     {
       std::lock_guard<std::mutex> lk(mu_);
-      if (path_.empty()) path_ = path;
-      pending_.push_back(entry);
-      pending_count_ += entry->instances.size();
-      if (static_cast<int>(pending_count_) >= g_opts.max_batchsize) {
-        flush_locked();
-      } else if (!timer_armed_) {
-        timer_armed_ = true;
-        std::thread([this] {
+      PathQueue& q = queues_[path];
+      q.pending.push_back(entry);
+      q.pending_count += entry->instances.size();
+      if (static_cast<int>(q.pending_count) >= g_opts.max_batchsize) {
+        flush_locked(path, &q);
+        if (!q.timer_armed) queues_.erase(path);
+      } else if (!q.timer_armed) {
+        q.timer_armed = true;
+        std::thread([this, path] {
           std::this_thread::sleep_for(
               std::chrono::milliseconds(g_opts.max_latency_ms));
           std::lock_guard<std::mutex> lk(mu_);
-          timer_armed_ = false;
-          if (!pending_.empty()) flush_locked();
+          auto it = queues_.find(path);
+          if (it == queues_.end()) return;
+          it->second.timer_armed = false;
+          if (!it->second.pending.empty()) flush_locked(path, &it->second);
+          // drop the idle entry so per-path state cannot grow without
+          // bound under client-controlled paths
+          if (it->second.pending.empty()) queues_.erase(it);
         }).detach();
       }
     }
@@ -369,11 +391,16 @@ class Batcher {
   }
 
  private:
-  void flush_locked() {
-    auto batch = std::move(pending_);
-    pending_.clear();
-    pending_count_ = 0;
-    std::string path = path_;
+  struct PathQueue {
+    std::vector<std::shared_ptr<BatchEntry>> pending;
+    size_t pending_count = 0;
+    bool timer_armed = false;
+  };
+
+  void flush_locked(const std::string& path, PathQueue* q) {
+    auto batch = std::move(q->pending);
+    q->pending.clear();
+    q->pending_count = 0;
     std::thread([this, batch = std::move(batch), path] {
       execute(batch, path);
     }).detach();
@@ -383,10 +410,7 @@ class Batcher {
                const std::string& path);
 
   std::mutex mu_;
-  std::vector<std::shared_ptr<BatchEntry>> pending_;
-  size_t pending_count_ = 0;
-  std::string path_;
-  bool timer_armed_ = false;
+  std::map<std::string, PathQueue> queues_;
 };
 
 // qpext parity (qpext/cmd/qpext/main.go:312): one scrape endpoint exposing
@@ -469,7 +493,7 @@ std::string merged_metrics() {
 
 // ------------------------------------------------------------ connection
 
-void handle_connection(int client_fd) {
+void handle_connection_impl(int client_fd) {
   HttpMessage request;
   if (!read_http(client_fd, &request)) {
     ::close(client_fd);
@@ -517,6 +541,19 @@ void handle_connection(int client_fd) {
   }
   send_all(client_fd, response_str);
   ::close(client_fd);
+}
+
+// A single bad connection must never take down the sidecar: any uncaught
+// exception in a detached thread would call std::terminate.
+void handle_connection(int client_fd) {
+  try {
+    handle_connection_impl(client_fd);
+  } catch (const std::exception& e) {
+    std::cerr << "[agent] connection error: " << e.what() << "\n";
+    ::close(client_fd);
+  } catch (...) {
+    ::close(client_fd);
+  }
 }
 
 }  // namespace

@@ -189,3 +189,51 @@ class TestSeededSampling:
             assert [o.token_id for o in a] != [o.token_id for o in b]
         finally:
             await engine.stop()
+
+
+class TestPenalties:
+    @async_test
+    async def test_frequency_penalty_blocks_repeats(self):
+        """A huge frequency penalty makes every generated token distinct
+        (greedy decoding would otherwise happily loop)."""
+        engine = make_engine()
+        await engine.start()
+        try:
+            outs = await collect(
+                engine,
+                [1, 2, 3, 4],
+                SamplingParams(
+                    max_tokens=12, temperature=0.0, frequency_penalty=1000.0,
+                    ignore_eos=True,
+                ),
+            )
+            tokens = [o.token_id for o in outs]
+            assert len(tokens) == len(set(tokens)), tokens
+        finally:
+            await engine.stop()
+
+    @async_test
+    async def test_penalized_and_plain_coexist_in_batch(self):
+        """One penalized + one plain request decode together; the plain
+        request is bit-identical to running alone (penalties must not leak
+        across lanes)."""
+        engine = make_engine()
+        await engine.start()
+        try:
+            alone = await collect(
+                engine, [5, 6, 7], SamplingParams(max_tokens=8, temperature=0.0)
+            )
+            plain, penalized = await asyncio.gather(
+                collect(engine, [5, 6, 7], SamplingParams(max_tokens=8, temperature=0.0)),
+                collect(
+                    engine,
+                    [9, 10, 11],
+                    SamplingParams(
+                        max_tokens=8, temperature=0.0, repetition_penalty=1.5
+                    ),
+                ),
+            )
+            assert [o.token_id for o in plain] == [o.token_id for o in alone]
+            assert penalized[-1].finished
+        finally:
+            await engine.stop()

@@ -178,3 +178,64 @@ def _params_from_hf(hf_model, config):
             layer[ours] = jnp.asarray(w.T if transpose else w, jnp.float32)
         params["layers"].append(layer)
     return params
+
+
+class TestRopeScaling:
+    def test_llama3_scaling_matches_reference_formula(self):
+        """Three-way where() (HF modeling_rope_utils llama3 variant) vs our
+        clip-based blend: identical on every frequency."""
+        import math
+
+        import numpy as np
+
+        from kserve_tpu.ops.rotary import rope_frequencies
+
+        scaling = {
+            "rope_type": "llama3",
+            "factor": 8.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 8192,
+        }
+        head_dim, theta = 128, 500000.0
+        got = np.asarray(rope_frequencies(head_dim, theta, scaling))
+
+        inv = 1.0 / theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim)
+        old_ctx = scaling["original_max_position_embeddings"]
+        low_wl = old_ctx / scaling["low_freq_factor"]
+        high_wl = old_ctx / scaling["high_freq_factor"]
+        wavelen = 2 * math.pi / inv
+        want = np.where(wavelen > low_wl, inv / scaling["factor"], inv)
+        smooth = (old_ctx / wavelen - scaling["low_freq_factor"]) / (
+            scaling["high_freq_factor"] - scaling["low_freq_factor"]
+        )
+        smoothed = (1 - smooth) * inv / scaling["factor"] + smooth * inv
+        medium = ~(wavelen < high_wl) & ~(wavelen > low_wl)
+        want = np.where(medium, smoothed, want)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        # the scaled table must actually differ from the unscaled one
+        assert not np.allclose(got, np.asarray(rope_frequencies(head_dim, theta)))
+
+    def test_from_hf_config_parses_rope_scaling(self):
+        cfg = {
+            "vocab_size": 512, "hidden_size": 64, "intermediate_size": 128,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "rope_theta": 500000.0,
+            "rope_scaling": {
+                "rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+                "high_freq_factor": 4.0, "original_max_position_embeddings": 8192,
+            },
+        }
+        parsed = LlamaConfig.from_hf_config(cfg)
+        assert parsed.rope_scaling["rope_type"] == "llama3"
+
+    def test_unsupported_rope_scaling_raises(self):
+        import pytest
+
+        cfg = {
+            "vocab_size": 512, "hidden_size": 64, "intermediate_size": 128,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "rope_scaling": {"rope_type": "yarn", "factor": 4.0},
+        }
+        with pytest.raises(ValueError, match="rope_scaling"):
+            LlamaConfig.from_hf_config(cfg)

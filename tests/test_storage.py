@@ -65,3 +65,142 @@ class TestLocalStorage:
         )
         assert os.path.exists(os.path.join(outs[0], "a.bin"))
         assert os.path.exists(os.path.join(outs[1], "b.bin"))
+
+
+class TestSafeRel:
+    def test_prefix_is_stripped_by_string_not_relpath(self):
+        from kserve_tpu.storage.storage import _safe_rel
+
+        # relpath('models/foobar', 'models/foo') would be '../foobar' and
+        # escape out_dir; string-stripping (reference behavior) keeps the
+        # remainder, preserving nesting for sibling keys
+        assert _safe_rel("models/foobar", "models/foo") == "bar"
+        assert _safe_rel("models/foo-a/x.bin", "models/foo") == "-a/x.bin"
+        assert _safe_rel("models/foo-b/x.bin", "models/foo") == "-b/x.bin"
+        assert _safe_rel("models/foo/w.bin", "models/foo") == "w.bin"
+        assert _safe_rel("models/foo", "models/foo") == "foo"
+
+    def test_rejects_escaping_paths(self):
+        import pytest
+
+        from kserve_tpu.storage.storage import StorageError, _safe_rel
+
+        with pytest.raises(StorageError):
+            _safe_rel("models/foo/../../etc/passwd", "models/foo")
+        with pytest.raises(StorageError):
+            _safe_rel("/etc/passwd", "")
+
+
+class _FakeCloudHandler:
+    """One handler serving both an azure-blob container listing/download and
+    a WebHDFS namenode, for provider tests without SDKs or real clusters."""
+
+    files = {"weights.bin": b"W" * 64, "sub/config.json": b"{}"}
+
+    @classmethod
+    def app(cls):
+        from aiohttp import web
+
+        async def azure_container(request):
+            if request.query.get("comp") == "list":
+                prefix = request.query.get("prefix", "")
+                blobs = "".join(
+                    f"<Blob><Name>{n}</Name></Blob>"
+                    for n in cls.files if n.startswith(prefix)
+                )
+                xml = (
+                    "<?xml version='1.0'?><EnumerationResults>"
+                    f"<Blobs>{blobs}</Blobs><NextMarker/></EnumerationResults>"
+                )
+                return web.Response(text=xml, content_type="application/xml")
+            return web.Response(status=400)
+
+        async def azure_blob(request):
+            name = request.match_info["name"]
+            if name not in cls.files:
+                return web.Response(status=404)
+            return web.Response(body=cls.files[name])
+
+        async def webhdfs(request):
+            path = request.match_info["path"]
+            op = request.query.get("op")
+            if op == "LISTSTATUS":
+                if path in ("", "model"):
+                    entries = [
+                        {"pathSuffix": "weights.bin", "type": "FILE"},
+                        {"pathSuffix": "sub", "type": "DIRECTORY"},
+                    ]
+                elif path == "model/sub":
+                    entries = [{"pathSuffix": "config.json", "type": "FILE"}]
+                else:
+                    return web.Response(status=404)
+                return web.json_response({"FileStatuses": {"FileStatus": entries}})
+            if op == "OPEN":
+                key = path[len("model/"):] if path.startswith("model/") else path
+                if key in cls.files:
+                    return web.Response(body=cls.files[key])
+                return web.Response(status=404)
+            return web.Response(status=400)
+
+        app = web.Application()
+        app.router.add_get("/{container:[a-z]+}", azure_container)
+        app.router.add_get("/{container:[a-z]+}/{name:.+}", azure_blob)
+        app.router.add_get("/webhdfs/v1/{path:.*}", webhdfs)
+        return app
+
+
+@pytest.fixture
+def fake_cloud_port():
+    import asyncio
+    import socket
+    import threading
+
+    from aiohttp import web
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    runner_box = {}
+
+    def serve():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(_FakeCloudHandler.app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        loop.run_until_complete(site.start())
+        runner_box["runner"] = runner
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    assert started.wait(5)
+    yield port
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=5)
+
+
+class TestAzureBlob:
+    def test_download_via_rest(self, tmp_path, fake_cloud_port, monkeypatch):
+        monkeypatch.setenv(
+            "KSERVE_AZURE_BLOB_ENDPOINT", f"http://127.0.0.1:{fake_cloud_port}"
+        )
+        out = Storage.download(
+            "https://acct.blob.core.windows.net/models", str(tmp_path)
+        )
+        assert (tmp_path / "weights.bin").read_bytes() == b"W" * 64
+        assert (tmp_path / "sub" / "config.json").exists()
+        assert out == str(tmp_path)
+
+
+class TestWebHdfs:
+    def test_download_recursive(self, tmp_path, fake_cloud_port):
+        Storage.download(
+            f"webhdfs://127.0.0.1:{fake_cloud_port}/model", str(tmp_path)
+        )
+        assert (tmp_path / "weights.bin").read_bytes() == b"W" * 64
+        assert (tmp_path / "sub" / "config.json").read_bytes() == b"{}"
