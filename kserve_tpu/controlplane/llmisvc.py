@@ -46,8 +46,13 @@ class LLMISVCReconciler:
         status: dict = dict(llm.status)
         objects: List[dict] = []
 
+        prefill_url = (
+            f"http://{llm.metadata.name}-kserve-prefill.{llm.metadata.namespace}:80"
+            if spec.prefill is not None
+            else None
+        )
         decode_objs = self._workload(llm, spec.workload or WorkloadSpec(), role="decode",
-                                     model_uri=spec.model.uri)
+                                     model_uri=spec.model.uri, prefill_url=prefill_url)
         objects.extend(decode_objs)
         if spec.prefill is not None:
             objects.extend(
@@ -99,7 +104,8 @@ class LLMISVCReconciler:
 
     # ---------------- workload ----------------
 
-    def _workload(self, llm, workload: WorkloadSpec, role: str, model_uri: str) -> List[dict]:
+    def _workload(self, llm, workload: WorkloadSpec, role: str, model_uri: str,
+                  prefill_url: Optional[str] = None) -> List[dict]:
         name = f"{llm.metadata.name}-kserve-{role}" if role == "prefill" else f"{llm.metadata.name}-kserve"
         namespace = llm.metadata.namespace
         par = workload.parallelism or ParallelismSpec()
@@ -123,6 +129,11 @@ class LLMISVCReconciler:
             args.append(f"--max_model_len={workload.maxModelLen}")
         if role == "prefill":
             args.append("--role=prefill")
+        elif prefill_url is not None:
+            # disaggregated pair: this decode workload fetches prompt KV
+            # from the prefill peer service
+            args.append("--role=decode")
+            args.append(f"--prefill_url={prefill_url}")
         if workload.kvCacheOffloading and workload.kvCacheOffloading.enabled:
             args.append("--kv_offload=host")
             if workload.kvCacheOffloading.hostMemoryGi:

@@ -52,7 +52,14 @@ class EngineConfig:
     prefill_buckets: Tuple[int, ...] = (32, 64, 128, 256, 512, 1024)
     tp: int = 1
     dp: int = 1
+    # sequence-parallel mesh axis (ring-attention prefill shards the prompt
+    # over it; decode state is replicated across it)
+    sp: int = 1
     dtype: str = "bfloat16"
+    # host-RAM KV tier: "none" | "host" (pages of preempted/cold sequences
+    # spill to pinned host memory instead of being recomputed)
+    kv_offload: str = "none"
+    kv_offload_gib: float = 0.0
     # None/False = XLA gather attention (current default everywhere — the
     # Pallas kernel breaks KV-cache aliasing at the custom-call boundary and
     # is slower end-to-end until the layout contract is fixed); True opts in
@@ -115,11 +122,17 @@ class _Slot:
 
 
 class _QueuedRequest:
-    def __init__(self, request_id, prompt_ids, params, queue):
+    def __init__(self, request_id, prompt_ids, params, queue,
+                 kv_data=None, first_token=None):
         self.request_id = request_id
         self.prompt_ids = prompt_ids
         self.params = params
         self.queue = queue
+        # P/D disaggregation: KV computed by a prefill-role server
+        # ([L, 2, P, n_kv, ps, d] host array) plus its sampled first token —
+        # admission scatters the pages instead of prefilling
+        self.kv_data = kv_data
+        self.first_token = first_token
 
 
 class LLMEngine:
@@ -137,7 +150,9 @@ class LLMEngine:
         self.config = engine_config
         self.tokenizer = tokenizer
         shd.validate_tp(model_config, engine_config.tp)
-        self.mesh = shd.create_mesh(tp=engine_config.tp, dp=engine_config.dp)
+        self.mesh = shd.create_mesh(
+            tp=engine_config.tp, dp=engine_config.dp, sp=engine_config.sp
+        )
         self._base_rng = jax.random.PRNGKey(rng_seed)
         self._step_counter = 0
 
@@ -162,6 +177,7 @@ class LLMEngine:
         self._slots: List[_Slot] = [_Slot() for _ in range(B)]
         self._waiting: List[_QueuedRequest] = []
         self._wake = asyncio.Event()
+        self._detached_lock = asyncio.Lock()
         self._stopped = False
         self._task: Optional[asyncio.Task] = None
         self._pipeline_busy = False
@@ -270,13 +286,23 @@ class LLMEngine:
 
             return fn
 
-        n_kv_args = 3  # kv_pages is arg index 3 in all three signatures
+        def _inject(kv_pages, kv_data, ids):
+            """Scatter transferred KV pages (P/D disaggregation) into the
+            cache.  Padded ids point at the null page (page 0), whose
+            contents are never read unmasked."""
+            return [
+                layer.at[:, ids].set(kv_data[i].astype(layer.dtype))
+                for i, layer in enumerate(kv_pages)
+            ]
+
+        n_kv_args = 3  # kv_pages is arg index 3 in the prefill/decode sigs
         self._prefill_fn = jax.jit(_prefill, donate_argnums=(n_kv_args,))
         self._decode_fn = jax.jit(_make_decode(False), donate_argnums=(n_kv_args,))
         # arg 10 = prompt mask (kept across chunks), arg 11 = counts (donated)
         self._decode_penalized_fn = jax.jit(
             _make_decode(True), donate_argnums=(n_kv_args, 11)
         )
+        self._inject_fn = jax.jit(_inject, donate_argnums=(0,))
 
     # ---------------- public API ----------------
 
@@ -322,12 +348,55 @@ class LLMEngine:
         queue: asyncio.Queue = asyncio.Queue()
         rid = request_id or f"req-{time.monotonic_ns()}"
         req = _QueuedRequest(rid, list(prompt_ids), params, queue)
+        async for out in self._submit_and_stream(req):
+            yield out
+
+    async def generate_injected(
+        self,
+        prompt_ids: List[int],
+        params: SamplingParams,
+        kv_data: np.ndarray,  # [L, 2, P, n_kv, ps, d] from prefill_detached
+        first_token: int,
+        request_id: Optional[str] = None,
+    ) -> AsyncIterator[GenerationOutput]:
+        """P/D disaggregation, decode side: admit a request whose prompt KV
+        was computed by a prefill-role server.  The KV pages are scattered
+        into this engine's cache and decoding starts at pos=len(prompt)."""
+        if len(prompt_ids) + params.max_tokens > self.config.max_model_len:
+            raise ValueError(
+                f"prompt+max_tokens exceeds max_model_len {self.config.max_model_len}"
+            )
+        # validate the peer-supplied KV BEFORE it reaches the engine loop —
+        # a shape mismatch inside _run_loop would kill the engine for all
+        # traffic, not just this request (version-skewed prefill peer)
+        kv_data = np.asarray(kv_data)
+        cc = self.cache_config
+        expect = (
+            cc.n_layers, 2, pages_needed(len(prompt_ids), cc.page_size),
+            cc.n_kv_heads, cc.page_size, cc.head_dim,
+        )
+        if tuple(kv_data.shape) != expect:
+            raise ValueError(
+                f"injected KV shape {tuple(kv_data.shape)} incompatible with "
+                f"this engine's cache (expected {expect}); prefill peer and "
+                "decode server must share model + page_size configuration"
+            )
+        queue: asyncio.Queue = asyncio.Queue()
+        rid = request_id or f"req-{time.monotonic_ns()}"
+        req = _QueuedRequest(
+            rid, list(prompt_ids), params, queue,
+            kv_data=kv_data, first_token=int(first_token),
+        )
+        async for out in self._submit_and_stream(req):
+            yield out
+
+    async def _submit_and_stream(self, req: "_QueuedRequest"):
         self._waiting.append(req)
         ENGINE_QUEUE_DEPTH.labels(model_name="engine").set(len(self._waiting))
         self._wake.set()
         try:
             while True:
-                out = await queue.get()
+                out = await req.queue.get()
                 if isinstance(out, Exception):
                     raise out
                 yield out
@@ -336,7 +405,53 @@ class LLMEngine:
         finally:
             # client went away (generator closed / task cancelled): release
             # the slot and pages instead of decoding to max_tokens for nobody
-            self.cancel(rid)
+            self.cancel(req.request_id)
+
+    async def prefill_detached(
+        self, prompt_ids: List[int], params: SamplingParams
+    ) -> Tuple[int, np.ndarray]:
+        """P/D disaggregation, prefill side: compute the prompt's KV and the
+        first sampled token, extract the KV pages to host, release the pages.
+        Returns (first_token, kv [L, 2, P, n_kv, ps, d]).
+
+        Parity: the KV-connector role of the reference's disaggregated
+        serving (workload_kvcache.go, llm_inference_service_types.go:105-110)
+        with the transfer payload produced TPU-side in one gather."""
+        n = len(prompt_ids)
+        if n > self.config.max_prefill_len:
+            raise ValueError(
+                f"prompt length {n} exceeds max_prefill_len "
+                f"{self.config.max_prefill_len}"
+            )
+        async with self._detached_lock:
+            n_pages = pages_needed(n, self.config.page_size)
+            if not self.allocator.can_allocate(n_pages):
+                raise MemoryError("KV pages exhausted for detached prefill")
+            pages = self.allocator.allocate(n_pages)
+            try:
+                bucket = self._bucket_for(n)
+                tokens = np.zeros((1, bucket), np.int32)
+                tokens[0, :n] = prompt_ids
+                page_ids = np.zeros((1, self.config.max_pages_per_seq), np.int32)
+                page_ids[0, :n_pages] = pages
+                state = SamplingState.from_params([params])
+                rng = jax.random.fold_in(self._base_rng, self._next_step())
+                first, self.kv_pages = self._prefill_fn(
+                    self.params,
+                    jnp.asarray(tokens),
+                    jnp.asarray(np.asarray([n], np.int32)),
+                    self.kv_pages,
+                    jnp.asarray(page_ids),
+                    state,
+                    rng,
+                )
+                ids = jnp.asarray(np.asarray(pages, np.int32))
+                kv = np.asarray(
+                    jnp.stack([layer[:, ids] for layer in self.kv_pages])
+                )
+                return int(np.asarray(first)[0]), kv
+            finally:
+                self._free_pages(pages)
 
     def cancel(self, request_id: str) -> None:
         self._waiting = [r for r in self._waiting if r.request_id != request_id]
@@ -408,6 +523,10 @@ class LLMEngine:
             and len(admitted) < self.config.prefill_batch
         ):
             req = self._waiting[0]
+            if req.kv_data is not None:
+                if admitted:
+                    break  # flush the batched prefill first
+                return self._admit_injected(req)
             n_pages = pages_needed(len(req.prompt_ids) + 1, self.config.page_size)
             if not self.allocator.can_allocate(n_pages):
                 break
@@ -462,6 +581,47 @@ class LLMEngine:
             slot.admitted_at = now
             self._mark_penalty_dirty(idx)
             self._emit(slot, first_token)
+        return True
+
+    def _admit_injected(self, req: "_QueuedRequest") -> bool:
+        """Admit a request with transferred KV (P/D decode side): allocate
+        pages, scatter the prefill-produced KV into them, seat the slot at
+        pos=len(prompt) with the prefill's first token."""
+        idx = self._free_slot_index()
+        if idx is None:
+            return False
+        n = len(req.prompt_ids)
+        need = pages_needed(n + 1, self.config.page_size)
+        if need > self.config.max_pages_per_seq or not self.allocator.can_allocate(need):
+            return False
+        self._waiting.remove(req)
+        pages = self.allocator.allocate(need)
+        kv = req.kv_data
+        P = kv.shape[2]
+        # pad the page dim to the standard width buckets (small compile cache)
+        bucket = self.config.page_bucket(P)
+        ids = np.zeros((bucket,), np.int32)
+        ids[:P] = pages[:P]
+        kvp = np.zeros(kv.shape[:2] + (bucket,) + kv.shape[3:], kv.dtype)
+        kvp[:, :, :P] = kv
+        self.kv_pages = self._inject_fn(
+            self.kv_pages, jnp.asarray(kvp), jnp.asarray(ids)
+        )
+        slot = self._slots[idx]
+        slot.request_id = req.request_id
+        slot.prompt_len = n
+        slot.prompt_ids = req.prompt_ids
+        slot.pages = pages
+        slot.pos = n
+        slot.generated = [req.first_token]
+        slot.params = req.params
+        slot.queue = req.queue
+        slot.detok = IncrementalDetokenizer(self.tokenizer)
+        slot.stop_texts = list(req.params.stop or [])
+        slot.admitted_at = time.perf_counter()
+        PROMPT_TOKENS.labels(model_name="engine").inc(n)
+        self._mark_penalty_dirty(idx)
+        self._emit(slot, req.first_token)
         return True
 
     def _ensure_pages_at(self, slot: _Slot, base: int, extra: int) -> bool:

@@ -25,19 +25,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.llama import LlamaConfig
 
 DATA_AXIS = "data"
+SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 
 
 def create_mesh(
-    tp: int = 1, dp: int = 1, devices: Optional[list] = None
+    tp: int = 1, dp: int = 1, sp: int = 1, devices: Optional[list] = None
 ) -> Mesh:
-    """(dp, tp) mesh. TP should map to ICI-adjacent devices: jax device order
-    within a slice is topology-contiguous, so tp is the fastest-varying axis."""
+    """(dp, sp, tp) mesh. TP should map to ICI-adjacent devices: jax device
+    order within a slice is topology-contiguous, so tp is the fastest-varying
+    axis; the seq axis (ring-attention sequence parallelism) sits between so
+    its ppermute neighbours are also ICI-adjacent."""
     devices = devices if devices is not None else jax.devices()
-    if tp * dp > len(devices):
-        raise ValueError(f"mesh {dp}x{tp} needs {tp*dp} devices, have {len(devices)}")
-    grid = np.asarray(devices[: tp * dp]).reshape(dp, tp)
-    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+    need = tp * dp * sp
+    if need > len(devices):
+        raise ValueError(f"mesh {dp}x{sp}x{tp} needs {need} devices, have {len(devices)}")
+    grid = np.asarray(devices[:need]).reshape(dp, sp, tp)
+    return Mesh(grid, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
 
 
 def validate_tp(config: LlamaConfig, tp: int) -> None:

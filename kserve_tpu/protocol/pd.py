@@ -1,0 +1,134 @@
+"""Prefill/decode disaggregation wire protocol.
+
+A prefill-role generative server exposes `POST /v1/prefill/{model}`: body is
+JSON `{"prompt_ids": [...], "params": {...SamplingParams fields...}}`, the
+response is the raw KV bytes (application/octet-stream) with an `X-KV-Meta`
+header carrying shape/dtype/first_token.  A decode-role server calls it via
+`PrefillClient`, then continues generation from the transferred KV.
+
+Parity: the KV-connector / disaggregated-serving contract of the reference
+(pkg/apis/serving/v1alpha2/llm_inference_service_types.go:105-110,
+llmisvc workload_kvcache reconciliation); the transfer rides DCN as one
+HTTP round-trip per request instead of a sidecar connector.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..engine.sampling import SamplingParams
+from ..errors import InvalidInput
+from ..logging import logger
+
+KV_META_HEADER = "X-KV-Meta"
+
+
+def serialize_kv(kv: np.ndarray, first_token: int) -> Tuple[str, bytes]:
+    """(meta-json, payload) for one sequence's KV [L, 2, P, n_kv, ps, d]."""
+    meta = {
+        "shape": list(kv.shape),
+        "dtype": str(kv.dtype),
+        "first_token": int(first_token),
+    }
+    return json.dumps(meta), kv.tobytes()
+
+
+def deserialize_kv(meta_json: str, payload: bytes) -> Tuple[np.ndarray, int]:
+    meta = json.loads(meta_json)
+    name = meta["dtype"]
+    if name == "bfloat16":
+        import ml_dtypes
+
+        dtype = np.dtype(ml_dtypes.bfloat16)
+    else:
+        dtype = np.dtype(name)
+    kv = np.frombuffer(payload, dtype=dtype).reshape(meta["shape"])
+    return kv, int(meta["first_token"])
+
+
+def sampling_params_to_dict(params: SamplingParams) -> dict:
+    return dataclasses.asdict(params)
+
+
+def sampling_params_from_dict(data: dict) -> SamplingParams:
+    fields = {f.name for f in dataclasses.fields(SamplingParams)}
+    return SamplingParams(**{k: v for k, v in data.items() if k in fields})
+
+
+class PDEndpoints:
+    """Registers the prefill route for models exposing `handle_prefill`."""
+
+    def __init__(self, model_registry):
+        self.model_registry = model_registry
+
+    def register(self, app) -> None:
+        app.router.add_post("/v1/prefill/{model_name}", self.prefill)
+
+    async def prefill(self, request):
+        from aiohttp import web
+
+        name = request.match_info["model_name"]
+        model = self.model_registry.get_model(name)
+        if model is None or not hasattr(model, "handle_prefill"):
+            raise InvalidInput(f"model {name!r} does not serve prefill")
+        body = await request.json()
+        prompt_ids = body.get("prompt_ids")
+        if not isinstance(prompt_ids, list) or not prompt_ids:
+            raise InvalidInput("prompt_ids must be a non-empty list")
+        params = sampling_params_from_dict(body.get("params") or {})
+        meta_json, payload = await model.handle_prefill(prompt_ids, params)
+        return web.Response(
+            body=payload,
+            content_type="application/octet-stream",
+            headers={KV_META_HEADER: meta_json},
+        )
+
+
+class PrefillClient:
+    """Decode-side client for a prefill-role peer (one aiohttp session,
+    created lazily inside the server event loop)."""
+
+    def __init__(self, base_url: str, timeout_s: float = 120.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+        self._session = None
+
+    async def _get_session(self):
+        if self._session is None:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.timeout_s)
+            )
+        return self._session
+
+    async def prefill(
+        self, model_name: str, prompt_ids, params: SamplingParams
+    ) -> Tuple[np.ndarray, int]:
+        """Returns (kv [L, 2, P, n_kv, ps, d], first_token)."""
+        session = await self._get_session()
+        url = f"{self.base_url}/v1/prefill/{model_name}"
+        async with session.post(
+            url,
+            json={
+                "prompt_ids": list(prompt_ids),
+                "params": sampling_params_to_dict(params),
+            },
+        ) as resp:
+            if resp.status != 200:
+                text = await resp.text()
+                raise RuntimeError(f"prefill peer {url} -> {resp.status}: {text[:200]}")
+            meta_json = resp.headers.get(KV_META_HEADER)
+            if not meta_json:
+                raise RuntimeError(f"prefill peer {url} response missing {KV_META_HEADER}")
+            payload = await resp.read()
+        return deserialize_kv(meta_json, payload)
+
+    async def close(self):
+        if self._session is not None:
+            await self._session.close()
+            self._session = None

@@ -130,6 +130,9 @@ class RESTServer:
 
         register_openai_routes(app, self.dataplane)
         TimeSeriesEndpoints(self.dataplane.model_registry).register(app)
+        from ..pd import PDEndpoints
+
+        PDEndpoints(self.dataplane.model_registry).register(app)
         return app
 
     async def start(self) -> None:

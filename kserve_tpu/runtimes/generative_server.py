@@ -59,12 +59,19 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
         model_config: Optional[llama.LlamaConfig] = None,
         engine_config: Optional[EngineConfig] = None,
         random_weights: bool = False,
+        role: str = "both",  # both | prefill | decode (P/D disaggregation)
+        prefill_url: Optional[str] = None,  # decode role: prefill peer base URL
     ):
         super().__init__(name)
         self.model_dir = model_dir
         self._model_config = model_config
         self.engine_config = engine_config or EngineConfig()
         self.random_weights = random_weights
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(f"unknown role {role!r}")
+        self.role = role
+        self.prefill_url = prefill_url
+        self._prefill_client = None
         self.engine: Optional[LLMEngine] = None
         self.tokenizer = None
 
@@ -98,15 +105,19 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
         logger.info("generative model %s ready", self.name)
 
     def stop(self):
-        if self.engine is not None and self.engine.running:
-            import asyncio
+        import asyncio
 
-            try:
-                loop = asyncio.get_event_loop()
-                if loop.is_running():
-                    loop.create_task(self.engine.stop())
-            except RuntimeError:
-                pass
+        try:
+            loop = asyncio.get_event_loop()
+        except RuntimeError:
+            return
+        if not loop.is_running():
+            return
+        if self.engine is not None and self.engine.running:
+            loop.create_task(self.engine.stop())
+        if self._prefill_client is not None:
+            loop.create_task(self._prefill_client.close())
+            self._prefill_client = None
 
     async def healthy(self) -> bool:
         return self.ready and self.engine is not None and self.engine.running
@@ -191,7 +202,34 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
             raise InvalidInput(
                 f"prompt+max_tokens exceeds max_model_len {self.engine.config.max_model_len}"
             )
+        if self.role == "decode" and self.prefill_url:
+            return self._generate_disaggregated(prompt_ids, params)
         return self.engine.generate(prompt_ids, params)
+
+    async def _generate_disaggregated(self, prompt_ids, params):
+        """Decode role: fetch the prompt's KV from the prefill peer, then
+        continue decoding locally from the transferred pages."""
+        from ..protocol.pd import PrefillClient
+
+        if self._prefill_client is None:
+            self._prefill_client = PrefillClient(self.prefill_url)
+        kv, first_token = await self._prefill_client.prefill(
+            self.name, prompt_ids, params
+        )
+        async for out in self.engine.generate_injected(
+            prompt_ids, params, kv, first_token
+        ):
+            yield out
+
+    async def handle_prefill(self, prompt_ids, params):
+        """Prefill role: serve one detached prefill (protocol/pd.py route)."""
+        from ..protocol.pd import serialize_kv
+
+        try:
+            first_token, kv = await self.engine.prefill_detached(prompt_ids, params)
+        except ValueError as e:
+            raise InvalidInput(str(e)) from e
+        return serialize_kv(kv, first_token)
 
     async def _run_one(self, prompt_ids, params):
         text = ""
@@ -323,12 +361,23 @@ def main(argv=None):
     parser.add_argument("--random_weights", action="store_true")
     parser.add_argument("--tensor_parallel_size", "--tp", default=1, type=int)
     parser.add_argument("--data_parallel_size", "--dp", default=1, type=int)
+    parser.add_argument("--sequence_parallel_size", "--sp", default=1, type=int)
+    parser.add_argument(
+        "--role", default="both", choices=("both", "prefill", "decode"),
+        help="P/D disaggregation role; decode needs --prefill_url",
+    )
+    parser.add_argument(
+        "--prefill_url", default=os.getenv("PREFILL_URL") or None,
+        help="base URL of the prefill-role peer (decode role)",
+    )
     parser.add_argument("--max_batch_size", default=8, type=int)
     parser.add_argument("--kv_pages", default=2048, type=int)
     parser.add_argument("--page_size", default=16, type=int)
     parser.add_argument("--max_model_len", default=2048, type=int)
     parser.add_argument("--max_prefill_len", default=1024, type=int)
     parser.add_argument("--kv_dtype", default="bfloat16", type=str)
+    parser.add_argument("--kv_offload", default="none", choices=("none", "host"))
+    parser.add_argument("--kv_offload_gib", default=0.0, type=float)
     args = parser.parse_args(argv)
 
     model_config = _NAMED_CONFIGS[args.model_config]() if args.model_config else None
@@ -340,7 +389,10 @@ def main(argv=None):
         max_prefill_len=args.max_prefill_len,
         tp=args.tensor_parallel_size,
         dp=args.data_parallel_size,
+        sp=args.sequence_parallel_size,
         dtype=args.kv_dtype,
+        kv_offload=args.kv_offload,
+        kv_offload_gib=args.kv_offload_gib,
     )
     model = JAXGenerativeModel(
         args.model_name,
@@ -348,6 +400,8 @@ def main(argv=None):
         model_config=model_config,
         engine_config=engine_config,
         random_weights=args.random_weights,
+        role=args.role,
+        prefill_url=args.prefill_url,
     )
     model.load()
     ModelServer(
