@@ -1,0 +1,149 @@
+"""Engine-level data parallelism: dp independent LLMEngine replicas over
+disjoint tp*sp-sized device groups, with least-loaded request routing.
+
+Decode batches have no cross-request math, so a lockstep `data` mesh axis
+would buy nothing and cost a synchronized schedule (every replica waiting on
+the slowest prefill) plus per-step collectives.  Independent replicas are
+the TPU-native answer and match the semantics the reference reaches through
+vLLM's DP ranks (llm_inference_service_types.go:679-700 dataParallelism):
+linear decode throughput, isolated failure domains, per-replica KV space.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import replace
+from typing import Any, AsyncIterator, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..logging import logger
+from ..models import llama
+from .engine import EngineConfig, GenerationOutput, LLMEngine
+from .sampling import SamplingParams
+from .tokenizer import BaseTokenizer
+
+
+class DataParallelEngine:
+    """API-compatible with LLMEngine (start/stop/generate/...); routes each
+    request to the least-loaded replica."""
+
+    def __init__(
+        self,
+        model_config: llama.LlamaConfig,
+        engine_config: EngineConfig,
+        tokenizer: BaseTokenizer,
+        params: Optional[Any] = None,
+        rng_seed: int = 0,
+        devices: Optional[list] = None,
+    ):
+        dp = engine_config.dp
+        if dp < 2:
+            raise ValueError("DataParallelEngine needs dp >= 2; use LLMEngine")
+        devices = list(devices) if devices is not None else list(jax.devices())
+        per_replica = engine_config.tp * engine_config.sp
+        if dp * per_replica > len(devices):
+            raise ValueError(
+                f"dp={dp} x (tp*sp)={per_replica} needs {dp * per_replica} "
+                f"devices, have {len(devices)}"
+            )
+        self.config = engine_config
+        self.model_config = model_config
+        self.tokenizer = tokenizer
+        replica_cfg = replace(engine_config, dp=1)
+        self.replicas: List[LLMEngine] = [
+            LLMEngine(
+                model_config,
+                replica_cfg,
+                tokenizer,
+                params=params,
+                rng_seed=rng_seed + g,
+                devices=devices[g * per_replica : (g + 1) * per_replica],
+                metrics_label=f"engine-dp{g}",
+            )
+            for g in range(dp)
+        ]
+        self.cache_config = self.replicas[0].cache_config
+        self.mesh = self.replicas[0].mesh  # compat: a replica's submesh
+        self._rr = 0  # round-robin cursor for equal-load tie-breaks
+
+    # ---------------- lifecycle ----------------
+
+    async def start(self):
+        for eng in self.replicas:
+            await eng.start()
+        logger.info(
+            "DP engine started: %d replicas x (tp=%d, sp=%d)",
+            len(self.replicas), self.config.tp, self.config.sp,
+        )
+
+    async def stop(self):
+        await asyncio.gather(*[eng.stop() for eng in self.replicas])
+
+    @property
+    def running(self) -> bool:
+        return all(eng.running for eng in self.replicas)
+
+    # ---------------- routing ----------------
+
+    def _load(self, eng: LLMEngine) -> Tuple[int, int]:
+        """(queued+active requests, -free pages): lower routes first."""
+        active = sum(1 for s in eng._slots if s.request_id is not None)
+        return (len(eng._waiting) + active, -eng.allocator.free_pages)
+
+    def _pick(self) -> LLMEngine:
+        """Least-loaded replica; equal loads rotate round-robin (submission
+        happens before the request lands in a replica's queue — async
+        generator bodies run lazily — so load alone can't separate a burst
+        of simultaneous submissions)."""
+        n = len(self.replicas)
+        best = min(
+            range(n),
+            key=lambda g: (self._load(self.replicas[g]), (g - self._rr) % n),
+        )
+        self._rr = (best + 1) % n
+        return self.replicas[best]
+
+    # ---------------- request API (LLMEngine-compatible) ----------------
+
+    def generate(
+        self,
+        prompt_ids: List[int],
+        params: SamplingParams,
+        request_id: Optional[str] = None,
+    ) -> AsyncIterator[GenerationOutput]:
+        return self._pick().generate(prompt_ids, params, request_id=request_id)
+
+    def generate_injected(
+        self,
+        prompt_ids: List[int],
+        params: SamplingParams,
+        kv_data: np.ndarray,
+        first_token: int,
+        request_id: Optional[str] = None,
+    ) -> AsyncIterator[GenerationOutput]:
+        return self._pick().generate_injected(
+            prompt_ids, params, kv_data, first_token, request_id=request_id
+        )
+
+    async def prefill_detached(
+        self, prompt_ids: List[int], params: SamplingParams
+    ) -> Tuple[int, np.ndarray]:
+        return await self._pick().prefill_detached(prompt_ids, params)
+
+    def cancel(self, request_id: str) -> None:
+        for eng in self.replicas:
+            eng.cancel(request_id)
+
+
+def build_engine(
+    model_config: llama.LlamaConfig,
+    engine_config: EngineConfig,
+    tokenizer: BaseTokenizer,
+    params: Optional[Any] = None,
+    rng_seed: int = 0,
+):
+    """LLMEngine for dp=1, DataParallelEngine for dp>1."""
+    cls = DataParallelEngine if engine_config.dp > 1 else LLMEngine
+    return cls(model_config, engine_config, tokenizer, params=params, rng_seed=rng_seed)

@@ -145,13 +145,23 @@ class LLMEngine:
         tokenizer: BaseTokenizer,
         params: Optional[Any] = None,
         rng_seed: int = 0,
+        devices: Optional[list] = None,
+        metrics_label: str = "engine",
     ):
+        if engine_config.dp > 1:
+            raise ValueError(
+                "LLMEngine is a single data-parallel replica (dp=1); use "
+                "engine.dp.DataParallelEngine for dp>1 — decode batches are "
+                "independent, so DP runs as disjoint replicas, not a lockstep "
+                "mesh axis"
+            )
         self.model_config = model_config
         self.config = engine_config
         self.tokenizer = tokenizer
+        self._mlabel = metrics_label
         shd.validate_tp(model_config, engine_config.tp)
         self.mesh = shd.create_mesh(
-            tp=engine_config.tp, dp=engine_config.dp, sp=engine_config.sp
+            tp=engine_config.tp, dp=1, sp=engine_config.sp, devices=devices
         )
         self._base_rng = jax.random.PRNGKey(rng_seed)
         self._step_counter = 0
@@ -178,6 +188,8 @@ class LLMEngine:
         self._waiting: List[_QueuedRequest] = []
         self._wake = asyncio.Event()
         self._detached_lock = asyncio.Lock()
+        self._detached_queue: List[tuple] = []
+        self._detached_task: Optional[asyncio.Task] = None
         self._stopped = False
         self._task: Optional[asyncio.Task] = None
         self._pipeline_busy = False
@@ -318,6 +330,9 @@ class LLMEngine:
     async def stop(self):
         self._stopped = True
         self._wake.set()
+        if self._detached_task is not None and not self._detached_task.done():
+            self._detached_task.cancel()
+            self._detached_task = None
         if self._task is not None:
             try:
                 await asyncio.wait_for(self._task, timeout=5)
@@ -392,7 +407,7 @@ class LLMEngine:
 
     async def _submit_and_stream(self, req: "_QueuedRequest"):
         self._waiting.append(req)
-        ENGINE_QUEUE_DEPTH.labels(model_name="engine").set(len(self._waiting))
+        ENGINE_QUEUE_DEPTH.labels(model_name=self._mlabel).set(len(self._waiting))
         self._wake.set()
         try:
             while True:
@@ -414,6 +429,10 @@ class LLMEngine:
         first sampled token, extract the KV pages to host, release the pages.
         Returns (first_token, kv [L, 2, P, n_kv, ps, d]).
 
+        Concurrent callers are micro-batched: a worker drains the queue and
+        prefills up to `prefill_batch` prompts per compiled call, so a
+        prefill-role server gets the same batching as co-located admission.
+
         Parity: the KV-connector role of the reference's disaggregated
         serving (workload_kvcache.go, llm_inference_service_types.go:105-110)
         with the transfer payload produced TPU-side in one gather."""
@@ -423,34 +442,79 @@ class LLMEngine:
                 f"prompt length {n} exceeds max_prefill_len "
                 f"{self.config.max_prefill_len}"
             )
-        async with self._detached_lock:
-            n_pages = pages_needed(n, self.config.page_size)
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._detached_queue.append((list(prompt_ids), params, fut))
+        if self._detached_task is None or self._detached_task.done():
+            self._detached_task = asyncio.create_task(self._detached_worker())
+        return await fut
+
+    async def _detached_worker(self):
+        """Drains queued detached prefills in micro-batches; exits when the
+        queue empties (restarted lazily by the next request)."""
+        while self._detached_queue and not self._stopped:
+            batch = self._detached_queue[: self.config.prefill_batch]
+            del self._detached_queue[: len(batch)]
+            async with self._detached_lock:
+                try:
+                    self._prefill_detached_batch(batch)
+                except Exception as e:  # noqa: BLE001 — fail the waiters, not the engine
+                    for _, _, fut in batch:
+                        if not fut.done():
+                            fut.set_exception(e)
+            await asyncio.sleep(0)
+
+    def _prefill_detached_batch(self, batch) -> None:
+        """One compiled prefill over up to prefill_batch detached prompts;
+        per-row KV extraction; pages freed after extraction."""
+        runnable = []
+        for prompt_ids, params, fut in batch:
+            n_pages = pages_needed(len(prompt_ids), self.config.page_size)
             if not self.allocator.can_allocate(n_pages):
-                raise MemoryError("KV pages exhausted for detached prefill")
-            pages = self.allocator.allocate(n_pages)
-            try:
-                bucket = self._bucket_for(n)
-                tokens = np.zeros((1, bucket), np.int32)
-                tokens[0, :n] = prompt_ids
-                page_ids = np.zeros((1, self.config.max_pages_per_seq), np.int32)
-                page_ids[0, :n_pages] = pages
-                state = SamplingState.from_params([params])
-                rng = jax.random.fold_in(self._base_rng, self._next_step())
-                first, self.kv_pages = self._prefill_fn(
-                    self.params,
-                    jnp.asarray(tokens),
-                    jnp.asarray(np.asarray([n], np.int32)),
-                    self.kv_pages,
-                    jnp.asarray(page_ids),
-                    state,
-                    rng,
+                fut.set_exception(
+                    MemoryError("KV pages exhausted for detached prefill")
                 )
+                continue
+            runnable.append(
+                (prompt_ids, params, fut, self.allocator.allocate(n_pages))
+            )
+        if not runnable:
+            return
+        bucket = self._bucket_for(max(len(r[0]) for r in runnable))
+        Bp = 1
+        while Bp < len(runnable):
+            Bp *= 2
+        tokens = np.zeros((Bp, bucket), np.int32)
+        valid = np.zeros((Bp,), np.int32)
+        page_ids = np.zeros((Bp, self.config.max_pages_per_seq), np.int32)
+        params_list = [SamplingParams() for _ in range(Bp)]
+        for j, (prompt_ids, params, _, pages) in enumerate(runnable):
+            n = len(prompt_ids)
+            tokens[j, :n] = prompt_ids
+            valid[j] = n
+            page_ids[j, : len(pages)] = pages
+            params_list[j] = params
+        state = SamplingState.from_params(params_list)
+        rng = jax.random.fold_in(self._base_rng, self._next_step())
+        try:
+            first, self.kv_pages = self._prefill_fn(
+                self.params,
+                jnp.asarray(tokens),
+                jnp.asarray(valid),
+                self.kv_pages,
+                jnp.asarray(page_ids),
+                state,
+                rng,
+            )
+            first_np = np.asarray(first)
+            for j, (prompt_ids, _, fut, pages) in enumerate(runnable):
                 ids = jnp.asarray(np.asarray(pages, np.int32))
                 kv = np.asarray(
                     jnp.stack([layer[:, ids] for layer in self.kv_pages])
                 )
-                return int(np.asarray(first)[0]), kv
-            finally:
+                if not fut.done():
+                    fut.set_result((int(first_np[j]), kv))
+        finally:
+            for _, _, _, pages in runnable:
                 self._free_pages(pages)
 
     def cancel(self, request_id: str) -> None:
@@ -474,10 +538,10 @@ class LLMEngine:
                     if not self._admit_batch():
                         break
                     did_work = True
-                ENGINE_QUEUE_DEPTH.labels(model_name="engine").set(len(self._waiting))
+                ENGINE_QUEUE_DEPTH.labels(model_name=self._mlabel).set(len(self._waiting))
                 active = [s for s in self._slots if s.request_id is not None]
-                ENGINE_BATCH_OCCUPANCY.labels(model_name="engine").set(len(active))
-                ENGINE_KV_PAGES_FREE.labels(model_name="engine").set(
+                ENGINE_BATCH_OCCUPANCY.labels(model_name=self._mlabel).set(len(active))
+                ENGINE_KV_PAGES_FREE.labels(model_name=self._mlabel).set(
                     self.allocator.free_pages
                 )
                 if active:
@@ -566,7 +630,7 @@ class LLMEngine:
         for j, (idx, req, pages) in enumerate(admitted):
             n_prompt = len(req.prompt_ids)
             first_token = int(first_np[j])
-            PROMPT_TOKENS.labels(model_name="engine").inc(n_prompt)
+            PROMPT_TOKENS.labels(model_name=self._mlabel).inc(n_prompt)
             slot = self._slots[idx]
             slot.request_id = req.request_id
             slot.prompt_len = n_prompt
@@ -619,7 +683,7 @@ class LLMEngine:
         slot.detok = IncrementalDetokenizer(self.tokenizer)
         slot.stop_texts = list(req.params.stop or [])
         slot.admitted_at = time.perf_counter()
-        PROMPT_TOKENS.labels(model_name="engine").inc(n)
+        PROMPT_TOKENS.labels(model_name=self._mlabel).inc(n)
         self._mark_penalty_dirty(idx)
         self._emit(slot, req.first_token)
         return True
@@ -793,7 +857,7 @@ class LLMEngine:
         steps = self.config.steps_per_sync
         chunk_np = np.asarray(chunk)  # [steps, B]
         active = meta["active"]
-        GENERATED_TOKENS.labels(model_name="engine").inc(int(active.sum()) * steps)
+        GENERATED_TOKENS.labels(model_name=self._mlabel).inc(int(active.sum()) * steps)
         finished_any = False
         for i, slot in enumerate(self._slots):
             if slot.request_id is None or not active[i]:

@@ -86,17 +86,6 @@ def kv_pages_pspec() -> P:
     return P(None, None, MODEL_AXIS, None, None)
 
 
-def batch_pspecs() -> Dict[str, P]:
-    """Decode-step batch arrays shard their leading (slot) dim over data."""
-    return {
-        "tokens": P(DATA_AXIS),
-        "pos": P(DATA_AXIS),
-        "page_table": P(DATA_AXIS, None),
-        "active": P(DATA_AXIS),
-        "logits": P(DATA_AXIS, None),
-    }
-
-
 def shard_params(params, config: LlamaConfig, mesh: Mesh):
     """Place a param pytree onto the mesh according to param_pspecs."""
     specs = param_pspecs(config)

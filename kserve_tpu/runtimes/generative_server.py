@@ -69,6 +69,10 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
         self.random_weights = random_weights
         if role not in ("both", "prefill", "decode"):
             raise ValueError(f"unknown role {role!r}")
+        if role == "decode" and not prefill_url:
+            # silently serving monolithically would hide that the operator's
+            # disaggregated topology is not in effect
+            raise ValueError("role=decode requires --prefill_url (or $PREFILL_URL)")
         self.role = role
         self.prefill_url = prefill_url
         self._prefill_client = None
@@ -93,7 +97,9 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
         return True  # ready flips in start_engine
 
     async def start_engine(self):
-        self.engine = LLMEngine(
+        from ..engine.dp import build_engine
+
+        self.engine = build_engine(
             self._model_config,
             self.engine_config,
             self.tokenizer,
@@ -113,10 +119,13 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
             return
         if not loop.is_running():
             return
+        # keep references: create_task results are weakly held by the loop
+        # and an un-referenced shutdown task can be GC'd before it runs
+        self._stop_tasks = getattr(self, "_stop_tasks", [])
         if self.engine is not None and self.engine.running:
-            loop.create_task(self.engine.stop())
+            self._stop_tasks.append(loop.create_task(self.engine.stop()))
         if self._prefill_client is not None:
-            loop.create_task(self._prefill_client.close())
+            self._stop_tasks.append(loop.create_task(self._prefill_client.close()))
             self._prefill_client = None
 
     async def healthy(self) -> bool:
