@@ -30,7 +30,9 @@ import numpy as np
 from ..logging import logger
 from ..metrics import (
     ENGINE_BATCH_OCCUPANCY,
+    ENGINE_KV_OFFLOAD_BYTES,
     ENGINE_KV_PAGES_FREE,
+    ENGINE_PREEMPTIONS,
     ENGINE_QUEUE_DEPTH,
     GENERATED_TOKENS,
     PROMPT_TOKENS,
@@ -56,8 +58,9 @@ class EngineConfig:
     # over it; decode state is replicated across it)
     sp: int = 1
     dtype: str = "bfloat16"
-    # host-RAM KV tier: "none" | "host" (pages of preempted/cold sequences
-    # spill to pinned host memory instead of being recomputed)
+    # host-RAM KV tier: "none" re-prefills preempted sequences on resume;
+    # "host" spills their KV pages to host RAM (within kv_offload_gib) and
+    # re-injects on resume — no recompute
     kv_offload: str = "none"
     kv_offload_gib: float = 0.0
     # None/False = XLA gather attention (current default everywhere — the
@@ -133,6 +136,15 @@ class _QueuedRequest:
         # admission scatters the pages instead of prefilling
         self.kv_data = kv_data
         self.first_token = first_token
+        # preemption resume state: {generated, detok, stop_texts, pos,
+        # admitted_at, kv (host np | None)} — with kv, admission re-injects
+        # the spilled pages; without, it re-prefills prompt+generated[:-1]
+        self.resume: Optional[dict] = None
+
+    @property
+    def kv_len(self) -> int:
+        """Token positions whose KV must exist before decoding starts."""
+        return self.resume["pos"] if self.resume else len(self.prompt_ids)
 
 
 class LLMEngine:
@@ -194,6 +206,14 @@ class LLMEngine:
         self._task: Optional[asyncio.Task] = None
         self._pipeline_busy = False
         self._deferred_free: List[int] = []
+        # host-RAM KV tier accounting (kv_offload="host")
+        self._offload_bytes = 0
+        self._offload_budget = (
+            int(engine_config.kv_offload_gib * (1 << 30))
+            if engine_config.kv_offload == "host"
+            else 0
+        )
+        self.preemption_count = 0
         # device-resident [B, V] penalty state; row-level updates on batch
         # composition changes (dirty_rows None => full rebuild needed)
         self._penalty_counts = None
@@ -518,7 +538,17 @@ class LLMEngine:
                 self._free_pages(pages)
 
     def cancel(self, request_id: str) -> None:
-        self._waiting = [r for r in self._waiting if r.request_id != request_id]
+        kept = []
+        for r in self._waiting:
+            if r.request_id != request_id:
+                kept.append(r)
+            elif r.resume is not None and r.resume["kv"] is not None:
+                # return the spilled bytes to the host-tier budget
+                self._offload_bytes -= r.resume["kv"].nbytes
+                ENGINE_KV_OFFLOAD_BYTES.labels(model_name=self._mlabel).set(
+                    self._offload_bytes
+                )
+        self._waiting = kept
         for i, slot in enumerate(self._slots):
             if slot.request_id == request_id:
                 self._free_pages(slot.pages)
@@ -587,19 +617,22 @@ class LLMEngine:
             and len(admitted) < self.config.prefill_batch
         ):
             req = self._waiting[0]
-            if req.kv_data is not None:
+            has_kv = req.kv_data is not None or (
+                req.resume is not None and req.resume["kv"] is not None
+            )
+            if has_kv:
                 if admitted:
                     break  # flush the batched prefill first
                 return self._admit_injected(req)
-            n_pages = pages_needed(len(req.prompt_ids) + 1, self.config.page_size)
-            if not self.allocator.can_allocate(n_pages):
+            n_pages = pages_needed(req.kv_len + 1, self.config.page_size)
+            if not self.allocator.can_allocate(self._admission_pages(req, n_pages)):
                 break
             self._waiting.pop(0)
             admitted.append((free.pop(0), req, self.allocator.allocate(n_pages)))
         if not admitted:
             return False
 
-        bucket = self._bucket_for(max(len(r.prompt_ids) for _, r, _ in admitted))
+        bucket = self._bucket_for(max(r.kv_len for _, r, _ in admitted))
         # pad the batch dim to pow2 so the compile cache stays small
         Bp = 1
         while Bp < len(admitted):
@@ -609,8 +642,14 @@ class LLMEngine:
         page_ids = np.zeros((Bp, self.config.max_pages_per_seq), np.int32)
         params_list = [SamplingParams() for _ in range(Bp)]
         for j, (_, req, pages) in enumerate(admitted):
-            n = len(req.prompt_ids)
-            tokens[j, :n] = req.prompt_ids
+            if req.resume is not None:
+                # recompute-resume: re-prefill prompt + generated[:-1]; the
+                # last generated token's KV is written by its decode step
+                seq = req.prompt_ids + req.resume["generated"][:-1]
+            else:
+                seq = req.prompt_ids
+            n = len(seq)
+            tokens[j, :n] = seq
             valid[j] = n
             page_ids[j, : len(pages)] = pages
             params_list[j] = req.params
@@ -628,10 +667,19 @@ class LLMEngine:
         first_np = np.asarray(first)
         now = time.perf_counter()
         for j, (idx, req, pages) in enumerate(admitted):
+            if req.resume is None:
+                # resume re-prefills are recompute overhead, not new prompt
+                # traffic — don't double-count them
+                PROMPT_TOKENS.labels(model_name=self._mlabel).inc(int(valid[j]))
+            slot = self._slots[idx]
+            if req.resume is not None:
+                # stream state survives preemption; the re-prefill's sampled
+                # token is discarded (the real next token comes from decode)
+                self._seat_resumed(slot, req, pages)
+                self._mark_penalty_dirty(idx)
+                continue
             n_prompt = len(req.prompt_ids)
             first_token = int(first_np[j])
-            PROMPT_TOKENS.labels(model_name=self._mlabel).inc(n_prompt)
-            slot = self._slots[idx]
             slot.request_id = req.request_id
             slot.prompt_len = n_prompt
             slot.prompt_ids = req.prompt_ids
@@ -647,20 +695,48 @@ class LLMEngine:
             self._emit(slot, first_token)
         return True
 
+    def _admission_pages(self, req: "_QueuedRequest", need: int) -> int:
+        """Pages that must be free to admit.  Resumes additionally require a
+        couple of chunks of decode headroom (capped at what the cache can
+        ever provide) — re-admitting a preempted sequence into an
+        immediately-starving cache would ping-pong its full KV device<->host
+        every few tokens."""
+        if req.resume is None:
+            return need
+        headroom = pages_needed(2 * self.config.steps_per_sync, self.config.page_size)
+        return min(need + headroom, self.config.num_pages - 1)
+
+    def _seat_resumed(self, slot: _Slot, req: "_QueuedRequest", pages: List[int]) -> None:
+        r = req.resume
+        slot.request_id = req.request_id
+        slot.prompt_len = len(req.prompt_ids)
+        slot.prompt_ids = req.prompt_ids
+        slot.pages = pages
+        slot.pos = r["pos"]
+        slot.generated = r["generated"]
+        slot.params = req.params
+        slot.queue = req.queue
+        slot.detok = r["detok"]
+        slot.stop_texts = r["stop_texts"]
+        slot.admitted_at = r["admitted_at"]
+
     def _admit_injected(self, req: "_QueuedRequest") -> bool:
-        """Admit a request with transferred KV (P/D decode side): allocate
-        pages, scatter the prefill-produced KV into them, seat the slot at
-        pos=len(prompt) with the prefill's first token."""
+        """Admit a request whose KV already exists on host: either P/D
+        transfer from a prefill peer (seat at pos=len(prompt), emit the
+        peer's first token) or a preemption resume from the host tier
+        (restore the full stream state, emit nothing)."""
         idx = self._free_slot_index()
         if idx is None:
             return False
-        n = len(req.prompt_ids)
-        need = pages_needed(n + 1, self.config.page_size)
-        if need > self.config.max_pages_per_seq or not self.allocator.can_allocate(need):
+        kv = req.resume["kv"] if req.resume is not None else req.kv_data
+        total = req.kv_len
+        need = pages_needed(total + 1, self.config.page_size)
+        if need > self.config.max_pages_per_seq:
+            return False
+        if not self.allocator.can_allocate(self._admission_pages(req, need)):
             return False
         self._waiting.remove(req)
         pages = self.allocator.allocate(need)
-        kv = req.kv_data
         P = kv.shape[2]
         # pad the page dim to the standard width buckets (small compile cache)
         bucket = self.config.page_bucket(P)
@@ -672,6 +748,15 @@ class LLMEngine:
             self.kv_pages, jnp.asarray(kvp), jnp.asarray(ids)
         )
         slot = self._slots[idx]
+        if req.resume is not None:
+            self._seat_resumed(slot, req, pages)
+            self._offload_bytes -= kv.nbytes
+            ENGINE_KV_OFFLOAD_BYTES.labels(model_name=self._mlabel).set(
+                self._offload_bytes
+            )
+            self._mark_penalty_dirty(idx)
+            return True
+        n = len(req.prompt_ids)
         slot.request_id = req.request_id
         slot.prompt_len = n
         slot.prompt_ids = req.prompt_ids
@@ -689,16 +774,108 @@ class LLMEngine:
         return True
 
     def _ensure_pages_at(self, slot: _Slot, base: int, extra: int) -> bool:
-        """Grow the slot's page list to cover positions base..base+extra-1.
-        False on allocator exhaustion or per-seq page limit."""
-        needed = pages_needed(base + extra, self.config.page_size)
-        if needed > self.config.max_pages_per_seq:
-            return False
-        while len(slot.pages) < needed:
-            if not self.allocator.can_allocate(1):
-                return False
+        """Best-effort grow of the slot's page list toward positions
+        base..base+extra-1 (capped at the per-seq limit); partial growth is
+        kept — the chunk capacity mask lets a lane run however many steps
+        its pages cover.  Returns True when the full range is covered."""
+        needed = min(
+            pages_needed(base + extra, self.config.page_size),
+            self.config.max_pages_per_seq,
+        )
+        while len(slot.pages) < needed and self.allocator.can_allocate(1):
             slot.pages.extend(self.allocator.allocate(1))
-        return True
+        return len(slot.pages) >= pages_needed(base + extra, self.config.page_size)
+
+    def _grow_and_preempt(self) -> None:
+        """Before an unchained chunk: grow every active slot's pages toward
+        the chunk's writes; on allocator exhaustion, preempt the NEWEST
+        non-oldest slot back to the queue (freeing its pages) and retry.
+        The oldest slot is never preempted, so it always finishes — liveness.
+        A single slot that exhausts the whole cache alone is truncated
+        honestly (config smaller than one max-length sequence)."""
+        steps = self.config.steps_per_sync
+        ps = self.config.page_size
+        while True:
+            active = [s for s in self._slots if s.request_id is not None]
+            if not active:
+                return
+            starved = []
+            for slot in active:
+                base = slot.pos
+                if base >= self.config.max_model_len:
+                    continue  # finished as "length" in _prepare_chunk
+                grow = min(steps, self.config.max_model_len - base)
+                self._ensure_pages_at(slot, base, grow)
+                if len(slot.pages) * ps <= base:
+                    starved.append(slot)
+            if not starved:
+                return
+            oldest = min(active, key=lambda s: s.admitted_at)
+            candidates = [
+                s for s in active if s is not oldest and self._can_preempt(s)
+            ]
+            if not candidates:
+                # nothing can legally be preempted (kv_offload contract:
+                # "none"/exhausted budget must not pin host RAM, and a
+                # too-long sequence can't re-prefill) — truncate honestly
+                for s in starved:
+                    self._finish(s, "length")
+                continue
+            self._preempt(max(candidates, key=lambda s: s.admitted_at))
+
+    def _can_preempt(self, slot: _Slot) -> bool:
+        """A slot is preemptible if its resume path exists: re-prefill fits
+        max_prefill_len, or the host tier has budget for its KV."""
+        if slot.pos <= self.config.max_prefill_len:
+            return True
+        P = pages_needed(slot.pos, self.config.page_size)
+        nbytes = P * self.model_config.n_layers * self.cache_config.bytes_per_page()
+        return bool(
+            self._offload_budget
+            and self._offload_bytes + nbytes <= self._offload_budget
+        )
+
+    def _preempt(self, slot: _Slot) -> None:
+        """Requeue a running slot (front of queue), freeing its pages.  With
+        the host tier enabled (and budget left) its KV spills to host RAM
+        and re-injects on resume; otherwise resume re-prefills
+        prompt+generated[:-1].  Nothing is emitted — the client stream just
+        pauses.  Parity: vLLM preemption + KVCacheOffloadingSpec
+        (llm_inference_service_types.go:188-232)."""
+        pos = slot.pos  # KV on device covers positions 0..pos-1
+        P = pages_needed(pos, self.config.page_size)
+        kv = None
+        nbytes = (
+            P * self.model_config.n_layers * self.cache_config.bytes_per_page()
+        )
+        # spill when the budget allows; _can_preempt guarantees the
+        # alternative (re-prefill) exists whenever we don't
+        if self._offload_budget and self._offload_bytes + nbytes <= self._offload_budget:
+            ids = jnp.asarray(np.asarray(slot.pages[:P], np.int32))
+            kv = np.asarray(jnp.stack([layer[:, ids] for layer in self.kv_pages]))
+            self._offload_bytes += kv.nbytes
+            ENGINE_KV_OFFLOAD_BYTES.labels(model_name=self._mlabel).set(
+                self._offload_bytes
+            )
+        req = _QueuedRequest(slot.request_id, slot.prompt_ids, slot.params, slot.queue)
+        req.resume = {
+            "generated": slot.generated,
+            "detok": slot.detok,
+            "stop_texts": slot.stop_texts,
+            "pos": pos,
+            "admitted_at": slot.admitted_at,
+            "kv": kv,
+        }
+        self._free_pages(slot.pages)
+        self._mark_penalty_dirty(self._slots.index(slot))
+        slot.reset()
+        self._waiting.insert(0, req)
+        self.preemption_count += 1
+        ENGINE_PREEMPTIONS.labels(model_name=self._mlabel).inc()
+        logger.info(
+            "preempted %s at pos=%d (%s)", req.request_id, pos,
+            "KV spilled to host" if kv is not None else "will re-prefill",
+        )
 
     def _free_pages(self, pages: List[int]) -> None:
         """Page frees are deferred while a chained chunk is in flight — a
@@ -720,6 +897,10 @@ class LLMEngine:
         min(steps, prev capacity) without reading prev's tokens."""
         B = self.config.max_batch_size
         steps = self.config.steps_per_sync
+        if prev is None:
+            # page growth + preemption happen only between pipelines (the KV
+            # extraction in _preempt needs no chunk in flight)
+            self._grow_and_preempt()
         tokens = np.zeros((B,), np.int32)
         pos = np.zeros((B,), np.int32)
         active = np.zeros((B,), bool)
@@ -736,13 +917,17 @@ class LLMEngine:
             else:
                 base = slot.pos
                 tokens[i] = slot.generated[-1]
-            # grow pages toward this chunk's writes; a lane may cover only
-            # part of the chunk (capacity masks the rest on device)
             grow = min(steps, self.config.max_model_len - base)
-            if grow <= 0 or not self._ensure_pages_at(slot, base, grow):
+            if grow <= 0:
                 if prev is None:
-                    self._finish(slot, "length")
+                    self._finish(slot, "length")  # genuinely at max_model_len
                 continue
+            if prev is not None:
+                # best-effort growth for chained chunks; no preemption while
+                # the previous chunk is in flight
+                self._ensure_pages_at(slot, base, grow)
+            if len(slot.pages) * self.config.page_size <= base:
+                continue  # no capacity this chunk; retried after the drain
             pos[i] = base
             active[i] = True
             capacity[i] = len(slot.pages) * self.config.page_size
@@ -857,7 +1042,17 @@ class LLMEngine:
         steps = self.config.steps_per_sync
         chunk_np = np.asarray(chunk)  # [steps, B]
         active = meta["active"]
-        GENERATED_TOKENS.labels(model_name=self._mlabel).inc(int(active.sum()) * steps)
+        # count real lane steps, not steps*lanes: partial-capacity lanes run
+        # only capacity-pos of the chunk
+        GENERATED_TOKENS.labels(model_name=self._mlabel).inc(
+            int(
+                sum(
+                    min(steps, int(meta["capacity"][i]) - int(meta["pos"][i]))
+                    for i in range(len(self._slots))
+                    if active[i]
+                )
+            )
+        )
         finished_any = False
         for i, slot in enumerate(self._slots):
             if slot.request_id is None or not active[i]:

@@ -39,6 +39,14 @@ ENGINE_QUEUE_DEPTH = Gauge(
 ENGINE_KV_PAGES_FREE = Gauge(
     "engine_kv_pages_free", "free KV cache pages", ["model_name"]
 )
+ENGINE_PREEMPTIONS = Counter(
+    "engine_preemptions_total",
+    "sequences preempted back to the queue on KV pressure", ["model_name"],
+)
+ENGINE_KV_OFFLOAD_BYTES = Gauge(
+    "engine_kv_offload_bytes",
+    "KV bytes currently parked in the host-RAM tier", ["model_name"],
+)
 
 
 def get_labels(model_name: str) -> dict:

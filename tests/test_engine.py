@@ -237,3 +237,65 @@ class TestPenalties:
             assert penalized[-1].finished
         finally:
             await engine.stop()
+
+
+class TestPreemption:
+    """VERDICT #6: page exhaustion must preempt, not truncate."""
+
+    def _squeezed_engine(self, **overrides):
+        # 8 pages (7 usable) x page_size 8 = 56 token positions; two
+        # 4+44-token requests need 12 pages total -> guaranteed exhaustion
+        cfg = dict(num_pages=8, max_pages_per_seq=8, max_batch_size=4)
+        cfg.update(overrides)
+        return make_engine(**cfg)
+
+    async def _roomy_reference(self, prompts, params):
+        engine = make_engine(num_pages=64, max_pages_per_seq=8, max_batch_size=4)
+        await engine.start()
+        try:
+            return [
+                [o.token_id for o in await collect(engine, p, params)]
+                for p in prompts
+            ]
+        finally:
+            await engine.stop()
+
+    @async_test
+    async def test_both_long_requests_complete_full_length(self):
+        params = SamplingParams(max_tokens=44, temperature=0.0, ignore_eos=True)
+        prompts = [[1, 2, 3, 4], [9, 10, 11, 12]]
+        want = await self._roomy_reference(prompts, params)
+        engine = self._squeezed_engine()
+        await engine.start()
+        try:
+            results = await asyncio.gather(
+                *[collect(engine, p, params) for p in prompts]
+            )
+        finally:
+            await engine.stop()
+        for outs, want_tokens in zip(results, want):
+            # full length: not silently truncated under KV pressure
+            assert outs[-1].num_generated == 44
+            assert [o.token_id for o in outs] == want_tokens
+        assert engine.preemption_count > 0, "cache was supposed to saturate"
+
+    @async_test
+    async def test_host_offload_spills_and_restores(self):
+        params = SamplingParams(max_tokens=44, temperature=0.0, ignore_eos=True)
+        prompts = [[1, 2, 3, 4], [9, 10, 11, 12]]
+        want = await self._roomy_reference(prompts, params)
+        engine = self._squeezed_engine(kv_offload="host", kv_offload_gib=1.0)
+        await engine.start()
+        try:
+            results = await asyncio.gather(
+                *[collect(engine, p, params) for p in prompts]
+            )
+        finally:
+            await engine.stop()
+        for outs, want_tokens in zip(results, want):
+            assert outs[-1].num_generated == 44
+            assert [o.token_id for o in outs] == want_tokens
+        assert engine.preemption_count > 0
+        # pages went host-side and came back; budget fully returned
+        assert engine._offload_bytes == 0
+        assert engine.allocator.free_pages == engine.config.num_pages - 1
