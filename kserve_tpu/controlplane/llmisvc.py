@@ -163,35 +163,72 @@ class LLMISVCReconciler:
             "serving.kserve.io/llminferenceservice": llm.metadata.name,
             "kserve.io/component": role,
         }
-        replicas = (workload.replicas or 1) * plan.hosts * plan.num_slices
-        deployment = make_object(
-            "apps/v1", "Deployment", name, namespace, labels=dict(labels),
-            spec={
-                "replicas": replicas,
-                "selector": {"matchLabels": {"app": name}},
-                "template": {"metadata": {"labels": dict(labels)}, "spec": pod_spec},
-            },
-        )
-        objects = [deployment]
         if plan.hosts > 1:
-            deployment["metadata"]["annotations"] = {
-                "serving.kserve.io/tpu-slice-hosts": str(plan.hosts),
-            }
-            # jax.distributed coordination across the slice's hosts — write
-            # into the FINAL pod spec (strategic_merge deep-copied the
-            # original container dict)
-            final = deployment["spec"]["template"]["spec"]["containers"][0]
-            final["env"] = final.get("env", []) + [
-                {"name": "COORDINATOR_ADDRESS", "value": f"{name}-peers.{namespace}:8476"},
-                {"name": "NUM_PROCESSES", "value": str(plan.hosts)},
-            ]
-            objects.append(
-                make_object(
-                    "v1", "Service", f"{name}-peers", namespace, labels=dict(labels),
-                    spec={"clusterIP": "None", "selector": {"app": name},
-                          "ports": [{"name": "coord", "port": 8476}]},
+            # Multi-host: ONE StatefulSet PER slice replica group — a group's
+            # pod ordinals 0..hosts-1 double as jax.distributed ranks
+            # (utils/distributed.infer_process_id), and each group gets its
+            # own pod-0 coordinator + headless peer Service.  Folding groups
+            # into one StatefulSet would hand ordinals >= hosts to the later
+            # groups and break their rank math.  The reference reaches the
+            # same property through LeaderWorkerSet + Ray
+            # (workload_multi_node.go:70-124).
+            groups = (workload.replicas or 1) * plan.num_slices
+            objects = []
+            import copy
+
+            for g in range(groups):
+                group_pod_spec = copy.deepcopy(pod_spec)
+                gname = f"{name}-g{g}" if groups > 1 else name
+                glabels = dict(labels)
+                glabels["kserve.io/slice-group"] = str(g)
+                sts = make_object(
+                    "apps/v1", "StatefulSet", gname, namespace, labels=glabels,
+                    spec={
+                        "replicas": plan.hosts,
+                        "serviceName": f"{gname}-peers",
+                        "podManagementPolicy": "Parallel",  # ranks must co-start
+                        "selector": {"matchLabels": {"app": name,
+                                                     "kserve.io/slice-group": str(g)}},
+                        "template": {"metadata": {"labels": dict(glabels)},
+                                     "spec": group_pod_spec},
+                    },
                 )
+                sts["metadata"]["annotations"] = {
+                    "serving.kserve.io/tpu-slice-hosts": str(plan.hosts),
+                }
+                # jax.distributed coordination: this group's pod-0 hosts the
+                # coordinator — write into the FINAL pod spec
+                # (strategic_merge deep-copied the original container dict)
+                final = sts["spec"]["template"]["spec"]["containers"][0]
+                final["env"] = final.get("env", []) + [
+                    {
+                        "name": "COORDINATOR_ADDRESS",
+                        "value": f"{gname}-0.{gname}-peers.{namespace}:8476",
+                    },
+                    {"name": "NUM_PROCESSES", "value": str(plan.hosts)},
+                ]
+                objects.append(sts)
+                objects.append(
+                    make_object(
+                        "v1", "Service", f"{gname}-peers", namespace,
+                        labels=dict(glabels),
+                        spec={"clusterIP": "None",
+                              "selector": {"app": name,
+                                           "kserve.io/slice-group": str(g)},
+                              "ports": [{"name": "coord", "port": 8476}]},
+                    )
+                )
+        else:
+            replicas = (workload.replicas or 1) * plan.num_slices
+            workload_obj = make_object(
+                "apps/v1", "Deployment", name, namespace, labels=dict(labels),
+                spec={
+                    "replicas": replicas,
+                    "selector": {"matchLabels": {"app": name}},
+                    "template": {"metadata": {"labels": dict(labels)}, "spec": pod_spec},
+                },
             )
+            objects = [workload_obj]
         objects.append(
             make_object(
                 "v1", "Service", name, namespace, labels=dict(labels),
@@ -273,6 +310,11 @@ class LLMISVCReconciler:
             tp=par.tp(), dp_local=par.dataLocal or 1,
             num_slices=par.pipeline or 1, sequence=par.sequence or 1,
         )
+        if plan.hosts > 1:
+            # multi-host groups are fixed-size StatefulSets; scaling them
+            # means adding/removing whole groups (a reconcile-level replica
+            # decision), not letting KEDA stretch pod counts mid-slice
+            return None
         pods_per_replica = plan.hosts * plan.num_slices
         return make_object(
             "keda.sh/v1alpha1", "ScaledObject", name, llm.metadata.namespace,
@@ -301,7 +343,7 @@ class LLMISVCReconciler:
             {"name": "OTEL_TRACES_SAMPLER_ARG", "value": spec.tracing.samplingRate or "0.1"},
         ]
         for obj in objects:
-            if obj["kind"] != "Deployment":
+            if obj["kind"] not in ("Deployment", "StatefulSet"):
                 continue
             for c in obj["spec"]["template"]["spec"].get("containers", []):
                 c["env"] = c.get("env", []) + env

@@ -817,9 +817,13 @@ class LLMEngine:
             if not candidates:
                 # nothing can legally be preempted (kv_offload contract:
                 # "none"/exhausted budget must not pin host RAM, and a
-                # too-long sequence can't re-prefill) — truncate honestly
+                # too-long sequence can't re-prefill)
+                if len(starved) < len(active):
+                    # other lanes are still decoding and will free pages on
+                    # finish; starved lanes pause (capacity mask) and retry
+                    return
                 for s in starved:
-                    self._finish(s, "length")
+                    self._finish(s, "length")  # no page source left anywhere
                 continue
             self._preempt(max(candidates, key=lambda s: s.admitted_at))
 

@@ -16,12 +16,22 @@ from ..logging import logger
 
 def apply_platform_override() -> None:
     want = os.environ.get("JAX_PLATFORM_NAME", "").strip().lower()
-    try:
-        import jax
+    # multi-host: join the slice BEFORE backend init (jax.distributed must
+    # precede the first device query).  Deliberately OUTSIDE the tolerant
+    # try below: a pod that cannot join its slice must crash-loop, not
+    # quietly serve single-host.
+    import jax
 
-        if want:
+    if want:
+        try:
             jax.config.update("jax_platforms", want)
             logger.info("JAX platform forced to %s via JAX_PLATFORM_NAME", want)
+        except Exception as e:
+            logger.warning("could not force JAX platform: %s", e)
+    from .distributed import maybe_initialize_distributed
+
+    maybe_initialize_distributed()
+    try:
         # Initialize the backend NOW: the ambient JAX_PLATFORMS=axon names a
         # plugin that intermittently fails to register when jax first
         # initializes late inside a server process.  Initializing early —

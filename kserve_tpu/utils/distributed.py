@@ -1,0 +1,80 @@
+"""Multi-host bootstrap: join the jax.distributed coordination service from
+the environment the LLMISVC controller injects.
+
+The controller's multi-host workload (controlplane/llmisvc.py) is a
+StatefulSet whose pods share a headless peer Service; it injects
+COORDINATOR_ADDRESS (peer-0 DNS:port) and NUM_PROCESSES (slice host count).
+The process rank comes from PROCESS_ID when set, else the StatefulSet pod
+ordinal parsed from the hostname ("name-3" -> 3).
+
+Parity: the reference bootstraps multi-node vLLM through Ray/LWS
+(pkg/controller/.../components/predictor.go:656-681,
+config/runtimes/kserve-huggingfaceserver-multinode.yaml:36-40); here the
+coordination layer IS jax.distributed — XLA collectives then ride ICI
+within a slice and DCN across slices with no extra runtime.
+
+MUST run after the platform override but before the first jax backend use.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Optional
+
+from ..logging import logger
+
+
+def infer_process_id() -> Optional[int]:
+    """Rank from $PROCESS_ID, $JOB_COMPLETION_INDEX (Jobs), or the
+    StatefulSet ordinal suffix of the hostname."""
+    for var in ("PROCESS_ID", "JOB_COMPLETION_INDEX"):
+        val = os.getenv(var)
+        if val is not None and val.strip():
+            return int(val)
+    hostname = os.getenv("HOSTNAME") or socket.gethostname()
+    _, _, suffix = hostname.rpartition("-")
+    if suffix.isdigit():
+        return int(suffix)
+    return None
+
+
+def maybe_initialize_distributed(env: Optional[dict] = None) -> bool:
+    """Call jax.distributed.initialize from the injected env; no-op (False)
+    when COORDINATOR_ADDRESS/NUM_PROCESSES are absent.  Raises on malformed
+    env or an unreachable coordinator — a multi-host pod that cannot join
+    its slice must crash-loop, not serve a split brain."""
+    env = env if env is not None else dict(os.environ)
+    address = (env.get("COORDINATOR_ADDRESS") or "").strip()
+    num = (env.get("NUM_PROCESSES") or "").strip()
+    if not address or not num:
+        return False
+    num_processes = int(num)
+    if num_processes < 2:
+        logger.info("NUM_PROCESSES=%s: single-host, skipping jax.distributed", num)
+        return False
+    explicit = (env.get("PROCESS_ID") or "").strip()
+    process_id = int(explicit) if explicit else infer_process_id()
+    if process_id is None:
+        raise RuntimeError(
+            "multi-host env present (COORDINATOR_ADDRESS/NUM_PROCESSES) but "
+            "no process rank: set PROCESS_ID or run under a StatefulSet "
+            "(ordinal hostname)"
+        )
+    import jax
+
+    logger.info(
+        "joining jax.distributed: coordinator=%s rank=%d/%s",
+        address, process_id, num_processes,
+    )
+    jax.distributed.initialize(
+        coordinator_address=address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info(
+        "jax.distributed up: process %d/%d, %d local / %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+    return True

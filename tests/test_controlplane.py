@@ -261,20 +261,30 @@ class TestLLMISVCReconcile:
     def test_prefill_decode_disaggregation(self):
         mgr = ControllerManager()
         mgr.apply(self._llm(prefill={"replicas": 2, "parallelism": {"tensor": 8}}))
-        prefill = mgr.cluster.get("Deployment", "llama-kserve-prefill")
-        assert prefill is not None
-        args = prefill["spec"]["template"]["spec"]["containers"][0]["args"]
-        assert "--role=prefill" in args
-        # tp=8 on v5e -> 2x4 slice, 2 hosts per slice x 2 replicas
-        assert prefill["spec"]["replicas"] == 4
+        # tp=8 on v5e spans 2 hosts -> one StatefulSet PER slice replica
+        # group, each sized to the slice's host count (ordinals = ranks)
+        for g in range(2):
+            sts = mgr.cluster.get("StatefulSet", f"llama-kserve-prefill-g{g}")
+            assert sts is not None
+            assert sts["spec"]["replicas"] == 2  # hosts per slice
+            args = sts["spec"]["template"]["spec"]["containers"][0]["args"]
+            assert "--role=prefill" in args
+            env = {e["name"]: e["value"] for e in
+                   sts["spec"]["template"]["spec"]["containers"][0]["env"]}
+            # every group has its own coordinator and rank space
+            assert env["COORDINATOR_ADDRESS"].startswith(
+                f"llama-kserve-prefill-g{g}-0."
+            )
+            assert env["NUM_PROCESSES"] == "2"
 
     def test_multihost_gets_coordinator(self):
         mgr = ControllerManager()
         mgr.apply(self._llm(workload={"replicas": 1, "parallelism": {"tensor": 8}}))
-        dep = mgr.cluster.get("Deployment", "llama-kserve")
+        sts = mgr.cluster.get("StatefulSet", "llama-kserve")
         env = {e["name"]: e["value"] for e in
-               dep["spec"]["template"]["spec"]["containers"][0]["env"]}
-        assert env["COORDINATOR_ADDRESS"].startswith("llama-kserve-peers.default")
+               sts["spec"]["template"]["spec"]["containers"][0]["env"]}
+        # coordinator is pod-0's stable StatefulSet DNS name
+        assert env["COORDINATOR_ADDRESS"] == "llama-kserve-0.llama-kserve-peers.default:8476"
         assert env["NUM_PROCESSES"] == "2"
         assert mgr.cluster.get("Service", "llama-kserve-peers") is not None
 
