@@ -53,8 +53,15 @@ async def run_bench():
         max_prefill_len=512,
         prefill_buckets=(128, 256, 512),
         dtype="bfloat16" if on_tpu else "float32",
-        use_pallas=None,  # default: XLA paged attention (see ops/attention.py)
-        steps_per_sync=32,
+        use_pallas=None,  # auto-dispatch (see ops/attention.py)
+        # knob sweep on one v5e chip (2026-07-29, page-major cache layout):
+        #   B=48 steps=32 pb=8  -> 1736 tok/s
+        #   B=48 steps=64 pb=8  -> 1699
+        #   B=48 steps=64 pb=16 -> 1850   <- best
+        #   B=64 steps=64 pb=16 -> 1739
+        #   B=96 steps=64 pb=16 -> 1618
+        steps_per_sync=64,
+        prefill_batch=16,
     )
     tokenizer = ByteTokenizer(model_config.vocab_size)
     engine = LLMEngine(model_config, engine_config, tokenizer, rng_seed=0)

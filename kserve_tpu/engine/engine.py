@@ -63,10 +63,11 @@ class EngineConfig:
     # re-injects on resume — no recompute
     kv_offload: str = "none"
     kv_offload_gib: float = 0.0
-    # None/False = XLA gather attention (current default everywhere — the
-    # Pallas kernel breaks KV-cache aliasing at the custom-call boundary and
-    # is slower end-to-end until the layout contract is fixed); True opts in
-    # (requires head_dim % 128 == 0, else the call raises)
+    # None = auto (ops/attention.py): the fused Pallas kernel for
+    # long-context decode (page-table width >= PALLAS_MIN_PAGES, head_dim %
+    # 128 == 0), the XLA gather for short context — each where it measures
+    # faster.  True forces the kernel (raises on unsupported head_dim);
+    # False forces the gather.
     use_pallas: Optional[bool] = None
     # decode steps executed on-device per host round-trip (lax.scan inner
     # loop).  >1 amortizes host<->device latency — essential when the chip
@@ -132,7 +133,7 @@ class _QueuedRequest:
         self.params = params
         self.queue = queue
         # P/D disaggregation: KV computed by a prefill-role server
-        # ([L, 2, P, n_kv, ps, d] host array) plus its sampled first token —
+        # ([L, P, 2, n_kv, ps, d] host array) plus its sampled first token —
         # admission scatters the pages instead of prefilling
         self.kv_data = kv_data
         self.first_token = first_token
@@ -323,7 +324,7 @@ class LLMEngine:
             cache.  Padded ids point at the null page (page 0), whose
             contents are never read unmasked."""
             return [
-                layer.at[:, ids].set(kv_data[i].astype(layer.dtype))
+                layer.at[ids].set(kv_data[i].astype(layer.dtype))
                 for i, layer in enumerate(kv_pages)
             ]
 
@@ -390,7 +391,7 @@ class LLMEngine:
         self,
         prompt_ids: List[int],
         params: SamplingParams,
-        kv_data: np.ndarray,  # [L, 2, P, n_kv, ps, d] from prefill_detached
+        kv_data: np.ndarray,  # [L, P, 2, n_kv, ps, d] from prefill_detached
         first_token: int,
         request_id: Optional[str] = None,
     ) -> AsyncIterator[GenerationOutput]:
@@ -407,7 +408,7 @@ class LLMEngine:
         kv_data = np.asarray(kv_data)
         cc = self.cache_config
         expect = (
-            cc.n_layers, 2, pages_needed(len(prompt_ids), cc.page_size),
+            cc.n_layers, pages_needed(len(prompt_ids), cc.page_size), 2,
             cc.n_kv_heads, cc.page_size, cc.head_dim,
         )
         if tuple(kv_data.shape) != expect:
@@ -447,7 +448,7 @@ class LLMEngine:
     ) -> Tuple[int, np.ndarray]:
         """P/D disaggregation, prefill side: compute the prompt's KV and the
         first sampled token, extract the KV pages to host, release the pages.
-        Returns (first_token, kv [L, 2, P, n_kv, ps, d]).
+        Returns (first_token, kv [L, P, 2, n_kv, ps, d]).
 
         Concurrent callers are micro-batched: a worker drains the queue and
         prefills up to `prefill_batch` prompts per compiled call, so a
@@ -529,7 +530,7 @@ class LLMEngine:
             for j, (prompt_ids, _, fut, pages) in enumerate(runnable):
                 ids = jnp.asarray(np.asarray(pages, np.int32))
                 kv = np.asarray(
-                    jnp.stack([layer[:, ids] for layer in self.kv_pages])
+                    jnp.stack([layer[ids] for layer in self.kv_pages])
                 )
                 if not fut.done():
                     fut.set_result((int(first_np[j]), kv))
@@ -737,13 +738,13 @@ class LLMEngine:
             return False
         self._waiting.remove(req)
         pages = self.allocator.allocate(need)
-        P = kv.shape[2]
+        P = kv.shape[1]
         # pad the page dim to the standard width buckets (small compile cache)
         bucket = self.config.page_bucket(P)
         ids = np.zeros((bucket,), np.int32)
         ids[:P] = pages[:P]
-        kvp = np.zeros(kv.shape[:2] + (bucket,) + kv.shape[3:], kv.dtype)
-        kvp[:, :, :P] = kv
+        kvp = np.zeros(kv.shape[:1] + (bucket,) + kv.shape[2:], kv.dtype)
+        kvp[:, :P] = kv
         self.kv_pages = self._inject_fn(
             self.kv_pages, jnp.asarray(kvp), jnp.asarray(ids)
         )
@@ -856,7 +857,7 @@ class LLMEngine:
         # alternative (re-prefill) exists whenever we don't
         if self._offload_budget and self._offload_bytes + nbytes <= self._offload_budget:
             ids = jnp.asarray(np.asarray(slot.pages[:P], np.int32))
-            kv = np.asarray(jnp.stack([layer[:, ids] for layer in self.kv_pages]))
+            kv = np.asarray(jnp.stack([layer[ids] for layer in self.kv_pages]))
             self._offload_bytes += kv.nbytes
             ENGINE_KV_OFFLOAD_BYTES.labels(model_name=self._mlabel).set(
                 self._offload_bytes

@@ -1,13 +1,13 @@
 """Paged KV cache: device arrays + host-side page allocator.
 
-Layout per layer: stacked K/V pages of shape
-[2, num_pages, n_kv_heads, page_size, head_dim] — page-major so the Pallas
-decode kernel fetches one page for ALL local KV heads in a single DMA
-(64KB-class transfers instead of per-head 8KB), while tensor parallelism
-still shards the head axis over the `model` mesh with no resharding at
-attention time.  Sequences own pages through a page table
-[B_slots, max_pages_per_seq]; page 0 is reserved as the null page so padded
-table entries are always valid gathers.
+Layout per layer: [num_pages, 2, n_kv_heads, page_size, head_dim] —
+page-MAJOR so one page is one contiguous block holding K and V for ALL
+local KV heads: the Pallas decode kernel streams it with a single 64KB-class
+DMA descriptor per page (K+V together), while tensor parallelism still
+shards the head axis over the `model` mesh with no resharding at attention
+time.  Sequences own pages through a page table [B_slots,
+max_pages_per_seq]; page 0 is reserved as the null page so padded table
+entries are always valid gathers.
 
 Role parity: replaces vLLM's block allocator + CUDA paged attention cache
 (the reference delegates this entirely to vLLM; see SURVEY.md §2.3) with an
@@ -44,9 +44,9 @@ class KVCacheConfig:
 
 
 def init_kv_pages(config: KVCacheConfig, sharding=None) -> List[jnp.ndarray]:
-    """[n_layers] list of stacked K/V pages:
-    [2, num_pages, n_kv_heads, page_size, head_dim]."""
-    shape = (2, config.num_pages, config.n_kv_heads, config.page_size, config.head_dim)
+    """[n_layers] list of page-major K/V pages:
+    [num_pages, 2, n_kv_heads, page_size, head_dim]."""
+    shape = (config.num_pages, 2, config.n_kv_heads, config.page_size, config.head_dim)
     dtype = jnp.dtype(config.dtype)
     pages = []
     for _ in range(config.n_layers):
@@ -87,7 +87,7 @@ def pages_needed(n_tokens: int, page_size: int) -> int:
 
 
 def write_prompt_kv(
-    kv_pages: jnp.ndarray,  # [2, num_pages, n_kv, ps, d]
+    kv_pages: jnp.ndarray,  # [num_pages, 2, n_kv, ps, d]
     k: jnp.ndarray,  # [T, n_kv, d]
     v: jnp.ndarray,  # [T, n_kv, d]
     page_ids: jnp.ndarray,  # [max_pages_this_seq] int32 (padded with 0)
@@ -102,15 +102,15 @@ def write_prompt_kv(
     page_of_t = jnp.where(valid, page_ids[t // page_size], 0)
     slot_of_t = t % page_size
     kv = jnp.stack([k, v]).astype(kv_pages.dtype)  # [2, T, n_kv, d]
-    # non-adjacent advanced indices (dims 1,3) put the broadcast dim first:
+    # non-adjacent advanced indices (dims 0,3) put the broadcast dim first:
     # the updated slice has shape [T, 2, n_kv, d]
-    return kv_pages.at[:, page_of_t, :, slot_of_t, :].set(
+    return kv_pages.at[page_of_t, :, :, slot_of_t, :].set(
         kv.transpose(1, 0, 2, 3), mode="drop", unique_indices=False
     )
 
 
 def write_prompt_kv_batch(
-    kv_pages: jnp.ndarray,  # [2, num_pages, n_kv, ps, d]
+    kv_pages: jnp.ndarray,  # [num_pages, 2, n_kv, ps, d]
     k: jnp.ndarray,  # [B, T, n_kv, d]
     v: jnp.ndarray,  # [B, T, n_kv, d]
     page_ids: jnp.ndarray,  # [B, max_pages] int32
@@ -127,13 +127,13 @@ def write_prompt_kv_batch(
     pages_flat = page_of.reshape(-1)
     kv = jnp.stack([k, v]).astype(kv_pages.dtype)  # [2, B, T, n_kv, d]
     values = kv.transpose(1, 2, 0, 3, 4).reshape(B * T, 2, kv.shape[3], kv.shape[4])
-    return kv_pages.at[:, pages_flat, :, slot_of, :].set(
+    return kv_pages.at[pages_flat, :, :, slot_of, :].set(
         values, mode="drop", unique_indices=False
     )
 
 
 def append_token_kv(
-    kv_pages: jnp.ndarray,  # [2, num_pages, n_kv, ps, d]
+    kv_pages: jnp.ndarray,  # [num_pages, 2, n_kv, ps, d]
     k: jnp.ndarray,  # [B, n_kv, d]
     v: jnp.ndarray,  # [B, n_kv, d]
     page_table: jnp.ndarray,  # [B, max_pages_per_seq]
@@ -148,4 +148,4 @@ def append_token_kv(
     slot = pos % page_size
     kv = jnp.stack([k, v]).astype(kv_pages.dtype)  # [2, B, n_kv, d]
     # see write_prompt_kv: updated slice shape is [B, 2, n_kv, d]
-    return kv_pages.at[:, page, :, slot, :].set(kv.transpose(1, 0, 2, 3), mode="drop")
+    return kv_pages.at[page, :, :, slot, :].set(kv.transpose(1, 0, 2, 3), mode="drop")

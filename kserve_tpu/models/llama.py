@@ -233,7 +233,7 @@ def prefill(
     config: LlamaConfig,
     tokens: jnp.ndarray,  # [B, T] padded prompt
     valid_len: jnp.ndarray,  # [B]
-    kv_pages: List[jnp.ndarray],  # per layer [2, nkv, num_pages, ps, d]
+    kv_pages: List[jnp.ndarray],  # per layer [num_pages, 2, nkv, ps, d]
     page_ids: jnp.ndarray,  # [B, max_pages] pages owned by each sequence
     page_size: int,
 ) -> Tuple[jnp.ndarray, List[jnp.ndarray]]:

@@ -65,7 +65,7 @@ def causal_prefill_attention(
 
 def paged_attention_xla(
     q: jnp.ndarray,  # [B, nq, d] — one decode token per sequence
-    kv_pages: jnp.ndarray,  # [2, num_pages, nkv, ps, d]
+    kv_pages: jnp.ndarray,  # [num_pages, 2, nkv, ps, d]
     page_table: jnp.ndarray,  # [B, max_pages]
     seq_lens: jnp.ndarray,  # [B] int32 (length INCLUDING current token)
     logit_softcap: float = 0.0,
@@ -77,10 +77,10 @@ def paged_attention_xla(
     ps = kv_pages.shape[3]
     max_pages = page_table.shape[1]
     L = max_pages * ps
-    # gather: [2, B, max_pages, nkv, ps, d]
-    gathered = kv_pages[:, page_table]
-    k = gathered[0].transpose(0, 1, 3, 2, 4).reshape(B, L, nkv, d)
-    v = gathered[1].transpose(0, 1, 3, 2, 4).reshape(B, L, nkv, d)
+    # gather: [B, max_pages, 2, nkv, ps, d]
+    gathered = kv_pages[page_table]
+    k = gathered[:, :, 0].transpose(0, 1, 3, 2, 4).reshape(B, L, nkv, d)
+    v = gathered[:, :, 1].transpose(0, 1, 3, 2, 4).reshape(B, L, nkv, d)
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     scores = _gqa_scores(q[:, None], k) * scale  # [B,nq,1,L]
     if logit_softcap > 0.0:
@@ -93,6 +93,18 @@ def paged_attention_xla(
     return out[:, 0].astype(q.dtype)
 
 
+# Auto-dispatch threshold, in page-table width (pages).  Measured e2e on one
+# v5e chip (B=48, bench_1b, page_size=16, 2026-07-29):
+#   width 16 (256-tok ctx):  gather 1671 tok/s  vs kernel 1146  -> gather
+#   width 40 (640-tok ctx):  gather  847 tok/s  vs kernel  809  -> gather
+#   width 72 (1152-tok ctx): gather  603 tok/s  vs kernel  636  -> kernel
+# The gather path writes a [B, width*ps, nkv, d] copy of the live KV before
+# attention; the kernel streams pages once.  The copy's extra traffic grows
+# with width, the kernel's serial per-sequence grid cost does not — they
+# cross between 40 and 72 pages.
+PALLAS_MIN_PAGES = 64
+
+
 def paged_attention(
     q: jnp.ndarray,
     kv_pages: jnp.ndarray,
@@ -101,14 +113,16 @@ def paged_attention(
     logit_softcap: float = 0.0,
     use_pallas: Optional[bool] = None,
 ) -> jnp.ndarray:
-    """Dispatch.  Default is the XLA gather path: with page-table width
-    bucketing it is faster end-to-end at short/medium context AND it keeps
-    XLA's buffer aliasing intact — the Pallas custom-call currently forces
-    per-layer KV-cache copies (layout mismatch at the custom-call boundary;
-    measured 922 vs 1577 tok/s at 4k pages).  Opt in to the kernel
-    (use_pallas=True) for long-context decode where gather width dominates;
-    fixing the layout contract is a round-2 item."""
+    """Dispatch between the fused Pallas kernel and the XLA gather path.
+
+    use_pallas=None (default) auto-selects: the kernel for long-context
+    batches (page-table width >= PALLAS_MIN_PAGES and a supported head_dim),
+    the gather otherwise — each path where it measures faster (table above).
+    True forces the kernel (raising on unsupported head_dim rather than
+    silently benchmarking the gather); False forces the gather."""
     d = q.shape[-1]
+    if use_pallas is None:
+        use_pallas = d % 128 == 0 and page_table.shape[1] >= PALLAS_MIN_PAGES
     if use_pallas:
         # loud, not silent: an explicit opt-in with an unsupported head_dim
         # must not quietly benchmark the XLA path

@@ -82,7 +82,7 @@ def param_pspecs(config: LlamaConfig) -> Dict[str, Any]:
 
 
 def kv_pages_pspec() -> P:
-    """[2, num_pages, n_kv, ps, d] — shard KV heads over model axis."""
+    """[num_pages, 2, n_kv, ps, d] — shard KV heads over model axis."""
     return P(None, None, MODEL_AXIS, None, None)
 
 

@@ -28,7 +28,7 @@ KV_META_HEADER = "X-KV-Meta"
 
 
 def serialize_kv(kv: np.ndarray, first_token: int) -> Tuple[str, bytes]:
-    """(meta-json, payload) for one sequence's KV [L, 2, P, n_kv, ps, d]."""
+    """(meta-json, payload) for one sequence's KV [L, P, 2, n_kv, ps, d]."""
     meta = {
         "shape": list(kv.shape),
         "dtype": str(kv.dtype),
@@ -109,7 +109,7 @@ class PrefillClient:
     async def prefill(
         self, model_name: str, prompt_ids, params: SamplingParams
     ) -> Tuple[np.ndarray, int]:
-        """Returns (kv [L, 2, P, n_kv, ps, d], first_token)."""
+        """Returns (kv [L, P, 2, n_kv, ps, d], first_token)."""
         session = await self._get_session()
         url = f"{self.base_url}/v1/prefill/{model_name}"
         async with session.post(

@@ -1,5 +1,9 @@
 """Pallas paged-attention kernel vs XLA reference (interpret mode on CPU;
-the compiled path runs on hardware via bench.py / the engine)."""
+the compiled path runs on hardware via bench.py / the engine).
+
+B=8 with MAX_SB=8 exercises the sequence-block kernel shape (whole block in
+one grid step); B=6 exercises sb<max and the multi-grid-step path; B=5
+exercises the odd-batch divisor fallback."""
 
 import numpy as np
 import pytest
@@ -8,14 +12,15 @@ import jax
 import jax.numpy as jnp
 
 from kserve_tpu.ops.attention import paged_attention_xla
-from kserve_tpu.ops.pallas_paged_attention import paged_attention_pallas
+from kserve_tpu.ops.pallas_paged_attention import _pick_sb, paged_attention_pallas
 
 
-def make_case(B=3, nq=8, nkv=4, d=64, ps=8, num_pages=16, max_pages=4, seed=0,
+def make_case(B=8, nq=8, nkv=4, d=64, ps=8, num_pages=80, max_pages=4, seed=0,
               dtype=jnp.float32):
     rng = np.random.RandomState(seed)
     q = jnp.asarray(rng.randn(B, nq, d), dtype)
-    kv = jnp.asarray(rng.randn(2, num_pages, nkv, ps, d), dtype)
+    # page-major cache layout (kvcache.py): [num_pages, 2, nkv, ps, d]
+    kv = jnp.asarray(rng.randn(num_pages, 2, nkv, ps, d), dtype)
     # distinct pages per sequence, ragged lengths
     page_table = jnp.asarray(
         rng.permutation(np.arange(1, num_pages))[: B * max_pages].reshape(B, max_pages),
@@ -25,29 +30,39 @@ def make_case(B=3, nq=8, nkv=4, d=64, ps=8, num_pages=16, max_pages=4, seed=0,
     return q, kv, page_table, seq_lens
 
 
+def assert_paths_match(q, kv, pt, lens, **kwargs):
+    ref = paged_attention_xla(q, kv, pt, lens, **kwargs)
+    got = paged_attention_pallas(q, kv, pt, lens, interpret=True, **kwargs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+    # guard against a vacuous comparison (both paths reading garbage that
+    # happens to agree): the reference must actually attend to real data
+    assert float(jnp.max(jnp.abs(ref))) > 1e-3
+
+
 class TestPallasPagedAttention:
     @pytest.mark.parametrize("seed", [0, 1])
-    def test_matches_xla(self, seed):
-        q, kv, pt, lens = make_case(seed=seed)
-        ref = paged_attention_xla(q, kv, pt, lens)
-        got = paged_attention_pallas(q, kv, pt, lens, interpret=True)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+    def test_matches_xla_full_block(self, seed):
+        # B == MAX_SB: one grid step owns the whole batch
+        assert_paths_match(*make_case(B=8, seed=seed))
+
+    @pytest.mark.parametrize("B", [6, 5, 16])
+    def test_matches_xla_other_batches(self, B):
+        assert_paths_match(*make_case(B=B, seed=2))
 
     def test_gqa_groups(self):
-        q, kv, pt, lens = make_case(nq=16, nkv=2)
-        ref = paged_attention_xla(q, kv, pt, lens)
-        got = paged_attention_pallas(q, kv, pt, lens, interpret=True)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+        assert_paths_match(*make_case(nq=16, nkv=2))
 
     def test_single_token_sequence(self):
         q, kv, pt, _ = make_case()
-        lens = jnp.asarray([1, 1, 1], jnp.int32)
-        ref = paged_attention_xla(q, kv, pt, lens)
-        got = paged_attention_pallas(q, kv, pt, lens, interpret=True)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+        lens = jnp.ones((q.shape[0],), jnp.int32)
+        assert_paths_match(q, kv, pt, lens)
 
     def test_softcap(self):
-        q, kv, pt, lens = make_case()
-        ref = paged_attention_xla(q, kv, pt, lens, logit_softcap=30.0)
-        got = paged_attention_pallas(q, kv, pt, lens, logit_softcap=30.0, interpret=True)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+        assert_paths_match(*make_case(), logit_softcap=30.0)
+
+    def test_pick_sb_covers_odd_batches(self):
+        assert _pick_sb(48) == 8
+        assert _pick_sb(49) == 7
+        assert _pick_sb(6) == 6
+        assert _pick_sb(5) == 5
+        assert _pick_sb(13) == 1  # prime > MAX_SB: no divisor <= 8 except 1
