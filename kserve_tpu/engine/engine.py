@@ -173,6 +173,13 @@ class LLMEngine:
         self.tokenizer = tokenizer
         self._mlabel = metrics_label
         shd.validate_tp(model_config, engine_config.tp)
+        if engine_config.sp > 1:
+            bad = [b for b in engine_config.prefill_buckets if b % engine_config.sp]
+            if bad:
+                raise ValueError(
+                    f"prefill buckets {bad} not divisible by sp={engine_config.sp} "
+                    "(ring-attention prefill shards the prompt dim over seq)"
+                )
         self.mesh = shd.create_mesh(
             tp=engine_config.tp, dp=1, sp=engine_config.sp, devices=devices
         )
@@ -231,9 +238,51 @@ class LLMEngine:
         rep = shd.named(mesh, jax.sharding.PartitionSpec())
         kv_shard = shd.named(mesh, shd.kv_pages_pspec())
 
+        # the pallas kernel has no GSPMD partitioning rule: under tp/sp>1 it
+        # would force the model-axis-sharded cache to replicate at the
+        # custom-call boundary — resolve the auto choice to the gather there
+        if cfg.use_pallas is None and (cfg.tp > 1 or cfg.sp > 1):
+            from dataclasses import replace as _dc_replace
+
+            cfg = self.config = _dc_replace(cfg, use_pallas=False)
+
+        attention_fn = None
+        if cfg.sp > 1:
+            # sequence-parallel prefill: the prompt dim shards over `seq`,
+            # attention runs as ring attention under shard_map (KV chunks
+            # rotate via ppermute, comms overlap compute); the KV-page
+            # scatter's output sharding is seq-replicated, so XLA inserts
+            # the K/V allgather automatically.  Decode stays seq-replicated
+            # (single-token steps have nothing to shard over seq).
+            from functools import partial as _partial
+
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as _P
+
+            from ..parallel.ring_attention import ring_attention
+
+            qkv_spec = _P(None, shd.SEQ_AXIS, shd.MODEL_AXIS, None)
+            ring_fn = shard_map(
+                _partial(
+                    ring_attention,
+                    axis_name=shd.SEQ_AXIS,
+                    logit_softcap=mc.logit_softcap,
+                ),
+                mesh=mesh,
+                in_specs=(qkv_spec, qkv_spec, qkv_spec, _P(None)),
+                out_specs=qkv_spec,
+                check_rep=False,
+            )
+            attention_fn = lambda q, k, v, vl, softcap: ring_fn(q, k, v, vl)  # noqa: E731
+
         def _prefill(params, tokens, valid_len, kv_pages, page_ids, state, rng):
+            if cfg.sp > 1:
+                tokens = jax.lax.with_sharding_constraint(
+                    tokens, shd.named(mesh, jax.sharding.PartitionSpec(None, shd.SEQ_AXIS))
+                )
             logits, kv_pages = llama.prefill(
-                params, mc, tokens, valid_len, kv_pages, page_ids, cfg.page_size
+                params, mc, tokens, valid_len, kv_pages, page_ids, cfg.page_size,
+                attention_fn=attention_fn,
             )
             # vLLM-parity: repetition_penalty counts prompt tokens as "seen"
             # for the very first sampled token.  Rows with default penalties

@@ -50,6 +50,10 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     attention_bias: bool = False
     logit_softcap: float = 0.0
+    # Mixture-of-Experts (Mixtral-style): n_experts == 0 => dense MLP.
+    # Experts shard over the `model` mesh axis (expert parallelism).
+    n_experts: int = 0
+    n_experts_per_tok: int = 2
     dtype: str = "bfloat16"
 
     def __post_init__(self):
@@ -142,7 +146,10 @@ class LlamaConfig:
         return LlamaConfig(
             vocab_size=cfg["vocab_size"],
             hidden_size=cfg["hidden_size"],
-            intermediate_size=cfg["intermediate_size"],
+            intermediate_size=(
+                cfg["intermediate_size"] if "intermediate_size" in cfg
+                else cfg["ffn_dim"]  # loud KeyError on unsupported configs
+            ),
             n_layers=cfg["num_hidden_layers"],
             n_heads=cfg["num_attention_heads"],
             n_kv_heads=cfg.get("num_key_value_heads", cfg["num_attention_heads"]),
@@ -153,6 +160,9 @@ class LlamaConfig:
             max_position_embeddings=cfg.get("max_position_embeddings", 4096),
             tie_word_embeddings=cfg.get("tie_word_embeddings", False),
             attention_bias=cfg.get("attention_bias", False),
+            # MixtralForCausalLM fields
+            n_experts=cfg.get("num_local_experts", 0),
+            n_experts_per_tok=cfg.get("num_experts_per_tok", 2),
         )
 
 
@@ -169,7 +179,7 @@ def init_params(config: LlamaConfig, rng: jax.Array, scale: float = 0.02) -> Par
 
     layers = []
     for i in range(config.n_layers):
-        k = jax.random.split(keys[i], 7)
+        k = jax.random.split(keys[i], 8)
         layer = {
             "attn_norm": jnp.ones((h,), dtype),
             "wq": dense(k[0], (h, nq * hd)),
@@ -177,10 +187,17 @@ def init_params(config: LlamaConfig, rng: jax.Array, scale: float = 0.02) -> Par
             "wv": dense(k[2], (h, nkv * hd)),
             "wo": dense(k[3], (nq * hd, h)),
             "mlp_norm": jnp.ones((h,), dtype),
-            "w_gate": dense(k[4], (h, config.intermediate_size)),
-            "w_up": dense(k[5], (h, config.intermediate_size)),
-            "w_down": dense(k[6], (config.intermediate_size, h)),
         }
+        if config.n_experts > 0:
+            E, f = config.n_experts, config.intermediate_size
+            layer["router"] = dense(k[7], (h, E))
+            layer["w_gate"] = dense(k[4], (E, h, f))
+            layer["w_up"] = dense(k[5], (E, h, f))
+            layer["w_down"] = dense(k[6], (E, f, h))
+        else:
+            layer["w_gate"] = dense(k[4], (h, config.intermediate_size))
+            layer["w_up"] = dense(k[5], (h, config.intermediate_size))
+            layer["w_down"] = dense(k[6], (config.intermediate_size, h))
         if config.attention_bias:
             layer["bq"] = jnp.zeros((nq * hd,), dtype)
             layer["bk"] = jnp.zeros((nkv * hd,), dtype)
@@ -211,7 +228,17 @@ def _qkv(layer: Params, x: jnp.ndarray, config: LlamaConfig):
     return q, k, v
 
 
-def _mlp(layer: Params, x: jnp.ndarray) -> jnp.ndarray:
+def _mlp(layer: Params, x: jnp.ndarray, config: LlamaConfig) -> jnp.ndarray:
+    if config.n_experts > 0:
+        from .moe import MoEConfig, moe_mlp
+
+        moe_cfg = MoEConfig(
+            n_experts=config.n_experts,
+            top_k=config.n_experts_per_tok,
+            hidden_size=config.hidden_size,
+            intermediate_size=config.intermediate_size,
+        )
+        return moe_mlp(layer, x, moe_cfg)
     gate = jax.nn.silu(x @ layer["w_gate"])
     up = x @ layer["w_up"]
     return (gate * up) @ layer["w_down"]
@@ -236,9 +263,13 @@ def prefill(
     kv_pages: List[jnp.ndarray],  # per layer [num_pages, 2, nkv, ps, d]
     page_ids: jnp.ndarray,  # [B, max_pages] pages owned by each sequence
     page_size: int,
+    attention_fn=None,  # (q, k, v, valid_len, softcap) -> attn; SP engines
+    # pass a shard_map-wrapped ring_attention here (parallel/ring_attention)
 ) -> Tuple[jnp.ndarray, List[jnp.ndarray]]:
     """Process prompts, write their KV into the cache, return logits at the
     last valid token of each row: [B, vocab]."""
+    if attention_fn is None:
+        attention_fn = causal_prefill_attention
     B, T = tokens.shape
     positions = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, axis=0)
     x = params["embed"][tokens].astype(jnp.dtype(config.dtype))
@@ -249,12 +280,12 @@ def prefill(
         q, k, v = _qkv(layer, h, config)
         q = apply_rope(q, positions, config.rope_theta, config.rope_scaling)
         k = apply_rope(k, positions, config.rope_theta, config.rope_scaling)
-        attn = causal_prefill_attention(q, k, v, valid_len, config.logit_softcap)
+        attn = attention_fn(q, k, v, valid_len, config.logit_softcap)
         attn = attn.reshape(B, T, -1) @ layer["wo"]
         x = residual + attn
         residual = x
         h = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
-        x = residual + _mlp(layer, h)
+        x = residual + _mlp(layer, h, config)
         # scatter the whole batch's K/V into its pages in one op
         pages = write_prompt_kv_batch(pages, k, v, page_ids, valid_len, page_size)
         new_pages.append(pages)
@@ -301,7 +332,7 @@ def decode_step(
         x = residual + attn
         residual = x
         h = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
-        x = residual + _mlp(layer, h)
+        x = residual + _mlp(layer, h, config)
         new_pages.append(pages)
     return _logits(params, x, config)[:, 0], new_pages
 
@@ -365,5 +396,19 @@ def load_hf_weights(model_dir: str, config: LlamaConfig) -> Params:
             key = prefix + hf_suffix
             if key in tensors:
                 layer[ours] = to_jnp(tensors[key], ours in _TRANSPOSED)
+        if config.n_experts > 0:
+            # MixtralForCausalLM: block_sparse_moe.gate + per-expert w1/w3/w2
+            # (HF w1=gate, w3=up, w2=down; Linear stores [out, in] -> stack
+            # experts then transpose to our [E, in, out] layout)
+            moe_prefix = prefix + "block_sparse_moe."
+            layer["router"] = to_jnp(tensors[moe_prefix + "gate.weight"], True)
+            for hf_name, ours in (("w1", "w_gate"), ("w3", "w_up"), ("w2", "w_down")):
+                stacked = np.stack(
+                    [
+                        tensors[f"{moe_prefix}experts.{e}.{hf_name}.weight"].T
+                        for e in range(config.n_experts)
+                    ]
+                )
+                layer[ours] = jnp.asarray(stacked).astype(dtype)
         params["layers"].append(layer)
     return params

@@ -122,7 +122,15 @@ def paged_attention(
     silently benchmarking the gather); False forces the gather."""
     d = q.shape[-1]
     if use_pallas is None:
-        use_pallas = d % 128 == 0 and page_table.shape[1] >= PALLAS_MIN_PAGES
+        from .pallas_paged_attention import _pick_sb
+
+        use_pallas = (
+            d % 128 == 0
+            and page_table.shape[1] >= PALLAS_MIN_PAGES
+            # a batch with no divisor <= MAX_SB would run the serialized
+            # sb=1 kernel shape, which loses to the gather
+            and _pick_sb(q.shape[0]) > 1
+        )
     if use_pallas:
         # loud, not silent: an explicit opt-in with an unsupported head_dim
         # must not quietly benchmark the XLA path

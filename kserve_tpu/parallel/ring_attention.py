@@ -32,6 +32,7 @@ def ring_attention(
     valid_len: jnp.ndarray,  # [B] global valid token count
     axis_name: str,
     causal: bool = True,
+    logit_softcap: float = 0.0,
 ) -> jnp.ndarray:
     """Returns the local output chunk [B, C, nq, d]."""
     B, C, nq, d = q.shape
@@ -56,6 +57,8 @@ def ring_attention(
             q32,
             k_r.astype(jnp.float32),
         ) * scale  # [B, C, nkv, group, C_k]
+        if logit_softcap > 0.0:
+            s = jnp.tanh(s / logit_softcap) * logit_softcap
         mask = k_pos[None, :] < valid_len[:, None]  # [B, C_k]
         if causal:
             mask = mask[:, None, :] & (k_pos[None, None, :] <= q_pos[None, :, None])

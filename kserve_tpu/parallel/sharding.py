@@ -52,7 +52,13 @@ def validate_tp(config: LlamaConfig, tp: int) -> None:
             f"n_kv_heads={config.n_kv_heads} not divisible by tp={tp}; "
             "KV-head replication is not implemented yet"
         )
-    if config.intermediate_size % tp != 0:
+    if config.n_experts > 0:
+        if config.n_experts % tp != 0:
+            raise ValueError(
+                f"n_experts={config.n_experts} not divisible by tp={tp} "
+                "(experts shard over the model axis)"
+            )
+    elif config.intermediate_size % tp != 0:
         raise ValueError(f"intermediate_size not divisible by tp={tp}")
 
 
@@ -69,6 +75,13 @@ def param_pspecs(config: LlamaConfig) -> Dict[str, Any]:
         "w_up": P(None, MODEL_AXIS),
         "w_down": P(MODEL_AXIS, None),
     }
+    if config.n_experts > 0:
+        # expert parallelism: the expert dim shards over `model`; XLA
+        # psums the masked combine across expert shards (specs owned by
+        # the MoE op so engine sharding can't drift from its contract)
+        from ..models.moe import moe_param_pspecs
+
+        layer.update(moe_param_pspecs())
     if config.attention_bias:
         layer.update({"bq": P(MODEL_AXIS), "bk": P(MODEL_AXIS), "bv": P(MODEL_AXIS)})
     specs: Dict[str, Any] = {

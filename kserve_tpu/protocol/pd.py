@@ -25,6 +25,8 @@ from ..errors import InvalidInput
 from ..logging import logger
 
 KV_META_HEADER = "X-KV-Meta"
+# bump when the on-wire KV axis order changes (kvcache.py layout)
+KV_WIRE_LAYOUT = "page-major-v2"
 
 
 def serialize_kv(kv: np.ndarray, first_token: int) -> Tuple[str, bytes]:
@@ -33,12 +35,21 @@ def serialize_kv(kv: np.ndarray, first_token: int) -> Tuple[str, bytes]:
         "shape": list(kv.shape),
         "dtype": str(kv.dtype),
         "first_token": int(first_token),
+        # wire-layout version: a mixed-version P/D pair must fail loudly,
+        # not scatter axis-swapped KV that happens to pass the shape check
+        "layout": KV_WIRE_LAYOUT,
     }
     return json.dumps(meta), kv.tobytes()
 
 
 def deserialize_kv(meta_json: str, payload: bytes) -> Tuple[np.ndarray, int]:
     meta = json.loads(meta_json)
+    layout = meta.get("layout")
+    if layout != KV_WIRE_LAYOUT:
+        raise RuntimeError(
+            f"prefill peer sent KV wire layout {layout!r}, this server needs "
+            f"{KV_WIRE_LAYOUT!r}; upgrade the P/D pair together"
+        )
     name = meta["dtype"]
     if name == "bfloat16":
         import ml_dtypes

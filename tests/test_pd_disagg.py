@@ -379,8 +379,9 @@ class TestDetachedBatching:
             n = len(prompt)
 
             def valid_tokens(kv):
-                L, two, P, nkv, ps, d = kv.shape
-                return kv.transpose(0, 1, 2, 4, 3, 5).reshape(
+                # layout [L, P, 2, nkv, ps, d]: token positions = (P, ps)
+                L, P, two, nkv, ps, d = kv.shape
+                return kv.transpose(0, 2, 1, 4, 3, 5).reshape(
                     L, two, P * ps, nkv, d
                 )[:, :, :n]
 

@@ -1,0 +1,134 @@
+"""SP and EP served THROUGH the engine (VERDICT #8) — not standalone ops:
+- an MoE model decodes with experts sharded over the mesh (EP), output
+  bit-identical to the unsharded engine
+- ring-attention prefill (sp>1) serves prompts with output identical to the
+  sp=1 engine, including a long-prompt smoke test on the 8-device mesh
+"""
+
+import asyncio
+
+import pytest
+
+from kserve_tpu.engine.engine import EngineConfig, LLMEngine
+from kserve_tpu.engine.sampling import SamplingParams
+from kserve_tpu.engine.tokenizer import ByteTokenizer
+from kserve_tpu.models.llama import LlamaConfig
+
+from conftest import async_test
+
+
+async def collect(engine, prompt, params):
+    return [o async for o in engine.generate(prompt, params)]
+
+
+def moe_config():
+    return LlamaConfig.tiny(dtype="float32", n_experts=4, n_experts_per_tok=2)
+
+
+def engine_config(**overrides):
+    cfg = dict(
+        max_batch_size=2,
+        page_size=8,
+        num_pages=64,
+        max_pages_per_seq=8,
+        max_prefill_len=32,
+        prefill_buckets=(16, 32),
+        dtype="float32",
+        use_pallas=False,
+    )
+    cfg.update(overrides)
+    return EngineConfig(**cfg)
+
+
+class TestMoEServing:
+    @async_test
+    async def test_moe_engine_generates_with_expert_parallelism(self):
+        params = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+        prompt = [3, 4, 5, 6]
+
+        ref_engine = LLMEngine(moe_config(), engine_config(tp=1), ByteTokenizer(512))
+        await ref_engine.start()
+        try:
+            want = [o.token_id for o in await collect(ref_engine, prompt, params)]
+        finally:
+            await ref_engine.stop()
+
+        ep_engine = LLMEngine(moe_config(), engine_config(tp=2), ByteTokenizer(512))
+        # experts actually sharded: each shard holds E/tp experts
+        w_gate = ep_engine.params["layers"][0]["w_gate"]
+        shard_shapes = {s.data.shape for s in w_gate.addressable_shards}
+        assert shard_shapes == {(2, 64, 128)}  # 4 experts / tp=2
+        await ep_engine.start()
+        try:
+            got = [o.token_id for o in await collect(ep_engine, prompt, params)]
+        finally:
+            await ep_engine.stop()
+        assert got == want
+
+    def test_expert_count_must_divide_tp(self):
+        with pytest.raises(ValueError, match="n_experts"):
+            LLMEngine(
+                LlamaConfig.tiny(dtype="float32", n_experts=3),
+                engine_config(tp=2),
+                ByteTokenizer(512),
+            )
+
+
+class TestSequenceParallelServing:
+    @async_test
+    async def test_sp_prefill_matches_sp1(self):
+        params = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+        prompt = list(range(3, 23))  # 20 tokens -> bucket 32, sharded 16/16
+
+        ref = LLMEngine(
+            LlamaConfig.tiny(dtype="float32"), engine_config(sp=1), ByteTokenizer(512)
+        )
+        await ref.start()
+        try:
+            want = [o.token_id for o in await collect(ref, prompt, params)]
+        finally:
+            await ref.stop()
+
+        sp = LLMEngine(
+            LlamaConfig.tiny(dtype="float32"), engine_config(sp=2), ByteTokenizer(512)
+        )
+        assert sp.mesh.shape["seq"] == 2
+        await sp.start()
+        try:
+            got = [o.token_id for o in await collect(sp, prompt, params)]
+        finally:
+            await sp.stop()
+        assert got == want
+
+    @async_test
+    async def test_long_prompt_over_8_device_ring(self):
+        """A prompt far beyond a single bucket's worth of per-device memory:
+        4096 tokens prefilled over an sp=8 ring, then decode."""
+        cfg = engine_config(
+            max_batch_size=1,
+            page_size=32,
+            num_pages=160,
+            max_pages_per_seq=132,
+            max_prefill_len=4096,
+            prefill_buckets=(4096,),
+            sp=8,
+        )
+        engine = LLMEngine(LlamaConfig.tiny(dtype="float32"), cfg, ByteTokenizer(512))
+        await engine.start()
+        try:
+            prompt = [(7 + i * 13) % 500 + 3 for i in range(4096)]
+            outs = await collect(
+                engine, prompt, SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+            )
+            assert len(outs) == 4
+            assert outs[-1].finished
+        finally:
+            await engine.stop()
+
+    def test_bucket_divisibility_enforced(self):
+        with pytest.raises(ValueError, match="divisible by sp"):
+            LLMEngine(
+                LlamaConfig.tiny(dtype="float32"),
+                engine_config(sp=2, prefill_buckets=(15,), max_prefill_len=15),
+                ByteTokenizer(512),
+            )
