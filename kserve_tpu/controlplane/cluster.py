@@ -17,6 +17,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 from .crds import (
     ClusterServingRuntime,
+    ClusterStorageContainer,
     InferenceGraph,
     InferenceService,
     LLMInferenceService,
@@ -25,6 +26,8 @@ from .crds import (
     ServingRuntime,
     TrainedModel,
 )
+from .credentials import CredentialsBuilder
+from .webhook import PodMutator
 from .default_runtimes import default_runtimes
 from .llmisvc import LLMISVCReconciler
 from .localmodel import LocalModelCacheReconciler
@@ -87,10 +90,24 @@ class ControllerManager:
             for rt in default_runtimes():
                 self.registry.add(rt)
                 self.cluster.apply(rt.model_dump())
-        self.isvc_reconciler = InferenceServiceReconciler(
-            self.registry, ingress_domain=ingress_domain
+        # credentials builder + storage-container selection read live
+        # cluster objects at pod-synthesis time
+        credentials = CredentialsBuilder(
+            secret_getter=lambda name, ns: self.cluster.get("Secret", name, ns),
+            service_account_getter=lambda name, ns: self.cluster.get(
+                "ServiceAccount", name, ns
+            ),
         )
-        self.llm_reconciler = LLMISVCReconciler(ingress_domain=ingress_domain)
+        mutator = PodMutator(
+            credentials=credentials,
+            storage_containers=lambda: self.cluster.list("ClusterStorageContainer"),
+        )
+        self.isvc_reconciler = InferenceServiceReconciler(
+            self.registry, mutator=mutator, ingress_domain=ingress_domain
+        )
+        self.llm_reconciler = LLMISVCReconciler(
+            mutator=mutator, ingress_domain=ingress_domain
+        )
         # node-group membership comes from Node labels in a live cluster;
         # tests/operators set it directly
         self.localmodel_reconciler = LocalModelCacheReconciler()
@@ -98,14 +115,29 @@ class ControllerManager:
     # ---------------- apply entrypoints (the kubectl surface) ----------------
 
     def apply(self, obj) -> dict:
-        """kubectl-apply analogue: validates typed CRDs, stores, reconciles."""
+        """kubectl-apply analogue: validates typed CRDs, stores, reconciles.
+        Secrets/ServiceAccounts (credentials builder inputs) and
+        ClusterStorageContainers are stored without a reconcile pass."""
         if isinstance(obj, dict):
+            if obj.get("kind") in self._RAW_KINDS:
+                return self.cluster.apply(obj)
             obj = self._parse(obj)
+        # hydrate controller-owned status from the store (a kubectl apply
+        # carries no status; reconcilers read it — e.g. the canary rollout's
+        # stable-spec snapshot)
+        if hasattr(obj, "status") and not obj.status:
+            existing = self.cluster.get(
+                obj.kind, obj.metadata.name, obj.metadata.namespace
+            )
+            if existing and existing.get("status"):
+                obj.status = existing["status"]
         stored = self.cluster.apply(obj.model_dump())
         if isinstance(obj, (ServingRuntime, ClusterServingRuntime)):
             self.registry.add(obj)
         elif isinstance(obj, LLMInferenceServiceConfig):
             self.llm_reconciler.presets[obj.metadata.name] = obj
+        elif isinstance(obj, ClusterStorageContainer):
+            pass  # consulted by the mutator at pod-synthesis time
         else:
             self.reconcile_object(obj)
         return stored
@@ -119,7 +151,10 @@ class ControllerManager:
         "TrainedModel": TrainedModel,
         "InferenceGraph": InferenceGraph,
         "LocalModelCache": LocalModelCache,
+        "ClusterStorageContainer": ClusterStorageContainer,
     }
+    # untyped cluster objects the controllers only read
+    _RAW_KINDS = {"Secret", "ServiceAccount", "ConfigMap", "Node", "Pod"}
 
     def _parse(self, obj: dict):
         kind = obj.get("kind")

@@ -103,7 +103,7 @@ class ComponentExtensionSpec(K8sModel):
     scaleMetric: Optional[str] = None  # concurrency|rps|cpu|memory|tokens-per-second
     containerConcurrency: Optional[int] = None
     timeout: Optional[int] = None
-    canaryTrafficPercent: Optional[int] = None
+    canaryTrafficPercent: Optional[int] = Field(default=None, ge=0, le=100)
     batcher: Optional[Dict[str, Any]] = None
     logger: Optional[Dict[str, Any]] = None
 

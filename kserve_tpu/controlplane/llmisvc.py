@@ -26,7 +26,7 @@ from .crds import (
     ParallelismSpec,
     WorkloadSpec,
 )
-from .objects import make_object, set_condition, set_owner, strategic_merge
+from .objects import ensure_probes, make_object, set_condition, set_owner, strategic_merge
 from .topology import plan_slice
 from .webhook import PodMutator
 
@@ -157,7 +157,11 @@ class LLMISVCReconciler:
             isvc_metadata=llm.metadata.model_dump(),
             model=ModelSpec(modelFormat=ModelFormat(name="huggingface"), storageUri=model_uri),
             slice_plan=plan,
+            service_account=pod_spec.get("serviceAccountName") or "default",
         )
+        for c in pod_spec.get("containers", []):
+            if c.get("name") == "main":
+                ensure_probes(c)
         labels = {
             "app": name,
             "serving.kserve.io/llminferenceservice": llm.metadata.name,

@@ -162,3 +162,27 @@ def get_condition(status: dict, cond_type: str) -> Optional[dict]:
         if c["type"] == cond_type:
             return c
     return None
+
+
+def ensure_probes(container: dict, port: int = None) -> dict:
+    """Readiness/liveness probes on a synthesized serving container (parity:
+    config/runtimes/kserve-huggingfaceserver-multinode.yaml:70-100 — every
+    reference runtime pod ships both; user-provided probes win).  The probe
+    port follows the container's declared port so custom containers
+    listening elsewhere don't restart-loop."""
+    if port is None:
+        ports = container.get("ports") or [{}]
+        port = ports[0].get("containerPort", 8080)
+    container.setdefault("readinessProbe", {
+        "httpGet": {"path": "/v2/health/ready", "port": port},
+        "initialDelaySeconds": 5,
+        "periodSeconds": 10,
+        "failureThreshold": 3,
+    })
+    container.setdefault("livenessProbe", {
+        "httpGet": {"path": "/v2/health/live", "port": port},
+        "initialDelaySeconds": 10,
+        "periodSeconds": 10,
+        "failureThreshold": 6,
+    })
+    return container

@@ -29,6 +29,8 @@ from .crds import (
     TPU_RESOURCE,
 )
 from .objects import (
+    deep_copy,
+    ensure_probes,
     make_object,
     merge_container,
     replace_placeholders,
@@ -78,16 +80,61 @@ class InferenceServiceReconciler:
 
         objects: List[dict] = []
         component_urls: Dict[str, str] = {}
+        canary_pct: Optional[int] = None
+        canary_has_stable = False
         for component in COMPONENTS:
             spec = getattr(isvc.spec, component, None)
             if spec is None:
                 continue
+            if component == "predictor" and spec.canaryTrafficPercent is not None:
+                # canary rollout (parity: predictor.go:886-913 raw-mode
+                # traffic split): the NEW spec deploys as {name}-canary; the
+                # last PROMOTED predictor spec (snapshotted in status,
+                # re-rendered here so controller upgrades apply to both
+                # sides) keeps serving as the stable backend; the route
+                # splits by weight.
+                if isvc.spec.transformer is not None:
+                    raise ReconcileError(
+                        "canaryTrafficPercent with a transformer is not "
+                        "supported: the transformer forwards to one "
+                        "predictor host, which would silently bypass the "
+                        "canary split"
+                    )
+                canary_pct = spec.canaryTrafficPercent
+                stable_spec = status.get("stablePredictorSpec")
+                objs, url = self._reconcile_component(
+                    isvc, component, spec, name_suffix="-canary"
+                )
+                if stable_spec:
+                    canary_has_stable = True
+                    stable_objs, _ = self._reconcile_component(
+                        isvc, component, PredictorSpec.model_validate(stable_spec)
+                    )
+                    objs = stable_objs + objs
+                objects.extend(objs)
+                component_urls[component] = url
+                set_condition(status, "PredictorReady", True, reason="Reconciled")
+                continue
             objs, url = self._reconcile_component(isvc, component, spec)
+            if component == "predictor":
+                # promotion point: this spec becomes the stable snapshot the
+                # next canary rollout serves alongside
+                status["stablePredictorSpec"] = spec.model_dump(exclude_none=True)
             objects.extend(objs)
             component_urls[component] = url
             set_condition(status, f"{component.capitalize()}Ready", True, reason="Reconciled")
 
-        objects.append(self._route(isvc, component_urls))
+        objects.append(
+            self._route(
+                isvc, component_urls,
+                canary_pct=canary_pct, canary_has_stable=canary_has_stable,
+            )
+        )
+        if canary_pct is not None:
+            status["canary"] = {"trafficPercent": canary_pct,
+                                "hasStable": canary_has_stable}
+        else:
+            status.pop("canary", None)
         status["components"] = {
             c: {"url": u} for c, u in component_urls.items()
         }
@@ -105,8 +152,9 @@ class InferenceServiceReconciler:
     def _component_name(self, isvc: InferenceService, component: str) -> str:
         return f"{isvc.metadata.name}-{component}"
 
-    def _reconcile_component(self, isvc, component: str, spec) -> Tuple[List[dict], str]:
-        name = self._component_name(isvc, component)
+    def _reconcile_component(self, isvc, component: str, spec,
+                             name_suffix: str = "") -> Tuple[List[dict], str]:
+        name = self._component_name(isvc, component) + name_suffix
         namespace = isvc.metadata.namespace
         if component == "predictor":
             pod_spec, plan = self._predictor_pod_spec(isvc, spec)
@@ -128,6 +176,9 @@ class InferenceServiceReconciler:
             model=spec.resolved_model() if component == "predictor" else None,
             component_spec=spec,
             slice_plan=plan,
+            # the reference's default flow attaches credentials to the
+            # namespace "default" ServiceAccount when none is named
+            service_account=getattr(spec, "serviceAccountName", None) or "default",
         )
         objects = self._raw_objects(isvc, name, spec, pod_spec, plan)
         url = f"http://{name}.{namespace}.{self.ingress_domain}"
@@ -208,6 +259,8 @@ class InferenceServiceReconciler:
             "serving.kserve.io/inferenceservice": isvc.metadata.name,
         }
         replicas = spec.minReplicas if spec.minReplicas is not None else 1
+        if pod_spec.get("containers"):
+            ensure_probes(pod_spec["containers"][0])
         deployment = make_object(
             "apps/v1", "Deployment", name, namespace, labels=dict(labels),
             spec={
@@ -295,19 +348,34 @@ class InferenceServiceReconciler:
             },
         )
 
-    def _route(self, isvc, component_urls: Dict[str, str]) -> dict:
+    def _route(self, isvc, component_urls: Dict[str, str],
+               canary_pct: Optional[int] = None,
+               canary_has_stable: bool = False) -> dict:
         """Gateway-API HTTPRoute: traffic enters at transformer when present,
         else predictor; :predict/:explain split to explainer (parity:
-        ingress_reconciler.go semantics on HTTPRoute instead of Istio VS)."""
+        ingress_reconciler.go semantics on HTTPRoute instead of Istio VS).
+        canaryTrafficPercent becomes weighted backendRefs on the predictor
+        entry (first rollout with no promoted stable gets 100% canary)."""
         name = isvc.metadata.name
         namespace = isvc.metadata.namespace
         entry = "transformer" if "transformer" in component_urls else "predictor"
+        entry_name = self._component_name(isvc, entry)
+        if canary_pct is not None and entry == "predictor":
+            if canary_has_stable:
+                backend_refs = [
+                    {"name": entry_name, "port": 80, "weight": 100 - canary_pct},
+                    {"name": f"{entry_name}-canary", "port": 80, "weight": canary_pct},
+                ]
+            else:
+                backend_refs = [
+                    {"name": f"{entry_name}-canary", "port": 80, "weight": 100}
+                ]
+        else:
+            backend_refs = [{"name": entry_name, "port": 80}]
         rules = [
             {
                 "matches": [{"path": {"type": "PathPrefix", "value": "/"}}],
-                "backendRefs": [
-                    {"name": self._component_name(isvc, entry), "port": 80}
-                ],
+                "backendRefs": backend_refs,
             }
         ]
         if "explainer" in component_urls:

@@ -30,9 +30,35 @@ class PodMutator:
         self,
         storage_initializer_image: str = STORAGE_INITIALIZER_IMAGE,
         agent_image: str = AGENT_IMAGE,
+        credentials=None,  # controlplane.credentials.CredentialsBuilder
+        storage_containers=None,  # () -> [ClusterStorageContainer dicts]
     ):
         self.storage_initializer_image = storage_initializer_image
         self.agent_image = agent_image
+        self.credentials = credentials
+        self.storage_containers = storage_containers
+
+    def _storage_container_for(self, storage_uri: str) -> Optional[dict]:
+        """First ClusterStorageContainer whose supportedUriFormats matches
+        (parity: pkg/apis/serving/v1alpha1/storage_container_types.go
+        prefix/regex matching)."""
+        import re
+
+        if self.storage_containers is None:
+            return None
+        for csc in self.storage_containers():
+            spec = csc.get("spec", {})
+            if not spec.get("container"):
+                continue  # a matching CSC without a container must not
+                # shadow a later valid one
+            for fmt in spec.get("supportedUriFormats", []):
+                prefix = fmt.get("prefix")
+                regex = fmt.get("regex")
+                if (prefix and storage_uri.startswith(prefix)) or (
+                    regex and re.match(regex, storage_uri)
+                ):
+                    return spec["container"]
+        return None
 
     def mutate(
         self,
@@ -41,13 +67,18 @@ class PodMutator:
         model: Optional[ModelSpec] = None,
         component_spec: Any = None,
         slice_plan: Optional[SlicePlan] = None,
+        service_account: Optional[str] = None,
     ) -> dict:
         if slice_plan is not None:
             pod_spec = inject_tpu_resources(pod_spec, slice_plan)
         if model is not None and (model.storageUri or model.storage):
             uri = model.storageUri or (model.storage.storageUri if model.storage else None)
             if uri:
-                pod_spec = self.inject_storage_initializer(pod_spec, uri)
+                pod_spec = self.inject_storage_initializer(
+                    pod_spec, uri,
+                    service_account=service_account,
+                    namespace=isvc_metadata.get("namespace", "default"),
+                )
         if component_spec is not None:
             batcher = getattr(component_spec, "batcher", None)
             logger_spec = getattr(component_spec, "logger", None)
@@ -55,9 +86,15 @@ class PodMutator:
                 pod_spec = self.inject_agent(pod_spec, batcher, logger_spec)
         return pod_spec
 
-    def inject_storage_initializer(self, pod_spec: dict, storage_uri: str) -> dict:
+    def inject_storage_initializer(
+        self, pod_spec: dict, storage_uri: str,
+        service_account: Optional[str] = None, namespace: str = "default",
+    ) -> dict:
         """pvc:// mounts the claim read-only; other schemes get a download
-        init container sharing an emptyDir with the runtime container."""
+        init container sharing an emptyDir with the runtime container.
+        With a CredentialsBuilder configured, the ServiceAccount's secrets
+        wire provider credentials onto the initializer (env secretKeyRefs /
+        GCS credential-file volume — credentials.py)."""
         volumes = pod_spec.setdefault("volumes", [])
         containers = pod_spec.get("containers", [])
         if not containers:
@@ -90,6 +127,15 @@ class PodMutator:
                 "limits": {"cpu": "1", "memory": "4Gi"},
             },
         }
+        # a ClusterStorageContainer matching this URI overrides the default
+        # initializer (custom image/env/resources for exotic stores)
+        custom = self._storage_container_for(storage_uri)
+        if custom:
+            for key in ("image", "env", "resources", "command"):
+                if key in custom:
+                    init[key] = custom[key]
+        if self.credentials is not None:
+            self.credentials.build(service_account, namespace, init, volumes)
         pod_spec.setdefault("initContainers", []).append(init)
         containers[0].setdefault("volumeMounts", []).append(
             {"name": "model-dir", "mountPath": MODEL_MOUNT_PATH, "readOnly": True}

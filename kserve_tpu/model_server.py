@@ -135,6 +135,7 @@ class ModelServer:
             access_log_format=self.access_log_format,
             enable_docs_url=self.enable_docs_url,
             enable_latency_logging=self.enable_latency_logging,
+            reuse_port=getattr(self, "_reuse_port", False),
         )
         await self._rest_server.start()
         if self.enable_grpc:
@@ -159,7 +160,17 @@ class ModelServer:
             await self._rest_server.stop()
 
     def start(self, models: List[BaseModel]) -> None:
-        """Blocking entrypoint."""
+        """Blocking entrypoint.  workers > 1 serves the REST port from N
+        processes sharing it via SO_REUSEPORT (parity: reference
+        protocol/rest/multiprocess/server.py) — predictive serving only;
+        a generative engine owns the accelerator and must stay single."""
+        if self.workers > 1:
+            self._start_multiprocess(models)
+            return
+        self._serve_blocking(models, reuse_port=False)
+
+    def _serve_blocking(self, models: List[BaseModel], reuse_port: bool) -> None:
+        self._reuse_port = reuse_port
 
         async def serve():
             await self.start_async(models)
@@ -175,6 +186,60 @@ class ModelServer:
             await self.stop_async()
 
         asyncio.run(serve())
+
+    def _child_main(self, models: List[BaseModel]) -> None:
+        # one gRPC listener is enough; REST shares the port via SO_REUSEPORT
+        self.enable_grpc = False
+        self._serve_blocking(models, reuse_port=True)
+
+    def _start_multiprocess(self, models: List[BaseModel]) -> None:
+        if any(_has_engine(m) for m in models):
+            raise ValueError(
+                "--workers > 1 is for predictive serving; a generative "
+                "engine owns the accelerator and cannot be forked"
+            )
+        import multiprocessing
+
+        ctx = multiprocessing.get_context("fork")
+        children = [
+            ctx.Process(target=self._child_main, args=(models,), daemon=True)
+            for _ in range(self.workers - 1)
+        ]
+        for child in children:
+            child.start()
+        logger.info(
+            "REST multiprocess: %d workers sharing port %d (SO_REUSEPORT)",
+            self.workers, self.http_port,
+        )
+        # a crashed worker must not silently degrade capacity: a monitor
+        # thread respawns dead children (parity: reference multiprocess
+        # server's process supervision)
+        import threading
+
+        stopping = threading.Event()
+
+        def monitor():
+            while not stopping.wait(5):
+                for i, child in enumerate(children):
+                    if not child.is_alive():
+                        logger.error(
+                            "REST worker pid=%s died (exitcode=%s); respawning",
+                            child.pid, child.exitcode,
+                        )
+                        children[i] = ctx.Process(
+                            target=self._child_main, args=(models,), daemon=True
+                        )
+                        children[i].start()
+
+        threading.Thread(target=monitor, daemon=True).start()
+        try:
+            self._serve_blocking(models, reuse_port=True)
+        finally:
+            stopping.set()
+            for child in children:
+                child.terminate()
+            for child in children:
+                child.join(timeout=self.grace_period)
 
     def _setup_asyncio_executor(self):
         workers = self.max_asyncio_workers
