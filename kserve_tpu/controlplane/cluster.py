@@ -163,6 +163,41 @@ class ControllerManager:
             raise ValueError(f"unknown kind {kind!r}")
         return cls.model_validate(obj)
 
+    def apply_yaml(self, path: str) -> List[dict]:
+        """kubectl-apply -f -R analogue: multi-document YAML files and
+        directories, recursively (so `apply_yaml('config')` installs the
+        whole tree).  CustomResourceDefinition documents are stored raw
+        (schema drift vs crdgen is caught by tests/test_installable_config);
+        everything else takes the typed apply path.  kustomization.yaml
+        files are skipped — they are kubectl -k inputs, not resources."""
+        import os
+
+        import yaml
+
+        paths: List[str] = []
+        if os.path.isdir(path):
+            for root, _, files in sorted(os.walk(path)):
+                for entry in sorted(files):
+                    if entry == "kustomization.yaml":
+                        continue
+                    if entry.endswith((".yaml", ".yml")):
+                        paths.append(os.path.join(root, entry))
+            if not paths:
+                raise ValueError(f"no YAML documents under {path!r}")
+        else:
+            paths = [path]
+        applied = []
+        for file_path in paths:
+            with open(file_path) as f:
+                for doc in yaml.safe_load_all(f):
+                    if not doc:
+                        continue
+                    if doc.get("kind") == "CustomResourceDefinition":
+                        applied.append(self.cluster.apply(doc))
+                        continue
+                    applied.append(self.apply(doc))
+        return applied
+
     def reconcile_object(self, obj) -> None:
         if isinstance(obj, InferenceService):
             desired, status = self.isvc_reconciler.reconcile(obj)

@@ -1,0 +1,164 @@
+"""CustomResourceDefinition YAML generation from the pydantic CRD models.
+
+`python -m kserve_tpu.controlplane.crdgen [out_dir]` renders one CRD
+manifest per kind into config/crd/ (parity: the reference's
+config/crd/full/*.yaml, which controller-gen derives from Go structs —
+here the pydantic schemas are the single source of truth, so the
+installable YAML can never drift from what the controller validates).
+
+Pydantic JSON schemas are normalized to Kubernetes structural-schema rules:
+$defs inlined, titles stripped, Optional anyOf flattened to nullable, and
+free-form dicts marked x-kubernetes-preserve-unknown-fields.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any, Dict, List, Tuple
+
+from . import crds
+
+# kind -> (group, version, scope)
+CRD_KINDS: Dict[str, Tuple[type, str, str, str]] = {
+    "InferenceService": (crds.InferenceService, "serving.kserve.io", "v1beta1", "Namespaced"),
+    "ServingRuntime": (crds.ServingRuntime, "serving.kserve.io", "v1alpha1", "Namespaced"),
+    "ClusterServingRuntime": (crds.ClusterServingRuntime, "serving.kserve.io", "v1alpha1", "Cluster"),
+    "TrainedModel": (crds.TrainedModel, "serving.kserve.io", "v1alpha1", "Namespaced"),
+    "InferenceGraph": (crds.InferenceGraph, "serving.kserve.io", "v1alpha1", "Namespaced"),
+    "LocalModelCache": (crds.LocalModelCache, "serving.kserve.io", "v1alpha1", "Namespaced"),
+    "ClusterStorageContainer": (crds.ClusterStorageContainer, "serving.kserve.io", "v1alpha1", "Cluster"),
+    "LLMInferenceService": (crds.LLMInferenceService, "serving.kserve.io", "v1alpha2", "Namespaced"),
+    "LLMInferenceServiceConfig": (crds.LLMInferenceServiceConfig, "serving.kserve.io", "v1alpha2", "Namespaced"),
+}
+
+_PLURALS = {
+    "InferenceService": "inferenceservices",
+    "ServingRuntime": "servingruntimes",
+    "ClusterServingRuntime": "clusterservingruntimes",
+    "TrainedModel": "trainedmodels",
+    "InferenceGraph": "inferencegraphs",
+    "LocalModelCache": "localmodelcaches",
+    "ClusterStorageContainer": "clusterstoragecontainers",
+    "LLMInferenceService": "llminferenceservices",
+    "LLMInferenceServiceConfig": "llminferenceserviceconfigs",
+}
+
+
+def _normalize(schema: Any, defs: Dict[str, Any], depth: int = 0) -> Any:
+    """Inline $refs and massage a pydantic JSON schema into a Kubernetes
+    structural openAPIV3Schema."""
+    if depth > 40:  # cycle guard; our CRDs are not recursive this deep
+        return {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+    if isinstance(schema, list):
+        return [_normalize(s, defs, depth + 1) for s in schema]
+    if not isinstance(schema, dict):
+        return schema
+    if "$ref" in schema:
+        name = schema["$ref"].split("/")[-1]
+        merged = dict(defs.get(name, {}))
+        merged.update({k: v for k, v in schema.items() if k != "$ref"})
+        return _normalize(merged, defs, depth + 1)
+    out: Dict[str, Any] = {}
+    for key, value in schema.items():
+        if key in ("properties", "patternProperties") and isinstance(value, dict):
+            # property NAMES are not schema keywords: normalize each value
+            # individually so a field named e.g. 'title' or 'anyOf' survives
+            out[key] = {
+                name: _normalize(sub, defs, depth + 1)
+                for name, sub in value.items()
+            }
+            continue
+        if key in ("title", "$defs"):
+            continue
+        if key == "anyOf":
+            variants = [v for v in value if v.get("type") != "null"]
+            nullable = len(variants) != len(value)
+            if len(variants) == 1:
+                inner = _normalize(variants[0], defs, depth + 1)
+                if isinstance(inner, dict):
+                    out.update(inner)
+                if nullable:
+                    out["nullable"] = True
+                continue
+            # heterogeneous unions can't be structural: preserve unknown
+            out.update({"x-kubernetes-preserve-unknown-fields": True})
+            continue
+        if key == "additionalProperties":
+            if value is True or value == {}:
+                out["x-kubernetes-preserve-unknown-fields"] = True
+                continue
+            if value is False:
+                continue  # structural schemas forbid explicit false
+            out[key] = _normalize(value, defs, depth + 1)
+            continue
+        if key == "default" and value in (None, {}, []):
+            continue
+        out[key] = _normalize(value, defs, depth + 1)
+    if out.get("type") == "object" and "properties" not in out and (
+        "additionalProperties" not in out
+    ):
+        out.setdefault("x-kubernetes-preserve-unknown-fields", True)
+    return out
+
+
+def crd_manifest(kind: str) -> dict:
+    model, group, version, scope = CRD_KINDS[kind]
+    plural = _PLURALS[kind]
+    raw = model.model_json_schema()
+    defs = raw.get("$defs", {})
+    schema = _normalize(raw, defs)
+    # metadata is handled by the apiserver, not the CRD schema
+    props = schema.get("properties", {})
+    props["metadata"] = {"type": "object"}
+    props.setdefault("apiVersion", {"type": "string"})
+    props.setdefault("kind", {"type": "string"})
+    schema["properties"] = props
+    schema.pop("required", None)
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{plural}.{group}"},
+        "spec": {
+            "group": group,
+            "names": {
+                "kind": kind,
+                "listKind": f"{kind}List",
+                "plural": plural,
+                "singular": kind.lower(),
+            },
+            "scope": scope,
+            "versions": [
+                {
+                    "name": version,
+                    "served": True,
+                    "storage": True,
+                    "schema": {"openAPIV3Schema": schema},
+                    "subresources": {"status": {}},
+                }
+            ],
+        },
+    }
+
+
+def generate(out_dir: str) -> List[str]:
+    import yaml
+
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+    for kind in CRD_KINDS:
+        manifest = crd_manifest(kind)
+        path = os.path.join(out_dir, f"{_PLURALS[kind]}.yaml")
+        with open(path, "w") as f:
+            f.write("# generated by kserve_tpu.controlplane.crdgen — do not edit\n")
+            yaml.safe_dump(manifest, f, sort_keys=False)
+        written.append(path)
+    return written
+
+
+if __name__ == "__main__":
+    target = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(__file__), "..", "..", "config", "crd"
+    )
+    for path in generate(os.path.abspath(target)):
+        print(path)
