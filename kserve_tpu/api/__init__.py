@@ -1,0 +1,3 @@
+from .client import KServeClient
+
+__all__ = ["KServeClient"]

@@ -1,0 +1,88 @@
+"""KServeClient: the operator-facing Python SDK.
+
+Parity: reference python/kserve/kserve/api/kserve_client.py (create :114,
+get :259, patch :357, delete :481, is_isvc_ready :523, wait_isvc_ready
+:543).  The reference SDK binds to the Kubernetes API server through the
+generated kubernetes client; here the transport is pluggable: the default
+binds to an in-process ControllerManager (the fake apiserver used across
+the control-plane tests).  A custom transport must provide apply(obj),
+apply_yaml(path), get(kind, name, namespace), list(kind, namespace) and
+delete(kind, name, namespace) — e.g. a thin shim over a real apiserver.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Union
+
+
+class KServeClient:
+    def __init__(self, transport=None):
+        if transport is None:
+            from ..controlplane.cluster import ControllerManager
+
+            transport = ControllerManager()
+        self.transport = transport
+
+    # ---------------- CRUD ----------------
+
+    def create(self, resource: dict) -> dict:
+        return self.transport.apply(resource)
+
+    def apply_yaml(self, path: str) -> List[dict]:
+        return self.transport.apply_yaml(path)
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> Optional[dict]:
+        return self.transport.get(kind, name, namespace)
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> List[dict]:
+        return self.transport.list(kind, namespace)
+
+    def patch(self, kind: str, name: str, patch: dict,
+              namespace: str = "default") -> dict:
+        """Strategic-merge patch + re-reconcile."""
+        from ..controlplane.objects import strategic_merge
+
+        existing = self.get(kind, name, namespace)
+        if existing is None:
+            raise KeyError(f"{kind}/{namespace}/{name} not found")
+        merged = strategic_merge(existing, patch)
+        return self.transport.apply(merged)
+
+    def replace(self, resource: dict) -> dict:
+        return self.transport.apply(resource)
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> bool:
+        return self.transport.delete(kind, name, namespace)
+
+    # ---------------- InferenceService conveniences ----------------
+
+    def is_isvc_ready(self, name: str, namespace: str = "default") -> bool:
+        isvc = self.get("InferenceService", name, namespace)
+        if isvc is None:
+            return False
+        for cond in isvc.get("status", {}).get("conditions", []):
+            if cond.get("type") == "Ready":
+                return cond.get("status") in (True, "True")
+        return False
+
+    def wait_isvc_ready(self, name: str, namespace: str = "default",
+                        timeout_seconds: int = 600,
+                        polling_interval: float = 1.0) -> dict:
+        deadline = time.monotonic() + timeout_seconds
+        while time.monotonic() < deadline:
+            if self.is_isvc_ready(name, namespace):
+                return self.get("InferenceService", name, namespace)
+            if hasattr(self.transport, "reconcile_all"):
+                self.transport.reconcile_all()
+            if self.is_isvc_ready(name, namespace):
+                return self.get("InferenceService", name, namespace)
+            time.sleep(polling_interval)
+        raise TimeoutError(
+            f"InferenceService {namespace}/{name} not Ready after "
+            f"{timeout_seconds}s"
+        )
+
+    def isvc_url(self, name: str, namespace: str = "default") -> Optional[str]:
+        isvc = self.get("InferenceService", name, namespace)
+        return (isvc or {}).get("status", {}).get("url")

@@ -163,6 +163,39 @@ class ControllerManager:
             raise ValueError(f"unknown kind {kind!r}")
         return cls.model_validate(obj)
 
+    def get(self, kind: str, name: str, namespace: str = "default") -> Optional[dict]:
+        return self.cluster.get(kind, name, namespace)
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> List[dict]:
+        return self.cluster.list(kind, namespace)
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> bool:
+        """kubectl-delete analogue WITH cascade: objects owned (via
+        ownerReferences) by the deleted object are pruned recursively —
+        without this, deleting an InferenceService would leak its
+        Deployments/Services forever (the reconcile GC only prunes children
+        of owners that still exist)."""
+        deleted = self.cluster.delete(kind, name, namespace)
+        if not deleted:
+            return False
+        queue = [(kind, name, namespace)]
+        while queue:
+            owner_kind, owner_name, owner_ns = queue.pop()
+            for obj in list(self.cluster._objects.values()):
+                meta = obj.get("metadata", {})
+                for ref in meta.get("ownerReferences", []):
+                    if ref.get("kind") == owner_kind and ref.get("name") == owner_name:
+                        child_ns = meta.get("namespace", "")
+                        if child_ns == owner_ns or not child_ns:
+                            self.cluster.delete(
+                                obj.get("kind", ""), meta.get("name", ""), child_ns
+                            )
+                            queue.append(
+                                (obj.get("kind", ""), meta.get("name", ""), child_ns)
+                            )
+                        break
+        return True
+
     def apply_yaml(self, path: str) -> List[dict]:
         """kubectl-apply -f -R analogue: multi-document YAML files and
         directories, recursively (so `apply_yaml('config')` installs the

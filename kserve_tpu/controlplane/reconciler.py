@@ -159,16 +159,39 @@ class InferenceServiceReconciler:
         if component == "predictor":
             pod_spec, plan = self._predictor_pod_spec(isvc, spec)
         else:
+            predictor_host = f"{self._component_name(isvc, 'predictor')}.{namespace}"
             if not spec.containers:
-                raise ReconcileError(f"{component} requires a container")
-            container = dict(spec.containers[0])
-            container.setdefault("name", "kserve-container")
-            if component == "transformer":
-                container.setdefault("args", [])
-                predictor_host = f"{self._component_name(isvc, 'predictor')}.{namespace}"
-                container["args"] = list(container["args"]) + [
-                    f"--predictor_host={predictor_host}",
-                ]
+                if component == "explainer":
+                    # default explainer runtime (runtimes/explainer_server):
+                    # model-agnostic attributions over the predictor API —
+                    # the role the reference fills with artexplainer
+                    container = {
+                        "name": "kserve-container",
+                        "image": "kserve-tpu/explainer:latest",
+                        "command": ["python", "-m",
+                                    "kserve_tpu.runtimes.explainer_server"],
+                        "args": [
+                            f"--model_name={isvc.metadata.name}",
+                            f"--predictor_host={predictor_host}",
+                        ],
+                        "ports": [{"containerPort": 8080, "name": "http"}],
+                    }
+                else:
+                    raise ReconcileError(f"{component} requires a container")
+            else:
+                container = dict(spec.containers[0])
+                container.setdefault("name", "kserve-container")
+                if component == "transformer":
+                    container.setdefault("args", [])
+                    container["args"] = list(container["args"]) + [
+                        f"--predictor_host={predictor_host}",
+                    ]
+            # default resources parity with the reference's
+            # inferenceservice-config defaults for sidecar components
+            container.setdefault("resources", {
+                "requests": {"cpu": "100m", "memory": "256Mi"},
+                "limits": {"cpu": "1", "memory": "2Gi"},
+            })
             pod_spec, plan = {"containers": [container]}, None
         pod_spec = self.mutator.mutate(
             pod_spec,

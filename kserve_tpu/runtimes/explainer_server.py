@@ -1,0 +1,176 @@
+"""Explainer runtime: model-agnostic attributions over a predictor.
+
+The reference ships ART/Alibi wrapper explainers
+(python/artexplainer/artserver.py, python/kserve explainer component wiring
+in pkg/controller/.../components/explainer.go); this runtime rebuilds the
+role TPU-natively: the perturbation batch is generated and the attribution
+math reduced in JAX (one vectorized program), while the black-box model
+stays behind the predictor's REST API.
+
+Two methods, selectable per request or by flag:
+- "permutation": mean |prediction delta| when each feature is resampled
+  from a background distribution (permutation feature importance)
+- "kernelshap": Kernel SHAP with the standard Shapley kernel weights,
+  solved as a weighted least squares over sampled coalitions
+
+Entrypoint:
+    python -m kserve_tpu.runtimes.explainer_server \
+        --model_name=m --predictor_host=host:port [--method=permutation]
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..errors import InvalidInput
+from ..logging import logger
+from ..model import Model, PredictorConfig
+from ..model_server import ModelServer, build_arg_parser
+
+
+def _shapley_kernel_weights(mask_sizes: np.ndarray, n_features: int) -> np.ndarray:
+    """Kernel SHAP coalition weights; degenerate (all-off/all-on) coalitions
+    get a large finite weight instead of infinity."""
+    weights = np.zeros_like(mask_sizes, dtype=np.float64)
+    for i, size in enumerate(mask_sizes):
+        if size == 0 or size == n_features:
+            weights[i] = 1e6
+        else:
+            from math import comb
+
+            weights[i] = (n_features - 1) / (
+                comb(n_features, int(size)) * size * (n_features - size)
+            )
+    return weights
+
+
+class ExplainerModel(Model):
+    """explain() perturbs the instance, batches ONE predictor call, and
+    reduces attributions in JAX."""
+
+    def __init__(
+        self,
+        name: str,
+        predictor_host: str,
+        method: str = "permutation",
+        n_samples: int = 64,
+        seed: int = 0,
+    ):
+        super().__init__(
+            name,
+            predictor_config=PredictorConfig(predictor_host=predictor_host),
+        )
+        if method not in ("permutation", "kernelshap"):
+            raise ValueError(f"unknown explanation method {method!r}")
+        self.method = method
+        self.n_samples = n_samples
+        self.seed = seed
+        self.ready = True
+
+    def load(self) -> bool:
+        self.ready = True
+        return True
+
+    async def _predict_rows(self, rows: np.ndarray, headers) -> np.ndarray:
+        """One batched predictor round-trip for all perturbed rows."""
+        payload = {"instances": rows.tolist()}
+        response = await self._http_predict(payload, headers)
+        preds = response.get("predictions") if isinstance(response, dict) else response
+        arr = np.asarray(preds, dtype=np.float64)
+        if arr.ndim > 1:  # class scores: explain the top class of the base row
+            arr = arr.reshape(arr.shape[0], -1)
+        else:
+            arr = arr[:, None]
+        return arr
+
+    async def explain(self, payload, headers: Optional[Dict[str, str]] = None):
+        instances = payload.get("instances") if isinstance(payload, dict) else None
+        if not instances:
+            raise InvalidInput("explain expects {'instances': [row, ...]}")
+        method = (payload.get("method") if isinstance(payload, dict) else None) or self.method
+        rng = np.random.RandomState(self.seed)
+        x = np.asarray(instances, dtype=np.float64)
+        if x.ndim == 1:
+            x = x[None, :]
+        n_features = x.shape[1]
+        background = np.asarray(
+            payload.get("background") or [np.zeros(n_features).tolist()],
+            dtype=np.float64,
+        )
+        explanations = []
+        for row in x:
+            if method == "permutation":
+                attributions = await self._permutation(row, background, rng, headers)
+            else:
+                attributions = await self._kernelshap(row, background, rng, headers)
+            explanations.append(attributions.tolist())
+        return {"explanations": explanations, "method": method}
+
+    async def _permutation(self, row, background, rng, headers) -> np.ndarray:
+        n = row.shape[0]
+        reps = max(1, self.n_samples // n)
+        rows: List[np.ndarray] = [row]
+        for j in range(n):
+            for _ in range(reps):
+                perturbed = row.copy()
+                bg = background[rng.randint(len(background))]
+                perturbed[j] = bg[j]
+                rows.append(perturbed)
+        preds = await self._predict_rows(np.stack(rows), headers)
+        import jax.numpy as jnp
+
+        base = preds[0]
+        target = int(np.argmax(base))
+        deltas = jnp.asarray(preds[1:, target]).reshape(n, reps)
+        return np.asarray(
+            jnp.abs(jnp.asarray(base[target]) - deltas).mean(axis=1)
+        )
+
+    async def _kernelshap(self, row, background, rng, headers) -> np.ndarray:
+        n = row.shape[0]
+        k = max(self.n_samples, n + 2)
+        masks = rng.randint(0, 2, size=(k, n)).astype(np.float64)
+        masks[0, :] = 0.0
+        masks[1, :] = 1.0
+        bg = background.mean(axis=0)
+        rows = masks * row[None, :] + (1.0 - masks) * bg[None, :]
+        preds = await self._predict_rows(np.vstack([row[None, :], rows]), headers)
+        target = int(np.argmax(preds[0]))
+        y = preds[1:, target]
+        weights = _shapley_kernel_weights(masks.sum(axis=1), n)
+        import jax.numpy as jnp
+
+        # weighted least squares: y ~ masks @ phi + phi0
+        X = jnp.concatenate([jnp.asarray(masks), jnp.ones((k, 1))], axis=1)
+        W = jnp.asarray(weights)[:, None]
+        A = X.T @ (W * X) + 1e-6 * jnp.eye(n + 1)
+        b = X.T @ (W * jnp.asarray(y)[:, None])
+        phi = jnp.linalg.solve(A, b)[:, 0]
+        return np.asarray(phi[:n])
+
+
+def main(argv=None):
+    parent = build_arg_parser()
+    parser = argparse.ArgumentParser(parents=[parent], conflict_handler="resolve")
+    parser.add_argument("--predictor_host", required=True)
+    parser.add_argument("--method", default="permutation",
+                        choices=("permutation", "kernelshap"))
+    parser.add_argument("--n_samples", default=64, type=int)
+    args = parser.parse_args(argv)
+    model = ExplainerModel(
+        args.model_name, args.predictor_host,
+        method=args.method, n_samples=args.n_samples,
+    )
+    logger.info("explainer %s -> predictor %s (%s)",
+                args.model_name, args.predictor_host, args.method)
+    ModelServer(
+        http_port=args.http_port, grpc_port=args.grpc_port,
+        enable_grpc=args.enable_grpc,
+    ).start([model])
+
+
+if __name__ == "__main__":
+    main()

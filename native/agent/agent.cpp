@@ -18,6 +18,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -27,6 +28,7 @@
 #include <cstring>
 #include <deque>
 #include <map>
+#include <fstream>
 #include <functional>
 #include <iostream>
 #include <memory>
@@ -46,8 +48,11 @@ struct Options {
   int max_batchsize = 32;
   int max_latency_ms = 50;  // flush deadline for a partial batch
   bool enable_logger = false;
-  std::string log_url;
+  std::string log_url;           // http://collector/ OR file:///dir (blob sink)
   std::string log_mode = "all";  // all | request | response
+  std::string log_format = "json";   // json | csv (file sink marshaller)
+  int log_batch_size = 16;           // events per flushed file
+  int log_flush_interval_ms = 2000;  // partial-batch flush deadline
 };
 
 Options g_opts;
@@ -260,45 +265,142 @@ bool extract_array(const std::string& body, const std::string& key,
 
 // ---------------------------------------------------------------- logger
 
+// One structured payload event (kept structured so file-sink marshallers
+// can emit csv without re-parsing JSON).
+struct LogEvent {
+  uint64_t id;
+  std::string type;
+  std::string path;
+  std::string payload;
+};
+
+std::string csv_escape(const std::string& s) {
+  std::string out = "\"";
+  for (char c : s) {
+    if (c == '"') out += "\"\"";
+    else out += c;
+  }
+  out += "\"";
+  return out;
+}
+
 class PayloadLogger {
  public:
-  void start() {
+  // true on success; a sink dir we cannot create must fail startup loudly
+  // rather than silently dropping every payload batch
+  bool start() {
+    file_sink_ = g_opts.enable_logger && g_opts.log_url.rfind("file://", 0) == 0;
+    if (file_sink_) {
+      dir_ = g_opts.log_url.substr(7);
+      // mkdir -p: create each path level
+      std::string prefix;
+      for (size_t i = 0; i <= dir_.size(); i++) {
+        if (i == dir_.size() || dir_[i] == '/') {
+          prefix = dir_.substr(0, i);
+          if (!prefix.empty()) ::mkdir(prefix.c_str(), 0755);
+        }
+      }
+      struct stat st {};
+      if (::stat(dir_.c_str(), &st) != 0 || !S_ISDIR(st.st_mode)) {
+        std::cerr << "[agent] cannot create log sink dir " << dir_ << "\n";
+        return false;
+      }
+    }
     worker_ = std::thread([this] { run(); });
+    return true;
   }
   void log(const std::string& type, const std::string& path,
            const std::string& payload) {
     if (!g_opts.enable_logger) return;
     if (g_opts.log_mode == "request" && type != "request") return;
     if (g_opts.log_mode == "response" && type != "response") return;
+    static std::atomic<uint64_t> seq{0};
     std::lock_guard<std::mutex> lk(mu_);
-    queue_.push_back(make_cloudevent(type, path, payload));
+    queue_.push_back(LogEvent{seq++, type, path, payload});
     cv_.notify_one();
   }
 
  private:
-  static std::string make_cloudevent(const std::string& type,
-                                     const std::string& path,
-                                     const std::string& payload) {
-    static std::atomic<uint64_t> seq{0};
+  static std::string make_cloudevent(const LogEvent& e) {
     std::ostringstream out;
-    out << "{\"specversion\":\"1.0\",\"id\":\"" << seq++
+    out << "{\"specversion\":\"1.0\",\"id\":\"" << e.id
         << "\",\"source\":\"kserve-tpu-agent\",\"type\":"
-        << "\"org.kubeflow.serving.inference." << type << "\","
-        << "\"datacontenttype\":\"application/json\",\"path\":\"" << path
-        << "\",\"data\":" << (payload.empty() ? "null" : payload) << "}";
+        << "\"org.kubeflow.serving.inference." << e.type << "\","
+        << "\"datacontenttype\":\"application/json\",\"path\":\"" << e.path
+        << "\",\"data\":" << (e.payload.empty() ? "null" : e.payload) << "}";
     return out.str();
   }
 
   void run() {
+    if (file_sink_) {
+      run_file_sink();
+      return;
+    }
     for (;;) {
-      std::string event;
+      LogEvent event;
       {
         std::unique_lock<std::mutex> lk(mu_);
         cv_.wait(lk, [this] { return !queue_.empty(); });
         event = std::move(queue_.front());
         queue_.pop_front();
       }
-      deliver(event);
+      deliver(make_cloudevent(event));
+    }
+  }
+
+  // blob-store sink (reference pkg/logger/store.go:82-125 +
+  // marshaller_{json,csv}.go): events buffer into batches and each batch
+  // is written as one file (json-lines or csv) under the file:// dir —
+  // in-cluster, that dir is a mounted bucket/PVC
+  void run_file_sink() {
+    std::vector<LogEvent> batch;
+    for (;;) {
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait_for(lk,
+                     std::chrono::milliseconds(g_opts.log_flush_interval_ms),
+                     [this] {
+                       return static_cast<int>(queue_.size()) >=
+                              g_opts.log_batch_size;
+                     });
+        while (!queue_.empty() &&
+               static_cast<int>(batch.size()) < g_opts.log_batch_size) {
+          batch.push_back(std::move(queue_.front()));
+          queue_.pop_front();
+        }
+      }
+      if (!batch.empty()) {
+        write_batch(batch);
+        batch.clear();
+      }
+    }
+  }
+
+  void write_batch(const std::vector<LogEvent>& batch) {
+    const bool csv = g_opts.log_format == "csv";
+    // filename carries wall time + pid: the sink dir persists across agent
+    // restarts and replicas (mounted bucket/PVC), so a process-local
+    // sequence alone would overwrite earlier batches
+    auto now_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                      std::chrono::system_clock::now().time_since_epoch())
+                      .count();
+    std::ostringstream name;
+    name << dir_ << "/payloads-" << now_ms << "-" << ::getpid() << "-"
+         << batch.front().id << "-" << batch.back().id
+         << (csv ? ".csv" : ".jsonl");
+    std::ofstream out(name.str());
+    if (!out) {
+      std::cerr << "[agent] cannot write log batch to " << name.str() << "\n";
+      return;
+    }
+    if (csv) {
+      out << "id,type,path,payload\n";
+      for (const auto& e : batch) {
+        out << e.id << "," << e.type << "," << csv_escape(e.path) << ","
+            << csv_escape(e.payload) << "\n";
+      }
+    } else {
+      for (const auto& e : batch) out << make_cloudevent(e) << "\n";
     }
   }
 
@@ -330,8 +432,10 @@ class PayloadLogger {
 
   std::mutex mu_;
   std::condition_variable cv_;
-  std::deque<std::string> queue_;
+  std::deque<LogEvent> queue_;
   std::thread worker_;
+  bool file_sink_ = false;
+  std::string dir_;
 };
 
 PayloadLogger g_logger;
@@ -571,12 +675,15 @@ int main(int argc, char** argv) {
     else if (arg == "--enable-logger") g_opts.enable_logger = true;
     else if (arg == "--log-url") g_opts.log_url = next();
     else if (arg == "--log-mode") g_opts.log_mode = next();
+    else if (arg == "--log-format") g_opts.log_format = next();
+    else if (arg == "--log-batch-size") g_opts.log_batch_size = std::stoi(next());
+    else if (arg == "--log-flush-interval") g_opts.log_flush_interval_ms = std::stoi(next());
     else {
       std::cerr << "unknown flag: " << arg << "\n";
       return 2;
     }
   }
-  g_logger.start();
+  if (!g_logger.start()) return 1;
 
   int server_fd = ::socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;

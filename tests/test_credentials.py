@@ -127,3 +127,25 @@ class TestClusterStorageContainer:
         mgr.apply(make_isvc(uri="s3://bucket/model"))
         init, _ = initializer_of(mgr)
         assert init["image"] != "example/custom:v1"
+
+
+class TestKServeClient:
+    def test_sdk_lifecycle(self):
+        from kserve_tpu.api import KServeClient
+
+        client = KServeClient()
+        client.create(make_isvc(uri="gs://b/sdk"))
+        isvc = client.wait_isvc_ready("m", timeout_seconds=5)
+        assert client.is_isvc_ready("m")
+        assert client.isvc_url("m").startswith("http://m.default.")
+        # patch flows through strategic merge + reconcile
+        client.patch("InferenceService", "m", {
+            "spec": {"predictor": {"minReplicas": 3}}})
+        dep = client.get("Deployment", "m-predictor")
+        assert dep["spec"]["replicas"] == 3
+        assert client.delete("InferenceService", "m") is True
+        assert client.get("InferenceService", "m") is None
+        # cascade: owned children are pruned, not leaked
+        assert client.get("Deployment", "m-predictor") is None
+        assert client.get("Service", "m-predictor") is None
+        assert client.get("HTTPRoute", "m") is None

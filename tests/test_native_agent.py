@@ -29,7 +29,12 @@ def free_port():
 
 @pytest.fixture(scope="module")
 def agent_binary():
-    if not AGENT_BIN.exists():
+    src = AGENT_DIR / "agent.cpp"
+    stale = (
+        not AGENT_BIN.exists()
+        or src.stat().st_mtime > AGENT_BIN.stat().st_mtime
+    )
+    if stale:
         if shutil.which("g++") is None:
             pytest.skip("no g++ toolchain")
         subprocess.run(["make", "-C", str(AGENT_DIR)], check=True)
@@ -126,6 +131,94 @@ async def test_agent_latency_flush(agent_binary):
         elapsed = time.perf_counter() - start
         assert r.json()["predictions"] == [10]
         assert elapsed < 2.0  # flushed by the 100ms timer, not stuck
+    finally:
+        proc.terminate()
+        await runner.cleanup()
+
+
+@async_test
+async def test_file_sink_jsonl_batching(agent_binary, tmp_path):
+    """Blob-store sink: events batch into json-lines files under file://dir
+    (reference pkg/logger/store.go + marshaller_json.go roles)."""
+    backend = _Backend()
+    backend_port = free_port()
+    agent_port = free_port()
+    runner = web.AppRunner(backend.app())
+    await runner.setup()
+    await web.TCPSite(runner, "127.0.0.1", backend_port).start()
+    log_dir = tmp_path / "payloads"
+    proc = subprocess.Popen(
+        [agent_binary, "--port", str(agent_port), "--component_port", str(backend_port),
+         "--enable-logger", "--log-url", f"file://{log_dir}",
+         "--log-batch-size", "4", "--log-flush-interval", "200"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        await asyncio.sleep(0.3)
+        async with httpx.AsyncClient() as client:
+            for i in range(2):  # 2 predicts -> 4 events (request+response)
+                r = await client.post(
+                    f"http://127.0.0.1:{agent_port}/v1/models/stub:predict",
+                    json={"instances": [[i, i]]}, timeout=10,
+                )
+                assert r.status_code == 200
+        deadline = time.time() + 5
+        files = []
+        while time.time() < deadline:
+            files = sorted(log_dir.glob("payloads-*.jsonl"))
+            if files:
+                break
+            await asyncio.sleep(0.1)
+        assert files, "no batch file written"
+        events = [json.loads(line) for line in files[0].read_text().splitlines()]
+        assert len(events) == 4
+        types = {e["type"] for e in events}
+        assert types == {
+            "org.kubeflow.serving.inference.request",
+            "org.kubeflow.serving.inference.response",
+        }
+        assert events[0]["data"]["instances"] == [[0, 0]]
+    finally:
+        proc.terminate()
+        await runner.cleanup()
+
+
+@async_test
+async def test_file_sink_csv_marshaller(agent_binary, tmp_path):
+    backend = _Backend()
+    backend_port = free_port()
+    agent_port = free_port()
+    runner = web.AppRunner(backend.app())
+    await runner.setup()
+    await web.TCPSite(runner, "127.0.0.1", backend_port).start()
+    log_dir = tmp_path / "csv"
+    proc = subprocess.Popen(
+        [agent_binary, "--port", str(agent_port), "--component_port", str(backend_port),
+         "--enable-logger", "--log-url", f"file://{log_dir}",
+         "--log-format", "csv", "--log-batch-size", "2",
+         "--log-flush-interval", "200"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        await asyncio.sleep(0.3)
+        async with httpx.AsyncClient() as client:
+            r = await client.post(
+                f"http://127.0.0.1:{agent_port}/v1/models/stub:predict",
+                json={"instances": [[5, 6]]}, timeout=10,
+            )
+            assert r.status_code == 200
+        deadline = time.time() + 5
+        files = []
+        while time.time() < deadline:
+            files = sorted(log_dir.glob("payloads-*.csv"))
+            if files:
+                break
+            await asyncio.sleep(0.1)
+        assert files
+        lines = files[0].read_text().splitlines()
+        assert lines[0] == "id,type,path,payload"
+        assert len(lines) == 3  # header + request + response
+        assert "request" in lines[1] and "[[5,6]]" in lines[1].replace('""', '"').replace(" ", "")
     finally:
         proc.terminate()
         await runner.cleanup()
