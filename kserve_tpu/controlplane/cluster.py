@@ -26,6 +26,7 @@ from .crds import (
     ServingRuntime,
     TrainedModel,
 )
+from ..logging import logger
 from .credentials import CredentialsBuilder
 from .webhook import PodMutator
 from .default_runtimes import default_runtimes
@@ -85,6 +86,7 @@ class ControllerManager:
                  install_default_runtimes: bool = True,
                  ingress_domain: str = "example.com"):
         self.cluster = cluster or FakeCluster()
+        self._default_domain = ingress_domain
         self.registry = RuntimeRegistry()
         if install_default_runtimes:
             for rt in default_runtimes():
@@ -120,7 +122,9 @@ class ControllerManager:
         ClusterStorageContainers are stored without a reconcile pass."""
         if isinstance(obj, dict):
             if obj.get("kind") in self._RAW_KINDS:
-                return self.cluster.apply(obj)
+                stored_raw = self.cluster.apply(obj)
+                self._on_raw_applied(obj)
+                return stored_raw
             obj = self._parse(obj)
         # hydrate controller-owned status from the store (a kubectl apply
         # carries no status; reconcilers read it — e.g. the canary rollout's
@@ -131,10 +135,14 @@ class ControllerManager:
             )
             if existing and existing.get("status"):
                 obj.status = existing["status"]
-        stored = self.cluster.apply(obj.model_dump())
         if isinstance(obj, (ServingRuntime, ClusterServingRuntime)):
+            # admission path (parity: servingruntime validating webhook):
+            # registry.add validates and must REJECT BEFORE PERSISTENCE —
+            # a rejected runtime must not linger in the store
             self.registry.add(obj)
-        elif isinstance(obj, LLMInferenceServiceConfig):
+            return self.cluster.apply(obj.model_dump())
+        stored = self.cluster.apply(obj.model_dump())
+        if isinstance(obj, LLMInferenceServiceConfig):
             self.llm_reconciler.presets[obj.metadata.name] = obj
         elif isinstance(obj, ClusterStorageContainer):
             pass  # consulted by the mutator at pod-synthesis time
@@ -163,6 +171,78 @@ class ControllerManager:
             raise ValueError(f"unknown kind {kind!r}")
         return cls.model_validate(obj)
 
+    CONTROLLER_NAMESPACE = "kserve-system"
+
+    def _on_raw_applied(self, obj: dict) -> None:
+        """Config hot-reload hooks (parity: configmap.go:116 watch +
+        llmisvc/controller.go live reload): the inferenceservice-config
+        ConfigMap retunes images/domains and everything re-reconciles; the
+        global CA bundle ConfigMap switches initializer trust mounting.
+        Only the controller namespace's ConfigMaps count — a tenant
+        ConfigMap with the same name must not retune global config."""
+        if obj.get("kind") != "ConfigMap":
+            return
+        meta = obj.get("metadata", {})
+        if meta.get("namespace") != self.CONTROLLER_NAMESPACE:
+            return
+        name = meta.get("name")
+        if name == "inferenceservice-config":
+            self._load_config(obj.get("data", {}))
+            self.reconcile_all()
+        elif name == "kserve-ca-bundle":
+            self.isvc_reconciler.mutator.ca_bundle_configmap = name
+            self._copy_ca_bundle_to_workload_namespaces(obj)
+            self.reconcile_all()
+
+    def _load_config(self, data: dict) -> None:
+        import json as _json
+
+        from .webhook import AGENT_IMAGE, STORAGE_INITIALIZER_IMAGE
+
+        def section(key):
+            raw = data.get(key)
+            if not raw:
+                return {}
+            if isinstance(raw, dict):
+                return raw
+            try:
+                return _json.loads(raw)
+            except (ValueError, TypeError):
+                logger.warning(
+                    "inferenceservice-config key %r is not valid JSON; ignoring", key
+                )
+                return {}
+
+        mutator = self.isvc_reconciler.mutator
+        # absent keys REVERT to defaults — hot-reload must not ratchet
+        mutator.storage_initializer_image = (
+            section("storageInitializer").get("image") or STORAGE_INITIALIZER_IMAGE
+        )
+        mutator.agent_image = section("agent").get("image") or AGENT_IMAGE
+        domain = section("ingress").get("ingressDomain") or self._default_domain
+        self.isvc_reconciler.ingress_domain = domain
+        self.llm_reconciler.ingress_domain = domain
+
+    def _copy_ca_bundle_to_workload_namespaces(self, source: dict) -> None:
+        """Pods can only mount same-namespace ConfigMaps: mirror the global
+        bundle into every namespace that serves models (parity: the
+        reference cabundleconfigmap reconciler's per-namespace copies)."""
+        namespaces = {
+            o.get("metadata", {}).get("namespace", "default")
+            for kind in ("InferenceService", "LLMInferenceService")
+            for o in self.cluster.list(kind)
+        }
+        for ns in sorted(namespaces):
+            if ns == self.CONTROLLER_NAMESPACE:
+                continue
+            copy = {
+                "apiVersion": "v1",
+                "kind": "ConfigMap",
+                "metadata": {"name": "kserve-ca-bundle", "namespace": ns},
+                "data": dict(source.get("data", {})),
+            }
+            self.cluster.apply(copy)
+
     def get(self, kind: str, name: str, namespace: str = "default") -> Optional[dict]:
         return self.cluster.get(kind, name, namespace)
 
@@ -178,6 +258,14 @@ class ControllerManager:
         deleted = self.cluster.delete(kind, name, namespace)
         if not deleted:
             return False
+        if kind == "ConfigMap" and namespace == self.CONTROLLER_NAMESPACE:
+            # deleting controller config REVERTS it (no ratchet)
+            if name == "inferenceservice-config":
+                self._load_config({})
+                self.reconcile_all()
+            elif name == "kserve-ca-bundle":
+                self.isvc_reconciler.mutator.ca_bundle_configmap = None
+                self.reconcile_all()
         queue = [(kind, name, namespace)]
         while queue:
             owner_kind, owner_name, owner_ns = queue.pop()
@@ -232,6 +320,24 @@ class ControllerManager:
         return applied
 
     def reconcile_object(self, obj) -> None:
+        # a new serving namespace needs its CA-bundle mirror before its pods
+        # can mount it
+        mutator = self.isvc_reconciler.mutator
+        if mutator.ca_bundle_configmap and hasattr(obj, "metadata"):
+            ns = obj.metadata.namespace
+            source = self.cluster.get(
+                "ConfigMap", mutator.ca_bundle_configmap, self.CONTROLLER_NAMESPACE
+            )
+            if source and ns != self.CONTROLLER_NAMESPACE and not self.cluster.get(
+                "ConfigMap", mutator.ca_bundle_configmap, ns
+            ):
+                self.cluster.apply({
+                    "apiVersion": "v1",
+                    "kind": "ConfigMap",
+                    "metadata": {"name": mutator.ca_bundle_configmap,
+                                 "namespace": ns},
+                    "data": dict(source.get("data", {})),
+                })
         if isinstance(obj, InferenceService):
             desired, status = self.isvc_reconciler.reconcile(obj)
         elif isinstance(obj, LLMInferenceService):

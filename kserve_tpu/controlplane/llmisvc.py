@@ -71,7 +71,16 @@ class LLMISVCReconciler:
             objects.append(scaler)
 
         if spec.tracing and spec.tracing.enabled:
-            self._inject_tracing(objects, spec)
+            if not spec.tracing.otlpEndpoint:
+                # no external collector named: reconcile a per-service OTel
+                # collector (parity: reconcilers/otel/otel_reconciler.go:138).
+                # The CR is named {name}-otel because the operator derives
+                # the Service name as {cr}-collector.
+                objects.append(self._otel_collector(llm))
+            self._inject_tracing(objects, spec, default_endpoint=(
+                f"http://{llm.metadata.name}-otel-collector."
+                f"{llm.metadata.namespace}:4317"
+            ))
 
         owner = {
             "apiVersion": llm.apiVersion,
@@ -339,10 +348,38 @@ class LLMISVCReconciler:
             },
         )
 
-    def _inject_tracing(self, objects: List[dict], spec) -> None:
+    def _otel_collector(self, llm) -> dict:
+        """Per-LLMISVC OpenTelemetryCollector (sidecar-less deployment mode)
+        exporting spans to the collector operator's default pipeline."""
+        return make_object(
+            "opentelemetry.io/v1beta1", "OpenTelemetryCollector",
+            f"{llm.metadata.name}-otel", llm.metadata.namespace,
+            spec={
+                "mode": "deployment",
+                "config": {
+                    "receivers": {
+                        "otlp": {"protocols": {"grpc": {"endpoint": "0.0.0.0:4317"}}}
+                    },
+                    "processors": {"batch": {}},
+                    "exporters": {"debug": {}},
+                    "service": {
+                        "pipelines": {
+                            "traces": {
+                                "receivers": ["otlp"],
+                                "processors": ["batch"],
+                                "exporters": ["debug"],
+                            }
+                        }
+                    },
+                },
+            },
+        )
+
+    def _inject_tracing(self, objects: List[dict], spec,
+                        default_endpoint: str = "http://otel-collector:4317") -> None:
         env = [
             {"name": "OTEL_EXPORTER_OTLP_ENDPOINT",
-             "value": spec.tracing.otlpEndpoint or "http://otel-collector:4317"},
+             "value": spec.tracing.otlpEndpoint or default_endpoint},
             {"name": "OTEL_TRACES_SAMPLER", "value": "parentbased_traceidratio"},
             {"name": "OTEL_TRACES_SAMPLER_ARG", "value": spec.tracing.samplingRate or "0.1"},
         ]

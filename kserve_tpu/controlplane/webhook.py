@@ -37,6 +37,11 @@ class PodMutator:
         self.agent_image = agent_image
         self.credentials = credentials
         self.storage_containers = storage_containers
+        # global CA bundle ConfigMap (reference
+        # pkg/controller/.../reconcilers/cabundleconfigmap): when set, the
+        # storage-initializer mounts it and exporters/SDKs trust it
+        self.ca_bundle_configmap: Optional[str] = None
+        self.ca_bundle_mount_path = "/etc/ssl/custom-certs"
 
     def _storage_container_for(self, storage_uri: str) -> Optional[dict]:
         """First ClusterStorageContainer whose supportedUriFormats matches
@@ -136,6 +141,23 @@ class PodMutator:
                     init[key] = custom[key]
         if self.credentials is not None:
             self.credentials.build(service_account, namespace, init, volumes)
+        if self.ca_bundle_configmap:
+            volumes.append({
+                "name": "cabundle",
+                "configMap": {"name": self.ca_bundle_configmap},
+            })
+            init.setdefault("volumeMounts", []).append(
+                {"name": "cabundle", "mountPath": self.ca_bundle_mount_path,
+                 "readOnly": True}
+            )
+            init.setdefault("env", []).extend([
+                {"name": "CA_BUNDLE_CONFIGMAP_NAME",
+                 "value": self.ca_bundle_configmap},
+                {"name": "CA_BUNDLE_VOLUME_MOUNT_POINT",
+                 "value": self.ca_bundle_mount_path},
+                {"name": "AWS_CA_BUNDLE",
+                 "value": f"{self.ca_bundle_mount_path}/cabundle.crt"},
+            ])
         pod_spec.setdefault("initContainers", []).append(init)
         containers[0].setdefault("volumeMounts", []).append(
             {"name": "model-dir", "mountPath": MODEL_MOUNT_PATH, "readOnly": True}

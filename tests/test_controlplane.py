@@ -332,3 +332,111 @@ class TestTrainedModelAndGraph:
         assert dep is not None
         args = dep["spec"]["template"]["spec"]["containers"][0]["args"]
         assert args[0] == "--graph-json"
+
+
+class TestConfigReloadAndAdmission:
+    def _isvc(self, name="cfg"):
+        return {
+            "apiVersion": "serving.kserve.io/v1beta1",
+            "kind": "InferenceService",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"predictor": {"model": {
+                "modelFormat": {"name": "sklearn"},
+                "storageUri": "gs://b/m"}}},
+        }
+
+    def test_inferenceservice_config_hot_reload(self):
+        mgr = ControllerManager()
+        mgr.apply(self._isvc())
+        init = mgr.cluster.get("Deployment", "cfg-predictor")[
+            "spec"]["template"]["spec"]["initContainers"][0]
+        assert init["image"].startswith("kserve-tpu/")
+        mgr.apply({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "inferenceservice-config",
+                         "namespace": "kserve-system"},
+            "data": {
+                "storageInitializer": '{"image": "example/init:v9"}',
+                "ingress": '{"ingressDomain": "models.corp"}',
+            },
+        })
+        # live reload: existing objects re-reconciled with the new config
+        init = mgr.cluster.get("Deployment", "cfg-predictor")[
+            "spec"]["template"]["spec"]["initContainers"][0]
+        assert init["image"] == "example/init:v9"
+        isvc = mgr.cluster.get("InferenceService", "cfg")
+        assert isvc["status"]["url"].endswith("models.corp")
+
+    def test_ca_bundle_configmap_mounts_on_initializer(self):
+        mgr = ControllerManager()
+        mgr.apply(self._isvc())
+        mgr.apply({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "kserve-ca-bundle", "namespace": "kserve-system"},
+            "data": {"cabundle.crt": "---cert---"},
+        })
+        pod = mgr.cluster.get("Deployment", "cfg-predictor")[
+            "spec"]["template"]["spec"]
+        init = pod["initContainers"][0]
+        env = {e["name"]: e.get("value") for e in init["env"]}
+        assert env["CA_BUNDLE_CONFIGMAP_NAME"] == "kserve-ca-bundle"
+        assert env["AWS_CA_BUNDLE"].endswith("cabundle.crt")
+        assert any(v.get("configMap", {}).get("name") == "kserve-ca-bundle"
+                   for v in pod["volumes"])
+        # pods mount same-namespace ConfigMaps only: the bundle is mirrored
+        # into the workload namespace
+        copy = mgr.cluster.get("ConfigMap", "kserve-ca-bundle", "default")
+        assert copy is not None and copy["data"]["cabundle.crt"] == "---cert---"
+        # deleting the source reverts the mounting behavior (no ratchet)
+        mgr.delete("ConfigMap", "kserve-ca-bundle", "kserve-system")
+        init = mgr.cluster.get("Deployment", "cfg-predictor")[
+            "spec"]["template"]["spec"]["initContainers"][0]
+        assert not any(e["name"] == "CA_BUNDLE_CONFIGMAP_NAME"
+                       for e in init.get("env", []))
+
+    def test_duplicate_priority_runtime_rejected_at_apply(self):
+        import pytest
+
+        from kserve_tpu.controlplane.registry import RuntimeSelectionError
+
+        mgr = ControllerManager()
+        with pytest.raises(RuntimeSelectionError, match="duplicate"):
+            mgr.apply({
+                "apiVersion": "serving.kserve.io/v1alpha1",
+                "kind": "ServingRuntime",
+                "metadata": {"name": "dup", "namespace": "default"},
+                "spec": {
+                    "supportedModelFormats": [
+                        {"name": "sklearn", "version": "1", "priority": 1,
+                         "autoSelect": True},
+                        {"name": "sklearn", "version": "1", "priority": 1,
+                         "autoSelect": True},
+                    ],
+                    "containers": [{"name": "kserve-container", "image": "x"}],
+                },
+            })
+        # rejected BEFORE persistence: the store must not contain it
+        assert mgr.cluster.get("ServingRuntime", "dup") is None
+
+    def test_llmisvc_tracing_synthesizes_otel_collector(self):
+        mgr = ControllerManager()
+        mgr.apply({
+            "apiVersion": "serving.kserve.io/v1alpha2",
+            "kind": "LLMInferenceService",
+            "metadata": {"name": "tr", "namespace": "default"},
+            "spec": {
+                "model": {"uri": "hf://org/m", "name": "llm"},
+                "tracing": {"enabled": True},
+            },
+        })
+        # CR named {name}-otel so the operator's derived Service is
+        # {name}-otel-collector (what the injected endpoint points at)
+        otc = mgr.cluster.get("OpenTelemetryCollector", "tr-otel")
+        assert otc is not None
+        assert "otlp" in otc["spec"]["config"]["receivers"]
+        container = mgr.cluster.get("Deployment", "tr-kserve")[
+            "spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e["value"] for e in container["env"]}
+        assert env["OTEL_EXPORTER_OTLP_ENDPOINT"] == (
+            "http://tr-otel-collector.default:4317"
+        )
