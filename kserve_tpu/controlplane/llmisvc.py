@@ -149,6 +149,38 @@ class LLMISVCReconciler:
                 args.append(
                     f"--kv_offload_gib={workload.kvCacheOffloading.hostMemoryGi}"
                 )
+        # LoRA adapters (parity: workload_lora.go): each adapter downloads
+        # into a shared emptyDir via its own init container; the runtime
+        # loads all of them as a stacked multi-adapter batch
+        adapters = getattr(llm.spec.model, "loraAdapters", []) or []
+        adapter_inits: List[dict] = []
+        if adapters:
+            import re as _re
+
+            pairs = []
+            for ad in adapters:
+                ad_name = ad.get("name")
+                ad_uri = ad.get("uri")
+                if not ad_name or not ad_uri:
+                    raise ValueError("loraAdapters entries need name and uri")
+                if not _re.fullmatch(r"[a-z0-9]([-a-z0-9]*[a-z0-9])?", ad_name):
+                    # reject at reconcile time with a clear message instead
+                    # of an opaque apiserver RFC-1123 error on the Deployment
+                    raise ValueError(
+                        f"loraAdapters name {ad_name!r} must be DNS-1123 "
+                        "(lowercase alphanumerics and '-')"
+                    )
+                pairs.append(f"{ad_name}=/mnt/adapters/{ad_name}")
+                adapter_inits.append({
+                    "name": f"lora-{ad_name}",
+                    "image": "kserve-tpu/storage-initializer:latest",
+                    "command": ["python", "-m", "kserve_tpu.storage.initializer"],
+                    "args": [ad_uri, f"/mnt/adapters/{ad_name}"],
+                    "volumeMounts": [
+                        {"name": "lora-adapters", "mountPath": "/mnt/adapters"}
+                    ],
+                })
+            args.append(f"--lora_adapters={','.join(pairs)}")
         container = {
             "name": "main",
             "image": GENERATIVE_IMAGE,
@@ -157,6 +189,13 @@ class LLMISVCReconciler:
             "ports": [{"containerPort": 8080, "name": "http"}],
         }
         pod_spec: dict = {"containers": [container]}
+        if adapters:
+            pod_spec["volumes"] = [{"name": "lora-adapters", "emptyDir": {}}]
+            pod_spec["initContainers"] = adapter_inits
+            container["volumeMounts"] = [
+                {"name": "lora-adapters", "mountPath": "/mnt/adapters",
+                 "readOnly": True}
+            ]
         if workload.template:
             pod_spec = strategic_merge(pod_spec, workload.template)
         from .crds import ModelSpec, ModelFormat
@@ -171,6 +210,21 @@ class LLMISVCReconciler:
         for c in pod_spec.get("containers", []):
             if c.get("name") == "main":
                 ensure_probes(c)
+        if adapters:
+            # adapter downloads get the same image override, credentials and
+            # CA trust as the model's storage-initializer
+            sa = pod_spec.get("serviceAccountName") or "default"
+            for c in pod_spec.get("initContainers", []):
+                if not c["name"].startswith("lora-"):
+                    continue
+                c["image"] = self.mutator.storage_initializer_image
+                c.setdefault("resources", {
+                    "requests": {"cpu": "100m", "memory": "500Mi"},
+                    "limits": {"cpu": "1", "memory": "4Gi"},
+                })
+                self.mutator.apply_initializer_credentials(
+                    c, pod_spec.setdefault("volumes", []), sa, namespace
+                )
         labels = {
             "app": name,
             "serving.kserve.io/llminferenceservice": llm.metadata.name,

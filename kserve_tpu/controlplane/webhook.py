@@ -139,13 +139,29 @@ class PodMutator:
             for key in ("image", "env", "resources", "command"):
                 if key in custom:
                     init[key] = custom[key]
+        self.apply_initializer_credentials(init, volumes, service_account, namespace)
+        pod_spec.setdefault("initContainers", []).append(init)
+        containers[0].setdefault("volumeMounts", []).append(
+            {"name": "model-dir", "mountPath": MODEL_MOUNT_PATH, "readOnly": True}
+        )
+        return pod_spec
+
+    def apply_initializer_credentials(
+        self, init: dict, volumes: list,
+        service_account: Optional[str], namespace: str,
+    ) -> None:
+        """Credentials + CA-bundle wiring shared by every download-style
+        init container (the model storage-initializer AND LoRA adapter
+        downloads) — bypassing this for one of them would leave it unable
+        to reach private storage."""
         if self.credentials is not None:
             self.credentials.build(service_account, namespace, init, volumes)
         if self.ca_bundle_configmap:
-            volumes.append({
-                "name": "cabundle",
-                "configMap": {"name": self.ca_bundle_configmap},
-            })
+            if not any(v.get("name") == "cabundle" for v in volumes):
+                volumes.append({
+                    "name": "cabundle",
+                    "configMap": {"name": self.ca_bundle_configmap},
+                })
             init.setdefault("volumeMounts", []).append(
                 {"name": "cabundle", "mountPath": self.ca_bundle_mount_path,
                  "readOnly": True}
@@ -158,11 +174,6 @@ class PodMutator:
                 {"name": "AWS_CA_BUNDLE",
                  "value": f"{self.ca_bundle_mount_path}/cabundle.crt"},
             ])
-        pod_spec.setdefault("initContainers", []).append(init)
-        containers[0].setdefault("volumeMounts", []).append(
-            {"name": "model-dir", "mountPath": MODEL_MOUNT_PATH, "readOnly": True}
-        )
-        return pod_spec
 
     def inject_agent(self, pod_spec: dict, batcher: Optional[dict],
                      logger_spec: Optional[dict]) -> dict:

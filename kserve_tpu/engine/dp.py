@@ -37,6 +37,7 @@ class DataParallelEngine:
         params: Optional[Any] = None,
         rng_seed: int = 0,
         devices: Optional[list] = None,
+        lora_adapters: Optional[dict] = None,
     ):
         dp = engine_config.dp
         if dp < 2:
@@ -51,6 +52,14 @@ class DataParallelEngine:
         self.config = engine_config
         self.model_config = model_config
         self.tokenizer = tokenizer
+        # stack adapters ONCE; replicas shard the same host arrays
+        lora_stacked = None
+        if lora_adapters:
+            from ..models import lora as lora_mod
+
+            lora_stacked = lora_mod.stack_adapters(
+                lora_adapters, model_config.n_layers, dtype=model_config.dtype
+            )
         replica_cfg = replace(engine_config, dp=1)
         self.replicas: List[LLMEngine] = [
             LLMEngine(
@@ -61,10 +70,12 @@ class DataParallelEngine:
                 rng_seed=rng_seed + g,
                 devices=devices[g * per_replica : (g + 1) * per_replica],
                 metrics_label=f"engine-dp{g}",
+                lora_stacked=lora_stacked,
             )
             for g in range(dp)
         ]
         self.cache_config = self.replicas[0].cache_config
+        self.adapter_ids = self.replicas[0].adapter_ids
         self.mesh = self.replicas[0].mesh  # compat: a replica's submesh
         self._rr = 0  # round-robin cursor for equal-load tie-breaks
 
@@ -112,8 +123,11 @@ class DataParallelEngine:
         prompt_ids: List[int],
         params: SamplingParams,
         request_id: Optional[str] = None,
+        adapter: Optional[str] = None,
     ) -> AsyncIterator[GenerationOutput]:
-        return self._pick().generate(prompt_ids, params, request_id=request_id)
+        return self._pick().generate(
+            prompt_ids, params, request_id=request_id, adapter=adapter
+        )
 
     def generate_injected(
         self,
@@ -122,15 +136,18 @@ class DataParallelEngine:
         kv_data: np.ndarray,
         first_token: int,
         request_id: Optional[str] = None,
+        adapter: Optional[str] = None,
     ) -> AsyncIterator[GenerationOutput]:
         return self._pick().generate_injected(
-            prompt_ids, params, kv_data, first_token, request_id=request_id
+            prompt_ids, params, kv_data, first_token, request_id=request_id,
+            adapter=adapter,
         )
 
     async def prefill_detached(
-        self, prompt_ids: List[int], params: SamplingParams
+        self, prompt_ids: List[int], params: SamplingParams,
+        adapter: Optional[str] = None,
     ) -> Tuple[int, np.ndarray]:
-        return await self._pick().prefill_detached(prompt_ids, params)
+        return await self._pick().prefill_detached(prompt_ids, params, adapter=adapter)
 
     def cancel(self, request_id: str) -> None:
         for eng in self.replicas:
@@ -143,7 +160,9 @@ def build_engine(
     tokenizer: BaseTokenizer,
     params: Optional[Any] = None,
     rng_seed: int = 0,
+    lora_adapters: Optional[dict] = None,
 ):
     """LLMEngine for dp=1, DataParallelEngine for dp>1."""
     cls = DataParallelEngine if engine_config.dp > 1 else LLMEngine
-    return cls(model_config, engine_config, tokenizer, params=params, rng_seed=rng_seed)
+    return cls(model_config, engine_config, tokenizer, params=params,
+               rng_seed=rng_seed, lora_adapters=lora_adapters)

@@ -115,7 +115,7 @@ class _Slot:
 
     __slots__ = (
         "request_id", "prompt_len", "prompt_ids", "pages", "pos", "generated",
-        "params", "queue", "detok", "stop_texts", "admitted_at",
+        "params", "queue", "detok", "stop_texts", "admitted_at", "adapter_id",
     )
 
     def __init__(self):
@@ -127,11 +127,12 @@ class _Slot:
 
 class _QueuedRequest:
     def __init__(self, request_id, prompt_ids, params, queue,
-                 kv_data=None, first_token=None):
+                 kv_data=None, first_token=None, adapter_id=-1):
         self.request_id = request_id
         self.prompt_ids = prompt_ids
         self.params = params
         self.queue = queue
+        self.adapter_id = adapter_id  # LoRA stack row; -1 = base model
         # P/D disaggregation: KV computed by a prefill-role server
         # ([L, P, 2, n_kv, ps, d] host array) plus its sampled first token —
         # admission scatters the pages instead of prefilling
@@ -160,6 +161,8 @@ class LLMEngine:
         rng_seed: int = 0,
         devices: Optional[list] = None,
         metrics_label: str = "engine",
+        lora_adapters: Optional[Dict[str, str]] = None,
+        lora_stacked=None,  # (adapter_ids, per-layer stacks) pre-loaded
     ):
         if engine_config.dp > 1:
             raise ValueError(
@@ -189,6 +192,34 @@ class LLMEngine:
         if params is None:
             params = llama.init_params(model_config, jax.random.PRNGKey(1))
         self.params = shd.shard_params(params, model_config, self.mesh)
+
+        # multi-adapter LoRA: stacked [n_adapters, ...] tensors attached per
+        # layer; a per-slot id selects at runtime (models/lora.py)
+        self.adapter_ids: Dict[str, int] = {}
+        if lora_adapters or lora_stacked:
+            if model_config.n_experts > 0:
+                raise NotImplementedError("LoRA over MoE layers is not supported yet")
+            from ..models import lora as lora_mod
+
+            if lora_stacked is not None:
+                self.adapter_ids, stacks = lora_stacked
+            else:
+                self.adapter_ids, stacks = lora_mod.stack_adapters(
+                    lora_adapters, model_config.n_layers, dtype=model_config.dtype
+                )
+            for i, stack in enumerate(stacks):
+                if not stack:
+                    continue
+                specs = lora_mod.lora_pspecs(stack)
+                self.params["layers"][i]["lora"] = jax.tree.map(
+                    lambda arr, spec: jax.device_put(
+                        arr, jax.sharding.NamedSharding(self.mesh, spec)
+                    ),
+                    stack,
+                    specs,
+                    is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
+                )
+            logger.info("LoRA adapters loaded: %s", sorted(self.adapter_ids))
 
         cache_cfg = KVCacheConfig(
             n_layers=model_config.n_layers,
@@ -275,14 +306,15 @@ class LLMEngine:
             )
             attention_fn = lambda q, k, v, vl, softcap: ring_fn(q, k, v, vl)  # noqa: E731
 
-        def _prefill(params, tokens, valid_len, kv_pages, page_ids, state, rng):
+        def _prefill(params, tokens, valid_len, kv_pages, page_ids, state, rng,
+                     adapter_ids):
             if cfg.sp > 1:
                 tokens = jax.lax.with_sharding_constraint(
                     tokens, shd.named(mesh, jax.sharding.PartitionSpec(None, shd.SEQ_AXIS))
                 )
             logits, kv_pages = llama.prefill(
                 params, mc, tokens, valid_len, kv_pages, page_ids, cfg.page_size,
-                attention_fn=attention_fn,
+                attention_fn=attention_fn, adapter_ids=adapter_ids,
             )
             # vLLM-parity: repetition_penalty counts prompt tokens as "seen"
             # for the very first sampled token.  Rows with default penalties
@@ -320,7 +352,7 @@ class LLMEngine:
             without penalties never pay the per-step [B, V] scatter/gather."""
 
             def fn(params, tokens, pos, kv_pages, page_table, active,
-                   capacity, counters, state, rng, *penalty_args):
+                   capacity, counters, state, rng, adapter_ids, *penalty_args):
                 steps = cfg.steps_per_sync
                 B = tokens.shape[0]
 
@@ -333,6 +365,7 @@ class LLMEngine:
                     logits, kv_pages = llama.decode_step(
                         params, mc, tokens, pos, kv_pages, page_table, live,
                         cfg.page_size, use_pallas=cfg.use_pallas,
+                        adapter_ids=adapter_ids,
                     )
                     if with_penalties:
                         logits = apply_penalties(
@@ -380,9 +413,9 @@ class LLMEngine:
         n_kv_args = 3  # kv_pages is arg index 3 in the prefill/decode sigs
         self._prefill_fn = jax.jit(_prefill, donate_argnums=(n_kv_args,))
         self._decode_fn = jax.jit(_make_decode(False), donate_argnums=(n_kv_args,))
-        # arg 10 = prompt mask (kept across chunks), arg 11 = counts (donated)
+        # arg 11 = prompt mask (kept across chunks), arg 12 = counts (donated)
         self._decode_penalized_fn = jax.jit(
-            _make_decode(True), donate_argnums=(n_kv_args, 11)
+            _make_decode(True), donate_argnums=(n_kv_args, 12)
         )
         self._inject_fn = jax.jit(_inject, donate_argnums=(0,))
 
@@ -414,13 +447,17 @@ class LLMEngine:
     def running(self) -> bool:
         return self._task is not None and not self._task.done()
 
-    async def generate(
+    def generate(
         self,
         prompt_ids: List[int],
         params: SamplingParams,
         request_id: Optional[str] = None,
+        adapter: Optional[str] = None,
     ) -> AsyncIterator[GenerationOutput]:
-        """Submit a request; yields GenerationOutput per emitted token."""
+        """Submit a request; yields GenerationOutput per emitted token.
+        `adapter` selects a loaded LoRA adapter by name (None = base).
+        Validation runs HERE, not at first __anext__ — callers get their
+        ValueError before any stream machinery is involved."""
         if len(prompt_ids) > self.config.max_prefill_len:
             raise ValueError(
                 f"prompt length {len(prompt_ids)} exceeds max_prefill_len "
@@ -432,28 +469,42 @@ class LLMEngine:
             )
         queue: asyncio.Queue = asyncio.Queue()
         rid = request_id or f"req-{time.monotonic_ns()}"
-        req = _QueuedRequest(rid, list(prompt_ids), params, queue)
-        async for out in self._submit_and_stream(req):
-            yield out
+        req = _QueuedRequest(
+            rid, list(prompt_ids), params, queue,
+            adapter_id=self._resolve_adapter(adapter),
+        )
+        return self._submit_and_stream(req)
 
-    async def generate_injected(
+    def _resolve_adapter(self, adapter: Optional[str]) -> int:
+        if adapter is None:
+            return -1
+        if adapter not in self.adapter_ids:
+            raise ValueError(
+                f"unknown LoRA adapter {adapter!r}; loaded: "
+                f"{sorted(self.adapter_ids) or 'none'}"
+            )
+        return self.adapter_ids[adapter]
+
+    def generate_injected(
         self,
         prompt_ids: List[int],
         params: SamplingParams,
         kv_data: np.ndarray,  # [L, P, 2, n_kv, ps, d] from prefill_detached
         first_token: int,
         request_id: Optional[str] = None,
+        adapter: Optional[str] = None,
     ) -> AsyncIterator[GenerationOutput]:
         """P/D disaggregation, decode side: admit a request whose prompt KV
         was computed by a prefill-role server.  The KV pages are scattered
-        into this engine's cache and decoding starts at pos=len(prompt)."""
+        into this engine's cache and decoding starts at pos=len(prompt).
+        Sync validation, async stream (see generate)."""
         if len(prompt_ids) + params.max_tokens > self.config.max_model_len:
             raise ValueError(
                 f"prompt+max_tokens exceeds max_model_len {self.config.max_model_len}"
             )
-        # validate the peer-supplied KV BEFORE it reaches the engine loop —
-        # a shape mismatch inside _run_loop would kill the engine for all
-        # traffic, not just this request (version-skewed prefill peer)
+        # validation runs HERE (sync), not at first __anext__: a shape
+        # mismatch inside _run_loop would kill the engine for all traffic,
+        # not just this request (version-skewed prefill peer)
         kv_data = np.asarray(kv_data)
         cc = self.cache_config
         expect = (
@@ -471,9 +522,9 @@ class LLMEngine:
         req = _QueuedRequest(
             rid, list(prompt_ids), params, queue,
             kv_data=kv_data, first_token=int(first_token),
+            adapter_id=self._resolve_adapter(adapter),
         )
-        async for out in self._submit_and_stream(req):
-            yield out
+        return self._submit_and_stream(req)
 
     async def _submit_and_stream(self, req: "_QueuedRequest"):
         self._waiting.append(req)
@@ -493,7 +544,8 @@ class LLMEngine:
             self.cancel(req.request_id)
 
     async def prefill_detached(
-        self, prompt_ids: List[int], params: SamplingParams
+        self, prompt_ids: List[int], params: SamplingParams,
+        adapter: Optional[str] = None,
     ) -> Tuple[int, np.ndarray]:
         """P/D disaggregation, prefill side: compute the prompt's KV and the
         first sampled token, extract the KV pages to host, release the pages.
@@ -513,7 +565,9 @@ class LLMEngine:
                 f"{self.config.max_prefill_len}"
             )
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
-        self._detached_queue.append((list(prompt_ids), params, fut))
+        self._detached_queue.append(
+            (list(prompt_ids), params, fut, self._resolve_adapter(adapter))
+        )
         if self._detached_task is None or self._detached_task.done():
             self._detached_task = asyncio.create_task(self._detached_worker())
         return await fut
@@ -528,7 +582,7 @@ class LLMEngine:
                 try:
                     self._prefill_detached_batch(batch)
                 except Exception as e:  # noqa: BLE001 — fail the waiters, not the engine
-                    for _, _, fut in batch:
+                    for _, _, fut, _ in batch:
                         if not fut.done():
                             fut.set_exception(e)
             await asyncio.sleep(0)
@@ -537,7 +591,7 @@ class LLMEngine:
         """One compiled prefill over up to prefill_batch detached prompts;
         per-row KV extraction; pages freed after extraction."""
         runnable = []
-        for prompt_ids, params, fut in batch:
+        for prompt_ids, params, fut, adapter_id in batch:
             n_pages = pages_needed(len(prompt_ids), self.config.page_size)
             if not self.allocator.can_allocate(n_pages):
                 fut.set_exception(
@@ -545,7 +599,8 @@ class LLMEngine:
                 )
                 continue
             runnable.append(
-                (prompt_ids, params, fut, self.allocator.allocate(n_pages))
+                (prompt_ids, params, fut, adapter_id,
+                 self.allocator.allocate(n_pages))
             )
         if not runnable:
             return
@@ -556,12 +611,14 @@ class LLMEngine:
         tokens = np.zeros((Bp, bucket), np.int32)
         valid = np.zeros((Bp,), np.int32)
         page_ids = np.zeros((Bp, self.config.max_pages_per_seq), np.int32)
+        adapter_arr = np.full((Bp,), -1, np.int32)
         params_list = [SamplingParams() for _ in range(Bp)]
-        for j, (prompt_ids, params, _, pages) in enumerate(runnable):
+        for j, (prompt_ids, params, _, adapter_id, pages) in enumerate(runnable):
             n = len(prompt_ids)
             tokens[j, :n] = prompt_ids
             valid[j] = n
             page_ids[j, : len(pages)] = pages
+            adapter_arr[j] = adapter_id
             params_list[j] = params
         state = SamplingState.from_params(params_list)
         rng = jax.random.fold_in(self._base_rng, self._next_step())
@@ -574,9 +631,10 @@ class LLMEngine:
                 jnp.asarray(page_ids),
                 state,
                 rng,
+                jnp.asarray(adapter_arr),
             )
             first_np = np.asarray(first)
-            for j, (prompt_ids, _, fut, pages) in enumerate(runnable):
+            for j, (prompt_ids, _, fut, _, pages) in enumerate(runnable):
                 ids = jnp.asarray(np.asarray(pages, np.int32))
                 kv = np.asarray(
                     jnp.stack([layer[ids] for layer in self.kv_pages])
@@ -584,7 +642,7 @@ class LLMEngine:
                 if not fut.done():
                     fut.set_result((int(first_np[j]), kv))
         finally:
-            for _, _, _, pages in runnable:
+            for *_, pages in runnable:
                 self._free_pages(pages)
 
     def cancel(self, request_id: str) -> None:
@@ -690,6 +748,7 @@ class LLMEngine:
         tokens = np.zeros((Bp, bucket), np.int32)
         valid = np.zeros((Bp,), np.int32)
         page_ids = np.zeros((Bp, self.config.max_pages_per_seq), np.int32)
+        adapter_arr = np.full((Bp,), -1, np.int32)
         params_list = [SamplingParams() for _ in range(Bp)]
         for j, (_, req, pages) in enumerate(admitted):
             if req.resume is not None:
@@ -702,6 +761,7 @@ class LLMEngine:
             tokens[j, :n] = seq
             valid[j] = n
             page_ids[j, : len(pages)] = pages
+            adapter_arr[j] = req.adapter_id
             params_list[j] = req.params
         state = SamplingState.from_params(params_list)
         rng = jax.random.fold_in(self._base_rng, self._next_step())
@@ -713,6 +773,7 @@ class LLMEngine:
             jnp.asarray(page_ids),
             state,
             rng,
+            jnp.asarray(adapter_arr),
         )
         first_np = np.asarray(first)
         now = time.perf_counter()
@@ -741,6 +802,7 @@ class LLMEngine:
             slot.detok = IncrementalDetokenizer(self.tokenizer)
             slot.stop_texts = list(req.params.stop or [])
             slot.admitted_at = now
+            slot.adapter_id = req.adapter_id
             self._mark_penalty_dirty(idx)
             self._emit(slot, first_token)
         return True
@@ -769,6 +831,7 @@ class LLMEngine:
         slot.detok = r["detok"]
         slot.stop_texts = r["stop_texts"]
         slot.admitted_at = r["admitted_at"]
+        slot.adapter_id = req.adapter_id
 
     def _admit_injected(self, req: "_QueuedRequest") -> bool:
         """Admit a request whose KV already exists on host: either P/D
@@ -818,6 +881,7 @@ class LLMEngine:
         slot.detok = IncrementalDetokenizer(self.tokenizer)
         slot.stop_texts = list(req.params.stop or [])
         slot.admitted_at = time.perf_counter()
+        slot.adapter_id = req.adapter_id
         PROMPT_TOKENS.labels(model_name=self._mlabel).inc(n)
         self._mark_penalty_dirty(idx)
         self._emit(slot, req.first_token)
@@ -911,7 +975,8 @@ class LLMEngine:
             ENGINE_KV_OFFLOAD_BYTES.labels(model_name=self._mlabel).set(
                 self._offload_bytes
             )
-        req = _QueuedRequest(slot.request_id, slot.prompt_ids, slot.params, slot.queue)
+        req = _QueuedRequest(slot.request_id, slot.prompt_ids, slot.params, slot.queue,
+                             adapter_id=slot.adapter_id)
         req.resume = {
             "generated": slot.generated,
             "detok": slot.detok,
@@ -996,10 +1061,12 @@ class LLMEngine:
             if slot.request_id is not None and active[i]:
                 page_table[i, : len(slot.pages)] = slot.pages
         counters = np.zeros((B,), np.int32)
+        adapters = np.full((B,), -1, np.int32)
         for i, slot in enumerate(self._slots):
             if slot.request_id is not None and active[i]:
                 # tokens generated when this chunk starts (for seeded lanes)
                 counters[i] = int(pos[i]) - slot.prompt_len + 1
+                adapters[i] = slot.adapter_id
         # penalized chunks use device-resident [B, V] count/prompt arrays,
         # rebuilt from the host-side slot lists only when batch composition
         # changed; such chunks are never pipeline-chained so the counts are
@@ -1017,6 +1084,7 @@ class LLMEngine:
             "capacity": capacity,
             "page_table": page_table,
             "counters": counters,
+            "adapters": adapters,
             "state": SamplingState.from_params(params_list),
             "penalized": penalized,
         }
@@ -1077,6 +1145,7 @@ class LLMEngine:
             jnp.asarray(meta["counters"]),
             meta["state"],
             rng,
+            jnp.asarray(meta["adapters"]),
         )
         if meta.get("penalized"):
             chunk, self.kv_pages, self._penalty_counts = self._decode_penalized_fn(

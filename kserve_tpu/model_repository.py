@@ -25,6 +25,17 @@ class ModelRepository:
         self.models_dir = models_dir
 
     def get_model(self, name: str) -> Optional[BaseModel]:
+        model = self._get_model_direct(name)
+        if model is not None:
+            return model
+        # alias resolution: a model may serve under extra names (vLLM-style
+        # LoRA adapters select by the OpenAI `model` field)
+        for candidate in self.models.values():
+            if name in getattr(candidate, "aliases", ()):
+                return candidate
+        return None
+
+    def _get_model_direct(self, name: str) -> Optional[BaseModel]:
         return self.models.get(name)
 
     def get_models(self) -> Dict[str, BaseModel]:

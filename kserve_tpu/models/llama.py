@@ -30,6 +30,7 @@ from ..engine.kvcache import append_token_kv, write_prompt_kv_batch
 from ..ops.attention import causal_prefill_attention, paged_attention
 from ..ops.norms import rms_norm
 from ..ops.rotary import apply_rope
+from .lora import lora_delta
 
 Params = Dict[str, Any]
 
@@ -213,11 +214,17 @@ def init_params(config: LlamaConfig, rng: jax.Array, scale: float = 0.02) -> Par
     return params
 
 
-def _qkv(layer: Params, x: jnp.ndarray, config: LlamaConfig):
+def _maybe_add(y: jnp.ndarray, delta) -> jnp.ndarray:
+    # trace-time decision: the no-LoRA program is unchanged
+    return y if delta is None else y + delta
+
+
+def _qkv(layer: Params, x: jnp.ndarray, config: LlamaConfig, onehot=None):
     B, T, _ = x.shape
-    q = x @ layer["wq"]
-    k = x @ layer["wk"]
-    v = x @ layer["wv"]
+    lora = layer.get("lora")
+    q = _maybe_add(x @ layer["wq"], lora_delta(lora, "wq", x, onehot))
+    k = _maybe_add(x @ layer["wk"], lora_delta(lora, "wk", x, onehot))
+    v = _maybe_add(x @ layer["wv"], lora_delta(lora, "wv", x, onehot))
     if config.attention_bias:
         q = q + layer["bq"]
         k = k + layer["bk"]
@@ -228,7 +235,7 @@ def _qkv(layer: Params, x: jnp.ndarray, config: LlamaConfig):
     return q, k, v
 
 
-def _mlp(layer: Params, x: jnp.ndarray, config: LlamaConfig) -> jnp.ndarray:
+def _mlp(layer: Params, x: jnp.ndarray, config: LlamaConfig, onehot=None) -> jnp.ndarray:
     if config.n_experts > 0:
         from .moe import MoEConfig, moe_mlp
 
@@ -239,9 +246,13 @@ def _mlp(layer: Params, x: jnp.ndarray, config: LlamaConfig) -> jnp.ndarray:
             intermediate_size=config.intermediate_size,
         )
         return moe_mlp(layer, x, moe_cfg)
-    gate = jax.nn.silu(x @ layer["w_gate"])
-    up = x @ layer["w_up"]
-    return (gate * up) @ layer["w_down"]
+    lora = layer.get("lora")
+    gate = jax.nn.silu(
+        _maybe_add(x @ layer["w_gate"], lora_delta(lora, "w_gate", x, onehot))
+    )
+    up = _maybe_add(x @ layer["w_up"], lora_delta(lora, "w_up", x, onehot))
+    h = gate * up
+    return _maybe_add(h @ layer["w_down"], lora_delta(lora, "w_down", h, onehot))
 
 
 def _logits(params: Params, x: jnp.ndarray, config: LlamaConfig) -> jnp.ndarray:
@@ -255,6 +266,19 @@ def _logits(params: Params, x: jnp.ndarray, config: LlamaConfig) -> jnp.ndarray:
     return logits
 
 
+def _adapter_onehot(params: Params, adapter_ids, batch: int):
+    """[B, n_adapters] one-hot from per-slot adapter ids (-1 -> all-zero row
+    -> exact-zero delta -> base model); None when no adapters are loaded."""
+    for layer in params["layers"]:
+        lora = layer.get("lora")
+        if lora:
+            n_a = next(iter(lora.values()))["A"].shape[0]
+            if adapter_ids is None:
+                adapter_ids = jnp.full((batch,), -1, jnp.int32)
+            return jax.nn.one_hot(adapter_ids, n_a, dtype=jnp.float32)
+    return None
+
+
 def prefill(
     params: Params,
     config: LlamaConfig,
@@ -265,27 +289,33 @@ def prefill(
     page_size: int,
     attention_fn=None,  # (q, k, v, valid_len, softcap) -> attn; SP engines
     # pass a shard_map-wrapped ring_attention here (parallel/ring_attention)
+    adapter_ids: Optional[jnp.ndarray] = None,  # [B] LoRA ids (-1 = base)
 ) -> Tuple[jnp.ndarray, List[jnp.ndarray]]:
     """Process prompts, write their KV into the cache, return logits at the
     last valid token of each row: [B, vocab]."""
     if attention_fn is None:
         attention_fn = causal_prefill_attention
     B, T = tokens.shape
+    onehot = _adapter_onehot(params, adapter_ids, B)
     positions = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, axis=0)
     x = params["embed"][tokens].astype(jnp.dtype(config.dtype))
     new_pages = []
     for layer, pages in zip(params["layers"], kv_pages):
         residual = x
         h = rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
-        q, k, v = _qkv(layer, h, config)
+        q, k, v = _qkv(layer, h, config, onehot)
         q = apply_rope(q, positions, config.rope_theta, config.rope_scaling)
         k = apply_rope(k, positions, config.rope_theta, config.rope_scaling)
         attn = attention_fn(q, k, v, valid_len, config.logit_softcap)
-        attn = attn.reshape(B, T, -1) @ layer["wo"]
+        attn_flat = attn.reshape(B, T, -1)
+        attn = _maybe_add(
+            attn_flat @ layer["wo"],
+            lora_delta(layer.get("lora"), "wo", attn_flat, onehot),
+        )
         x = residual + attn
         residual = x
         h = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
-        x = residual + _mlp(layer, h, config)
+        x = residual + _mlp(layer, h, config, onehot)
         # scatter the whole batch's K/V into its pages in one op
         pages = write_prompt_kv_batch(pages, k, v, page_ids, valid_len, page_size)
         new_pages.append(pages)
@@ -304,9 +334,11 @@ def decode_step(
     active: jnp.ndarray,  # [B] bool
     page_size: int,
     use_pallas: Optional[bool] = None,
+    adapter_ids: Optional[jnp.ndarray] = None,  # [B] LoRA ids (-1 = base)
 ) -> Tuple[jnp.ndarray, List[jnp.ndarray]]:
     """One decode token per sequence; returns ([B, vocab] logits, new pages)."""
     B = tokens.shape[0]
+    onehot = _adapter_onehot(params, adapter_ids, B)
     x = params["embed"][tokens][:, None, :].astype(jnp.dtype(config.dtype))  # [B,1,h]
     positions = pos[:, None]
     seq_lens = jnp.where(active, pos + 1, 0)
@@ -314,7 +346,7 @@ def decode_step(
     for layer, pages in zip(params["layers"], kv_pages):
         residual = x
         h = rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
-        q, k, v = _qkv(layer, h, config)
+        q, k, v = _qkv(layer, h, config, onehot)
         q = apply_rope(q, positions, config.rope_theta, config.rope_scaling)
         k = apply_rope(k, positions, config.rope_theta, config.rope_scaling)
         pages = append_token_kv(
@@ -328,11 +360,15 @@ def decode_step(
             logit_softcap=config.logit_softcap,
             use_pallas=use_pallas,
         )
-        attn = attn.reshape(B, 1, -1) @ layer["wo"]
+        attn_flat = attn.reshape(B, 1, -1)
+        attn = _maybe_add(
+            attn_flat @ layer["wo"],
+            lora_delta(layer.get("lora"), "wo", attn_flat, onehot),
+        )
         x = residual + attn
         residual = x
         h = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
-        x = residual + _mlp(layer, h, config)
+        x = residual + _mlp(layer, h, config, onehot)
         new_pages.append(pages)
     return _logits(params, x, config)[:, 0], new_pages
 

@@ -1,0 +1,167 @@
+"""Multi-adapter LoRA for the Llama family, batched S-LoRA style.
+
+All registered adapters live on device as stacked tensors per layer per
+projection — A: [n_adapters, in, r], B: [n_adapters, r, out] (alpha/r folded
+into B at load).  A request selects its adapter with a per-slot id; the
+forward pass applies
+
+    delta = einsum('bth,ahr,aro,ba->bto', x, A, B, onehot(adapter_id))
+
+so one compiled program serves any mix of adapters AND the base model in the
+same continuous batch (id -1 -> all-zero one-hot -> exact zero delta).  No
+per-request weight swapping, no recompiles, and the adapter math rides the
+MXU as two small matmuls.
+
+Parity: the reference's LoRA wiring (workload_lora.go, vLLM --enable-lora);
+checkpoint format is HF PEFT (adapter_config.json +
+adapter_model.safetensors).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# PEFT target_modules -> our projection names (layer dict keys)
+_TARGET_MAP = {
+    "q_proj": "wq",
+    "k_proj": "wk",
+    "v_proj": "wv",
+    "o_proj": "wo",
+    "gate_proj": "w_gate",
+    "up_proj": "w_up",
+    "down_proj": "w_down",
+}
+TARGETS = tuple(_TARGET_MAP.values())
+
+
+def load_peft_adapter(path: str) -> Tuple[dict, Dict[int, Dict[str, tuple]]]:
+    """Read one HF PEFT adapter dir.  Returns (config,
+    {layer_index: {proj: (A [in, r], B [r, out])}}) with alpha/r pre-folded
+    into B."""
+    with open(os.path.join(path, "adapter_config.json")) as f:
+        config = json.load(f)
+    r = int(config["r"])
+    alpha = float(config.get("lora_alpha", r))
+    scale = alpha / r
+    from safetensors import safe_open
+
+    weights = os.path.join(path, "adapter_model.safetensors")
+    tensors: Dict[str, np.ndarray] = {}
+    with safe_open(weights, framework="numpy") as f:
+        for name in f.keys():
+            tensors[name] = f.get_tensor(name)
+    layers: Dict[int, Dict[str, tuple]] = {}
+    for name, arr in tensors.items():
+        # base_model.model.model.layers.{i}.self_attn.q_proj.lora_A.weight
+        parts = name.split(".")
+        if "layers" not in parts or "weight" != parts[-1]:
+            continue
+        i = int(parts[parts.index("layers") + 1])
+        proj_hf = parts[-3]
+        ours = _TARGET_MAP.get(proj_hf)
+        if ours is None:
+            continue
+        kind = parts[-2]  # lora_A | lora_B
+        slot = layers.setdefault(i, {}).setdefault(ours, [None, None])
+        if kind == "lora_A":
+            slot[0] = arr.T  # PEFT stores [r, in] -> ours [in, r]
+        elif kind == "lora_B":
+            slot[1] = arr.T * scale  # [out, r] -> [r, out], fold alpha/r
+    out: Dict[int, Dict[str, tuple]] = {}
+    for i, projs in layers.items():
+        out[i] = {}
+        for proj, (A, B) in projs.items():
+            if A is None or B is None:
+                raise ValueError(
+                    f"adapter {path}: {proj} in layer {i} missing lora_A or lora_B"
+                )
+            out[i][proj] = (A, B)
+    return config, out
+
+
+def stack_adapters(
+    adapter_dirs: Dict[str, str],
+    n_layers: int,
+    dtype: str = "bfloat16",
+) -> Tuple[Dict[str, int], List[Dict[str, Dict[str, jnp.ndarray]]]]:
+    """Load and stack adapters into per-layer device tensors.
+
+    Returns (name -> adapter id, per-layer {proj: {"A": [n, in, r_max],
+    "B": [n, r_max, out]}}).  Ranks are zero-padded to the max — zero rows
+    contribute exactly nothing.  Projections untouched by every adapter are
+    omitted entirely (no dead compute)."""
+    names = sorted(adapter_dirs)
+    loaded = [load_peft_adapter(adapter_dirs[name])[1] for name in names]
+    ids = {name: idx for idx, name in enumerate(names)}
+    jdtype = jnp.dtype(dtype)
+
+    per_layer: List[Dict[str, Dict[str, jnp.ndarray]]] = []
+    for layer_idx in range(n_layers):
+        layer_stack: Dict[str, Dict[str, jnp.ndarray]] = {}
+        for proj in TARGETS:
+            shapes = [
+                adapter.get(layer_idx, {}).get(proj)
+                for adapter in loaded
+            ]
+            present = [s for s in shapes if s is not None]
+            if not present:
+                continue
+            in_dim = present[0][0].shape[0]
+            out_dim = present[0][1].shape[1]
+            r_max = max(ab[0].shape[1] for ab in present)
+            A = np.zeros((len(loaded), in_dim, r_max), np.float32)
+            B = np.zeros((len(loaded), r_max, out_dim), np.float32)
+            for a_idx, ab in enumerate(shapes):
+                if ab is None:
+                    continue
+                r = ab[0].shape[1]
+                A[a_idx, :, :r] = ab[0]
+                B[a_idx, :r, :] = ab[1]
+            layer_stack[proj] = {
+                "A": jnp.asarray(A, jdtype),
+                "B": jnp.asarray(B, jdtype),
+            }
+        per_layer.append(layer_stack)
+    return ids, per_layer
+
+
+def lora_delta(
+    lora: Dict[str, Dict[str, jnp.ndarray]],
+    proj: str,
+    x: jnp.ndarray,  # [B, T, in]
+    onehot: Optional[jnp.ndarray],  # [B, n_adapters]
+) -> jnp.ndarray:
+    """Per-slot adapter delta for one projection, or None when no adapter
+    touches it — None keeps the no-LoRA program literally unchanged (the
+    caller skips the add at trace time).  Rows whose one-hot is all zero
+    (base-model rows) get an exact-zero delta."""
+    entry = lora.get(proj) if lora else None
+    if entry is None or onehot is None:
+        return None
+    return jnp.einsum(
+        "bth,ahr,aro,ba->bto", x, entry["A"], entry["B"], onehot.astype(x.dtype)
+    )
+
+
+def lora_pspecs(layer_stack: Dict[str, Dict[str, jnp.ndarray]]):
+    """PartitionSpecs matching one layer's stack: B's output dim follows the
+    projection's TP sharding (column-parallel projs shard out over `model`);
+    A is replicated (rank dims are tiny)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.sharding import MODEL_AXIS
+
+    col_parallel = {"wq", "wk", "wv", "w_gate", "w_up"}
+    specs: Dict[str, Dict[str, Any]] = {}
+    for proj in layer_stack:
+        if proj in col_parallel:
+            specs[proj] = {"A": P(), "B": P(None, None, MODEL_AXIS)}
+        else:  # row-parallel (wo, w_down): input dim sharded over model
+            specs[proj] = {"A": P(None, MODEL_AXIS, None), "B": P()}
+    return specs

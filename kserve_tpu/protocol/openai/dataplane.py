@@ -63,9 +63,12 @@ class OpenAIDataPlane(DataPlane):
         return await model.create_rerank(request, raw_request, context)
 
     async def models(self) -> ModelList:
-        cards = [
-            ModelCard(id=name)
-            for name, model in self._model_registry.get_models().items()
-            if isinstance(model, OpenAIModel)
-        ]
+        cards = []
+        for name, model in self._model_registry.get_models().items():
+            if not isinstance(model, OpenAIModel):
+                continue
+            cards.append(ModelCard(id=name))
+            # LoRA adapters list as selectable models (vLLM semantics)
+            for alias in getattr(model, "aliases", ()):
+                cards.append(ModelCard(id=alias))
         return ModelList(data=cards)

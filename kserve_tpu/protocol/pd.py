@@ -91,7 +91,10 @@ class PDEndpoints:
         if not isinstance(prompt_ids, list) or not prompt_ids:
             raise InvalidInput("prompt_ids must be a non-empty list")
         params = sampling_params_from_dict(body.get("params") or {})
-        meta_json, payload = await model.handle_prefill(prompt_ids, params)
+        adapter = body.get("adapter")
+        meta_json, payload = await model.handle_prefill(
+            prompt_ids, params, adapter=adapter
+        )
         return web.Response(
             body=payload,
             content_type="application/octet-stream",
@@ -118,7 +121,8 @@ class PrefillClient:
         return self._session
 
     async def prefill(
-        self, model_name: str, prompt_ids, params: SamplingParams
+        self, model_name: str, prompt_ids, params: SamplingParams,
+        adapter: Optional[str] = None,
     ) -> Tuple[np.ndarray, int]:
         """Returns (kv [L, P, 2, n_kv, ps, d], first_token)."""
         session = await self._get_session()
@@ -128,6 +132,7 @@ class PrefillClient:
             json={
                 "prompt_ids": list(prompt_ids),
                 "params": sampling_params_to_dict(params),
+                "adapter": adapter,
             },
         ) as resp:
             if resp.status != 200:

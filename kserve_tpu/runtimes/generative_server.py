@@ -61,6 +61,7 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
         random_weights: bool = False,
         role: str = "both",  # both | prefill | decode (P/D disaggregation)
         prefill_url: Optional[str] = None,  # decode role: prefill peer base URL
+        lora_adapters: Optional[dict] = None,  # name -> local adapter dir
     ):
         super().__init__(name)
         self.model_dir = model_dir
@@ -75,6 +76,11 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
             raise ValueError("role=decode requires --prefill_url (or $PREFILL_URL)")
         self.role = role
         self.prefill_url = prefill_url
+        self.lora_adapters = lora_adapters or {}
+        # adapters are addressable via the OpenAI `model` field: the
+        # registry resolves these aliases back to this model and /v1/models
+        # lists them (vLLM semantics)
+        self.aliases = tuple(sorted(self.lora_adapters))
         self._prefill_client = None
         self.engine: Optional[LLMEngine] = None
         self.tokenizer = None
@@ -104,6 +110,7 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
             self.engine_config,
             self.tokenizer,
             params=getattr(self, "_params", None),
+            lora_adapters=self.lora_adapters or None,
         )
         self._params = None  # free the host copy
         await self.engine.start()
@@ -178,17 +185,20 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
     ):
         prompts = self._encode_prompt(request.prompt)
         params = self._sampling_from(request, max_len_default=16)
+        adapter = self._adapter_for(request)
         if request.stream:
             if len(prompts) > 1 or request.n > 1:
                 raise InvalidInput("streaming supports a single prompt with n=1")
-            return self._stream_completion(request, prompts[0], params)
+            return self._stream_completion(request, prompts[0], params, adapter)
         import asyncio
 
         runs = [
             prompt_ids for prompt_ids in prompts for _ in range(max(request.n, 1))
         ]
         # concurrent submission: the engine batches all of them in one pass
-        results = await asyncio.gather(*[self._run_one(p, params) for p in runs])
+        results = await asyncio.gather(
+            *[self._run_one(p, params, adapter) for p in runs]
+        )
         choices = []
         usage = UsageInfo()
         for idx, (prompt_ids, (text, n_gen, finish)) in enumerate(zip(runs, results)):
@@ -198,7 +208,13 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
         usage.total_tokens = usage.prompt_tokens + usage.completion_tokens
         return Completion(model=request.model, choices=choices, usage=usage)
 
-    def _generate(self, prompt_ids, params):
+    def _adapter_for(self, request) -> Optional[str]:
+        """OpenAI `model` naming a loaded LoRA adapter selects it (vLLM
+        semantics); any other value serves the base model."""
+        name = getattr(request, "model", None)
+        return name if name in self.lora_adapters else None
+
+    def _generate(self, prompt_ids, params, adapter=None):
         """engine.generate with limit errors surfaced as 400s (the checks
         must run before iteration starts — async generators defer their body
         to the first __anext__)."""
@@ -212,10 +228,10 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
                 f"prompt+max_tokens exceeds max_model_len {self.engine.config.max_model_len}"
             )
         if self.role == "decode" and self.prefill_url:
-            return self._generate_disaggregated(prompt_ids, params)
-        return self.engine.generate(prompt_ids, params)
+            return self._generate_disaggregated(prompt_ids, params, adapter)
+        return self.engine.generate(prompt_ids, params, adapter=adapter)
 
-    async def _generate_disaggregated(self, prompt_ids, params):
+    async def _generate_disaggregated(self, prompt_ids, params, adapter=None):
         """Decode role: fetch the prompt's KV from the prefill peer, then
         continue decoding locally from the transferred pages."""
         from ..protocol.pd import PrefillClient
@@ -223,39 +239,41 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
         if self._prefill_client is None:
             self._prefill_client = PrefillClient(self.prefill_url)
         kv, first_token = await self._prefill_client.prefill(
-            self.name, prompt_ids, params
+            self.name, prompt_ids, params, adapter=adapter
         )
         async for out in self.engine.generate_injected(
-            prompt_ids, params, kv, first_token
+            prompt_ids, params, kv, first_token, adapter=adapter
         ):
             yield out
 
-    async def handle_prefill(self, prompt_ids, params):
+    async def handle_prefill(self, prompt_ids, params, adapter=None):
         """Prefill role: serve one detached prefill (protocol/pd.py route)."""
         from ..protocol.pd import serialize_kv
 
         try:
-            first_token, kv = await self.engine.prefill_detached(prompt_ids, params)
+            first_token, kv = await self.engine.prefill_detached(
+                prompt_ids, params, adapter=adapter
+            )
         except ValueError as e:
             raise InvalidInput(str(e)) from e
         return serialize_kv(kv, first_token)
 
-    async def _run_one(self, prompt_ids, params):
+    async def _run_one(self, prompt_ids, params, adapter=None):
         text = ""
         n_gen = 0
         finish = None
-        async for out in self._generate(prompt_ids, params):
+        async for out in self._generate(prompt_ids, params, adapter):
             text += out.text_delta
             n_gen = out.num_generated
             finish = out.finish_reason
         return text, n_gen, finish or "stop"
 
     async def _stream_completion(
-        self, request: CompletionRequest, prompt_ids, params
+        self, request: CompletionRequest, prompt_ids, params, adapter=None
     ) -> AsyncIterator[Completion]:
         completion_id = random_uuid("cmpl-")
         n_gen = 0
-        async for out in self._generate(prompt_ids, params):
+        async for out in self._generate(prompt_ids, params, adapter):
             n_gen = out.num_generated
             chunk = Completion(
                 id=completion_id,
@@ -296,15 +314,16 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
     ):
         prompt_ids = self._chat_prompt(request)
         params = self._sampling_from(request, max_len_default=256)
+        adapter = self._adapter_for(request)
         if request.stream:
             if request.n > 1:
                 raise InvalidInput("streaming supports n=1")
-            return self._stream_chat(request, prompt_ids, params)
+            return self._stream_chat(request, prompt_ids, params, adapter)
         import asyncio
 
         n = max(request.n, 1)
         results = await asyncio.gather(
-            *[self._run_one(prompt_ids, params) for _ in range(n)]
+            *[self._run_one(prompt_ids, params, adapter) for _ in range(n)]
         )
         choices = []
         usage = UsageInfo(prompt_tokens=len(prompt_ids) * n)
@@ -321,7 +340,7 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
         return ChatCompletion(model=request.model, choices=choices, usage=usage)
 
     async def _stream_chat(
-        self, request: ChatCompletionRequest, prompt_ids, params
+        self, request: ChatCompletionRequest, prompt_ids, params, adapter=None
     ) -> AsyncIterator[ChatCompletionChunk]:
         chunk_id = random_uuid("chatcmpl-")
         yield ChatCompletionChunk(
@@ -334,7 +353,7 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
             ],
         )
         n_gen = 0
-        async for out in self._generate(prompt_ids, params):
+        async for out in self._generate(prompt_ids, params, adapter):
             n_gen = out.num_generated
             chunk = ChatCompletionChunk(
                 id=chunk_id,
@@ -387,6 +406,10 @@ def main(argv=None):
     parser.add_argument("--kv_dtype", default="bfloat16", type=str)
     parser.add_argument("--kv_offload", default="none", choices=("none", "host"))
     parser.add_argument("--kv_offload_gib", default=0.0, type=float)
+    parser.add_argument(
+        "--lora_adapters", default=None,
+        help="comma-separated name=/local/adapter/dir (HF PEFT format)",
+    )
     args = parser.parse_args(argv)
 
     model_config = _NAMED_CONFIGS[args.model_config]() if args.model_config else None
@@ -403,6 +426,11 @@ def main(argv=None):
         kv_offload=args.kv_offload,
         kv_offload_gib=args.kv_offload_gib,
     )
+    lora_adapters = None
+    if args.lora_adapters:
+        lora_adapters = dict(
+            pair.split("=", 1) for pair in args.lora_adapters.split(",") if pair
+        )
     model = JAXGenerativeModel(
         args.model_name,
         model_dir=args.model_dir if os.path.isdir(args.model_dir) else None,
@@ -411,6 +439,7 @@ def main(argv=None):
         random_weights=args.random_weights,
         role=args.role,
         prefill_url=args.prefill_url,
+        lora_adapters=lora_adapters,
     )
     model.load()
     ModelServer(

@@ -1,0 +1,251 @@
+"""Multi-adapter LoRA serving (VERDICT missing #6): PEFT loading, batched
+per-slot adapter selection, base-model bit-exactness, controller wiring."""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kserve_tpu.engine.engine import EngineConfig, LLMEngine
+from kserve_tpu.engine.sampling import SamplingParams
+from kserve_tpu.engine.tokenizer import ByteTokenizer
+from kserve_tpu.models.llama import LlamaConfig
+
+from conftest import async_test
+
+
+def write_peft_adapter(path, config: LlamaConfig, seed, r=4, alpha=8,
+                       targets=("q_proj", "v_proj", "up_proj")):
+    """Synthetic HF PEFT adapter dir for the tiny model."""
+    from safetensors.numpy import save_file
+
+    os.makedirs(path, exist_ok=True)
+    rng = np.random.RandomState(seed)
+    with open(os.path.join(path, "adapter_config.json"), "w") as f:
+        json.dump({"r": r, "lora_alpha": alpha,
+                   "target_modules": list(targets)}, f)
+    dims = {
+        "q_proj": (config.hidden_size, config.n_heads * config.head_dim),
+        "k_proj": (config.hidden_size, config.n_kv_heads * config.head_dim),
+        "v_proj": (config.hidden_size, config.n_kv_heads * config.head_dim),
+        "o_proj": (config.n_heads * config.head_dim, config.hidden_size),
+        "gate_proj": (config.hidden_size, config.intermediate_size),
+        "up_proj": (config.hidden_size, config.intermediate_size),
+        "down_proj": (config.intermediate_size, config.hidden_size),
+    }
+    module_of = {
+        "q_proj": "self_attn", "k_proj": "self_attn", "v_proj": "self_attn",
+        "o_proj": "self_attn", "gate_proj": "mlp", "up_proj": "mlp",
+        "down_proj": "mlp",
+    }
+    tensors = {}
+    for i in range(config.n_layers):
+        for proj in targets:
+            d_in, d_out = dims[proj]
+            prefix = (
+                f"base_model.model.model.layers.{i}.{module_of[proj]}.{proj}"
+            )
+            tensors[f"{prefix}.lora_A.weight"] = (
+                rng.randn(r, d_in).astype(np.float32) * 0.5
+            )
+            tensors[f"{prefix}.lora_B.weight"] = (
+                rng.randn(d_out, r).astype(np.float32) * 0.5
+            )
+    save_file(tensors, os.path.join(path, "adapter_model.safetensors"))
+    return str(path)
+
+
+def make_engine(lora_adapters=None, **overrides):
+    cfg = dict(
+        max_batch_size=4, page_size=8, num_pages=64, max_pages_per_seq=8,
+        max_prefill_len=32, prefill_buckets=(16, 32), dtype="float32",
+        use_pallas=False,
+    )
+    cfg.update(overrides)
+    return LLMEngine(
+        LlamaConfig.tiny(dtype="float32"), EngineConfig(**cfg),
+        ByteTokenizer(512), lora_adapters=lora_adapters,
+    )
+
+
+async def collect(gen):
+    return [o async for o in gen]
+
+
+@pytest.fixture(scope="module")
+def adapters(tmp_path_factory):
+    root = tmp_path_factory.mktemp("adapters")
+    config = LlamaConfig.tiny(dtype="float32")
+    return {
+        "style-a": write_peft_adapter(root / "a", config, seed=1),
+        "style-b": write_peft_adapter(root / "b", config, seed=2, r=2,
+                                      targets=("q_proj", "o_proj", "down_proj")),
+    }
+
+
+class TestLoRAServing:
+    @async_test
+    async def test_base_rows_bitexact_and_adapters_differ(self, adapters):
+        prompt = [5, 6, 7, 8]
+        params = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+        plain = make_engine()
+        await plain.start()
+        try:
+            base_want = [o.token_id for o in await collect(plain.generate(prompt, params))]
+        finally:
+            await plain.stop()
+
+        engine = make_engine(lora_adapters=adapters)
+        assert set(engine.adapter_ids) == {"style-a", "style-b"}
+        await engine.start()
+        try:
+            base, a, b = await asyncio.gather(
+                collect(engine.generate(prompt, params)),
+                collect(engine.generate(prompt, params, adapter="style-a")),
+                collect(engine.generate(prompt, params, adapter="style-b")),
+            )
+            base_tokens = [o.token_id for o in base]
+            a_tokens = [o.token_id for o in a]
+            b_tokens = [o.token_id for o in b]
+            # base requests in a LoRA engine match the no-LoRA engine exactly
+            assert base_tokens == base_want
+            # adapters actually change generation, each differently
+            assert a_tokens != base_tokens
+            assert b_tokens != base_tokens
+            assert a_tokens != b_tokens
+        finally:
+            await engine.stop()
+
+    @async_test
+    async def test_mixed_batch_matches_isolated_runs(self, adapters):
+        """Adapter math must not leak across lanes of one batch."""
+        prompt = [9, 10, 11]
+        params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+        engine = make_engine(lora_adapters=adapters)
+        await engine.start()
+        try:
+            alone_a = [o.token_id for o in await collect(
+                engine.generate(prompt, params, adapter="style-a"))]
+            alone_base = [o.token_id for o in await collect(
+                engine.generate(prompt, params))]
+            together = await asyncio.gather(
+                collect(engine.generate(prompt, params, adapter="style-a")),
+                collect(engine.generate(prompt, params)),
+            )
+            assert [o.token_id for o in together[0]] == alone_a
+            assert [o.token_id for o in together[1]] == alone_base
+        finally:
+            await engine.stop()
+
+    @async_test
+    async def test_unknown_adapter_rejected(self, adapters):
+        engine = make_engine(lora_adapters=adapters)
+        await engine.start()
+        try:
+            with pytest.raises(ValueError, match="unknown LoRA adapter"):
+                await collect(
+                    engine.generate([1, 2], SamplingParams(max_tokens=2),
+                                    adapter="nope")
+                )
+        finally:
+            await engine.stop()
+
+    @async_test
+    async def test_preemption_resume_keeps_adapter(self, adapters):
+        """A preempted LoRA request resumes with its adapter, output
+        identical to an unconstrained engine."""
+        params = SamplingParams(max_tokens=44, temperature=0.0, ignore_eos=True)
+        prompts = [[1, 2, 3, 4], [9, 10, 11, 12]]
+        roomy = make_engine(lora_adapters=adapters, num_pages=64)
+        await roomy.start()
+        try:
+            want = [
+                [o.token_id for o in await collect(
+                    roomy.generate(p, params, adapter="style-a"))]
+                for p in prompts
+            ]
+        finally:
+            await roomy.stop()
+        squeezed = make_engine(lora_adapters=adapters, num_pages=8)
+        await squeezed.start()
+        try:
+            results = await asyncio.gather(
+                *[collect(squeezed.generate(p, params, adapter="style-a"))
+                  for p in prompts]
+            )
+            assert squeezed.preemption_count > 0
+            for outs, want_tokens in zip(results, want):
+                assert [o.token_id for o in outs] == want_tokens
+        finally:
+            await squeezed.stop()
+
+
+class TestLoRAControlPlane:
+    def test_llmisvc_lora_adapters_wiring(self):
+        from kserve_tpu.controlplane.cluster import ControllerManager
+
+        mgr = ControllerManager()
+        mgr.apply({
+            "apiVersion": "serving.kserve.io/v1alpha2",
+            "kind": "LLMInferenceService",
+            "metadata": {"name": "lr", "namespace": "default"},
+            "spec": {
+                "model": {
+                    "uri": "hf://org/base", "name": "llm",
+                    "loraAdapters": [
+                        {"name": "fin", "uri": "gs://b/fin-adapter"},
+                        {"name": "med", "uri": "gs://b/med-adapter"},
+                    ],
+                },
+            },
+        })
+        pod = mgr.cluster.get("Deployment", "lr-kserve")[
+            "spec"]["template"]["spec"]
+        args = pod["containers"][0]["args"]
+        assert "--lora_adapters=fin=/mnt/adapters/fin,med=/mnt/adapters/med" in args
+        inits = {c["name"]: c for c in pod["initContainers"]}
+        assert inits["lora-fin"]["args"] == ["gs://b/fin-adapter", "/mnt/adapters/fin"]
+        assert inits["lora-med"]["args"][0] == "gs://b/med-adapter"
+        assert any(v["name"] == "lora-adapters" for v in pod["volumes"])
+
+    def test_server_flag_parsing(self):
+        from kserve_tpu.runtimes.generative_server import JAXGenerativeModel
+
+        model = JAXGenerativeModel(
+            "m", model_config=LlamaConfig.tiny(),
+            lora_adapters={"a": "/tmp/a"}, random_weights=True,
+        )
+
+        class Req:
+            model = "a"
+
+        assert model._adapter_for(Req()) == "a"
+        Req.model = "something-else"
+        assert model._adapter_for(Req()) is None
+
+
+class TestAdapterAliases:
+    def test_adapter_name_resolves_through_registry_and_lists(self):
+        """The OpenAI route resolves `model` via the registry: adapter names
+        must alias the base model there and appear in /v1/models."""
+        import asyncio as _asyncio
+
+        from kserve_tpu.model_repository import ModelRepository
+        from kserve_tpu.protocol.openai.dataplane import OpenAIDataPlane
+        from kserve_tpu.runtimes.generative_server import JAXGenerativeModel
+
+        model = JAXGenerativeModel(
+            "base", model_config=LlamaConfig.tiny(),
+            lora_adapters={"style-a": "/x", "style-b": "/y"},
+            random_weights=True,
+        )
+        repo = ModelRepository()
+        repo.update(model)
+        assert repo.get_model("style-a") is model
+        assert repo.get_model("base") is model
+        assert repo.get_model("missing") is None
+        listed = _asyncio.run(OpenAIDataPlane(repo).models())
+        ids = {card.id for card in listed.data}
+        assert {"base", "style-a", "style-b"} <= ids
