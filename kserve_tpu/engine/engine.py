@@ -76,6 +76,10 @@ class EngineConfig:
     # waiting requests prefilled together in one compiled call (padded to the
     # largest length bucket among them; batch padded to pow2)
     prefill_batch: int = 8
+    # prefix caching: full prompt pages are kept (refcounted, LRU-evicted on
+    # pressure) and shared by later requests with the same page-aligned
+    # prefix, which then prefill only their uncached tail
+    prefix_cache: bool = True
 
     def __post_init__(self):
         # prefill buckets must reach max_prefill_len or long prompts would
@@ -253,6 +257,12 @@ class LLMEngine:
             else 0
         )
         self.preemption_count = 0
+        # prefix cache: chained page key -> page id, LRU-ordered (front =
+        # coldest); the cache holds one ref per page
+        from collections import OrderedDict as _OD
+
+        self._prefix_cache: "_OD[tuple, int]" = _OD()
+        self.prefix_cache_hits = 0  # pages reused (observability/tests)
         # device-resident [B, V] penalty state; row-level updates on batch
         # composition changes (dirty_rows None => full rebuild needed)
         self._penalty_counts = None
@@ -410,8 +420,20 @@ class LLMEngine:
                 for i, layer in enumerate(kv_pages)
             ]
 
+        def _prefill_chunk(params, tokens, chunk_start, valid_len, kv_pages,
+                           page_ids, adapter_ids):
+            return llama.prefill_chunk(
+                params, mc, tokens, chunk_start, valid_len, kv_pages,
+                page_ids, cfg.page_size, adapter_ids=adapter_ids,
+            )
+
+        def _sample_first(logits, state, rng):
+            return sample_tokens(logits, state, rng)
+
         n_kv_args = 3  # kv_pages is arg index 3 in the prefill/decode sigs
         self._prefill_fn = jax.jit(_prefill, donate_argnums=(n_kv_args,))
+        self._prefill_chunk_fn = jax.jit(_prefill_chunk, donate_argnums=(4,))
+        self._sample_first_fn = jax.jit(_sample_first)
         self._decode_fn = jax.jit(_make_decode(False), donate_argnums=(n_kv_args,))
         # arg 11 = prompt mask (kept across chunks), arg 12 = counts (donated)
         self._decode_penalized_fn = jax.jit(
@@ -457,12 +479,9 @@ class LLMEngine:
         """Submit a request; yields GenerationOutput per emitted token.
         `adapter` selects a loaded LoRA adapter by name (None = base).
         Validation runs HERE, not at first __anext__ — callers get their
-        ValueError before any stream machinery is involved."""
-        if len(prompt_ids) > self.config.max_prefill_len:
-            raise ValueError(
-                f"prompt length {len(prompt_ids)} exceeds max_prefill_len "
-                f"{self.config.max_prefill_len}"
-            )
+        ValueError before any stream machinery is involved.  Prompts longer
+        than max_prefill_len prefill in chunks (one compiled program per
+        chunk bucket), so only the model length bounds them."""
         if len(prompt_ids) + params.max_tokens > self.config.max_model_len:
             raise ValueError(
                 f"prompt+max_tokens exceeds max_model_len {self.config.max_model_len}"
@@ -732,8 +751,28 @@ class LLMEngine:
                 if admitted:
                     break  # flush the batched prefill first
                 return self._admit_injected(req)
+            hits = (
+                self._prefix_cache_lookup(
+                    req.prompt_ids + req.resume["generated"][:-1]
+                    if req.resume is not None else req.prompt_ids
+                )
+                if req.adapter_id < 0 else []
+            )
+            # chunked admission is one-request-at-a-time: take it when the
+            # prompt can't fit a bucket, or when the cache covers enough of
+            # it that skipping the recompute beats batched amortization
+            # (batched prefill with per-row chunk_start is the follow-up
+            # that removes this trade)
+            big_hit = (
+                len(hits) * self.config.page_size * 2 >= req.kv_len
+                and hits
+            )
+            if req.kv_len > self.config.prefill_buckets[-1] or big_hit:
+                if admitted:
+                    break  # flush the batched prefill first
+                return self._admit_chunked(req, hits)
             n_pages = pages_needed(req.kv_len + 1, self.config.page_size)
-            if not self.allocator.can_allocate(self._admission_pages(req, n_pages)):
+            if not self._ensure_allocatable(self._admission_pages(req, n_pages)):
                 break
             self._waiting.pop(0)
             admitted.append((free.pop(0), req, self.allocator.allocate(n_pages)))
@@ -803,8 +842,160 @@ class LLMEngine:
             slot.stop_texts = list(req.params.stop or [])
             slot.admitted_at = now
             slot.adapter_id = req.adapter_id
+            if req.resume is None and req.adapter_id < 0:
+                self._prefix_cache_register(req.prompt_ids, pages)
             self._mark_penalty_dirty(idx)
             self._emit(slot, first_token)
+        return True
+
+    def _prefix_keys(self, seq: List[int], for_lookup: bool) -> List[bytes]:
+        """Digest-chained page keys for page-aligned prefixes of `seq`
+        (blake2b over prev_digest || page tokens: O(page) per key, no
+        nested-tuple rehash blowup).  Lookup leaves at least one token to
+        prefill (the sampler needs logits); registration may include the
+        final exactly-full page."""
+        import hashlib
+
+        ps = self.config.page_size
+        count = (len(seq) - 1) // ps if for_lookup else len(seq) // ps
+        keys = []
+        digest = b""
+        for i in range(count):
+            h = hashlib.blake2b(digest, digest_size=16)
+            h.update(np.asarray(seq[i * ps : (i + 1) * ps], np.int64).tobytes())
+            digest = h.digest()
+            keys.append(digest)
+        return keys
+
+    def _prefix_cache_lookup(self, seq: List[int]) -> List[int]:
+        """Longest cached page run for this sequence (pages NOT yet shared)."""
+        if not self.config.prefix_cache:
+            return []
+        pages = []
+        for key in self._prefix_keys(seq, for_lookup=True):
+            page = self._prefix_cache.get(key)
+            if page is None:
+                break
+            self._prefix_cache.move_to_end(key)  # LRU touch
+            pages.append(page)
+        return pages
+
+    def _prefix_cache_register(self, prompt_ids: List[int], pages: List[int]) -> None:
+        if not self.config.prefix_cache:
+            return
+        for i, key in enumerate(self._prefix_keys(prompt_ids, for_lookup=False)):
+            if key in self._prefix_cache:
+                continue
+            page = pages[i]
+            self._prefix_cache[key] = page
+            self.allocator.share([page])  # the cache's own reference
+
+    def _ensure_allocatable(self, n: int) -> bool:
+        """can_allocate with LRU prefix-cache eviction as the pressure
+        valve: cold cached pages are dropped (their cache ref freed) before
+        admission fails or anything gets preempted."""
+        while not self.allocator.can_allocate(n) and self._prefix_cache:
+            _, page = self._prefix_cache.popitem(last=False)
+            self.allocator.free([page])
+        return self.allocator.can_allocate(n)
+
+    def _admit_chunked(self, req: "_QueuedRequest",
+                       hits: Optional[List[int]] = None) -> bool:
+        """Admit one long-prompt request by chunked prefill: the prompt
+        prefills max_prefill_len-sized chunks into its pages, each chunk
+        attending to the cached history (ops/attention.py
+        chunked_prefill_attention).  Unblocks prompts up to max_model_len
+        without sequence parallelism."""
+        idx = self._free_slot_index()
+        if idx is None:
+            return False
+        total = req.kv_len
+        need = pages_needed(total + 1, self.config.page_size)
+        if need > self.config.max_pages_per_seq:
+            self._waiting.remove(req)
+            req.queue.put_nowait(ValueError(
+                f"prompt needs {need} pages > max_pages_per_seq "
+                f"{self.config.max_pages_per_seq}"
+            ))
+            return True
+        if req.resume is not None:
+            seq = req.prompt_ids + req.resume["generated"][:-1]
+        else:
+            seq = req.prompt_ids
+        # LoRA adapters produce adapter-specific KV: only base-model
+        # requests share the prefix cache
+        if hits is None:
+            hits = self._prefix_cache_lookup(seq) if req.adapter_id < 0 else []
+        cached = list(hits)
+        # take our reference BEFORE eviction runs: eviction may drop these
+        # pages from the cache, but a live ref keeps them off the free list
+        # (evicted-then-shared pages would otherwise be re-allocated while
+        # this sequence reads them)
+        self.allocator.share(cached)
+        fresh_needed = need - len(cached)
+        if not self._ensure_allocatable(self._admission_pages(req, fresh_needed)):
+            self.allocator.free(cached)  # release the early reference
+            return False
+        self._waiting.remove(req)
+        self.prefix_cache_hits += len(cached)
+        pages = cached + self.allocator.allocate(fresh_needed)
+        page_ids_full = np.zeros((self.config.max_pages_per_seq,), np.int32)
+        page_ids_full[: len(pages)] = pages
+        chunk_cap = self.config.prefill_buckets[-1]
+        adapter_arr = jnp.asarray(np.asarray([req.adapter_id], np.int32))
+        done = len(cached) * self.config.page_size
+        logits = None
+        # chunks dispatch back-to-back: on device they run before the next
+        # decode chunk, so a very long admission delays in-flight streams by
+        # its full prefill (interleaving chunk/decode dispatches via a
+        # prefill-in-progress slot state is the known follow-up)
+        while done < total:
+            n = min(chunk_cap, total - done)
+            bucket = self._bucket_for(n)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :n] = seq[done : done + n]
+            # table width must cover this chunk's writes (and the history
+            # gather reads the same table, masked by history length)
+            width = self.config.page_bucket(
+                pages_needed(done + n, self.config.page_size)
+            )
+            logits, self.kv_pages = self._prefill_chunk_fn(
+                self.params,
+                jnp.asarray(tokens),
+                jnp.asarray(np.asarray([done], np.int32)),
+                jnp.asarray(np.asarray([n], np.int32)),
+                self.kv_pages,
+                jnp.asarray(page_ids_full[None, :width]),
+                adapter_arr,
+            )
+            done += n
+        PROMPT_TOKENS.labels(model_name=self._mlabel).inc(
+            total if req.resume is None else 0
+        )
+        if req.adapter_id < 0:
+            self._prefix_cache_register(req.prompt_ids, pages)
+        slot = self._slots[idx]
+        if req.resume is not None:
+            self._seat_resumed(slot, req, pages)
+            self._mark_penalty_dirty(idx)
+            return True
+        state = SamplingState.from_params([req.params])
+        rng = jax.random.fold_in(self._base_rng, self._next_step())
+        first_token = int(np.asarray(self._sample_first_fn(logits, state, rng))[0])
+        slot.request_id = req.request_id
+        slot.prompt_len = total
+        slot.prompt_ids = req.prompt_ids
+        slot.pages = pages
+        slot.pos = total
+        slot.generated = [first_token]
+        slot.params = req.params
+        slot.queue = req.queue
+        slot.detok = IncrementalDetokenizer(self.tokenizer)
+        slot.stop_texts = list(req.params.stop or [])
+        slot.admitted_at = time.perf_counter()
+        slot.adapter_id = req.adapter_id
+        self._mark_penalty_dirty(idx)
+        self._emit(slot, first_token)
         return True
 
     def _admission_pages(self, req: "_QueuedRequest", need: int) -> int:
@@ -846,7 +1037,7 @@ class LLMEngine:
         need = pages_needed(total + 1, self.config.page_size)
         if need > self.config.max_pages_per_seq:
             return False
-        if not self.allocator.can_allocate(self._admission_pages(req, need)):
+        if not self._ensure_allocatable(self._admission_pages(req, need)):
             return False
         self._waiting.remove(req)
         pages = self.allocator.allocate(need)
@@ -924,6 +1115,9 @@ class LLMEngine:
                     starved.append(slot)
             if not starved:
                 return
+            # cold cached pages go before anyone gets preempted
+            if self._ensure_allocatable(1):
+                continue
             oldest = min(active, key=lambda s: s.admitted_at)
             candidates = [
                 s for s in active if s is not oldest and self._can_preempt(s)
@@ -942,16 +1136,9 @@ class LLMEngine:
             self._preempt(max(candidates, key=lambda s: s.admitted_at))
 
     def _can_preempt(self, slot: _Slot) -> bool:
-        """A slot is preemptible if its resume path exists: re-prefill fits
-        max_prefill_len, or the host tier has budget for its KV."""
-        if slot.pos <= self.config.max_prefill_len:
-            return True
-        P = pages_needed(slot.pos, self.config.page_size)
-        nbytes = P * self.model_config.n_layers * self.cache_config.bytes_per_page()
-        return bool(
-            self._offload_budget
-            and self._offload_bytes + nbytes <= self._offload_budget
-        )
+        """Every slot has a resume path now: chunked re-prefill covers any
+        length, and the host tier (when budgeted) avoids the recompute."""
+        return True
 
     def _preempt(self, slot: _Slot) -> None:
         """Requeue a running slot (front of queue), freeing its pages.  With
@@ -966,8 +1153,8 @@ class LLMEngine:
         nbytes = (
             P * self.model_config.n_layers * self.cache_config.bytes_per_page()
         )
-        # spill when the budget allows; _can_preempt guarantees the
-        # alternative (re-prefill) exists whenever we don't
+        # spill when the budget allows; otherwise chunked re-prefill
+        # recomputes the KV on resume
         if self._offload_budget and self._offload_bytes + nbytes <= self._offload_budget:
             ids = jnp.asarray(np.asarray(slot.pages[:P], np.int32))
             kv = np.asarray(jnp.stack([layer[ids] for layer in self.kv_pages]))

@@ -58,11 +58,15 @@ def init_kv_pages(config: KVCacheConfig, sharding=None) -> List[jnp.ndarray]:
 
 
 class PageAllocator:
-    """Host-side free-list; page 0 is reserved (null page for padding)."""
+    """Host-side free-list with refcounts; page 0 is reserved (null page for
+    padding).  Refcounts let prefix-cached pages be shared by concurrent
+    sequences AND the cache itself — a page returns to the free list only
+    when its last reference drops."""
 
     def __init__(self, num_pages: int):
         self.num_pages = num_pages
         self._free = list(range(num_pages - 1, 0, -1))  # stack, page 0 reserved
+        self._refs = [0] * num_pages
 
     @property
     def free_pages(self) -> int:
@@ -74,11 +78,26 @@ class PageAllocator:
     def allocate(self, n: int) -> List[int]:
         if n > len(self._free):
             raise MemoryError(f"KV cache exhausted: need {n} pages, {len(self._free)} free")
-        return [self._free.pop() for _ in range(n)]
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._refs[p] = 1
+        return pages
+
+    def share(self, pages: List[int]) -> None:
+        for p in pages:
+            if p != 0:
+                self._refs[p] += 1
 
     def free(self, pages: List[int]) -> None:
         for p in pages:
-            if p != 0:
+            if p == 0:
+                continue
+            if self._refs[p] <= 0:
+                # double-free must not duplicate the page on the free list
+                # (two sequences would then share it and corrupt KV)
+                continue
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
                 self._free.append(p)
 
 
@@ -127,6 +146,32 @@ def write_prompt_kv_batch(
     pages_flat = page_of.reshape(-1)
     kv = jnp.stack([k, v]).astype(kv_pages.dtype)  # [2, B, T, n_kv, d]
     values = kv.transpose(1, 2, 0, 3, 4).reshape(B * T, 2, kv.shape[3], kv.shape[4])
+    return kv_pages.at[pages_flat, :, :, slot_of, :].set(
+        values, mode="drop", unique_indices=False
+    )
+
+
+def write_chunk_kv_batch(
+    kv_pages: jnp.ndarray,  # [num_pages, 2, nkv, ps, d]
+    k: jnp.ndarray,  # [B, C, n_kv, d] — chunk keys
+    v: jnp.ndarray,  # [B, C, n_kv, d]
+    page_ids: jnp.ndarray,  # [B, max_pages] int32 — the SEQUENCE's pages
+    chunk_start: jnp.ndarray,  # [B] absolute position of chunk token 0
+    valid_len: jnp.ndarray,  # [B] valid tokens within the chunk
+    page_size: int,
+) -> jnp.ndarray:
+    """write_prompt_kv_batch generalized to an offset chunk (chunked
+    prefill): chunk token t lands at absolute position chunk_start+t."""
+    B, C = k.shape[:2]
+    t = jnp.arange(C, dtype=jnp.int32)
+    pos = chunk_start[:, None] + t[None, :]  # [B, C]
+    page_idx = pos // page_size
+    page_of = jnp.take_along_axis(page_ids, page_idx, axis=1)
+    page_of = jnp.where(t[None, :] < valid_len[:, None], page_of, 0)
+    slot_of = (pos % page_size).reshape(-1)
+    pages_flat = page_of.reshape(-1)
+    kv = jnp.stack([k, v]).astype(kv_pages.dtype)  # [2, B, C, n_kv, d]
+    values = kv.transpose(1, 2, 0, 3, 4).reshape(B * C, 2, kv.shape[3], kv.shape[4])
     return kv_pages.at[pages_flat, :, :, slot_of, :].set(
         values, mode="drop", unique_indices=False
     )

@@ -26,8 +26,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..engine.kvcache import append_token_kv, write_prompt_kv_batch
-from ..ops.attention import causal_prefill_attention, paged_attention
+from ..engine.kvcache import append_token_kv, write_chunk_kv_batch, write_prompt_kv_batch
+from ..ops.attention import (
+    causal_prefill_attention,
+    chunked_prefill_attention,
+    paged_attention,
+)
 from ..ops.norms import rms_norm
 from ..ops.rotary import apply_rope
 from .lora import lora_delta
@@ -318,6 +322,55 @@ def prefill(
         x = residual + _mlp(layer, h, config, onehot)
         # scatter the whole batch's K/V into its pages in one op
         pages = write_prompt_kv_batch(pages, k, v, page_ids, valid_len, page_size)
+        new_pages.append(pages)
+    last = jnp.maximum(valid_len - 1, 0)
+    x_last = x[jnp.arange(B), last]  # [B, h]
+    return _logits(params, x_last[:, None], config)[:, 0], new_pages
+
+
+def prefill_chunk(
+    params: Params,
+    config: LlamaConfig,
+    tokens: jnp.ndarray,  # [B, C] one chunk of the prompt (padded)
+    chunk_start: jnp.ndarray,  # [B] tokens already prefilled (history)
+    valid_len: jnp.ndarray,  # [B] valid tokens within THIS chunk
+    kv_pages: List[jnp.ndarray],
+    page_ids: jnp.ndarray,  # [B, max_pages] the sequence's pages
+    page_size: int,
+    adapter_ids: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, List[jnp.ndarray]]:
+    """One chunk of a chunked prefill: attends to the cached history plus
+    the chunk's causal prefix, writes the chunk's KV into the cache, and
+    returns logits at the chunk's last valid token.  history=0 makes this
+    equivalent to (a window of) plain prefill; a prefix-cache hit just
+    starts with chunk_start > 0 and the cached pages in page_ids."""
+    B, C = tokens.shape
+    onehot = _adapter_onehot(params, adapter_ids, B)
+    positions = chunk_start[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
+    x = params["embed"][tokens].astype(jnp.dtype(config.dtype))
+    new_pages = []
+    for layer, pages in zip(params["layers"], kv_pages):
+        residual = x
+        h = rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
+        q, k, v = _qkv(layer, h, config, onehot)
+        q = apply_rope(q, positions, config.rope_theta, config.rope_scaling)
+        k = apply_rope(k, positions, config.rope_theta, config.rope_scaling)
+        attn = chunked_prefill_attention(
+            q, k, v, pages, page_ids, chunk_start, valid_len,
+            config.logit_softcap,
+        )
+        attn_flat = attn.reshape(B, C, -1)
+        attn = _maybe_add(
+            attn_flat @ layer["wo"],
+            lora_delta(layer.get("lora"), "wo", attn_flat, onehot),
+        )
+        x = residual + attn
+        residual = x
+        h = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
+        x = residual + _mlp(layer, h, config, onehot)
+        pages = write_chunk_kv_batch(
+            pages, k, v, page_ids, chunk_start, valid_len, page_size
+        )
         new_pages.append(pages)
     last = jnp.maximum(valid_len - 1, 0)
     x_last = x[jnp.arange(B), last]  # [B, h]

@@ -63,6 +63,53 @@ def causal_prefill_attention(
     return out.astype(q.dtype)
 
 
+def chunked_prefill_attention(
+    q: jnp.ndarray,  # [B, C, nq, d] — current chunk queries
+    k_chunk: jnp.ndarray,  # [B, C, nkv, d] — current chunk keys
+    v_chunk: jnp.ndarray,  # [B, C, nkv, d]
+    kv_pages: jnp.ndarray,  # [num_pages, 2, nkv, ps, d] — cache w/ history
+    page_table: jnp.ndarray,  # [B, W] pages holding positions 0..history-1
+    history_len: jnp.ndarray,  # [B] tokens already in the cache
+    valid_len: jnp.ndarray,  # [B] valid tokens within THIS chunk
+    logit_softcap: float = 0.0,
+) -> jnp.ndarray:
+    """Causal attention for a prefill CHUNK: queries attend to the cached
+    history (gathered from pages) plus the causal prefix of the chunk
+    itself.  This is what makes chunked prefill and prefix-cache reuse
+    possible — the first chunk (history_len=0) degenerates to plain causal
+    prefill attention."""
+    B, C, nq, d = q.shape
+    nkv = kv_pages.shape[2]
+    ps = kv_pages.shape[3]
+    W = page_table.shape[1]
+    H = W * ps
+    gathered = kv_pages[page_table]  # [B, W, 2, nkv, ps, d]
+    k_hist = gathered[:, :, 0].transpose(0, 1, 3, 2, 4).reshape(B, H, nkv, d)
+    v_hist = gathered[:, :, 1].transpose(0, 1, 3, 2, 4).reshape(B, H, nkv, d)
+    k_all = jnp.concatenate([k_hist, k_chunk], axis=1)  # [B, H+C, nkv, d]
+    v_all = jnp.concatenate([v_hist, v_chunk], axis=1)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    scores = _gqa_scores(q, k_all) * scale  # [B, nq, C, H+C]
+    if logit_softcap > 0.0:
+        scores = jnp.tanh(scores / logit_softcap) * logit_softcap
+    hist_pos = jnp.arange(H, dtype=jnp.int32)
+    hist_mask = hist_pos[None, :] < history_len[:, None]  # [B, H]
+    c = jnp.arange(C, dtype=jnp.int32)
+    causal = c[None, :] <= c[:, None]  # [Cq, Ck]
+    chunk_mask = causal[None, :, :] & (c[None, None, :] < valid_len[:, None, None])
+    mask = jnp.concatenate(
+        [
+            jnp.broadcast_to(hist_mask[:, None, :], (B, C, H)),
+            chunk_mask,
+        ],
+        axis=-1,
+    )  # [B, C, H+C]
+    scores = jnp.where(mask[:, None, :, :], scores, -1e30)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = _gqa_out(weights, v_all)  # [B, C, nq, d]
+    return out.astype(q.dtype)
+
+
 def paged_attention_xla(
     q: jnp.ndarray,  # [B, nq, d] — one decode token per sequence
     kv_pages: jnp.ndarray,  # [num_pages, 2, nkv, ps, d]

@@ -218,11 +218,6 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
         """engine.generate with limit errors surfaced as 400s (the checks
         must run before iteration starts — async generators defer their body
         to the first __anext__)."""
-        if len(prompt_ids) > self.engine.config.max_prefill_len:
-            raise InvalidInput(
-                f"prompt length {len(prompt_ids)} exceeds max_prefill_len "
-                f"{self.engine.config.max_prefill_len}"
-            )
         if len(prompt_ids) + params.max_tokens > self.engine.config.max_model_len:
             raise InvalidInput(
                 f"prompt+max_tokens exceeds max_model_len {self.engine.config.max_model_len}"

@@ -299,3 +299,171 @@ class TestPreemption:
         # pages went host-side and came back; budget fully returned
         assert engine._offload_bytes == 0
         assert engine.allocator.free_pages == engine.config.num_pages - 1
+
+
+class TestChunkedPrefill:
+    """Prompts beyond max_prefill_len prefill in history-attending chunks."""
+
+    @async_test
+    async def test_long_prompt_matches_single_shot(self):
+        prompt = [(3 + i * 7) % 500 + 3 for i in range(50)]
+        params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+        # reference: an engine whose bucket swallows the prompt whole
+        big = make_engine(
+            max_prefill_len=64, prefill_buckets=(64,),
+            num_pages=64, max_pages_per_seq=16,
+        )
+        await big.start()
+        try:
+            want = [o.token_id for o in await collect(big, prompt, params)]
+        finally:
+            await big.stop()
+        # chunked: 16-token chunks, 50-token prompt -> 4 chunks
+        small = make_engine(
+            max_prefill_len=16, prefill_buckets=(16,),
+            num_pages=64, max_pages_per_seq=16,
+        )
+        await small.start()
+        try:
+            got = [o.token_id for o in await collect(small, prompt, params)]
+        finally:
+            await small.stop()
+        assert got == want
+
+    @async_test
+    async def test_chunked_and_batched_requests_coexist(self):
+        engine = make_engine(
+            max_prefill_len=16, prefill_buckets=(16,),
+            num_pages=64, max_pages_per_seq=16,
+        )
+        params = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+        long_prompt = list(range(3, 43))  # 40 tokens -> chunked
+        short_prompt = [5, 6, 7]  # batched path
+        await engine.start()
+        try:
+            long_outs, short_outs = await asyncio.gather(
+                collect(engine, long_prompt, params),
+                collect(engine, short_prompt, params),
+            )
+            assert long_outs[-1].finished and short_outs[-1].finished
+            assert long_outs[-1].num_prompt_tokens == 40
+        finally:
+            await engine.stop()
+
+    @async_test
+    async def test_preempted_long_sequence_resumes_by_chunked_recompute(self):
+        """pos > max_prefill_len no longer forces truncation or host spill:
+        chunked re-prefill recomputes on resume."""
+        params = SamplingParams(max_tokens=44, temperature=0.0, ignore_eos=True)
+        prompts = [[1, 2, 3, 4], [9, 10, 11, 12]]
+        roomy = make_engine(
+            max_prefill_len=16, prefill_buckets=(16,),
+            num_pages=64, max_pages_per_seq=8,
+        )
+        await roomy.start()
+        try:
+            want = [
+                [o.token_id for o in await collect(roomy, p, params)]
+                for p in prompts
+            ]
+        finally:
+            await roomy.stop()
+        squeezed = make_engine(
+            max_prefill_len=16, prefill_buckets=(16,),
+            num_pages=8, max_pages_per_seq=8,
+        )
+        await squeezed.start()
+        try:
+            results = await asyncio.gather(
+                *[collect(squeezed, p, params) for p in prompts]
+            )
+            assert squeezed.preemption_count > 0
+            for outs, want_tokens in zip(results, want):
+                assert outs[-1].num_generated == 44
+                assert [o.token_id for o in outs] == want_tokens
+        finally:
+            await squeezed.stop()
+
+
+class TestPrefixCache:
+    """Full prompt pages are cached, shared and LRU-evicted."""
+
+    def _engine(self, **overrides):
+        cfg = dict(
+            max_prefill_len=16, prefill_buckets=(16,),
+            num_pages=64, max_pages_per_seq=8, max_batch_size=4,
+        )
+        cfg.update(overrides)
+        return make_engine(**cfg)
+
+    @async_test
+    async def test_second_request_reuses_prefix_pages(self):
+        engine = self._engine()
+        shared_prefix = list(range(3, 35))  # 32 tokens = 4 full pages
+        params = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+        await engine.start()
+        try:
+            first = [o.token_id for o in await collect(
+                engine, shared_prefix + [100, 101], params)]
+            assert engine.prefix_cache_hits == 0
+            second = [o.token_id for o in await collect(
+                engine, shared_prefix + [100, 101], params)]
+            # identical prompt: all 4 full pages reused
+            assert engine.prefix_cache_hits == 4
+            assert second == first  # reused KV is the same KV
+            # divergent tail still shares the common prefix
+            await collect(engine, shared_prefix + [200, 201], params)
+            assert engine.prefix_cache_hits == 8
+        finally:
+            await engine.stop()
+
+    @async_test
+    async def test_different_prefix_no_hit(self):
+        engine = self._engine()
+        params = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+        await engine.start()
+        try:
+            await collect(engine, list(range(3, 35)), params)
+            await collect(engine, list(range(103, 135)), params)
+            assert engine.prefix_cache_hits == 0
+        finally:
+            await engine.stop()
+
+    @async_test
+    async def test_cache_reuse_matches_uncached_engine(self):
+        """Output through a cache hit is bit-identical to a cold engine."""
+        prompt = list(range(7, 47))  # 40 tokens
+        params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+        cold = self._engine(prefix_cache=False)
+        await cold.start()
+        try:
+            want = [o.token_id for o in await collect(cold, prompt, params)]
+        finally:
+            await cold.stop()
+        warm = self._engine()
+        await warm.start()
+        try:
+            await collect(warm, prompt, params)  # populate
+            got = [o.token_id for o in await collect(warm, prompt, params)]
+            assert warm.prefix_cache_hits > 0
+            assert got == want
+        finally:
+            await warm.stop()
+
+    @async_test
+    async def test_eviction_under_pressure_keeps_serving(self):
+        """A small allocator: cached pages are evicted rather than blocking
+        new admissions; everything still completes full-length."""
+        engine = self._engine(num_pages=16, max_batch_size=2)
+        params = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+        await engine.start()
+        try:
+            for base in (0, 40, 80, 120):
+                outs = await collect(
+                    engine, [3 + base + i for i in range(32)], params)
+                assert outs[-1].num_generated == 8
+            # the 16-page allocator can't hold 4 x 4 cached pages + live
+            # sequences: eviction must have kicked in
+            assert len(engine._prefix_cache) * 1 < 16
+        finally:
+            await engine.stop()
