@@ -427,7 +427,17 @@ class LLMEngine:
                 page_ids, cfg.page_size, adapter_ids=adapter_ids,
             )
 
-        def _sample_first(logits, state, rng):
+        def _sample_first(logits, state, rng, in_prompt):
+            # same first-token penalty semantics as the batched prefill:
+            # repetition penalty counts prompt tokens as seen
+            logits = apply_penalties(
+                logits,
+                jnp.zeros(logits.shape, jnp.int32),
+                state.repetition_penalty,
+                state.frequency_penalty,
+                state.presence_penalty,
+                in_prompt,
+            )
             return sample_tokens(logits, state, rng)
 
         n_kv_args = 3  # kv_pages is arg index 3 in the prefill/decode sigs
@@ -815,7 +825,6 @@ class LLMEngine:
             jnp.asarray(adapter_arr),
         )
         first_np = np.asarray(first)
-        now = time.perf_counter()
         for j, (idx, req, pages) in enumerate(admitted):
             if req.resume is None:
                 # resume re-prefills are recompute overhead, not new prompt
@@ -828,25 +837,31 @@ class LLMEngine:
                 self._seat_resumed(slot, req, pages)
                 self._mark_penalty_dirty(idx)
                 continue
-            n_prompt = len(req.prompt_ids)
             first_token = int(first_np[j])
-            slot.request_id = req.request_id
-            slot.prompt_len = n_prompt
-            slot.prompt_ids = req.prompt_ids
-            slot.pages = pages
-            slot.pos = n_prompt  # position of the token being decoded next
-            slot.generated = [first_token]
-            slot.params = req.params
-            slot.queue = req.queue
-            slot.detok = IncrementalDetokenizer(self.tokenizer)
-            slot.stop_texts = list(req.params.stop or [])
-            slot.admitted_at = now
-            slot.adapter_id = req.adapter_id
-            if req.resume is None and req.adapter_id < 0:
+            self._seat_fresh(slot, req, pages, first_token)
+            if req.adapter_id < 0:
                 self._prefix_cache_register(req.prompt_ids, pages)
             self._mark_penalty_dirty(idx)
             self._emit(slot, first_token)
         return True
+
+    def _seat_fresh(self, slot: _Slot, req: "_QueuedRequest",
+                    pages: List[int], first_token: int) -> None:
+        """Single source of truth for seating a freshly-prefilled request —
+        the batched, chunked and injected admission paths all use it."""
+        n_prompt = len(req.prompt_ids)
+        slot.request_id = req.request_id
+        slot.prompt_len = n_prompt
+        slot.prompt_ids = req.prompt_ids
+        slot.pages = pages
+        slot.pos = n_prompt  # position of the token being decoded next
+        slot.generated = [first_token]
+        slot.params = req.params
+        slot.queue = req.queue
+        slot.detok = IncrementalDetokenizer(self.tokenizer)
+        slot.stop_texts = list(req.params.stop or [])
+        slot.admitted_at = time.perf_counter()
+        slot.adapter_id = req.adapter_id
 
     def _prefix_keys(self, seq: List[int], for_lookup: bool) -> List[bytes]:
         """Digest-chained page keys for page-aligned prefixes of `seq`
@@ -981,19 +996,12 @@ class LLMEngine:
             return True
         state = SamplingState.from_params([req.params])
         rng = jax.random.fold_in(self._base_rng, self._next_step())
-        first_token = int(np.asarray(self._sample_first_fn(logits, state, rng))[0])
-        slot.request_id = req.request_id
-        slot.prompt_len = total
-        slot.prompt_ids = req.prompt_ids
-        slot.pages = pages
-        slot.pos = total
-        slot.generated = [first_token]
-        slot.params = req.params
-        slot.queue = req.queue
-        slot.detok = IncrementalDetokenizer(self.tokenizer)
-        slot.stop_texts = list(req.params.stop or [])
-        slot.admitted_at = time.perf_counter()
-        slot.adapter_id = req.adapter_id
+        in_prompt = np.zeros((1, self.model_config.vocab_size), bool)
+        in_prompt[0, np.asarray(seq, np.int64)] = True
+        first_token = int(np.asarray(
+            self._sample_first_fn(logits, state, rng, jnp.asarray(in_prompt))
+        )[0])
+        self._seat_fresh(slot, req, pages, first_token)
         self._mark_penalty_dirty(idx)
         self._emit(slot, first_token)
         return True
@@ -1060,20 +1068,8 @@ class LLMEngine:
             )
             self._mark_penalty_dirty(idx)
             return True
-        n = len(req.prompt_ids)
-        slot.request_id = req.request_id
-        slot.prompt_len = n
-        slot.prompt_ids = req.prompt_ids
-        slot.pages = pages
-        slot.pos = n
-        slot.generated = [req.first_token]
-        slot.params = req.params
-        slot.queue = req.queue
-        slot.detok = IncrementalDetokenizer(self.tokenizer)
-        slot.stop_texts = list(req.params.stop or [])
-        slot.admitted_at = time.perf_counter()
-        slot.adapter_id = req.adapter_id
-        PROMPT_TOKENS.labels(model_name=self._mlabel).inc(n)
+        self._seat_fresh(slot, req, pages, req.first_token)
+        PROMPT_TOKENS.labels(model_name=self._mlabel).inc(len(req.prompt_ids))
         self._mark_penalty_dirty(idx)
         self._emit(slot, req.first_token)
         return True

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Closed-loop HTTP load generator for predictive serving latency
+(the in-repo analogue of the reference's vegeta runs in BASELINE.md:
+raw-mode p50/p99 for :predict / /infer).
+
+    python scripts/loadbench.py --url http://127.0.0.1:8080/v2/models/m/infer \
+        --body '{"inputs": [...]}' --concurrency 4 --duration 10
+
+Prints one JSON line: {"p50_ms": ..., "p99_ms": ..., "rps": ..., ...}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+from typing import List
+
+
+async def worker(client, url: str, body: bytes, headers: dict,
+                 stop_at: float, latencies: List[float], errors: List[int]):
+    while time.perf_counter() < stop_at:
+        t0 = time.perf_counter()
+        try:
+            response = await client.post(url, content=body, headers=headers)
+            ok = response.status_code == 200
+        except Exception:
+            ok = False
+        dt = (time.perf_counter() - t0) * 1000.0
+        if ok:
+            latencies.append(dt)
+        else:
+            errors.append(1)
+
+
+async def run(url: str, body: bytes, concurrency: int, duration: float,
+              warmup: float) -> dict:
+    import httpx
+
+    headers = {"content-type": "application/json"}
+    latencies: List[float] = []
+    errors: List[int] = []
+    async with httpx.AsyncClient(timeout=30) as client:
+        # warmup (compiles, connection pool) — not measured
+        warm_stop = time.perf_counter() + warmup
+        await asyncio.gather(*[
+            worker(client, url, body, headers, warm_stop, [], [])
+            for _ in range(concurrency)
+        ])
+        start = time.perf_counter()
+        stop_at = start + duration
+        await asyncio.gather(*[
+            worker(client, url, body, headers, stop_at, latencies, errors)
+            for _ in range(concurrency)
+        ])
+        elapsed = time.perf_counter() - start
+    if not latencies:
+        return {"error": "no successful requests", "errors": len(errors)}
+    latencies.sort()
+
+    def pct(p):
+        return round(latencies[min(len(latencies) - 1, int(p * len(latencies)))], 3)
+
+    return {
+        "requests": len(latencies),
+        "errors": len(errors),
+        "rps": round(len(latencies) / elapsed, 1),
+        "p50_ms": pct(0.50),
+        "p90_ms": pct(0.90),
+        "p99_ms": pct(0.99),
+        "mean_ms": round(sum(latencies) / len(latencies), 3),
+        "concurrency": concurrency,
+        "duration_s": round(elapsed, 2),
+    }
+
+
+def main(argv=None) -> dict:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--url", required=True)
+    parser.add_argument("--body", default='{"inputs": []}')
+    parser.add_argument("--body_file", default=None)
+    parser.add_argument("--concurrency", default=4, type=int)
+    parser.add_argument("--duration", default=10.0, type=float)
+    parser.add_argument("--warmup", default=2.0, type=float)
+    args = parser.parse_args(argv)
+    body = (
+        open(args.body_file, "rb").read() if args.body_file
+        else args.body.encode()
+    )
+    result = asyncio.run(
+        run(args.url, body, args.concurrency, args.duration, args.warmup)
+    )
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,178 @@
+"""gRPC Open Inference Protocol wire-format proof (VERDICT weak #9).
+
+The pb2 module is hand-built (no grpc_tools in this image), so nothing
+upstream guarantees its field numbers.  These tests decode the SERIALIZED
+BYTES with a minimal protobuf tag reader and assert every tag matches the
+public grpc_predict_v2.proto numbering — a field-number slip that would
+interop-fail against a reference-generated client fails loudly here.
+"""
+
+import struct
+
+import pytest
+
+from kserve_tpu.protocol.grpc import open_inference_pb2 as pb
+
+
+def read_varint(buf, i):
+    shift = 0
+    val = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def decode_tags(buf):
+    """[(field_number, wire_type, payload)] for one message level."""
+    out = []
+    i = 0
+    while i < len(buf):
+        tag, i = read_varint(buf, i)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:  # varint
+            val, i = read_varint(buf, i)
+            out.append((field, wire, val))
+        elif wire == 2:  # length-delimited
+            ln, i = read_varint(buf, i)
+            out.append((field, wire, buf[i : i + ln]))
+            i += ln
+        elif wire == 5:  # 32-bit
+            out.append((field, wire, buf[i : i + 4]))
+            i += 4
+        elif wire == 1:  # 64-bit
+            out.append((field, wire, buf[i : i + 8]))
+            i += 8
+        else:
+            raise AssertionError(f"unexpected wire type {wire}")
+    return out
+
+
+def fields(buf):
+    return {f for f, _, _ in decode_tags(buf)}
+
+
+class TestModelInferRequestWire:
+    def test_field_numbers_match_public_proto(self):
+        req = pb.ModelInferRequest(
+            model_name="m",
+            model_version="2",
+            id="req-1",
+            inputs=[
+                pb.ModelInferRequest.InferInputTensor(
+                    name="x",
+                    datatype="FP32",
+                    shape=[1, 3],
+                    contents=pb.InferTensorContents(fp32_contents=[1.0, 2.0, 3.0]),
+                )
+            ],
+            raw_input_contents=[b"\x01\x02"],
+        )
+        tags = decode_tags(req.SerializeToString())
+        by_field = {}
+        for f, w, payload in tags:
+            by_field.setdefault(f, []).append((w, payload))
+        # public grpc_predict_v2.proto: model_name=1, model_version=2, id=3,
+        # parameters=4, inputs=5, outputs=6, raw_input_contents=7
+        assert by_field[1] == [(2, b"m")]
+        assert by_field[2] == [(2, b"2")]
+        assert by_field[3] == [(2, b"req-1")]
+        assert 5 in by_field and by_field[5][0][0] == 2
+        assert by_field[7] == [(2, b"\x01\x02")]
+        assert 4 not in by_field and 6 not in by_field  # unset stay absent
+
+        # InferInputTensor: name=1, datatype=2, shape=3, parameters=4,
+        # contents=5
+        tensor_tags = decode_tags(by_field[5][0][1])
+        tensor_fields = {f: (w, p) for f, w, p in tensor_tags}
+        assert tensor_fields[1] == (2, b"x")
+        assert tensor_fields[2] == (2, b"FP32")
+        assert 3 in tensor_fields  # shape (packed varints or repeated)
+        assert 5 in tensor_fields  # contents submessage
+        # InferTensorContents: fp32_contents=6 (packed 32-bit floats)
+        contents_tags = decode_tags(tensor_fields[5][1])
+        fp32 = [t for t in contents_tags if t[0] == 6]
+        assert fp32, "fp32_contents must be field 6"
+        floats = struct.unpack("<3f", fp32[0][2]) if fp32[0][1] == 2 else None
+        assert floats == (1.0, 2.0, 3.0)
+
+    def test_reference_encoded_bytes_parse(self):
+        """Bytes a REFERENCE-generated client would send (hand-assembled
+        from the public field numbers) must parse into our classes."""
+        # model_name="m" (field 1), id="i" (field 3),
+        # inputs(field 5){ name="x"(1), datatype="INT32"(2),
+        #                  shape=[2](3 packed), contents(5){int_contents=[7,8](2 packed)} }
+        contents = b"\x12\x02\x07\x08"  # field 2 (int_contents), packed [7, 8]
+        tensor = (
+            b"\x0a\x01x"          # name="x"
+            b"\x12\x05INT32"      # datatype
+            b"\x1a\x01\x02"       # shape=[2] packed
+            b"\x2a" + bytes([len(contents)]) + contents  # contents
+        )
+        wire = (
+            b"\x0a\x01m"          # model_name
+            b"\x1a\x01i"          # id
+            b"\x2a" + bytes([len(tensor)]) + tensor  # inputs[0]
+        )
+        req = pb.ModelInferRequest()
+        req.ParseFromString(wire)
+        assert req.model_name == "m"
+        assert req.id == "i"
+        assert len(req.inputs) == 1
+        assert req.inputs[0].name == "x"
+        assert req.inputs[0].datatype == "INT32"
+        assert list(req.inputs[0].shape) == [2]
+        assert list(req.inputs[0].contents.int_contents) == [7, 8]
+
+
+class TestResponseAndMetaWire:
+    def test_model_infer_response_fields(self):
+        resp = pb.ModelInferResponse(
+            model_name="m",
+            id="r",
+            outputs=[
+                pb.ModelInferResponse.InferOutputTensor(
+                    name="y", datatype="FP32", shape=[1],
+                    contents=pb.InferTensorContents(fp32_contents=[9.0]),
+                )
+            ],
+            raw_output_contents=[b"\x00"],
+        )
+        by_field = {}
+        for f, w, p in decode_tags(resp.SerializeToString()):
+            by_field.setdefault(f, []).append((w, p))
+        # model_name=1, model_version=2, id=3, parameters=4, outputs=5,
+        # raw_output_contents=6
+        assert by_field[1] == [(2, b"m")]
+        assert by_field[3] == [(2, b"r")]
+        assert 5 in by_field
+        assert by_field[6] == [(2, b"\x00")]
+
+    def test_liveness_and_readiness_wire(self):
+        live = pb.ServerLiveResponse(live=True)
+        assert decode_tags(live.SerializeToString()) == [(1, 0, 1)]
+        ready = pb.ServerReadyResponse(ready=True)
+        assert decode_tags(ready.SerializeToString()) == [(1, 0, 1)]
+        mready = pb.ModelReadyRequest(name="m", version="1")
+        by_field = {f: p for f, _, p in decode_tags(mready.SerializeToString())}
+        assert by_field[1] == b"m" and by_field[2] == b"1"
+
+    def test_contents_field_numbers(self):
+        """InferTensorContents: bool=1 int=2 int64=3 uint=4 uint64=5
+        fp32=6 fp64=7 bytes=8 (public spec)."""
+        cases = [
+            (pb.InferTensorContents(bool_contents=[True]), 1),
+            (pb.InferTensorContents(int_contents=[1]), 2),
+            (pb.InferTensorContents(int64_contents=[1]), 3),
+            (pb.InferTensorContents(uint_contents=[1]), 4),
+            (pb.InferTensorContents(uint64_contents=[1]), 5),
+            (pb.InferTensorContents(fp32_contents=[1.0]), 6),
+            (pb.InferTensorContents(fp64_contents=[1.0]), 7),
+            (pb.InferTensorContents(bytes_contents=[b"z"]), 8),
+        ]
+        for msg, want_field in cases:
+            got = fields(msg.SerializeToString())
+            assert got == {want_field}, (want_field, got)
