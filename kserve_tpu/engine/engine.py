@@ -744,9 +744,17 @@ class LLMEngine:
 
     def _admit_batch(self) -> bool:
         """Prefill up to `prefill_batch` waiting requests in ONE compiled
-        call (padded to the widest length bucket among them); False when no
-        request can be admitted (no slots / no pages)."""
-        admitted: List[tuple] = []  # (slot_index, request, pages)
+        call (padded to the widest TAIL bucket among them); False when no
+        request can be admitted (no slots / no pages).
+
+        Each row carries its own chunk_start, so prefix-cache hits stay
+        BATCHED: a row with cached pages prefills only its uncached tail
+        while attending to the cached history.  sp>1 engines use the fused
+        ring-attention prefill instead (no cache; whole prompt per row)."""
+        use_fused = self.config.sp > 1
+        ps = self.config.page_size
+        chunk_cap = self.config.prefill_buckets[-1]
+        admitted: List[tuple] = []  # (slot_index, request, pages, n_cached, seq)
         free = [i for i, s in enumerate(self._slots) if s.request_id is None]
         while (
             self._waiting
@@ -761,75 +769,103 @@ class LLMEngine:
                 if admitted:
                     break  # flush the batched prefill first
                 return self._admit_injected(req)
+            seq = (
+                req.prompt_ids + req.resume["generated"][:-1]
+                if req.resume is not None else req.prompt_ids
+            )
             hits = (
-                self._prefix_cache_lookup(
-                    req.prompt_ids + req.resume["generated"][:-1]
-                    if req.resume is not None else req.prompt_ids
-                )
-                if req.adapter_id < 0 else []
+                self._prefix_cache_lookup(seq)
+                if req.adapter_id < 0 and not use_fused else []
             )
-            # chunked admission is one-request-at-a-time: take it when the
-            # prompt can't fit a bucket, or when the cache covers enough of
-            # it that skipping the recompute beats batched amortization
-            # (batched prefill with per-row chunk_start is the follow-up
-            # that removes this trade)
-            big_hit = (
-                len(hits) * self.config.page_size * 2 >= req.kv_len
-                and hits
-            )
-            if req.kv_len > self.config.prefill_buckets[-1] or big_hit:
+            tail = req.kv_len - len(hits) * ps
+            if tail > chunk_cap:
                 if admitted:
                     break  # flush the batched prefill first
                 return self._admit_chunked(req, hits)
-            n_pages = pages_needed(req.kv_len + 1, self.config.page_size)
-            if not self._ensure_allocatable(self._admission_pages(req, n_pages)):
+            need = pages_needed(req.kv_len + 1, ps)
+            # pin cache hits before eviction can free them (see
+            # _admit_chunked for why this must precede _ensure_allocatable)
+            self.allocator.share(hits)
+            if not self._ensure_allocatable(
+                self._admission_pages(req, need - len(hits))
+            ):
+                self.allocator.free(hits)
                 break
             self._waiting.pop(0)
-            admitted.append((free.pop(0), req, self.allocator.allocate(n_pages)))
+            self.prefix_cache_hits += len(hits)
+            pages = list(hits) + self.allocator.allocate(need - len(hits))
+            admitted.append((free.pop(0), req, pages, len(hits), seq))
         if not admitted:
             return False
 
-        bucket = self._bucket_for(max(r.kv_len for _, r, _ in admitted))
+        bucket = self._bucket_for(
+            max(len(seq) - c * ps for _, _, _, c, seq in admitted)
+        )
         # pad the batch dim to pow2 so the compile cache stays small
         Bp = 1
         while Bp < len(admitted):
             Bp *= 2
+        # history-attending chunk prefill only pays off when a row actually
+        # HAS history: cold batches take the fused program (no masked
+        # history gather, on-device prompt mask, single dispatch)
+        use_fused_call = use_fused or all(c == 0 for _, _, _, c, _ in admitted)
         tokens = np.zeros((Bp, bucket), np.int32)
         valid = np.zeros((Bp,), np.int32)
-        page_ids = np.zeros((Bp, self.config.max_pages_per_seq), np.int32)
+        width = (
+            self.config.max_pages_per_seq if use_fused_call
+            else self.config.page_bucket(
+                max(len(pages) for _, _, pages, _, _ in admitted)
+            )
+        )
+        page_ids = np.zeros((Bp, width), np.int32)
         adapter_arr = np.full((Bp,), -1, np.int32)
         params_list = [SamplingParams() for _ in range(Bp)]
-        for j, (_, req, pages) in enumerate(admitted):
-            if req.resume is not None:
-                # recompute-resume: re-prefill prompt + generated[:-1]; the
-                # last generated token's KV is written by its decode step
-                seq = req.prompt_ids + req.resume["generated"][:-1]
-            else:
-                seq = req.prompt_ids
-            n = len(seq)
-            tokens[j, :n] = seq
-            valid[j] = n
+        if not use_fused_call:
+            chunk_start = np.zeros((Bp,), np.int32)
+            in_prompt = np.zeros((Bp, self.model_config.vocab_size), bool)
+        for j, (_, req, pages, n_cached, seq) in enumerate(admitted):
+            start = n_cached * ps
+            tail_tokens = seq[start:]
+            tokens[j, : len(tail_tokens)] = tail_tokens
+            valid[j] = len(tail_tokens)
             page_ids[j, : len(pages)] = pages
             adapter_arr[j] = req.adapter_id
             params_list[j] = req.params
+            if not use_fused_call:
+                chunk_start[j] = start
+                in_prompt[j, np.asarray(seq, np.int64)] = True
         state = SamplingState.from_params(params_list)
         rng = jax.random.fold_in(self._base_rng, self._next_step())
-        first, self.kv_pages = self._prefill_fn(
-            self.params,
-            jnp.asarray(tokens),
-            jnp.asarray(valid),
-            self.kv_pages,
-            jnp.asarray(page_ids),
-            state,
-            rng,
-            jnp.asarray(adapter_arr),
-        )
+        if use_fused_call:
+            first, self.kv_pages = self._prefill_fn(
+                self.params,
+                jnp.asarray(tokens),
+                jnp.asarray(valid),
+                self.kv_pages,
+                jnp.asarray(page_ids),
+                state,
+                rng,
+                jnp.asarray(adapter_arr),
+            )
+        else:
+            logits, self.kv_pages = self._prefill_chunk_fn(
+                self.params,
+                jnp.asarray(tokens),
+                jnp.asarray(chunk_start),
+                jnp.asarray(valid),
+                self.kv_pages,
+                jnp.asarray(page_ids),
+                jnp.asarray(adapter_arr),
+            )
+            first = self._sample_first_fn(
+                logits, state, rng, jnp.asarray(in_prompt)
+            )
         first_np = np.asarray(first)
-        for j, (idx, req, pages) in enumerate(admitted):
+        for j, (idx, req, pages, _, seq) in enumerate(admitted):
             if req.resume is None:
                 # resume re-prefills are recompute overhead, not new prompt
                 # traffic — don't double-count them
-                PROMPT_TOKENS.labels(model_name=self._mlabel).inc(int(valid[j]))
+                PROMPT_TOKENS.labels(model_name=self._mlabel).inc(len(seq))
             slot = self._slots[idx]
             if req.resume is not None:
                 # stream state survives preemption; the re-prefill's sampled

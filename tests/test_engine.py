@@ -467,3 +467,27 @@ class TestPrefixCache:
             assert len(engine._prefix_cache) * 1 < 16
         finally:
             await engine.stop()
+
+    @async_test
+    async def test_cache_hits_stay_batched(self):
+        """Short prompts with cached prefixes go through BATCHED admission
+        (per-row chunk_start), never the serial chunked path."""
+        engine = self._engine()
+        prefix = list(range(3, 35))  # 4 full pages
+        params = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+        await engine.start()
+        try:
+            await collect(engine, prefix + [100, 101], params)  # warm
+
+            def no_serial(*a, **k):
+                raise AssertionError("serial _admit_chunked used for a short cached prompt")
+
+            engine._admit_chunked = no_serial
+            results = await asyncio.gather(
+                collect(engine, prefix + [110, 111], params),
+                collect(engine, prefix + [120, 121], params),
+            )
+            assert all(r[-1].finished for r in results)
+            assert engine.prefix_cache_hits == 8  # 4 pages x 2 requests
+        finally:
+            await engine.stop()
