@@ -14,11 +14,41 @@ import asyncio
 import json
 import os
 import sys
+import threading
 import time
 
 os.environ.setdefault("XLA_PYTHON_CLIENT_MEM_FRACTION", "0.92")
 
+# this image's TPU plugin force-selects itself regardless of env vars; the
+# config knob is the only reliable CPU override (for smoke runs off-chip)
+if "cpu" in (
+    os.environ.get("JAX_PLATFORM_NAME", "") + os.environ.get("JAX_PLATFORMS", "")
+).lower():
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+
 BASELINE_TOK_S_PER_CHIP = 1000.0
+WATCHDOG_SECONDS = 1200  # a wedged device tunnel must yield a result line,
+# not hang the driver (normal TPU run incl. warmup is ~4 min)
+
+
+def _arm_watchdog():
+    def fire():
+        print(json.dumps({
+            "metric": "llama3_1b_decode_throughput",
+            "value": 0.0,
+            "unit": "tok/s/chip",
+            "vs_baseline": 0.0,
+            "detail": {"error": f"watchdog: no result within {WATCHDOG_SECONDS}s "
+                                "(device tunnel hung?)"},
+        }), flush=True)
+        os._exit(3)
+
+    timer = threading.Timer(WATCHDOG_SECONDS, fire)
+    timer.daemon = True
+    timer.start()
+    return timer
 
 
 async def run_bench():
@@ -108,5 +138,7 @@ async def run_bench():
 
 
 if __name__ == "__main__":
+    watchdog = _arm_watchdog()
     result = asyncio.run(run_bench())
+    watchdog.cancel()
     print(json.dumps(result))

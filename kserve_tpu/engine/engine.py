@@ -120,13 +120,19 @@ class _Slot:
     __slots__ = (
         "request_id", "prompt_len", "prompt_ids", "pages", "pos", "generated",
         "params", "queue", "detok", "stop_texts", "admitted_at", "adapter_id",
+        "prefilling",
     )
 
     def __init__(self):
         self.request_id: Optional[str] = None
+        # long-prompt chunked prefill in progress: {"req", "seq", "done",
+        # "logits"} — the run loop advances ONE chunk per iteration so
+        # in-flight decode streams keep emitting (bounded stall)
+        self.prefilling: Optional[dict] = None
 
     def reset(self):
         self.request_id = None
+        self.prefilling = None
 
 
 class _QueuedRequest:
@@ -706,7 +712,12 @@ class LLMEngine:
                         break
                     did_work = True
                 ENGINE_QUEUE_DEPTH.labels(model_name=self._mlabel).set(len(self._waiting))
-                active = [s for s in self._slots if s.request_id is not None]
+                if self._advance_prefills():
+                    did_work = True
+                active = [
+                    s for s in self._slots
+                    if s.request_id is not None and s.prefilling is None
+                ]
                 ENGINE_BATCH_OCCUPANCY.labels(model_name=self._mlabel).set(len(active))
                 ENGINE_KV_PAGES_FREE.labels(model_name=self._mlabel).set(
                     self.allocator.free_pages
@@ -984,74 +995,113 @@ class LLMEngine:
         # this sequence reads them)
         self.allocator.share(cached)
         fresh_needed = need - len(cached)
-        if not self._ensure_allocatable(self._admission_pages(req, fresh_needed)):
+        if not self._ensure_allocatable(
+            self._admission_pages(req, fresh_needed, headroom=True)
+        ):
             self.allocator.free(cached)  # release the early reference
             return False
         self._waiting.remove(req)
         self.prefix_cache_hits += len(cached)
         pages = cached + self.allocator.allocate(fresh_needed)
-        page_ids_full = np.zeros((self.config.max_pages_per_seq,), np.int32)
-        page_ids_full[: len(pages)] = pages
+        # the slot enters "prefilling" state immediately and the run loop
+        # advances ONE chunk per iteration — in-flight decode streams keep
+        # emitting between chunks, and the queue behind this request isn't
+        # head-of-line blocked for its whole prefill
+        slot = self._slots[idx]
+        slot.request_id = req.request_id
+        slot.pages = pages
+        slot.queue = req.queue  # engine-crash propagation needs the stream
+        slot.prefilling = {
+            "req": req,
+            "seq": seq,
+            "done": len(cached) * self.config.page_size,
+            "logits": None,
+        }
+        return True
+
+    def _advance_prefills(self) -> bool:
+        """One chunk of progress for every prefilling slot; completes slots
+        whose prompt is fully prefilled (sampling the first token)."""
+        progressed = False
         chunk_cap = self.config.prefill_buckets[-1]
-        adapter_arr = jnp.asarray(np.asarray([req.adapter_id], np.int32))
-        done = len(cached) * self.config.page_size
-        logits = None
-        # chunks dispatch back-to-back: on device they run before the next
-        # decode chunk, so a very long admission delays in-flight streams by
-        # its full prefill (interleaving chunk/decode dispatches via a
-        # prefill-in-progress slot state is the known follow-up)
-        while done < total:
-            n = min(chunk_cap, total - done)
-            bucket = self._bucket_for(n)
-            tokens = np.zeros((1, bucket), np.int32)
-            tokens[0, :n] = seq[done : done + n]
-            # table width must cover this chunk's writes (and the history
-            # gather reads the same table, masked by history length)
-            width = self.config.page_bucket(
-                pages_needed(done + n, self.config.page_size)
-            )
-            logits, self.kv_pages = self._prefill_chunk_fn(
-                self.params,
-                jnp.asarray(tokens),
-                jnp.asarray(np.asarray([done], np.int32)),
-                jnp.asarray(np.asarray([n], np.int32)),
-                self.kv_pages,
-                jnp.asarray(page_ids_full[None, :width]),
-                adapter_arr,
-            )
-            done += n
+        for idx, slot in enumerate(self._slots):
+            pf = slot.prefilling
+            if slot.request_id is None or pf is None:
+                continue
+            seq, done = pf["seq"], pf["done"]
+            total = len(seq)
+            if done < total:
+                n = min(chunk_cap, total - done)
+                bucket = self._bucket_for(n)
+                tokens = np.zeros((1, bucket), np.int32)
+                tokens[0, :n] = seq[done : done + n]
+                page_ids = np.zeros((self.config.max_pages_per_seq,), np.int32)
+                page_ids[: len(slot.pages)] = slot.pages
+                # table width must cover this chunk's writes (the history
+                # gather reads the same table, masked by history length)
+                width = self.config.page_bucket(
+                    pages_needed(done + n, self.config.page_size)
+                )
+                pf["logits"], self.kv_pages = self._prefill_chunk_fn(
+                    self.params,
+                    jnp.asarray(tokens),
+                    jnp.asarray(np.asarray([done], np.int32)),
+                    jnp.asarray(np.asarray([n], np.int32)),
+                    self.kv_pages,
+                    jnp.asarray(page_ids[None, :width]),
+                    jnp.asarray(np.asarray([pf["req"].adapter_id], np.int32)),
+                )
+                pf["done"] = done + n
+                if pf["req"].adapter_id < 0 and pf["req"].resume is None:
+                    self._prefix_cache_register(
+                        pf["req"].prompt_ids[
+                            : min(pf["done"], len(pf["req"].prompt_ids))
+                        ],
+                        slot.pages,
+                    )
+                progressed = True
+            if pf["done"] >= total:
+                self._finish_prefilling(idx, slot, pf)
+                progressed = True
+        return progressed
+
+    def _finish_prefilling(self, idx: int, slot: _Slot, pf: dict) -> None:
+        req = pf["req"]
+        seq = pf["seq"]
+        pages = slot.pages
+        total = len(seq)
         PROMPT_TOKENS.labels(model_name=self._mlabel).inc(
             total if req.resume is None else 0
         )
         if req.adapter_id < 0:
             self._prefix_cache_register(req.prompt_ids, pages)
-        slot = self._slots[idx]
+        slot.prefilling = None
         if req.resume is not None:
             self._seat_resumed(slot, req, pages)
             self._mark_penalty_dirty(idx)
-            return True
+            return
         state = SamplingState.from_params([req.params])
         rng = jax.random.fold_in(self._base_rng, self._next_step())
         in_prompt = np.zeros((1, self.model_config.vocab_size), bool)
         in_prompt[0, np.asarray(seq, np.int64)] = True
         first_token = int(np.asarray(
-            self._sample_first_fn(logits, state, rng, jnp.asarray(in_prompt))
+            self._sample_first_fn(pf["logits"], state, rng, jnp.asarray(in_prompt))
         )[0])
         self._seat_fresh(slot, req, pages, first_token)
         self._mark_penalty_dirty(idx)
         self._emit(slot, first_token)
-        return True
 
-    def _admission_pages(self, req: "_QueuedRequest", need: int) -> int:
-        """Pages that must be free to admit.  Resumes additionally require a
-        couple of chunks of decode headroom (capped at what the cache can
-        ever provide) — re-admitting a preempted sequence into an
-        immediately-starving cache would ping-pong its full KV device<->host
-        every few tokens."""
-        if req.resume is None:
+    def _admission_pages(self, req: "_QueuedRequest", need: int,
+                         headroom: bool = False) -> int:
+        """Pages that must be free to admit.  Resumes and long chunked
+        admissions additionally require a couple of chunks of decode
+        headroom (capped at what the cache can ever provide) — admitting
+        into an immediately-starving cache would just bounce the work back
+        out (KV ping-pong for resumes, aborted prefills for long prompts)."""
+        if req.resume is None and not headroom:
             return need
-        headroom = pages_needed(2 * self.config.steps_per_sync, self.config.page_size)
-        return min(need + headroom, self.config.num_pages - 1)
+        extra = pages_needed(2 * self.config.steps_per_sync, self.config.page_size)
+        return min(need + extra, self.config.num_pages - 1)
 
     def _seat_resumed(self, slot: _Slot, req: "_QueuedRequest", pages: List[int]) -> None:
         r = req.resume
@@ -1133,7 +1183,10 @@ class LLMEngine:
         steps = self.config.steps_per_sync
         ps = self.config.page_size
         while True:
-            active = [s for s in self._slots if s.request_id is not None]
+            active = [
+                s for s in self._slots
+                if s.request_id is not None and s.prefilling is None
+            ]
             if not active:
                 return
             starved = []
@@ -1149,6 +1202,16 @@ class LLMEngine:
                 return
             # cold cached pages go before anyone gets preempted
             if self._ensure_allocatable(1):
+                continue
+            # a long admission still prefilling is the preferred victim: it
+            # has emitted nothing, its pages requeue cleanly, and truncating
+            # a LIVE decode stream to protect it would be backwards
+            prefilling = [
+                s for s in self._slots
+                if s.request_id is not None and s.prefilling is not None
+            ]
+            if prefilling:
+                self._preempt_prefilling(prefilling[-1])
                 continue
             oldest = min(active, key=lambda s: s.admitted_at)
             candidates = [
@@ -1171,6 +1234,19 @@ class LLMEngine:
         """Every slot has a resume path now: chunked re-prefill covers any
         length, and the host tier (when budgeted) avoids the recompute."""
         return True
+
+    def _preempt_prefilling(self, slot: _Slot) -> None:
+        """Abort an in-progress long admission: requeue its request (front)
+        and free its pages.  Nothing was emitted, so nothing is lost but
+        the chunks already computed."""
+        req = slot.prefilling["req"]
+        self._free_pages(slot.pages)
+        self._mark_penalty_dirty(self._slots.index(slot))
+        slot.reset()
+        self._waiting.insert(0, req)
+        self.preemption_count += 1
+        ENGINE_PREEMPTIONS.labels(model_name=self._mlabel).inc()
+        logger.info("preempted prefilling request %s", req.request_id)
 
     def _preempt(self, slot: _Slot) -> None:
         """Requeue a running slot (front of queue), freeing its pages.  With
@@ -1246,7 +1322,7 @@ class LLMEngine:
         params_list = [SamplingParams() for _ in range(B)]
         max_owned = 1
         for i, slot in enumerate(self._slots):
-            if slot.request_id is None:
+            if slot.request_id is None or slot.prefilling is not None:
                 continue
             if prev is not None:
                 if not prev["active"][i]:
@@ -1432,6 +1508,9 @@ class LLMEngine:
             admission_blocked = (
                 not self._waiting or self._free_slot_index() is None
             )
+            prefill_pending = any(
+                s.prefilling is not None for s in self._slots
+            )
             predictable_finish = any(
                 s.request_id is not None
                 and meta["active"][i]
@@ -1442,6 +1521,7 @@ class LLMEngine:
             if (
                 admission_blocked
                 and not predictable_finish
+                and not prefill_pending  # alternate with prefill chunks
                 and not meta.get("penalized")
                 and not self._stopped
             ):

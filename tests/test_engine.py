@@ -491,3 +491,50 @@ class TestPrefixCache:
             assert engine.prefix_cache_hits == 8  # 4 pages x 2 requests
         finally:
             await engine.stop()
+
+
+class TestInterleavedLongAdmission:
+    @async_test
+    async def test_decode_streams_continue_during_long_admission(self):
+        """A long-prompt admission must not stall in-flight decode streams:
+        chunks and decode dispatches alternate, so the short request keeps
+        emitting between prefill chunks."""
+        engine = make_engine(
+            max_prefill_len=16, prefill_buckets=(16,), num_pages=128,
+            max_pages_per_seq=64, max_batch_size=4,
+        )
+        await engine.start()
+        short_progress = []
+
+        async def short():
+            async for out in engine.generate(
+                [1, 2, 3],
+                SamplingParams(max_tokens=200, temperature=0.0, ignore_eos=True),
+            ):
+                short_progress.append(out.num_generated)
+
+        try:
+            task = asyncio.create_task(short())
+            while not short_progress:  # short is live and decoding
+                await asyncio.sleep(0.01)
+
+            seen_at_chunk = []
+            orig = engine._prefill_chunk_fn
+
+            def spy(*args, **kwargs):
+                seen_at_chunk.append(short_progress[-1])
+                return orig(*args, **kwargs)
+
+            engine._prefill_chunk_fn = spy
+            long_prompt = [3 + (i % 500) for i in range(400)]  # 25 chunks
+            outs = await collect(
+                engine, long_prompt,
+                SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True),
+            )
+            assert outs[-1].finished
+            assert len(seen_at_chunk) >= 20  # chunked as expected
+            # the short stream advanced while the long prompt was admitting
+            assert seen_at_chunk[-1] > seen_at_chunk[0], seen_at_chunk
+            task.cancel()
+        finally:
+            await engine.stop()
