@@ -21,9 +21,10 @@ os.environ.setdefault("XLA_PYTHON_CLIENT_MEM_FRACTION", "0.92")
 
 # this image's TPU plugin force-selects itself regardless of env vars; the
 # config knob is the only reliable CPU override (for smoke runs off-chip)
-if "cpu" in (
-    os.environ.get("JAX_PLATFORM_NAME", "") + os.environ.get("JAX_PLATFORMS", "")
-).lower():
+_platform_spec = (
+    os.environ.get("JAX_PLATFORM_NAME") or os.environ.get("JAX_PLATFORMS") or ""
+).strip().lower()
+if _platform_spec.split(",")[0] == "cpu":
     import jax as _jax
 
     _jax.config.update("jax_platforms", "cpu")

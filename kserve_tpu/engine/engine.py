@@ -942,11 +942,14 @@ class LLMEngine:
             pages.append(page)
         return pages
 
-    def _prefix_cache_register(self, prompt_ids: List[int], pages: List[int]) -> None:
+    def _prefix_cache_register(self, prompt_ids: List[int], pages: List[int],
+                               start_page: int = 0) -> None:
+        """Register full prompt pages; start_page skips already-registered
+        prefixes (incremental registration during interleaved prefill)."""
         if not self.config.prefix_cache:
             return
         for i, key in enumerate(self._prefix_keys(prompt_ids, for_lookup=False)):
-            if key in self._prefix_cache:
+            if i < start_page or key in self._prefix_cache:
                 continue
             page = pages[i]
             self._prefix_cache[key] = page
@@ -1053,12 +1056,16 @@ class LLMEngine:
                 )
                 pf["done"] = done + n
                 if pf["req"].adapter_id < 0 and pf["req"].resume is None:
+                    # register only the pages COMPLETED by this chunk — a
+                    # full re-register would re-hash the whole prefix per
+                    # chunk (O(L^2) host work on the engine loop)
+                    covered = min(pf["done"], len(pf["req"].prompt_ids))
                     self._prefix_cache_register(
-                        pf["req"].prompt_ids[
-                            : min(pf["done"], len(pf["req"].prompt_ids))
-                        ],
+                        pf["req"].prompt_ids[:covered],
                         slot.pages,
+                        start_page=pf.get("registered", 0),
                     )
+                    pf["registered"] = covered // self.config.page_size
                 progressed = True
             if pf["done"] >= total:
                 self._finish_prefilling(idx, slot, pf)
@@ -1073,7 +1080,9 @@ class LLMEngine:
         PROMPT_TOKENS.labels(model_name=self._mlabel).inc(
             total if req.resume is None else 0
         )
-        if req.adapter_id < 0:
+        if req.adapter_id < 0 and req.resume is not None:
+            # non-resume prompts registered incrementally per chunk; the
+            # resume path registers its prompt prefix once here
             self._prefix_cache_register(req.prompt_ids, pages)
         slot.prefilling = None
         if req.resume is not None:

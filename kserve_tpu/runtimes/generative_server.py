@@ -141,6 +141,19 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
     # ---------------- helpers ----------------
 
     def _sampling_from(self, req, max_len_default: int = 16) -> SamplingParams:
+        logprobs = getattr(req, "logprobs", None)
+        wants_logprobs = (
+            logprobs is True  # chat: bool, default False
+            # legacy completions: int, where 0 validly requests the sampled
+            # token's logprob — any int counts as a request
+            or (isinstance(logprobs, int) and not isinstance(logprobs, bool))
+            or getattr(req, "top_logprobs", None) is not None
+        )
+        if wants_logprobs:
+            # explicit 400 beats silently returning a response without the
+            # field the client asked for; logprob emission through the
+            # decode scan is a planned feature
+            raise InvalidInput("logprobs is not supported by this runtime yet")
         max_tokens = (
             getattr(req, "max_completion_tokens", None)
             or getattr(req, "max_tokens", None)

@@ -535,6 +535,6 @@ class TestInterleavedLongAdmission:
             assert len(seen_at_chunk) >= 20  # chunked as expected
             # the short stream advanced while the long prompt was admitting
             assert seen_at_chunk[-1] > seen_at_chunk[0], seen_at_chunk
-            task.cancel()
         finally:
+            task.cancel()
             await engine.stop()

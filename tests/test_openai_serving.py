@@ -168,3 +168,21 @@ class TestOpenAIServing:
         finally:
             await client.close()
             await model.engine.stop()
+
+
+class TestUnsupportedFields:
+    def test_logprobs_rejected_explicitly(self):
+        """ADVICE: unsupported sampling fields must 400, not silently drop."""
+        import pytest
+
+        from kserve_tpu.errors import InvalidInput
+        from kserve_tpu.models.llama import LlamaConfig
+        from kserve_tpu.protocol.openai.types import CompletionRequest
+        from kserve_tpu.runtimes.generative_server import JAXGenerativeModel
+
+        model = JAXGenerativeModel(
+            "m", model_config=LlamaConfig.tiny(), random_weights=True
+        )
+        req = CompletionRequest(model="m", prompt="hi", logprobs=2)
+        with pytest.raises(InvalidInput, match="logprobs"):
+            model._sampling_from(req)
