@@ -39,7 +39,13 @@ from ..metrics import (
 )
 from ..models import llama
 from ..parallel import sharding as shd
-from .kvcache import KVCacheConfig, PageAllocator, init_kv_pages, pages_needed
+from .kvcache import (
+    KVCacheConfig,
+    PageAllocator,
+    init_kv_pages,
+    init_kv_scales,
+    pages_needed,
+)
 from .sampling import SamplingParams, SamplingState, apply_penalties, sample_tokens
 from .tokenizer import BaseTokenizer, IncrementalDetokenizer
 
@@ -63,6 +69,11 @@ class EngineConfig:
     # re-injects on resume — no recompute
     kv_offload: str = "none"
     kv_offload_gib: float = 0.0
+    # int8 KV quantization (kvcache.py): halves decode KV traffic and
+    # doubles capacity; per-row absmax scales ride a parallel array.
+    # Incompatible (today) with the pallas kernel, P/D transfer and host
+    # offload spill — those paths stay bf16.
+    kv_quant: str = "none"  # none | int8
     # None = auto (ops/attention.py): the fused Pallas kernel for
     # long-context decode (page-table width >= PALLAS_MIN_PAGES, head_dim %
     # 128 == 0), the XLA gather for short context — each where it measures
@@ -241,7 +252,35 @@ class LLMEngine:
             dtype=engine_config.dtype,
         )
         self.cache_config = cache_cfg
-        self.kv_pages = shd.shard_kv_pages(init_kv_pages(cache_cfg), self.mesh)
+        if engine_config.kv_quant not in ("none", "int8"):
+            raise ValueError(
+                f"unknown kv_quant {engine_config.kv_quant!r}; supported: none, int8"
+            )
+        if engine_config.kv_quant == "int8":
+            if engine_config.kv_offload == "host":
+                raise NotImplementedError(
+                    "kv_quant=int8 with host offload spill is not supported yet"
+                )
+            if engine_config.use_pallas:
+                # fail at init, not inside the jitted decode trace where the
+                # error would kill the engine loop for all traffic
+                raise NotImplementedError(
+                    "the pallas kernel does not read int8 KV pages yet; "
+                    "use kv_quant=int8 with use_pallas None/False"
+                )
+            from dataclasses import replace as _replace
+
+            pages = shd.shard_kv_pages(
+                init_kv_pages(_replace(cache_cfg, dtype="int8")), self.mesh
+            )
+            scale_sharding = shd.named(
+                self.mesh,
+                jax.sharding.PartitionSpec(None, None, shd.MODEL_AXIS, None),
+            )
+            scales = init_kv_scales(cache_cfg, scale_sharding)
+            self.kv_pages = list(zip(pages, scales))
+        else:
+            self.kv_pages = shd.shard_kv_pages(init_kv_pages(cache_cfg), self.mesh)
         self.allocator = PageAllocator(cache_cfg.num_pages)
 
         B = engine_config.max_batch_size
@@ -537,6 +576,10 @@ class LLMEngine:
             raise ValueError(
                 f"prompt+max_tokens exceeds max_model_len {self.config.max_model_len}"
             )
+        if self.config.kv_quant != "none":
+            raise NotImplementedError(
+                "KV injection over a quantized cache is not supported yet"
+            )
         # validation runs HERE (sync), not at first __anext__: a shape
         # mismatch inside _run_loop would kill the engine for all traffic,
         # not just this request (version-skewed prefill peer)
@@ -593,6 +636,11 @@ class LLMEngine:
         Parity: the KV-connector role of the reference's disaggregated
         serving (workload_kvcache.go, llm_inference_service_types.go:105-110)
         with the transfer payload produced TPU-side in one gather."""
+        if self.config.kv_quant != "none":
+            raise NotImplementedError(
+                "detached prefill (P/D transfer) over a quantized KV cache "
+                "is not supported yet"
+            )
         n = len(prompt_ids)
         if n > self.config.max_prefill_len:
             raise ValueError(
@@ -1271,7 +1319,9 @@ class LLMEngine:
             P * self.model_config.n_layers * self.cache_config.bytes_per_page()
         )
         # spill when the budget allows; otherwise chunked re-prefill
-        # recomputes the KV on resume
+        # recomputes the KV on resume (quantized caches always recompute —
+        # spill extraction is bf16-only today, and init rejects
+        # int8+offload so the budget is 0 here)
         if self._offload_budget and self._offload_bytes + nbytes <= self._offload_budget:
             ids = jnp.asarray(np.asarray(slot.pages[:P], np.int32))
             kv = np.asarray(jnp.stack([layer[ids] for layer in self.kv_pages]))

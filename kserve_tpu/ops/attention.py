@@ -63,11 +63,40 @@ def causal_prefill_attention(
     return out.astype(q.dtype)
 
 
+def _gather_history(kv_pages, page_table):
+    """Gather history pages from a plain or quantized cache ->
+    (k [B,H,nkv,d], v [B,H,nkv,d]) dequantized."""
+    if isinstance(kv_pages, tuple):
+        pages, scales = kv_pages
+        B, W = page_table.shape
+        nkv, ps, d = pages.shape[2], pages.shape[3], pages.shape[4]
+        g = pages[page_table]  # [B, W, 2, nkv, ps, d] int8
+        s = scales[page_table]  # [B, W, 2, nkv, ps]
+        from ..engine.kvcache import dequantize_rows
+
+        # dequantize to bf16: the attention math upcasts to f32 internally,
+        # and a f32 intermediate would double the bandwidth the int8 cache
+        # exists to save
+        deq = dequantize_rows(
+            g.transpose(0, 1, 2, 4, 3, 5), s.transpose(0, 1, 2, 4, 3),
+            jnp.bfloat16,
+        )  # [B, W, 2, ps, nkv, d]
+        k = deq[:, :, 0].reshape(B, W * ps, nkv, d)
+        v = deq[:, :, 1].reshape(B, W * ps, nkv, d)
+        return k, v
+    B, W = page_table.shape
+    nkv, ps, d = kv_pages.shape[2], kv_pages.shape[3], kv_pages.shape[4]
+    gathered = kv_pages[page_table]  # [B, W, 2, nkv, ps, d]
+    k = gathered[:, :, 0].transpose(0, 1, 3, 2, 4).reshape(B, W * ps, nkv, d)
+    v = gathered[:, :, 1].transpose(0, 1, 3, 2, 4).reshape(B, W * ps, nkv, d)
+    return k, v
+
+
 def chunked_prefill_attention(
     q: jnp.ndarray,  # [B, C, nq, d] — current chunk queries
     k_chunk: jnp.ndarray,  # [B, C, nkv, d] — current chunk keys
     v_chunk: jnp.ndarray,  # [B, C, nkv, d]
-    kv_pages: jnp.ndarray,  # [num_pages, 2, nkv, ps, d] — cache w/ history
+    kv_pages,  # [num_pages, 2, nkv, ps, d] (or (int8, scales)) — cache w/ history
     page_table: jnp.ndarray,  # [B, W] pages holding positions 0..history-1
     history_len: jnp.ndarray,  # [B] tokens already in the cache
     valid_len: jnp.ndarray,  # [B] valid tokens within THIS chunk
@@ -79,15 +108,10 @@ def chunked_prefill_attention(
     possible — the first chunk (history_len=0) degenerates to plain causal
     prefill attention."""
     B, C, nq, d = q.shape
-    nkv = kv_pages.shape[2]
-    ps = kv_pages.shape[3]
-    W = page_table.shape[1]
-    H = W * ps
-    gathered = kv_pages[page_table]  # [B, W, 2, nkv, ps, d]
-    k_hist = gathered[:, :, 0].transpose(0, 1, 3, 2, 4).reshape(B, H, nkv, d)
-    v_hist = gathered[:, :, 1].transpose(0, 1, 3, 2, 4).reshape(B, H, nkv, d)
-    k_all = jnp.concatenate([k_hist, k_chunk], axis=1)  # [B, H+C, nkv, d]
-    v_all = jnp.concatenate([v_hist, v_chunk], axis=1)
+    k_hist, v_hist = _gather_history(kv_pages, page_table)
+    H = k_hist.shape[1]
+    k_all = jnp.concatenate([k_hist, k_chunk.astype(k_hist.dtype)], axis=1)
+    v_all = jnp.concatenate([v_hist, v_chunk.astype(v_hist.dtype)], axis=1)
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     scores = _gqa_scores(q, k_all) * scale  # [B, nq, C, H+C]
     if logit_softcap > 0.0:
@@ -112,7 +136,7 @@ def chunked_prefill_attention(
 
 def paged_attention_xla(
     q: jnp.ndarray,  # [B, nq, d] — one decode token per sequence
-    kv_pages: jnp.ndarray,  # [num_pages, 2, nkv, ps, d]
+    kv_pages,  # [num_pages, 2, nkv, ps, d] or (int8 pages, scales)
     page_table: jnp.ndarray,  # [B, max_pages]
     seq_lens: jnp.ndarray,  # [B] int32 (length INCLUDING current token)
     logit_softcap: float = 0.0,
@@ -120,14 +144,8 @@ def paged_attention_xla(
     """Decode attention: gather this batch's pages and do masked softmax.
     Materializes [B, L, nkv, d]; the Pallas kernel avoids that copy."""
     B, nq, d = q.shape
-    nkv = kv_pages.shape[2]
-    ps = kv_pages.shape[3]
-    max_pages = page_table.shape[1]
-    L = max_pages * ps
-    # gather: [B, max_pages, 2, nkv, ps, d]
-    gathered = kv_pages[page_table]
-    k = gathered[:, :, 0].transpose(0, 1, 3, 2, 4).reshape(B, L, nkv, d)
-    v = gathered[:, :, 1].transpose(0, 1, 3, 2, 4).reshape(B, L, nkv, d)
+    k, v = _gather_history(kv_pages, page_table)
+    L = k.shape[1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     scores = _gqa_scores(q[:, None], k) * scale  # [B,nq,1,L]
     if logit_softcap > 0.0:
@@ -168,17 +186,23 @@ def paged_attention(
     True forces the kernel (raising on unsupported head_dim rather than
     silently benchmarking the gather); False forces the gather."""
     d = q.shape[-1]
+    quantized = isinstance(kv_pages, tuple)
     if use_pallas is None:
         from .pallas_paged_attention import _pick_sb
 
         use_pallas = (
             d % 128 == 0
+            and not quantized  # kernel reads bf16 pages only (today)
             and page_table.shape[1] >= PALLAS_MIN_PAGES
             # a batch with no divisor <= MAX_SB would run the serialized
             # sb=1 kernel shape, which loses to the gather
             and _pick_sb(q.shape[0]) > 1
         )
     if use_pallas:
+        if quantized:
+            raise ValueError(
+                "pallas paged attention does not support the int8 KV cache"
+            )
         # loud, not silent: an explicit opt-in with an unsupported head_dim
         # must not quietly benchmark the XLA path
         from .pallas_paged_attention import paged_attention_pallas

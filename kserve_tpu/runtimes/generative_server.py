@@ -412,6 +412,7 @@ def main(argv=None):
     parser.add_argument("--max_model_len", default=2048, type=int)
     parser.add_argument("--max_prefill_len", default=1024, type=int)
     parser.add_argument("--kv_dtype", default="bfloat16", type=str)
+    parser.add_argument("--kv_quant", default="none", choices=("none", "int8"))
     parser.add_argument("--kv_offload", default="none", choices=("none", "host"))
     parser.add_argument("--kv_offload_gib", default=0.0, type=float)
     parser.add_argument(
@@ -431,6 +432,7 @@ def main(argv=None):
         dp=args.data_parallel_size,
         sp=args.sequence_parallel_size,
         dtype=args.kv_dtype,
+        kv_quant=args.kv_quant,
         kv_offload=args.kv_offload,
         kv_offload_gib=args.kv_offload_gib,
     )

@@ -538,3 +538,112 @@ class TestInterleavedLongAdmission:
         finally:
             task.cancel()
             await engine.stop()
+
+
+class TestInt8KVCache:
+    """Opt-in int8 KV quantization: half the decode KV traffic, bounded
+    numeric error."""
+
+    def test_quantize_roundtrip_error_bounded(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kserve_tpu.engine.kvcache import dequantize_rows, quantize_rows
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(4, 2, 64) * 0.3, jnp.float32)
+        q, scale = quantize_rows(x)
+        back = dequantize_rows(q, scale, jnp.float32)
+        err = np.max(np.abs(np.asarray(back - x)))
+        assert err <= np.max(np.abs(np.asarray(x))) / 127.0 + 1e-6
+
+    def test_paged_attention_quantized_close_to_fp(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kserve_tpu.engine.kvcache import quantize_rows
+        from kserve_tpu.ops.attention import paged_attention_xla
+
+        B, nq, nkv, d, ps, NP, W = 3, 8, 4, 32, 8, 32, 4
+        rng = np.random.RandomState(1)
+        q = jnp.asarray(rng.randn(B, nq, d), jnp.float32)
+        kv = jnp.asarray(rng.randn(NP, 2, nkv, ps, d) * 0.5, jnp.float32)
+        pt = jnp.asarray(
+            rng.permutation(np.arange(1, NP))[: B * W].reshape(B, W), jnp.int32
+        )
+        lens = jnp.asarray([W * ps, 11, 1], jnp.int32)
+        ref = paged_attention_xla(q, kv, pt, lens)
+        # quantize the cache the way the writers do: per token row
+        qkv, scales = quantize_rows(kv.transpose(0, 1, 3, 2, 4))
+        qpages = qkv.transpose(0, 1, 3, 2, 4)
+        qscales = scales.transpose(0, 1, 3, 2)
+        got = paged_attention_xla(q, (qpages, qscales), pt, lens)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=0.08, atol=0.03
+        )
+
+    @async_test
+    async def test_engine_serves_with_int8_cache(self):
+        engine = make_engine(kv_quant="int8")
+        params = SamplingParams(max_tokens=12, temperature=0.0, ignore_eos=True)
+        await engine.start()
+        try:
+            outs = await collect(engine, [3, 4, 5, 6], params)
+            assert outs[-1].finished
+            assert outs[-1].num_generated == 12
+            # the cache is genuinely int8
+            pages, scales = engine.kv_pages[0]
+            assert pages.dtype.name == "int8"
+            assert scales.dtype.name == "float32"
+        finally:
+            await engine.stop()
+
+    @async_test
+    async def test_int8_with_chunked_prefill_and_prefix_cache(self):
+        engine = make_engine(
+            kv_quant="int8", max_prefill_len=16, prefill_buckets=(16,),
+            num_pages=64, max_pages_per_seq=16,
+        )
+        params = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+        prompt = list(range(3, 43))  # 40 tokens -> chunked
+        await engine.start()
+        try:
+            first = [o.token_id for o in await collect(engine, prompt, params)]
+            again = [o.token_id for o in await collect(engine, prompt, params)]
+            assert engine.prefix_cache_hits > 0
+            assert again == first  # cached int8 pages reproduce the output
+        finally:
+            await engine.stop()
+
+    @async_test
+    async def test_pd_paths_rejected(self):
+        import pytest
+
+        engine = make_engine(kv_quant="int8")
+        with pytest.raises(NotImplementedError):
+            await engine.prefill_detached([1, 2, 3], SamplingParams(max_tokens=2))
+        import numpy as np
+
+        with pytest.raises(NotImplementedError):
+            engine.generate_injected(
+                [1, 2], SamplingParams(max_tokens=2),
+                np.zeros((2, 1, 2, 2, 8, 16), np.float32), 5,
+            )
+
+    def test_offload_combination_rejected(self):
+        import pytest
+
+        with pytest.raises(NotImplementedError, match="offload"):
+            make_engine(kv_quant="int8", kv_offload="host", kv_offload_gib=1.0)
+
+    def test_pallas_combination_rejected_at_init(self):
+        import pytest
+
+        with pytest.raises(NotImplementedError, match="pallas"):
+            make_engine(kv_quant="int8", use_pallas=True)
+
+    def test_unknown_quant_rejected(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="kv_quant"):
+            make_engine(kv_quant="fp8")
