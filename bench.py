@@ -34,6 +34,39 @@ WATCHDOG_SECONDS = 1200  # a wedged device tunnel must yield a result line,
 # not hang the driver (normal TPU run incl. warmup is ~4 min)
 
 
+def _preflight():
+    """Fast chip-health check BEFORE arming the long watchdog.
+
+    A wedged device tunnel (round-2 incident: a mid-compile SIGKILL left the
+    remote compile service hung; even ``jnp.ones()`` blocked forever) is
+    reported as a distinct ``wedged-tunnel`` error JSON within ~90s instead
+    of burning the full 1200s watchdog. Only runs when a TPU is expected —
+    CPU smoke mode skips it.
+    """
+    if _platform_spec.split(",")[0] == "cpu":
+        return
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"))
+    try:
+        from chipcheck import probe  # noqa: PLC0415
+
+        result = probe()
+    except Exception as exc:  # noqa: BLE001 — the result-line contract
+        # (one JSON line, always) outranks diagnosing a broken probe here
+        result = {"healthy": False, "error": f"{type(exc).__name__}: {exc}"}
+    if not result.get("healthy"):
+        print(json.dumps({
+            "metric": "llama3_1b_decode_throughput",
+            "value": 0.0,
+            "unit": "tok/s/chip",
+            "vs_baseline": 0.0,
+            "detail": {
+                "error": result.get("error", "probe-failed"),
+                "preflight": result,
+            },
+        }), flush=True)
+        sys.exit(4)
+
+
 def _arm_watchdog():
     def fire():
         print(json.dumps({
@@ -139,7 +172,10 @@ async def run_bench():
 
 
 if __name__ == "__main__":
-    watchdog = _arm_watchdog()
+    watchdog = _arm_watchdog()  # armed BEFORE the preflight so a hang inside
+    # the probe machinery itself (D-state child, inherited pipes) still
+    # yields a result line
+    _preflight()
     result = asyncio.run(run_bench())
     watchdog.cancel()
     print(json.dumps(result))
