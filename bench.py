@@ -53,6 +53,14 @@ def _preflight():
     except Exception as exc:  # noqa: BLE001 — the result-line contract
         # (one JSON line, always) outranks diagnosing a broken probe here
         result = {"healthy": False, "error": f"{type(exc).__name__}: {exc}"}
+    if result.get("healthy") and result.get("backend") != "tpu":
+        # a silent CPU fallback (plugin failed to load, chip unenumerated)
+        # must not pass the chip-health gate and run the bench off-chip
+        result = {
+            "healthy": False,
+            "error": f"wrong-backend:{result.get('backend')}",
+            "preflight_was": result,
+        }
     if not result.get("healthy"):
         print(json.dumps({
             "metric": "llama3_1b_decode_throughput",

@@ -510,6 +510,13 @@ class LLMEngine:
     async def stop(self):
         self._stopped = True
         self._wake.set()
+        # fail queued detached-prefill waiters before cancelling the worker —
+        # otherwise prefill-role HTTP handlers awaiting prefill_detached()
+        # hang until client timeout
+        pending, self._detached_queue = self._detached_queue, []
+        for _, _, fut, _ in pending:
+            if not fut.done():
+                fut.set_exception(RuntimeError("engine stopped"))
         if self._detached_task is not None and not self._detached_task.done():
             self._detached_task.cancel()
             self._detached_task = None
@@ -647,6 +654,8 @@ class LLMEngine:
                 f"prompt length {n} exceeds max_prefill_len "
                 f"{self.config.max_prefill_len}"
             )
+        if self._stopped:
+            raise RuntimeError("engine stopped")
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._detached_queue.append(
             (list(prompt_ids), params, fut, self._resolve_adapter(adapter))
@@ -669,6 +678,12 @@ class LLMEngine:
                         if not fut.done():
                             fut.set_exception(e)
             await asyncio.sleep(0)
+        if self._stopped:
+            # exiting on shutdown: fail anything enqueued after stop()'s drain
+            pending, self._detached_queue = self._detached_queue, []
+            for _, _, fut, _ in pending:
+                if not fut.done():
+                    fut.set_exception(RuntimeError("engine stopped"))
 
     def _prefill_detached_batch(self, batch) -> None:
         """One compiled prefill over up to prefill_batch detached prompts;
@@ -1455,7 +1470,12 @@ class LLMEngine:
             counts_row = np.zeros((V,), np.int32)
             prompt_row = np.zeros((V,), bool)
             slot = self._slots[i]
-            if slot.request_id is not None and active[i]:
+            # gate on residency, NOT on active[i]: a resident lane skipped
+            # from this chunk (KV-page starvation) must keep its counts —
+            # zeroing it during a full rebuild would silently drop its
+            # penalties for the rest of the request (it is not marked dirty
+            # when it reactivates)
+            if slot.request_id is not None:
                 np.add.at(counts_row, slot.generated, 1)
                 prompt_row[slot.prompt_ids] = True
             return counts_row, prompt_row
@@ -1519,18 +1539,9 @@ class LLMEngine:
         steps = self.config.steps_per_sync
         chunk_np = np.asarray(chunk)  # [steps, B]
         active = meta["active"]
-        # count real lane steps, not steps*lanes: partial-capacity lanes run
-        # only capacity-pos of the chunk
-        GENERATED_TOKENS.labels(model_name=self._mlabel).inc(
-            int(
-                sum(
-                    min(steps, int(meta["capacity"][i]) - int(meta["pos"][i]))
-                    for i in range(len(self._slots))
-                    if active[i]
-                )
-            )
-        )
         finished_any = False
+        routed = 0  # tokens actually delivered — the speculative tail after
+        # a mid-chunk EOS/stop is discarded and must not count as generated
         for i, slot in enumerate(self._slots):
             if slot.request_id is None or not active[i]:
                 continue
@@ -1542,11 +1553,13 @@ class LLMEngine:
                 slot.pos += 1
                 slot.generated.append(token)
                 self._emit(slot, token)
+                routed += 1
             if slot.request_id is None:
                 finished_any = True
             elif slot.pos >= self.config.max_model_len:
                 self._finish(slot, "length")
                 finished_any = True
+        GENERATED_TOKENS.labels(model_name=self._mlabel).inc(routed)
         return finished_any
 
     async def _decode_once(self):

@@ -20,7 +20,7 @@ import tarfile
 import tempfile
 import zipfile
 from typing import Callable, Dict, List, Optional
-from urllib.parse import quote, urlparse
+from urllib.parse import quote, unquote, urlparse
 
 from ..logging import logger
 
@@ -247,15 +247,20 @@ class Storage:
 
         client = httpx.Client(follow_redirects=True, timeout=600)
 
+        def q(path: str) -> str:
+            # percent-encode path segments ('%', '#', '?', spaces) — same
+            # treatment the Azure blob path gets; '/' stays a separator
+            return quote(path, safe="/")
+
         def list_status(path: str) -> List[dict]:
-            r = client.get(base + path, params={**params, "op": "LISTSTATUS"})
+            r = client.get(base + q(path), params={**params, "op": "LISTSTATUS"})
             if r.status_code != 200:
                 raise StorageError(f"webhdfs LISTSTATUS {path} -> HTTP {r.status_code}")
             return r.json()["FileStatuses"]["FileStatus"]
 
         def fetch_file(path: str, dest: str) -> None:
             os.makedirs(os.path.dirname(dest), exist_ok=True)
-            with client.stream("GET", base + path, params={**params, "op": "OPEN"}) as r:
+            with client.stream("GET", base + q(path), params={**params, "op": "OPEN"}) as r:
                 if r.status_code != 200:
                     raise StorageError(f"webhdfs OPEN {path} -> HTTP {r.status_code}")
                 with open(dest, "wb") as f:
@@ -263,7 +268,10 @@ class Storage:
                         f.write(chunk)
             _maybe_unpack(dest, out_dir)
 
-        root = parsed.path or "/"
+        # unquote once: the URI's path arrives percent-encoded from urlparse,
+        # and q() re-encodes uniformly — without this, '%20' would become
+        # '%2520' (double encoding)
+        root = unquote(parsed.path) or "/"
         count = 0
         stack = [(root, "")]
         try:
