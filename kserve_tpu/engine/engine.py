@@ -46,7 +46,13 @@ from .kvcache import (
     init_kv_scales,
     pages_needed,
 )
-from .sampling import SamplingParams, SamplingState, apply_penalties, sample_tokens
+from .sampling import (
+    SamplingParams,
+    SamplingState,
+    apply_penalties,
+    compute_logprobs,
+    sample_tokens,
+)
 from .tokenizer import BaseTokenizer, IncrementalDetokenizer
 
 
@@ -91,6 +97,9 @@ class EngineConfig:
     # pressure) and shared by later requests with the same page-aligned
     # prefix, which then prefill only their uncached tail
     prefix_cache: bool = True
+    # static top-k width for the logprob-emitting program variants (OpenAI
+    # caps top_logprobs at 20); requests asking for fewer slice host-side
+    max_logprobs: int = 20
 
     def __post_init__(self):
         # prefill buckets must reach max_prefill_len or long prompts would
@@ -123,6 +132,10 @@ class GenerationOutput:
     num_generated: int = 0
     num_prompt_tokens: int = 0
     cumulative_text: str = ""
+    # OpenAI logprobs surface (populated only when the request asked):
+    # logprob of the sampled token + [(token_id, logprob)] for the top-k
+    logprob: Optional[float] = None
+    top_logprobs: Optional[List[tuple]] = None
 
 
 class _Slot:
@@ -361,41 +374,47 @@ class LLMEngine:
             )
             attention_fn = lambda q, k, v, vl, softcap: ring_fn(q, k, v, vl)  # noqa: E731
 
-        def _prefill(params, tokens, valid_len, kv_pages, page_ids, state, rng,
-                     adapter_ids):
-            if cfg.sp > 1:
-                tokens = jax.lax.with_sharding_constraint(
-                    tokens, shd.named(mesh, jax.sharding.PartitionSpec(None, shd.SEQ_AXIS))
+        def _make_prefill(with_logprobs: bool):
+            def fn(params, tokens, valid_len, kv_pages, page_ids, state, rng,
+                   adapter_ids):
+                if cfg.sp > 1:
+                    tokens = jax.lax.with_sharding_constraint(
+                        tokens, shd.named(mesh, jax.sharding.PartitionSpec(None, shd.SEQ_AXIS))
+                    )
+                logits, kv_pages = llama.prefill(
+                    params, mc, tokens, valid_len, kv_pages, page_ids, cfg.page_size,
+                    attention_fn=attention_fn, adapter_ids=adapter_ids,
                 )
-            logits, kv_pages = llama.prefill(
-                params, mc, tokens, valid_len, kv_pages, page_ids, cfg.page_size,
-                attention_fn=attention_fn, adapter_ids=adapter_ids,
-            )
-            # vLLM-parity: repetition_penalty counts prompt tokens as "seen"
-            # for the very first sampled token.  Rows with default penalties
-            # are bit-identical to the unpenalized math.
-            Bp, V = logits.shape
-            pos_valid = (
-                jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :]
-                < valid_len[:, None]
-            )
-            in_prompt = (
-                jnp.zeros((Bp, V), bool)
-                .at[jnp.arange(Bp)[:, None], tokens]
-                .max(pos_valid)
-            )
-            logits = apply_penalties(
-                logits,
-                jnp.zeros((Bp, V), jnp.int32),
-                state.repetition_penalty,
-                state.frequency_penalty,
-                state.presence_penalty,
-                in_prompt,
-            )
-            first = sample_tokens(logits, state, rng)
-            return first, kv_pages
+                # vLLM-parity: repetition_penalty counts prompt tokens as
+                # "seen" for the very first sampled token.  Rows with default
+                # penalties are bit-identical to the unpenalized math.
+                Bp, V = logits.shape
+                pos_valid = (
+                    jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :]
+                    < valid_len[:, None]
+                )
+                in_prompt = (
+                    jnp.zeros((Bp, V), bool)
+                    .at[jnp.arange(Bp)[:, None], tokens]
+                    .max(pos_valid)
+                )
+                logits = apply_penalties(
+                    logits,
+                    jnp.zeros((Bp, V), jnp.int32),
+                    state.repetition_penalty,
+                    state.frequency_penalty,
+                    state.presence_penalty,
+                    in_prompt,
+                )
+                first = sample_tokens(logits, state, rng)
+                if with_logprobs:
+                    lp, tv, ti = compute_logprobs(logits, first, cfg.max_logprobs)
+                    return first, (lp, tv, ti), kv_pages
+                return first, kv_pages
 
-        def _make_decode(with_penalties: bool):
+            return fn
+
+        def _make_decode(with_penalties: bool, with_logprobs: bool = False):
             """steps_per_sync decode steps on device; emits [steps, B] tokens.
             Lanes past their page capacity (or inactive) hold token/pos and
             write to the null page — a clamped page-table index would
@@ -404,7 +423,10 @@ class LLMEngine:
             The penalized variant additionally threads a [B, V] output-count
             carry (plus a static [B, V] prompt mask) through the scan and
             returns the updated counts; it is compiled separately so requests
-            without penalties never pay the per-step [B, V] scatter/gather."""
+            without penalties never pay the per-step [B, V] scatter/gather.
+            The logprobs variant additionally emits per-step sampled-token
+            logprobs and the top-k (cfg.max_logprobs) ids/values — compiled
+            separately so ordinary requests never pay the per-step top_k."""
 
             def fn(params, tokens, pos, kv_pages, page_table, active,
                    capacity, counters, state, rng, adapter_ids, *penalty_args):
@@ -432,6 +454,11 @@ class LLMEngine:
                         )
                     nxt = sample_tokens(logits, state, step_rng, counters)
                     nxt = jnp.where(live, nxt, tokens)
+                    if with_logprobs:
+                        lp, tv, ti = compute_logprobs(logits, nxt, cfg.max_logprobs)
+                        out_step = (nxt, lp, tv, ti)
+                    else:
+                        out_step = nxt
                     new_carry = (
                         nxt,
                         pos + live.astype(pos.dtype),
@@ -443,7 +470,7 @@ class LLMEngine:
                             live.astype(counts.dtype)
                         )
                         new_carry = new_carry + (counts,)
-                    return new_carry, nxt
+                    return new_carry, out_step
 
                 init = (tokens, pos, counters, kv_pages)
                 if with_penalties:
@@ -472,27 +499,41 @@ class LLMEngine:
                 page_ids, cfg.page_size, adapter_ids=adapter_ids,
             )
 
-        def _sample_first(logits, state, rng, in_prompt):
-            # same first-token penalty semantics as the batched prefill:
-            # repetition penalty counts prompt tokens as seen
-            logits = apply_penalties(
-                logits,
-                jnp.zeros(logits.shape, jnp.int32),
-                state.repetition_penalty,
-                state.frequency_penalty,
-                state.presence_penalty,
-                in_prompt,
-            )
-            return sample_tokens(logits, state, rng)
+        def _make_sample_first(with_logprobs: bool):
+            def fn(logits, state, rng, in_prompt):
+                # same first-token penalty semantics as the batched prefill:
+                # repetition penalty counts prompt tokens as seen
+                logits = apply_penalties(
+                    logits,
+                    jnp.zeros(logits.shape, jnp.int32),
+                    state.repetition_penalty,
+                    state.frequency_penalty,
+                    state.presence_penalty,
+                    in_prompt,
+                )
+                first = sample_tokens(logits, state, rng)
+                if with_logprobs:
+                    return first, compute_logprobs(logits, first, cfg.max_logprobs)
+                return first
+
+            return fn
 
         n_kv_args = 3  # kv_pages is arg index 3 in the prefill/decode sigs
-        self._prefill_fn = jax.jit(_prefill, donate_argnums=(n_kv_args,))
+        self._prefill_fn = jax.jit(_make_prefill(False), donate_argnums=(n_kv_args,))
+        self._prefill_lp_fn = jax.jit(_make_prefill(True), donate_argnums=(n_kv_args,))
         self._prefill_chunk_fn = jax.jit(_prefill_chunk, donate_argnums=(4,))
-        self._sample_first_fn = jax.jit(_sample_first)
+        self._sample_first_fn = jax.jit(_make_sample_first(False))
+        self._sample_first_lp_fn = jax.jit(_make_sample_first(True))
         self._decode_fn = jax.jit(_make_decode(False), donate_argnums=(n_kv_args,))
+        self._decode_lp_fn = jax.jit(
+            _make_decode(False, with_logprobs=True), donate_argnums=(n_kv_args,)
+        )
         # arg 11 = prompt mask (kept across chunks), arg 12 = counts (donated)
         self._decode_penalized_fn = jax.jit(
             _make_decode(True), donate_argnums=(n_kv_args, 12)
+        )
+        self._decode_penalized_lp_fn = jax.jit(
+            _make_decode(True, with_logprobs=True), donate_argnums=(n_kv_args, 12)
         )
         self._inject_fn = jax.jit(_inject, donate_argnums=(0,))
 
@@ -647,6 +688,13 @@ class LLMEngine:
             raise NotImplementedError(
                 "detached prefill (P/D transfer) over a quantized KV cache "
                 "is not supported yet"
+            )
+        if params.logprobs is not None:
+            # the P/D wire format carries (kv, first_token) only; the decode
+            # role would be missing the first token's logprobs.  Explicit
+            # here beats a silently-None first entry.
+            raise ValueError(
+                "logprobs is not supported with prefill/decode disaggregation"
             )
         n = len(prompt_ids)
         if n > self.config.max_prefill_len:
@@ -910,8 +958,16 @@ class LLMEngine:
                 in_prompt[j, np.asarray(seq, np.int64)] = True
         state = SamplingState.from_params(params_list)
         rng = jax.random.fold_in(self._base_rng, self._next_step())
+        # logprob-emitting program variants only when some fresh row asked —
+        # ordinary admissions never pay the top_k
+        want_lp = any(
+            req.resume is None and req.params.logprobs is not None
+            for _, req, _, _, _ in admitted
+        )
+        lp_tuple = None
         if use_fused_call:
-            first, self.kv_pages = self._prefill_fn(
+            prefill_fn = self._prefill_lp_fn if want_lp else self._prefill_fn
+            out = prefill_fn(
                 self.params,
                 jnp.asarray(tokens),
                 jnp.asarray(valid),
@@ -921,6 +977,10 @@ class LLMEngine:
                 rng,
                 jnp.asarray(adapter_arr),
             )
+            if want_lp:
+                first, lp_tuple, self.kv_pages = out
+            else:
+                first, self.kv_pages = out
         else:
             logits, self.kv_pages = self._prefill_chunk_fn(
                 self.params,
@@ -931,10 +991,18 @@ class LLMEngine:
                 jnp.asarray(page_ids),
                 jnp.asarray(adapter_arr),
             )
-            first = self._sample_first_fn(
-                logits, state, rng, jnp.asarray(in_prompt)
-            )
+            if want_lp:
+                first, lp_tuple = self._sample_first_lp_fn(
+                    logits, state, rng, jnp.asarray(in_prompt)
+                )
+            else:
+                first = self._sample_first_fn(
+                    logits, state, rng, jnp.asarray(in_prompt)
+                )
         first_np = np.asarray(first)
+        lp_np = (
+            tuple(np.asarray(a) for a in lp_tuple) if lp_tuple is not None else None
+        )
         for j, (idx, req, pages, _, seq) in enumerate(admitted):
             if req.resume is None:
                 # resume re-prefills are recompute overhead, not new prompt
@@ -952,8 +1020,22 @@ class LLMEngine:
             if req.adapter_id < 0:
                 self._prefix_cache_register(req.prompt_ids, pages)
             self._mark_penalty_dirty(idx)
-            self._emit(slot, first_token)
+            self._emit(slot, first_token, *self._lp_for(req.params, lp_np, j))
         return True
+
+    @staticmethod
+    def _lp_for(params: SamplingParams, lp_np, j: int, s: Optional[int] = None):
+        """(logprob, top_logprobs) for row j (step s) of a device lp tuple,
+        sliced to the request's asked-for top-k; (None, None) when the
+        request didn't ask or the chunk didn't compute them."""
+        if lp_np is None or params.logprobs is None:
+            return None, None
+        lp, tv, ti = lp_np
+        if s is not None:
+            lp, tv, ti = lp[s], tv[s], ti[s]
+        k = min(int(params.logprobs), tv.shape[-1])
+        top = [(int(ti[j, i]), float(tv[j, i])) for i in range(k)]
+        return float(lp[j]), top
 
     def _seat_fresh(self, slot: _Slot, req: "_QueuedRequest",
                     pages: List[int], first_token: int) -> None:
@@ -1156,12 +1238,20 @@ class LLMEngine:
         rng = jax.random.fold_in(self._base_rng, self._next_step())
         in_prompt = np.zeros((1, self.model_config.vocab_size), bool)
         in_prompt[0, np.asarray(seq, np.int64)] = True
-        first_token = int(np.asarray(
-            self._sample_first_fn(pf["logits"], state, rng, jnp.asarray(in_prompt))
-        )[0])
+        lp_np = None
+        if req.params.logprobs is not None:
+            first, lp_tuple = self._sample_first_lp_fn(
+                pf["logits"], state, rng, jnp.asarray(in_prompt)
+            )
+            lp_np = tuple(np.asarray(a) for a in lp_tuple)
+        else:
+            first = self._sample_first_fn(
+                pf["logits"], state, rng, jnp.asarray(in_prompt)
+            )
+        first_token = int(np.asarray(first)[0])
         self._seat_fresh(slot, req, pages, first_token)
         self._mark_penalty_dirty(idx)
-        self._emit(slot, first_token)
+        self._emit(slot, first_token, *self._lp_for(req.params, lp_np, 0))
 
     def _admission_pages(self, req: "_QueuedRequest", need: int,
                          headroom: bool = False) -> int:
@@ -1445,7 +1535,12 @@ class LLMEngine:
             for i, slot in enumerate(self._slots)
         )
         if penalized:
-            self._refresh_penalty_state(active)
+            self._refresh_penalty_state()
+        want_logprobs = any(
+            slot.request_id is not None and active[i]
+            and slot.params.logprobs is not None
+            for i, slot in enumerate(self._slots)
+        )
         return {
             "tokens": tokens,
             "pos": pos,
@@ -1456,9 +1551,10 @@ class LLMEngine:
             "adapters": adapters,
             "state": SamplingState.from_params(params_list),
             "penalized": penalized,
+            "want_logprobs": want_logprobs,
         }
 
-    def _refresh_penalty_state(self, active: np.ndarray) -> None:
+    def _refresh_penalty_state(self) -> None:
         """Bring the device [B, V] count/prompt arrays up to date.  Rows for
         lanes that stayed resident are already correct on device (the
         penalized decode returns updated counts); only rows touched by
@@ -1521,12 +1617,15 @@ class LLMEngine:
             rng,
             jnp.asarray(meta["adapters"]),
         )
+        want_lp = meta.get("want_logprobs", False)
         if meta.get("penalized"):
-            chunk, self.kv_pages, self._penalty_counts = self._decode_penalized_fn(
+            fn = self._decode_penalized_lp_fn if want_lp else self._decode_penalized_fn
+            chunk, self.kv_pages, self._penalty_counts = fn(
                 *args, self._penalty_prompt, self._penalty_counts
             )
         else:
-            chunk, self.kv_pages = self._decode_fn(*args)
+            fn = self._decode_lp_fn if want_lp else self._decode_fn
+            chunk, self.kv_pages = fn(*args)
             if self._penalty_counts is not None:
                 # a non-penalized chunk advances lanes without updating the
                 # device counts; they are stale for every resident row now
@@ -1537,7 +1636,12 @@ class LLMEngine:
         """Read a finished chunk and stream its tokens.  True when any slot
         finished (the pipeline must drain: chained lanes are stale)."""
         steps = self.config.steps_per_sync
-        chunk_np = np.asarray(chunk)  # [steps, B]
+        if isinstance(chunk, tuple):  # logprobs variant: (tokens, lp, tv, ti)
+            chunk_np = np.asarray(chunk[0])  # [steps, B]
+            lp_np = tuple(np.asarray(a) for a in chunk[1:])
+        else:
+            chunk_np = np.asarray(chunk)  # [steps, B]
+            lp_np = None
         active = meta["active"]
         finished_any = False
         routed = 0  # tokens actually delivered — the speculative tail after
@@ -1552,7 +1656,7 @@ class LLMEngine:
                 token = int(chunk_np[s, i])
                 slot.pos += 1
                 slot.generated.append(token)
-                self._emit(slot, token)
+                self._emit(slot, token, *self._lp_for(slot.params, lp_np, i, s))
                 routed += 1
             if slot.request_id is None:
                 finished_any = True
@@ -1599,7 +1703,10 @@ class LLMEngine:
             ):
                 meta2 = self._prepare_chunk(prev=meta)
             if meta2 is not None:
-                chunk2 = self._dispatch_chunk(meta2, tokens_dev=chunk[-1])
+                last_tokens = (
+                    chunk[0][-1] if isinstance(chunk, tuple) else chunk[-1]
+                )
+                chunk2 = self._dispatch_chunk(meta2, tokens_dev=last_tokens)
                 self._pipeline_busy = True
             finished_any = self._route_chunk(meta, chunk)
             # flush streams while the chained chunk runs on device
@@ -1618,7 +1725,9 @@ class LLMEngine:
         self._pipeline_busy = False
         self._flush_deferred_frees()
 
-    def _emit(self, slot: _Slot, token: int):
+    def _emit(self, slot: _Slot, token: int,
+              logprob: Optional[float] = None,
+              top_logprobs: Optional[List[tuple]] = None):
         """Stream one token; apply stop conditions."""
         n_gen = len(slot.generated)
         params = slot.params
@@ -1649,6 +1758,8 @@ class LLMEngine:
             num_generated=n_gen,
             num_prompt_tokens=slot.prompt_len,
             cumulative_text=text,
+            logprob=logprob,
+            top_logprobs=top_logprobs,
         )
         slot.queue.put_nowait(out)
         if finish_reason is not None:

@@ -159,6 +159,25 @@ def sample_tokens(
     return jnp.where(state.temperature <= 0.0, greedy, sampled).astype(jnp.int32)
 
 
+def compute_logprobs(
+    logits: jnp.ndarray,  # [B, V] f32 — post-penalty, pre-temperature
+    sampled: jnp.ndarray,  # [B] i32 sampled token ids
+    k: int,  # static top-k width (engine config max_logprobs)
+) -> tuple:
+    """Log-probabilities for OpenAI `logprobs` surfaces.
+
+    Computed from the post-penalty, pre-temperature/filter logits: reported
+    logprobs describe the model's distribution, not the sampling filters
+    (matches vLLM's default behaviour the reference inherits through
+    `huggingfaceserver/vllm/vllm_model.py`).
+
+    Returns (lp [B], top_vals [B, k], top_ids [B, k])."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    lp = jnp.take_along_axis(logp, sampled[:, None].astype(jnp.int32), axis=1)[:, 0]
+    top_vals, top_ids = jax.lax.top_k(logp, k)
+    return lp, top_vals, top_ids.astype(jnp.int32)
+
+
 def apply_penalties(
     logits: jnp.ndarray,  # [B, V]
     output_counts: jnp.ndarray,  # [B, V] int32 — counts of generated tokens

@@ -35,10 +35,14 @@ from ..protocol.openai.types import (
     ChatCompletionChunk,
     ChatCompletionChunkChoice,
     ChatCompletionChunkDelta,
+    ChatCompletionLogprob,
+    ChatCompletionLogprobs,
+    ChatCompletionLogprobsContent,
     ChatCompletionRequest,
     ChatCompletionResponseMessage,
     Completion,
     CompletionChoice,
+    CompletionLogprobs,
     CompletionRequest,
     UsageInfo,
     random_uuid,
@@ -140,20 +144,41 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
 
     # ---------------- helpers ----------------
 
-    def _sampling_from(self, req, max_len_default: int = 16) -> SamplingParams:
-        logprobs = getattr(req, "logprobs", None)
-        wants_logprobs = (
-            logprobs is True  # chat: bool, default False
-            # legacy completions: int, where 0 validly requests the sampled
-            # token's logprob — any int counts as a request
-            or (isinstance(logprobs, int) and not isinstance(logprobs, bool))
-            or getattr(req, "top_logprobs", None) is not None
+    def _logprobs_k(self, req) -> Optional[int]:
+        """Normalize the two OpenAI logprob dialects to one int: None = not
+        requested, 0 = sampled token's logprob only, N = N top alternatives.
+
+        Completions (legacy): ``logprobs`` is an int count.
+        Chat: ``logprobs`` is a bool gate + ``top_logprobs`` int count."""
+        lp = getattr(req, "logprobs", None)
+        top = getattr(req, "top_logprobs", None)
+        if isinstance(lp, bool):  # chat dialect
+            if top is not None and not lp:
+                raise InvalidInput("top_logprobs requires logprobs=true")
+            if not lp:
+                return None
+            k = top or 0
+        elif isinstance(lp, int):  # completions dialect (0 is a valid ask)
+            k = lp
+        else:
+            if top is not None:  # {"logprobs": null, "top_logprobs": N}
+                raise InvalidInput("top_logprobs requires logprobs=true")
+            return None
+        max_k = (
+            self.engine.config.max_logprobs
+            if self.engine is not None else 20
         )
-        if wants_logprobs:
-            # explicit 400 beats silently returning a response without the
-            # field the client asked for; logprob emission through the
-            # decode scan is a planned feature
-            raise InvalidInput("logprobs is not supported by this runtime yet")
+        if not 0 <= k <= max_k:
+            raise InvalidInput(f"logprobs must be between 0 and {max_k}")
+        if self.role == "decode" and self.prefill_url:
+            # the P/D wire format carries (kv, first_token) only
+            raise InvalidInput(
+                "logprobs is not supported with prefill/decode disaggregation"
+            )
+        return k
+
+    def _sampling_from(self, req, max_len_default: int = 16) -> SamplingParams:
+        logprobs_k = self._logprobs_k(req)
         max_tokens = (
             getattr(req, "max_completion_tokens", None)
             or getattr(req, "max_tokens", None)
@@ -175,6 +200,7 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
             ignore_eos=bool(req.ignore_eos),
             stop=stop,
             seed=req.seed,
+            logprobs=logprobs_k,
         )
 
     def _encode_prompt(self, prompt: Union[str, List[int], List[str]]) -> List[List[int]]:
@@ -214,8 +240,18 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
         )
         choices = []
         usage = UsageInfo()
-        for idx, (prompt_ids, (text, n_gen, finish)) in enumerate(zip(runs, results)):
-            choices.append(CompletionChoice(index=idx, text=text, finish_reason=finish))
+        for idx, (prompt_ids, (text, n_gen, finish, entries)) in enumerate(
+            zip(runs, results)
+        ):
+            lp = (
+                self._completion_logprobs(entries, params.logprobs)
+                if entries is not None else None
+            )
+            choices.append(
+                CompletionChoice(
+                    index=idx, text=text, finish_reason=finish, logprobs=lp
+                )
+            )
             usage.prompt_tokens += len(prompt_ids)
             usage.completion_tokens += n_gen
         usage.total_tokens = usage.prompt_tokens + usage.completion_tokens
@@ -270,19 +306,84 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
         text = ""
         n_gen = 0
         finish = None
+        entries = [] if params.logprobs is not None else None
         async for out in self._generate(prompt_ids, params, adapter):
             text += out.text_delta
             n_gen = out.num_generated
             finish = out.finish_reason
-        return text, n_gen, finish or "stop"
+            if entries is not None and out.token_id >= 0:
+                entries.append(
+                    (out.token_id, out.text_delta, out.logprob, out.top_logprobs)
+                )
+        return text, n_gen, finish or "stop", entries
+
+    # ---------------- logprob marshalling ----------------
+
+    def _token_str(self, token_id: int) -> str:
+        return self.tokenizer.decode([token_id])
+
+    def _completion_logprobs(
+        self, entries, k: int, offset0: int = 0
+    ) -> CompletionLogprobs:
+        """Legacy-completions logprobs block.  `entries` are engine
+        (token_id, text_delta, logprob, top) tuples; the sampled token is
+        folded into each top_logprobs dict (OpenAI behaviour)."""
+        lp = CompletionLogprobs(top_logprobs=[] if k > 0 else None)
+        offset = offset0
+        for tid, delta, logprob, top in entries:
+            lp.tokens.append(self._token_str(tid))
+            lp.token_logprobs.append(logprob)
+            lp.text_offset.append(offset)
+            offset += len(delta)
+            if k > 0:
+                # the legacy dict format is keyed by token TEXT — byte-level
+                # tokenizers can decode distinct ids to the same string, so
+                # keep the best (first, list is sorted desc) on collision
+                d: dict = {}
+                for t, v in (top or [])[:k]:
+                    d.setdefault(self._token_str(t), v)
+                if logprob is not None:
+                    d.setdefault(self._token_str(tid), logprob)
+                lp.top_logprobs.append(d)
+        return lp
+
+    def _chat_logprobs(self, entries, k: int) -> ChatCompletionLogprobs:
+        content = []
+        for tid, _delta, logprob, top in entries:
+            tok = self._token_str(tid)
+            content.append(
+                ChatCompletionLogprobsContent(
+                    token=tok,
+                    logprob=logprob if logprob is not None else -9999.0,
+                    bytes=list(tok.encode("utf-8")),
+                    top_logprobs=[
+                        ChatCompletionLogprob(
+                            token=self._token_str(t),
+                            logprob=v,
+                            bytes=list(self._token_str(t).encode("utf-8")),
+                        )
+                        for t, v in (top or [])[:k]
+                    ],
+                )
+            )
+        return ChatCompletionLogprobs(content=content)
 
     async def _stream_completion(
         self, request: CompletionRequest, prompt_ids, params, adapter=None
     ) -> AsyncIterator[Completion]:
         completion_id = random_uuid("cmpl-")
         n_gen = 0
+        text_offset = 0
         async for out in self._generate(prompt_ids, params, adapter):
             n_gen = out.num_generated
+            lp = None
+            if params.logprobs is not None and out.token_id >= 0:
+                lp = self._completion_logprobs(
+                    [(out.token_id, out.text_delta, out.logprob, out.top_logprobs)],
+                    params.logprobs,
+                    offset0=text_offset,
+                )
+            text_offset += len(out.text_delta)
             chunk = Completion(
                 id=completion_id,
                 model=request.model,
@@ -291,6 +392,7 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
                         index=0,
                         text=out.text_delta,
                         finish_reason=out.finish_reason,
+                        logprobs=lp,
                     )
                 ],
             )
@@ -335,12 +437,16 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
         )
         choices = []
         usage = UsageInfo(prompt_tokens=len(prompt_ids) * n)
-        for i, (text, n_gen, finish) in enumerate(results):
+        for i, (text, n_gen, finish, entries) in enumerate(results):
             choices.append(
                 ChatCompletionChoice(
                     index=i,
                     message=ChatCompletionResponseMessage(role="assistant", content=text),
                     finish_reason=finish,
+                    logprobs=(
+                        self._chat_logprobs(entries, params.logprobs)
+                        if entries is not None else None
+                    ),
                 )
             )
             usage.completion_tokens += n_gen
@@ -363,6 +469,12 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
         n_gen = 0
         async for out in self._generate(prompt_ids, params, adapter):
             n_gen = out.num_generated
+            lp = None
+            if params.logprobs is not None and out.token_id >= 0:
+                lp = self._chat_logprobs(
+                    [(out.token_id, out.text_delta, out.logprob, out.top_logprobs)],
+                    params.logprobs,
+                )
             chunk = ChatCompletionChunk(
                 id=chunk_id,
                 model=request.model,
@@ -371,6 +483,7 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
                         index=0,
                         delta=ChatCompletionChunkDelta(content=out.text_delta),
                         finish_reason=out.finish_reason,
+                        logprobs=lp,
                     )
                 ],
             )

@@ -170,19 +170,173 @@ class TestOpenAIServing:
             await model.engine.stop()
 
 
-class TestUnsupportedFields:
-    def test_logprobs_rejected_explicitly(self):
-        """ADVICE: unsupported sampling fields must 400, not silently drop."""
+class TestLogprobs:
+    """OpenAI logprobs parity (vLLM path of the reference,
+    huggingfaceserver/vllm/vllm_model.py:273): sampled-token logprob + top-k
+    alternatives through both dialects, streamed and not."""
+
+    @async_test
+    async def test_completion_logprobs(self):
+        model = make_model()
+        client = await make_client(model)
+        try:
+            res = await client.post(
+                "/openai/v1/completions",
+                json={
+                    "model": "tinyllm",
+                    "prompt": "hello",
+                    "max_tokens": 5,
+                    "temperature": 0,
+                    "ignore_eos": True,
+                    "logprobs": 3,
+                },
+            )
+            assert res.status == 200
+            body = await res.json()
+            lp = body["choices"][0]["logprobs"]
+            assert len(lp["tokens"]) == 5
+            assert len(lp["token_logprobs"]) == 5
+            assert len(lp["text_offset"]) == 5
+            assert all(v <= 0.0 for v in lp["token_logprobs"])
+            assert len(lp["top_logprobs"]) == 5
+            for i, d in enumerate(lp["top_logprobs"]):
+                # dict keyed by token text: byte tokenizers may decode
+                # distinct ids to colliding strings, so only k+1 bounds hold
+                assert 1 <= len(d) <= 4
+                # greedy decode: the sampled token IS the argmax, so its
+                # logprob must equal the best alternative's
+                assert abs(max(d.values()) - lp["token_logprobs"][i]) < 1e-4
+        finally:
+            await client.close()
+            await model.engine.stop()
+
+    @async_test
+    async def test_chat_top_logprobs(self):
+        model = make_model()
+        client = await make_client(model)
+        try:
+            res = await client.post(
+                "/openai/v1/chat/completions",
+                json={
+                    "model": "tinyllm",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 4,
+                    "temperature": 0,
+                    "ignore_eos": True,
+                    "logprobs": True,
+                    "top_logprobs": 2,
+                },
+            )
+            assert res.status == 200
+            body = await res.json()
+            content = body["choices"][0]["logprobs"]["content"]
+            assert len(content) == 4
+            for entry in content:
+                assert entry["logprob"] <= 0.0
+                assert len(entry["top_logprobs"]) == 2
+                assert isinstance(entry["bytes"], list)
+        finally:
+            await client.close()
+            await model.engine.stop()
+
+    @async_test
+    async def test_streamed_chat_logprobs(self):
+        model = make_model()
+        client = await make_client(model)
+        try:
+            res = await client.post(
+                "/openai/v1/chat/completions",
+                json={
+                    "model": "tinyllm",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 4,
+                    "temperature": 0,
+                    "ignore_eos": True,
+                    "stream": True,
+                    "logprobs": True,
+                    "top_logprobs": 2,
+                },
+            )
+            assert res.status == 200
+            raw = (await res.read()).decode()
+            events = [
+                json.loads(line[len("data: "):])
+                for line in raw.strip().split("\n\n")
+                if line.startswith("data: ") and "[DONE]" not in line
+            ]
+            with_lp = [
+                e for e in events if e["choices"][0].get("logprobs")
+            ]
+            assert len(with_lp) == 4
+            for e in with_lp:
+                entry = e["choices"][0]["logprobs"]["content"][0]
+                assert entry["logprob"] <= 0.0
+                assert len(entry["top_logprobs"]) == 2
+        finally:
+            await client.close()
+            await model.engine.stop()
+
+    @async_test
+    async def test_mixed_batch_logprobs_and_not(self):
+        """A logprobs request and a plain request decode in the SAME batch;
+        the plain one must not grow logprob fields."""
+        model = make_model()
+        client = await make_client(model)
+        try:
+            r1, r2 = await asyncio.gather(
+                client.post(
+                    "/openai/v1/completions",
+                    json={
+                        "model": "tinyllm", "prompt": "aa", "max_tokens": 6,
+                        "temperature": 0, "ignore_eos": True, "logprobs": 2,
+                    },
+                ),
+                client.post(
+                    "/openai/v1/completions",
+                    json={
+                        "model": "tinyllm", "prompt": "bb", "max_tokens": 6,
+                        "temperature": 0, "ignore_eos": True,
+                    },
+                ),
+            )
+            b1, b2 = await r1.json(), await r2.json()
+            assert b1["choices"][0]["logprobs"] is not None
+            assert len(b1["choices"][0]["logprobs"]["tokens"]) == 6
+            assert b2["choices"][0].get("logprobs") is None
+        finally:
+            await client.close()
+            await model.engine.stop()
+
+    def test_logprobs_validation(self):
         import pytest
 
         from kserve_tpu.errors import InvalidInput
         from kserve_tpu.models.llama import LlamaConfig
-        from kserve_tpu.protocol.openai.types import CompletionRequest
+        from kserve_tpu.protocol.openai.types import (
+            ChatCompletionRequest,
+            CompletionRequest,
+        )
         from kserve_tpu.runtimes.generative_server import JAXGenerativeModel
 
         model = JAXGenerativeModel(
             "m", model_config=LlamaConfig.tiny(), random_weights=True
         )
-        req = CompletionRequest(model="m", prompt="hi", logprobs=2)
-        with pytest.raises(InvalidInput, match="logprobs"):
-            model._sampling_from(req)
+        with pytest.raises(InvalidInput, match="between 0 and"):
+            model._sampling_from(
+                CompletionRequest(model="m", prompt="hi", logprobs=21)
+            )
+        with pytest.raises(InvalidInput, match="requires logprobs"):
+            model._sampling_from(
+                ChatCompletionRequest(
+                    model="m",
+                    messages=[{"role": "user", "content": "x"}],
+                    top_logprobs=2,
+                )
+            )
+        # P/D decode role cannot serve logprobs (wire format limitation)
+        model.role = "decode"
+        model.prefill_url = "http://localhost:1"
+        with pytest.raises(InvalidInput, match="disaggregation"):
+            model._sampling_from(
+                CompletionRequest(model="m", prompt="hi", logprobs=1)
+            )
