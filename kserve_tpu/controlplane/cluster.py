@@ -78,6 +78,12 @@ class FakeCluster:
         if obj is not None:
             obj["status"] = status
 
+    def all_objects(self) -> List[dict]:
+        """Every stored object — the ownership sweep the prune/GC passes
+        need.  Same surface as HTTPCluster.all_objects so ControllerManager
+        runs against either store."""
+        return list(self._objects.values())
+
 
 class ControllerManager:
     """Runs all reconcilers against the cluster until convergence."""
@@ -149,6 +155,27 @@ class ControllerManager:
         else:
             self.reconcile_object(obj)
         return stored
+
+    def observe(self, obj) -> None:
+        """Watch-driven entrypoint (the HTTP manager's event handler):
+        same dispatch as apply(), but never writes the observed object
+        back to the store — the apiserver already holds it, and an echo
+        write would race concurrent deletes and re-create objects the
+        user just removed."""
+        if isinstance(obj, dict):
+            if obj.get("kind") in self._RAW_KINDS:
+                self._on_raw_applied(obj)
+                return
+            obj = self._parse(obj)
+        if isinstance(obj, (ServingRuntime, ClusterServingRuntime)):
+            self.registry.add(obj)
+            return
+        if isinstance(obj, LLMInferenceServiceConfig):
+            self.llm_reconciler.presets[obj.metadata.name] = obj
+            return
+        if isinstance(obj, ClusterStorageContainer):
+            return
+        self.reconcile_object(obj)
 
     _KINDS = {
         "InferenceService": InferenceService,
@@ -269,7 +296,7 @@ class ControllerManager:
         queue = [(kind, name, namespace)]
         while queue:
             owner_kind, owner_name, owner_ns = queue.pop()
-            for obj in list(self.cluster._objects.values()):
+            for obj in self.cluster.all_objects():
                 meta = obj.get("metadata", {})
                 for ref in meta.get("ownerReferences", []):
                     if ref.get("kind") == owner_kind and ref.get("name") == owner_name:
@@ -289,34 +316,15 @@ class ControllerManager:
         directories, recursively (so `apply_yaml('config')` installs the
         whole tree).  CustomResourceDefinition documents are stored raw
         (schema drift vs crdgen is caught by tests/test_installable_config);
-        everything else takes the typed apply path.  kustomization.yaml
-        files are skipped — they are kubectl -k inputs, not resources."""
-        import os
+        everything else takes the typed apply path."""
+        from .objects import iter_yaml_documents
 
-        import yaml
-
-        paths: List[str] = []
-        if os.path.isdir(path):
-            for root, _, files in sorted(os.walk(path)):
-                for entry in sorted(files):
-                    if entry == "kustomization.yaml":
-                        continue
-                    if entry.endswith((".yaml", ".yml")):
-                        paths.append(os.path.join(root, entry))
-            if not paths:
-                raise ValueError(f"no YAML documents under {path!r}")
-        else:
-            paths = [path]
         applied = []
-        for file_path in paths:
-            with open(file_path) as f:
-                for doc in yaml.safe_load_all(f):
-                    if not doc:
-                        continue
-                    if doc.get("kind") == "CustomResourceDefinition":
-                        applied.append(self.cluster.apply(doc))
-                        continue
-                    applied.append(self.apply(doc))
+        for doc in iter_yaml_documents(path):
+            if doc.get("kind") == "CustomResourceDefinition":
+                applied.append(self.cluster.apply(doc))
+            else:
+                applied.append(self.apply(doc))
         return applied
 
     def reconcile_object(self, obj) -> None:
@@ -376,6 +384,16 @@ class ControllerManager:
             obj.kind, obj.metadata.name, obj.metadata.namespace, status
         )
 
+    # every kind any reconciler synthesizes — the prune sweep only needs to
+    # look at these (an all-objects sweep over an HTTP store would be one
+    # LIST per known resource type per reconcile)
+    _CHILD_KINDS = (
+        "Deployment", "StatefulSet", "Service", "ConfigMap",
+        "HorizontalPodAutoscaler", "ScaledObject", "HTTPRoute", "Ingress",
+        "VirtualService", "InferencePool", "OpenTelemetryCollector",
+        "Job", "PersistentVolume", "PersistentVolumeClaim",
+    )
+
     def _prune_owned(self, owner_obj, desired: List[dict]) -> None:
         """Garbage-collect children owned by this object that are no longer
         desired (the apiserver's ownerReference GC, done eagerly)."""
@@ -384,18 +402,25 @@ class ControllerManager:
         # cluster-scoped owners (LocalModelCache) own children across
         # namespaces; namespaced owners only own within their namespace
         cluster_scoped = owner_obj.kind == "LocalModelCache"
-        for key, obj in list(self.cluster._objects.items()):
-            if not cluster_scoped and obj.get("metadata", {}).get("namespace") != owner_ns:
-                continue  # ownerReferences are namespace-local
-            refs = obj.get("metadata", {}).get("ownerReferences", [])
-            for ref in refs:
-                if (
-                    ref.get("kind") == owner_obj.kind
-                    and ref.get("name") == owner_obj.metadata.name
-                    and key not in desired_keys
-                ):
-                    del self.cluster._objects[key]
-                    break
+        for kind in self._CHILD_KINDS:
+            try:
+                children = self.cluster.list(
+                    kind, None if cluster_scoped else owner_ns)
+            except Exception:  # noqa: BLE001 — a type the store doesn't
+                continue  # serve (stripped-down apiserver) prunes nothing
+            for obj in children:
+                meta = obj.get("metadata", {})
+                if not cluster_scoped and meta.get("namespace") != owner_ns:
+                    continue  # ownerReferences are namespace-local
+                key = FakeCluster._key(obj)
+                for ref in meta.get("ownerReferences", []):
+                    if (
+                        ref.get("kind") == owner_obj.kind
+                        and ref.get("name") == owner_obj.metadata.name
+                        and key not in desired_keys
+                    ):
+                        self.cluster.delete(key[0], key[2], key[1])
+                        break
 
     def reconcile_all(self) -> None:
         for kind in (

@@ -112,6 +112,35 @@ def replace_placeholders(obj: Any, metadata: Dict[str, Any]) -> Any:
 # ---------------- object helpers ----------------
 
 
+def iter_yaml_documents(path: str):
+    """kubectl-apply -f -R traversal: yields every YAML document under a
+    file or directory tree (sorted, multi-doc aware), skipping
+    kustomization.yaml (a kubectl -k input, not a resource).  Shared by
+    the fake-cluster and HTTP apply_yaml paths so their skip rules cannot
+    drift.  Raises ValueError for a directory with no YAML."""
+    import os
+
+    import yaml
+
+    paths = []
+    if os.path.isdir(path):
+        for root, _, files in sorted(os.walk(path)):
+            for entry in sorted(files):
+                if entry == "kustomization.yaml":
+                    continue
+                if entry.endswith((".yaml", ".yml")):
+                    paths.append(os.path.join(root, entry))
+        if not paths:
+            raise ValueError(f"no YAML documents under {path!r}")
+    else:
+        paths = [path]
+    for file_path in paths:
+        with open(file_path) as f:
+            for doc in yaml.safe_load_all(f):
+                if doc:
+                    yield doc
+
+
 def make_object(api_version: str, kind: str, name: str, namespace: str = "default",
                 labels: Optional[dict] = None, annotations: Optional[dict] = None,
                 spec: Optional[dict] = None) -> dict:

@@ -53,6 +53,16 @@ class RuntimeRegistry:
         else:
             self._namespaced[(runtime.metadata.namespace, runtime.metadata.name)] = runtime
 
+    def remove(self, name: str, namespace: str = "") -> bool:
+        """Drop a deleted runtime so selection stops scheduling onto it
+        (the watch-driven manager calls this on DELETED events).  A
+        namespace targets ONLY the namespaced entry — a missing namespaced
+        runtime must not evict a same-named cluster runtime that still
+        exists."""
+        if namespace:
+            return self._namespaced.pop((namespace, name), None) is not None
+        return self._cluster.pop(name, None) is not None
+
     def get(self, name: str, namespace: str) -> Runtime:
         """Namespace-scoped first, then cluster-scoped (parity utils.go:305)."""
         rt = self._namespaced.get((namespace, name))
