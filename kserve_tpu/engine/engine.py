@@ -34,6 +34,7 @@ from ..metrics import (
     ENGINE_KV_PAGES_FREE,
     ENGINE_PREEMPTIONS,
     ENGINE_QUEUE_DEPTH,
+    ENGINE_WEDGED,
     GENERATED_TOKENS,
     PROMPT_TOKENS,
 )
@@ -61,6 +62,11 @@ class EngineConfig:
     max_batch_size: int = 8
     page_size: int = 16
     num_pages: int = 2048
+    # wedge detection (VERDICT round-2 weak #6): a device fetch exceeding
+    # this deadline marks the engine wedged — /v2/health/live goes red so
+    # the pod restarts instead of hanging forever.  Must exceed the worst
+    # first-call compile (~40s on chip); 300s is 3x slack over that.
+    step_deadline_s: float = 300.0
     max_pages_per_seq: int = 128
     max_prefill_len: int = 1024
     prefill_buckets: Tuple[int, ...] = (32, 64, 128, 256, 512, 1024)
@@ -121,6 +127,60 @@ class EngineConfig:
         while b < n_pages:
             b *= 2
         return min(b, self.max_pages_per_seq)
+
+
+class EngineWedgedError(RuntimeError):
+    """A device fetch exceeded step_deadline_s: the device tunnel is
+    assumed wedged; liveness fails until the pod restarts."""
+
+
+class _DeadlineFetcher:
+    """One daemon worker thread executing fetch thunks with a deadline.
+    A wedged fetch leaves the worker stuck; the thread being a daemon is
+    the point — it must never block interpreter shutdown."""
+
+    def __init__(self):
+        import queue as _queue
+        import threading as _threading
+
+        self._q: "_queue.Queue" = _queue.Queue()
+        self._threading = _threading
+        self._closed = False
+        self._thread = _threading.Thread(
+            target=self._run, daemon=True, name="engine-fetch")
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, box, done = item
+            try:
+                box.append(("ok", fn()))
+            except BaseException as exc:  # noqa: BLE001 — relayed to caller
+                box.append(("err", exc))
+            done.set()
+
+    def fetch(self, fn, timeout_s: float):
+        if self._closed:
+            # a drain-path fetch after close() must fail fast, not wait a
+            # full deadline on a dead worker queue (that would freeze the
+            # event loop through a graceful shutdown)
+            raise RuntimeError("engine stopped")
+        box: list = []
+        done = self._threading.Event()
+        self._q.put((fn, box, done))
+        if not done.wait(timeout_s):
+            raise TimeoutError(f"fetch exceeded {timeout_s}s")
+        kind, value = box[0]
+        if kind == "err":
+            raise value
+        return value
+
+    def close(self):
+        self._closed = True
+        self._q.put(None)
 
 
 @dataclass
@@ -315,6 +375,13 @@ class LLMEngine:
             else 0
         )
         self.preemption_count = 0
+        # wedge detection: device fetches run on a DAEMON worker with a
+        # deadline; a timeout flips `wedged` (liveness).  Daemon, not a
+        # ThreadPoolExecutor: its non-daemon workers are joined at
+        # interpreter exit, so one stuck fetch would hang process shutdown —
+        # the exact failure mode this exists to escape.
+        self._fetcher = _DeadlineFetcher()
+        self._wedged = False
         # prefix cache: chained page key -> page id, LRU-ordered (front =
         # coldest); the cache holds one ref per page
         from collections import OrderedDict as _OD
@@ -337,13 +404,21 @@ class LLMEngine:
         rep = shd.named(mesh, jax.sharding.PartitionSpec())
         kv_shard = shd.named(mesh, shd.kv_pages_pspec())
 
-        # the pallas kernel has no GSPMD partitioning rule: under tp/sp>1 it
-        # would force the model-axis-sharded cache to replicate at the
-        # custom-call boundary — resolve the auto choice to the gather there
-        if cfg.use_pallas is None and (cfg.tp > 1 or cfg.sp > 1):
-            from dataclasses import replace as _dc_replace
+        # the pallas kernel has no GSPMD partitioning rule; under tp/sp>1
+        # decode attention runs under shard_map over the model axis instead
+        # (each device: its LOCAL heads — q and KV heads shard together so
+        # GQA groups stay intact; no collectives) so the kernel's
+        # auto-dispatch stays available on the multi-chip path
+        decode_attention_fn = None
+        if cfg.tp > 1 or cfg.sp > 1:
+            from ..ops.attention import make_sharded_paged_attention
 
-            cfg = self.config = _dc_replace(cfg, use_pallas=False)
+            decode_attention_fn = make_sharded_paged_attention(
+                mesh,
+                logit_softcap=mc.logit_softcap,
+                use_pallas=cfg.use_pallas,
+                quantized=(getattr(cfg, "kv_quant", None) == "int8"),
+            )
 
         attention_fn = None
         if cfg.sp > 1:
@@ -443,6 +518,7 @@ class LLMEngine:
                         params, mc, tokens, pos, kv_pages, page_table, live,
                         cfg.page_size, use_pallas=cfg.use_pallas,
                         adapter_ids=adapter_ids,
+                        attention_fn=decode_attention_fn,
                     )
                     if with_penalties:
                         logits = apply_penalties(
@@ -567,10 +643,33 @@ class LLMEngine:
             except (asyncio.TimeoutError, asyncio.CancelledError):
                 self._task.cancel()
             self._task = None
+        # close AFTER the loop task is done: an in-flight chunk draining
+        # through _fetch must reach a live worker (close-first would stall
+        # the drain a full step deadline, then false-flag a wedge)
+        self._fetcher.close()
 
     @property
     def running(self) -> bool:
         return self._task is not None and not self._task.done()
+
+    @property
+    def wedged(self) -> bool:
+        """True once a device fetch blew the step deadline (a wedged device
+        tunnel); consumed by liveness so the pod restarts."""
+        return self._wedged
+
+    def _fetch(self, x) -> np.ndarray:
+        """Device->host fetch with the wedge deadline (see step_deadline_s)."""
+        try:
+            return self._fetcher.fetch(
+                lambda: np.asarray(x), self.config.step_deadline_s)
+        except TimeoutError:
+            self._wedged = True
+            ENGINE_WEDGED.labels(model_name=self._mlabel).set(1)
+            raise EngineWedgedError(
+                f"device fetch exceeded step_deadline_s="
+                f"{self.config.step_deadline_s}s — device tunnel wedged?"
+            ) from None
 
     def generate(
         self,
@@ -779,10 +878,13 @@ class LLMEngine:
                 rng,
                 jnp.asarray(adapter_arr),
             )
-            first_np = np.asarray(first)
+            first_np = self._fetch(first)
             for j, (prompt_ids, _, fut, _, pages) in enumerate(runnable):
                 ids = jnp.asarray(np.asarray(pages, np.int32))
-                kv = np.asarray(
+                # deadline-guarded: this is the engine's LARGEST device->
+                # host copy — a tunnel wedge mid-DMA must trip liveness,
+                # not hang the prefill-role handlers forever
+                kv = self._fetch(
                     jnp.stack([layer[ids] for layer in self.kv_pages])
                 )
                 if not fut.done():
@@ -999,9 +1101,10 @@ class LLMEngine:
                 first = self._sample_first_fn(
                     logits, state, rng, jnp.asarray(in_prompt)
                 )
-        first_np = np.asarray(first)
+        first_np = self._fetch(first)
         lp_np = (
-            tuple(np.asarray(a) for a in lp_tuple) if lp_tuple is not None else None
+            tuple(self._fetch(a) for a in lp_tuple)
+            if lp_tuple is not None else None
         )
         for j, (idx, req, pages, _, seq) in enumerate(admitted):
             if req.resume is None:
@@ -1248,7 +1351,7 @@ class LLMEngine:
             first = self._sample_first_fn(
                 pf["logits"], state, rng, jnp.asarray(in_prompt)
             )
-        first_token = int(np.asarray(first)[0])
+        first_token = int(self._fetch(first)[0])
         self._seat_fresh(slot, req, pages, first_token)
         self._mark_penalty_dirty(idx)
         self._emit(slot, first_token, *self._lp_for(req.params, lp_np, 0))
@@ -1637,10 +1740,10 @@ class LLMEngine:
         finished (the pipeline must drain: chained lanes are stale)."""
         steps = self.config.steps_per_sync
         if isinstance(chunk, tuple):  # logprobs variant: (tokens, lp, tv, ti)
-            chunk_np = np.asarray(chunk[0])  # [steps, B]
-            lp_np = tuple(np.asarray(a) for a in chunk[1:])
+            chunk_np = self._fetch(chunk[0])  # [steps, B]
+            lp_np = tuple(self._fetch(a) for a in chunk[1:])
         else:
-            chunk_np = np.asarray(chunk)  # [steps, B]
+            chunk_np = self._fetch(chunk)  # [steps, B]
             lp_np = None
         active = meta["active"]
         finished_any = False

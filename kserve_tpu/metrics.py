@@ -39,6 +39,10 @@ ENGINE_QUEUE_DEPTH = Gauge(
 ENGINE_KV_PAGES_FREE = Gauge(
     "engine_kv_pages_free", "free KV cache pages", ["model_name"]
 )
+ENGINE_WEDGED = Gauge(
+    "engine_wedged", "1 once a device fetch blew the step deadline "
+    "(liveness fails; pod restart expected)", ["model_name"]
+)
 ENGINE_PREEMPTIONS = Counter(
     "engine_preemptions_total",
     "sequences preempted back to the queue on KV pressure", ["model_name"],

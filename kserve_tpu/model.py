@@ -84,6 +84,12 @@ class BaseModel:
         health of their background loop."""
         return self.ready
 
+    async def live(self) -> bool:
+        """Process liveness: False means the pod should be RESTARTED (vs
+        healthy/ready which gate traffic).  Engine models return False once
+        their device loop is wedged (a fetch blew its deadline)."""
+        return True
+
     def load(self) -> bool:
         """Synchronously load weights/artifacts; set and return `self.ready`."""
         self.ready = True

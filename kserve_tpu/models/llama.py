@@ -388,6 +388,8 @@ def decode_step(
     page_size: int,
     use_pallas: Optional[bool] = None,
     adapter_ids: Optional[jnp.ndarray] = None,  # [B] LoRA ids (-1 = base)
+    attention_fn=None,  # fn(q,[B,nq,d], pages, page_table, seq_lens) —
+    # e.g. ops.attention.make_sharded_paged_attention for tp>1
 ) -> Tuple[jnp.ndarray, List[jnp.ndarray]]:
     """One decode token per sequence; returns ([B, vocab] logits, new pages)."""
     B = tokens.shape[0]
@@ -405,14 +407,17 @@ def decode_step(
         pages = append_token_kv(
             pages, k[:, 0], v[:, 0], page_table, pos, active, page_size
         )
-        attn = paged_attention(
-            q[:, 0],
-            pages,
-            page_table,
-            seq_lens,
-            logit_softcap=config.logit_softcap,
-            use_pallas=use_pallas,
-        )
+        if attention_fn is not None:
+            attn = attention_fn(q[:, 0], pages, page_table, seq_lens)
+        else:
+            attn = paged_attention(
+                q[:, 0],
+                pages,
+                page_table,
+                seq_lens,
+                logit_softcap=config.logit_softcap,
+                use_pallas=use_pallas,
+            )
         attn_flat = attn.reshape(B, 1, -1)
         attn = _maybe_add(
             attn_flat @ layer["wo"],

@@ -76,8 +76,13 @@ class DataPlane:
 
     # ---------- health & metadata ----------
 
-    @staticmethod
-    async def live() -> Dict[str, str]:
+    async def live(self) -> Dict[str, str]:
+        """'alive' unless some model reports its background loop wedged —
+        liveness is the restart signal, so a wedged engine must surface
+        here, not just in readiness."""
+        for model in self._model_registry.get_models().values():
+            if isinstance(model, BaseModel) and not await model.live():
+                return {"status": "wedged"}
         return {"status": "alive"}
 
     async def ready(self) -> bool:

@@ -29,7 +29,10 @@ class V2Endpoints:
 
     async def live(self, request: web.Request) -> web.Response:
         status = await self.dataplane.live()
-        return web.json_response({"live": status["status"] == "alive"})
+        live = status["status"] == "alive"
+        # non-2xx on wedge: kubelet httpGet probes key off the status code
+        return web.json_response({"live": live},
+                                 status=200 if live else 503)
 
     async def ready(self, request: web.Request) -> web.Response:
         ready = await self.dataplane.ready()

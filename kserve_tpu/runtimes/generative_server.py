@@ -142,6 +142,12 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
     async def healthy(self) -> bool:
         return self.ready and self.engine is not None and self.engine.running
 
+    async def live(self) -> bool:
+        """Wedge detection (parity: huggingfaceserver health_check.py role):
+        a wedged engine must flip /v2/health/live red so the pod restarts
+        instead of hanging with a healthy-looking HTTP server."""
+        return self.engine is None or not self.engine.wedged
+
     # ---------------- helpers ----------------
 
     def _logprobs_k(self, req) -> Optional[int]:

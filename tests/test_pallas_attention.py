@@ -66,3 +66,55 @@ class TestPallasPagedAttention:
         assert _pick_sb(6) == 6
         assert _pick_sb(5) == 5
         assert _pick_sb(13) == 1  # prime > MAX_SB: no divisor <= 8 except 1
+
+
+class TestShardedPagedAttention:
+    """The kernel under TP (shard_map over the model axis) — VERDICT #6.
+    Each device runs the kernel on its local heads; numerics must match
+    the unsharded XLA reference exactly (no collectives involved)."""
+
+    def _mesh(self, tp):
+        from kserve_tpu.parallel.sharding import create_mesh
+
+        return create_mesh(tp=tp)
+
+    @pytest.mark.parametrize("tp", [2, 4])
+    def test_interpret_kernel_under_tp(self, tp):
+        from kserve_tpu.ops.attention import make_sharded_paged_attention
+
+        q, kv, pt, lens = make_case(B=8, nq=8, nkv=4, d=64)
+        mesh = self._mesh(tp)
+        fn = make_sharded_paged_attention(mesh, interpret=True)
+        ref = paged_attention_xla(q, kv, pt, lens)
+        got = jax.jit(fn)(q, kv, pt, lens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        assert float(jnp.max(jnp.abs(ref))) > 1e-3
+
+    def test_gather_path_under_tp(self):
+        """use_pallas=False through the same wrapper (the auto-dispatch
+        short-context case still runs sharded)."""
+        from kserve_tpu.ops.attention import make_sharded_paged_attention
+
+        q, kv, pt, lens = make_case(B=8, nq=16, nkv=2, d=64)
+        mesh = self._mesh(2)
+        fn = make_sharded_paged_attention(mesh, use_pallas=False)
+        ref = paged_attention_xla(q, kv, pt, lens)
+        got = jax.jit(fn)(q, kv, pt, lens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_engine_tp2_builds_sharded_decode(self):
+        """The engine no longer forces use_pallas off under tp>1: the
+        decode path is built with the shard_map wrapper instead."""
+        from kserve_tpu.engine.engine import EngineConfig, LLMEngine
+        from kserve_tpu.engine.tokenizer import ByteTokenizer
+        from kserve_tpu.models.llama import LlamaConfig
+
+        mc = LlamaConfig.tiny(dtype="float32")
+        cfg = EngineConfig(max_batch_size=4, page_size=8, num_pages=64,
+                           max_pages_per_seq=8, max_prefill_len=32,
+                           prefill_buckets=(32,), dtype="float32", tp=2)
+        engine = LLMEngine(mc, cfg, ByteTokenizer(mc.vocab_size), rng_seed=0)
+        # auto stays auto (not forced False) — the sharded wrapper decides
+        assert engine.config.use_pallas is None
