@@ -96,6 +96,12 @@ class DataParallelEngine:
     def running(self) -> bool:
         return all(eng.running for eng in self.replicas)
 
+    @property
+    def wedged(self) -> bool:
+        """Any replica wedged wedges the pod: its slice of traffic would
+        hang forever, and a restart re-homes all replicas together."""
+        return any(eng.wedged for eng in self.replicas)
+
     # ---------------- routing ----------------
 
     def _load(self, eng: LLMEngine) -> Tuple[int, int]:

@@ -430,7 +430,7 @@ class LLMEngine:
             # (single-token steps have nothing to shard over seq).
             from functools import partial as _partial
 
-            from jax.experimental.shard_map import shard_map
+            from jax import shard_map
             from jax.sharding import PartitionSpec as _P
 
             from ..parallel.ring_attention import ring_attention
@@ -445,7 +445,7 @@ class LLMEngine:
                 mesh=mesh,
                 in_specs=(qkv_spec, qkv_spec, qkv_spec, _P(None)),
                 out_specs=qkv_spec,
-                check_rep=False,
+                check_vma=False,
             )
             attention_fn = lambda q, k, v, vl, softcap: ring_fn(q, k, v, vl)  # noqa: E731
 

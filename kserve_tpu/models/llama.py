@@ -283,6 +283,40 @@ def _adapter_onehot(params: Params, adapter_ids, batch: int):
     return None
 
 
+def transformer_block(
+    layer: Params,
+    x: jnp.ndarray,  # [B, T, h]
+    positions: jnp.ndarray,  # [B, T]
+    valid_len: jnp.ndarray,  # [B]
+    config: LlamaConfig,
+    onehot=None,  # LoRA adapter one-hot (or None = base weights)
+    attention_fn=None,  # (q, k, v, valid_len, softcap) -> attn
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One transformer block (prefill form, pre-cache): returns
+    (x_out, k, v) — the caller scatters K/V into its pages (prefill) or
+    discards them (the pipeline-parallel layer_fn).  The single source of
+    the block math: prefill and parallel/pipeline.py both call this, so
+    rope/softcap/LoRA changes cannot drift between them."""
+    if attention_fn is None:
+        attention_fn = causal_prefill_attention
+    B, T = x.shape[0], x.shape[1]
+    residual = x
+    h = rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
+    q, k, v = _qkv(layer, h, config, onehot)
+    q = apply_rope(q, positions, config.rope_theta, config.rope_scaling)
+    k = apply_rope(k, positions, config.rope_theta, config.rope_scaling)
+    attn = attention_fn(q, k, v, valid_len, config.logit_softcap)
+    attn_flat = attn.reshape(B, T, -1)
+    attn = _maybe_add(
+        attn_flat @ layer["wo"],
+        lora_delta(layer.get("lora"), "wo", attn_flat, onehot),
+    )
+    x = residual + attn
+    residual = x
+    h = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
+    return residual + _mlp(layer, h, config, onehot), k, v
+
+
 def prefill(
     params: Params,
     config: LlamaConfig,
@@ -305,21 +339,10 @@ def prefill(
     x = params["embed"][tokens].astype(jnp.dtype(config.dtype))
     new_pages = []
     for layer, pages in zip(params["layers"], kv_pages):
-        residual = x
-        h = rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
-        q, k, v = _qkv(layer, h, config, onehot)
-        q = apply_rope(q, positions, config.rope_theta, config.rope_scaling)
-        k = apply_rope(k, positions, config.rope_theta, config.rope_scaling)
-        attn = attention_fn(q, k, v, valid_len, config.logit_softcap)
-        attn_flat = attn.reshape(B, T, -1)
-        attn = _maybe_add(
-            attn_flat @ layer["wo"],
-            lora_delta(layer.get("lora"), "wo", attn_flat, onehot),
+        x, k, v = transformer_block(
+            layer, x, positions, valid_len, config,
+            onehot=onehot, attention_fn=attention_fn,
         )
-        x = residual + attn
-        residual = x
-        h = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
-        x = residual + _mlp(layer, h, config, onehot)
         # scatter the whole batch's K/V into its pages in one op
         pages = write_prompt_kv_batch(pages, k, v, page_ids, valid_len, page_size)
         new_pages.append(pages)

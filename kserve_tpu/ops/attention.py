@@ -191,7 +191,7 @@ def make_sharded_paged_attention(
     Returns fn(q [B,nq,d], kv_pages, page_table [B,W], seq_lens [B]) ->
     [B,nq,d].  `quantized` selects the (int8 pages, scales) cache layout.
     """
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.sharding import MODEL_AXIS
@@ -217,7 +217,7 @@ def make_sharded_paged_attention(
         mesh=mesh,
         in_specs=(q_spec, kv_spec, P(None, None), P(None)),
         out_specs=q_spec,
-        check_rep=False,
+        check_vma=False,
     )
 
 

@@ -1,0 +1,149 @@
+"""Pipeline parallelism: a stage mesh axis + collective-permute of
+activations (GPipe-style microbatch schedule, SPMD formulation).
+
+The reference turns `PipelineParallelSize` into multi-node worker math
+(pkg/controller/v1beta1/inferenceservice/components/predictor.go:761) and
+lets vLLM run the stages over NCCL.  The TPU-native equivalent is a
+`pipe` mesh axis: the layer stack is sharded over it (each device holds
+L/S contiguous layers), microbatches stream through the stages, and
+activations move stage->stage via `lax.ppermute` over ICI/DCN — the
+canonical use is spanning pods (DCN) where a single ppermute hop per
+microbatch tolerates the higher latency, while TP stays inside the slice.
+
+Within one slice, TP is strictly preferable at serving scales: the
+pipeline adds (S-1) bubble steps per round and holds S in-flight
+microbatch activations, while TP's all-reduces ride full ICI bandwidth.
+PP exists for when the model does not fit a slice's HBM (see README
+"Pipeline parallelism" for the measured framing).
+
+Schedule (S stages, M microbatches, M+S-1 steps, all SPMD — every stage
+computes every step; warm-up/drain emit garbage that is masked off):
+
+    step t: stage s computes microbatch (t - s) if 0 <= t-s < M
+            activations ppermute s -> s+1
+            stage S-1's outputs for t >= S-1 are the pipeline outputs
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+PIPE_AXIS = "pipe"
+
+
+def create_pp_mesh(pp: int, devices=None) -> Mesh:
+    """A (pipe,) mesh.  Stages should map contiguously onto the device
+    order so the ppermute hop is ICI-adjacent (or crosses DCN exactly once
+    between pods)."""
+    devices = devices if devices is not None else jax.devices()
+    if pp > len(devices):
+        raise ValueError(f"pp={pp} needs {pp} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices[:pp]), (PIPE_AXIS,))
+
+
+def stack_stage_params(layer_params_list):
+    """[L] list of per-layer pytrees -> one pytree with leading layer axis
+    (sharded over PIPE_AXIS by pipeline_forward's in_specs)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *layer_params_list)
+
+
+def _pipeline_local(
+    stacked_local,  # pytree, leading axis = L/S local layers
+    microbatches: jnp.ndarray,  # [M, mb, ...] same on every stage
+    layer_fn: Callable,  # (layer_params, x) -> x, one transformer block
+    axis_name: str,
+    S: int,  # static stage count (the ppermute ring needs a Python int)
+):
+    """The per-device program (inside shard_map)."""
+    stage = jax.lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+
+    def run_stage(x):
+        def body(h, layer):
+            return layer_fn(layer, h), None
+
+        out, _ = jax.lax.scan(body, x, stacked_local)
+        return out
+
+    def step(carry, t):
+        buf = carry  # activation received from the previous stage
+        # stage 0 ingests microbatch t (clamped index; garbage past M is
+        # masked by the output gather), later stages consume the buffer
+        mb = microbatches[jnp.clip(t, 0, M - 1)]
+        x_in = jnp.where(stage == 0, mb, buf)
+        y = run_stage(x_in)
+        # rotate activations one stage forward (the S-1 -> 0 wrap carries
+        # garbage that stage 0 ignores)
+        buf_next = jax.lax.ppermute(
+            y, axis_name, [(i, (i + 1) % S) for i in range(S)]
+        )
+        # only the LAST stage's output is the pipeline output; zero
+        # elsewhere so a psum over the axis broadcasts it
+        out = jnp.where(stage == S - 1, y, jnp.zeros_like(y))
+        return buf_next, out
+
+    steps = M + S - 1
+    _, outs = jax.lax.scan(
+        step, jnp.zeros_like(microbatches[0]), jnp.arange(steps)
+    )
+    # outs[t] is microbatch t-(S-1); steps before the pipeline filled are
+    # warm-up garbage
+    outs = outs[S - 1:]
+    # broadcast the last stage's outputs to every device (replicated out)
+    return jax.lax.psum(outs, axis_name)
+
+
+def llama_block_layer_fn(config):
+    """One full llama transformer block (prefill form, no KV cache) as a
+    pipeline `layer_fn` — delegates to llama.transformer_block, the single
+    source of the block math (no drift between prefill and the pipeline)."""
+    from ..models.llama import transformer_block
+
+    def layer_fn(layer, x):
+        B, T, _ = x.shape
+        positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        valid = jnp.full((B,), T, jnp.int32)
+        x_out, _, _ = transformer_block(layer, x, positions, valid, config)
+        return x_out
+
+    return layer_fn
+
+
+def pipeline_forward(
+    stacked_params,  # pytree with leading axis L (= S * layers_per_stage)
+    x: jnp.ndarray,  # [B, ...] full batch
+    layer_fn: Callable,
+    mesh: Mesh,
+    n_microbatches: int,
+    axis_name: str = PIPE_AXIS,
+) -> jnp.ndarray:
+    """Run a layer stack over the pipe axis of `mesh`.
+
+    The batch is split into `n_microbatches` along dim 0 (must divide B);
+    output is the full [B, ...] result, replicated over the pipe axis.
+    """
+    from jax import shard_map
+
+    B = x.shape[0]
+    if B % n_microbatches != 0:
+        raise ValueError(f"batch {B} not divisible by {n_microbatches} microbatches")
+    mb = B // n_microbatches
+    microbatches = x.reshape((n_microbatches, mb) + x.shape[1:])
+
+    stage_spec = jax.tree.map(lambda _: P(PIPE_AXIS), stacked_params)
+    fn = shard_map(
+        partial(_pipeline_local, layer_fn=layer_fn, axis_name=axis_name,
+                S=mesh.shape[axis_name]),
+        mesh=mesh,
+        in_specs=(stage_spec, P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    out = fn(stacked_params, microbatches)
+    return out.reshape((B,) + out.shape[2:])

@@ -1,0 +1,79 @@
+"""Pipeline parallelism (VERDICT round-2 #9): layer stack sharded over a
+`pipe` mesh axis, activations moved stage->stage via ppermute, GPipe
+microbatch schedule.  Numerics must match the plain sequential layer loop
+bit-for-bit-ish (same dtype, same math, different schedule).
+
+Parity: the reference's PipelineParallelSize -> node math
+(predictor.go:761) realized as a mesh axis instead of NCCL ranks."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kserve_tpu.models.llama import LlamaConfig, init_params
+from kserve_tpu.parallel.pipeline import (
+    create_pp_mesh,
+    llama_block_layer_fn as make_layer_fn,
+    pipeline_forward,
+    stack_stage_params,
+)
+
+
+def reference_forward(layers, x, layer_fn):
+    for layer in layers:
+        x = layer_fn(layer, x)
+    return x
+
+
+class TestPipelineForward:
+    @pytest.mark.parametrize("pp,n_layers,n_micro", [
+        (2, 4, 2),   # the VERDICT's 2-stage ask
+        (2, 4, 4),   # more microbatches than stages
+        (4, 4, 2),   # one layer per stage
+    ])
+    def test_matches_sequential(self, pp, n_layers, n_micro):
+        config = LlamaConfig.tiny(dtype="float32", n_layers=n_layers)
+        params = init_params(config, jax.random.PRNGKey(0))
+        layers = params["layers"]
+        layer_fn = make_layer_fn(config)
+
+        B, T, H = 4, 8, config.hidden_size
+        x = jax.random.normal(jax.random.PRNGKey(1), (B, T, H),
+                              jnp.float32)
+        ref = reference_forward(layers, x, layer_fn)
+
+        mesh = create_pp_mesh(pp)
+        stacked = stack_stage_params(layers)
+        got = jax.jit(
+            lambda p, xx: pipeline_forward(p, xx, layer_fn, mesh, n_micro)
+        )(stacked, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        assert float(jnp.max(jnp.abs(ref))) > 1e-2  # non-vacuous
+
+    def test_batch_not_divisible_raises(self):
+        config = LlamaConfig.tiny(dtype="float32", n_layers=2)
+        params = init_params(config, jax.random.PRNGKey(0))
+        stacked = stack_stage_params(params["layers"])
+        x = jnp.zeros((5, 4, config.hidden_size), jnp.float32)
+        with pytest.raises(ValueError, match="not divisible"):
+            pipeline_forward(x=x, stacked_params=stacked,
+                             layer_fn=make_layer_fn(config),
+                             mesh=create_pp_mesh(2), n_microbatches=3)
+
+    def test_microbatch_schedule_uses_all_stages(self):
+        """Each stage must transform the data (garbage-in at warm-up must
+        be masked): with identity-ish layers replaced by +1 per layer, the
+        pipeline output equals x + n_layers everywhere."""
+        mesh = create_pp_mesh(2)
+        n_layers = 4
+        stacked = {"b": jnp.ones((n_layers, 1), jnp.float32)}
+        x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+
+        def layer_fn(layer, h):
+            return h + layer["b"]
+
+        out = pipeline_forward(stacked, x, layer_fn, mesh, 4)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x) + n_layers)

@@ -110,3 +110,21 @@ class TestWedgedLiveness:
         dataplane = DataPlane(repo)
         assert (await dataplane.live())["status"] == "alive"
         await engine.stop()
+
+
+class TestDataParallelWedge:
+    def test_dp_engine_aggregates_wedged(self):
+        """dp>1 serves through DataParallelEngine — its liveness must
+        aggregate replica wedge state (a missing property would 500 every
+        probe and restart-loop a healthy pod)."""
+        from kserve_tpu.engine.dp import DataParallelEngine, build_engine
+        from kserve_tpu.engine.tokenizer import ByteTokenizer
+
+        from test_dp_engine import make_config, model_config
+
+        engine = build_engine(model_config(), make_config(dp=2),
+                              ByteTokenizer(512))
+        assert isinstance(engine, DataParallelEngine)
+        assert not engine.wedged
+        engine.replicas[1]._wedged = True
+        assert engine.wedged
