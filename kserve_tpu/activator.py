@@ -1,0 +1,241 @@
+"""Scale-to-zero activator: buffer requests at zero, wake the workload,
+forward when ready.
+
+Knative's serverless path puts its activator in the data path at zero
+(ref pkg/controller/v1beta1/inferenceservice/reconcilers/knative/
+ksvc_reconciler.go:64 + the KPA's activator semantics).  This framework
+declares Knative a non-goal (SURVEY §7) and autoscales with KEDA; KEDA
+alone scales on metrics and cannot wake a scaled-to-zero Deployment for
+the FIRST request — something must sit in the request path.  This is that
+something: an aiohttp reverse proxy the ISVC reconciler routes to when
+`minReplicas: 0` (reconciler.py scale-to-zero branch).  On a request while
+the backend is down it (1) triggers scale-up — in-cluster, a replicas
+patch through the apiserver, same effect as KEDA's http-add-on
+interceptor; in tests, a callback — (2) holds the request while polling
+readiness, (3) forwards, and passes through directly once warm.
+
+Cold-start budget = pod schedule + server boot + first-compile; the
+activator adds one proxy hop only while scaled to zero (see README
+"Scale to zero").
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Awaitable, Callable, Optional
+
+import aiohttp
+from aiohttp import web
+
+from .logging import logger
+
+HOP_HEADERS = {"connection", "keep-alive", "transfer-encoding", "upgrade",
+               "proxy-authenticate", "proxy-authorization", "te", "trailers",
+               "host", "content-length"}
+
+
+class Activator:
+    def __init__(
+        self,
+        backend_url: str,
+        scale_up: Optional[Callable[[], Awaitable[None]]] = None,
+        readiness_path: str = "/v2/health/ready",
+        poll_interval: float = 0.25,
+        wake_timeout: float = 120.0,
+        port: int = 8012,
+    ):
+        self.backend_url = backend_url.rstrip("/")
+        self.scale_up = scale_up
+        self.readiness_path = readiness_path
+        self.poll_interval = poll_interval
+        self.wake_timeout = wake_timeout
+        self.port = port
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._wake_lock = asyncio.Lock()
+        self._backend_ready = False
+        # a failed wake poisons the cohort briefly: waiters queued behind
+        # the lock fail fast instead of each serially re-polling a full
+        # wake_timeout and firing redundant scale-ups
+        self._wake_failed_until = 0.0
+        self.stats = {"buffered": 0, "proxied": 0, "cold_start_s": None}
+        self._runner = None
+
+    async def _ensure_session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def _backend_is_ready(self) -> bool:
+        session = await self._ensure_session()
+        try:
+            async with session.get(
+                self.backend_url + self.readiness_path,
+                timeout=aiohttp.ClientTimeout(total=2),
+            ) as resp:
+                return resp.status == 200
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            return False
+
+    async def _wake(self) -> None:
+        """Trigger scale-up once, then poll readiness.  Concurrent cold
+        requests share one wake (the lock) — N buffered requests must not
+        fire N scale-ups."""
+        async with self._wake_lock:
+            if self._backend_ready:
+                return  # another waiter completed the wake while we queued
+            now = time.monotonic()
+            if now < self._wake_failed_until:
+                raise web.HTTPServiceUnavailable(
+                    text="backend wake recently failed; retry later")
+            if await self._backend_is_ready():
+                self._backend_ready = True
+                return
+            t0 = time.monotonic()
+            if self.scale_up is not None:
+                await self.scale_up()
+            deadline = t0 + self.wake_timeout
+            while time.monotonic() < deadline:
+                if await self._backend_is_ready():
+                    self._backend_ready = True
+                    self.stats["cold_start_s"] = round(time.monotonic() - t0, 3)
+                    logger.info("activator: backend awake after %.2fs",
+                                self.stats["cold_start_s"])
+                    return
+                await asyncio.sleep(self.poll_interval)
+            self._wake_failed_until = time.monotonic() + min(
+                self.wake_timeout / 4, 10.0)
+            raise web.HTTPGatewayTimeout(
+                text=f"backend did not become ready within {self.wake_timeout}s"
+            )
+
+    async def handle(self, request: web.Request) -> web.StreamResponse:
+        # warm path trusts state — no per-request readiness probe (it
+        # would serialize a round-trip per request and misread one slow
+        # probe as scaled-to-zero).  A connect failure below flips the
+        # state and retries through the wake path once.
+        if not self._backend_ready:
+            self.stats["buffered"] += 1
+            await self._wake()
+        body = await request.read()
+        try:
+            return await self._proxy(request, body)
+        except (aiohttp.ClientConnectorError, aiohttp.ServerDisconnectedError):
+            self._backend_ready = False
+            self.stats["buffered"] += 1
+            await self._wake()
+            return await self._proxy(request, body)
+
+    async def _proxy(self, request: web.Request,
+                     body: bytes) -> web.StreamResponse:
+        session = await self._ensure_session()
+        headers = {k: v for k, v in request.headers.items()
+                   if k.lower() not in HOP_HEADERS}
+        async with session.request(
+            request.method,
+            self.backend_url + request.rel_url.path_qs,
+            data=body if body else None,
+            headers=headers,
+            # no total timeout: long streaming generations must not be
+            # truncated mid-response; bound only the connect
+            timeout=aiohttp.ClientTimeout(total=None, sock_connect=10),
+        ) as resp:
+            self.stats["proxied"] += 1
+            out_headers = {k: v for k, v in resp.headers.items()
+                           if k.lower() not in HOP_HEADERS}
+            out = web.StreamResponse(status=resp.status, headers=out_headers)
+            await out.prepare(request)
+            async for chunk in resp.content.iter_chunked(65536):
+                await out.write(chunk)
+            await out.write_eof()
+            return out
+
+    async def handle_stats(self, request: web.Request) -> web.Response:
+        return web.json_response(self.stats)
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/activator/stats", self.handle_stats)
+        app.router.add_route("*", "/{tail:.*}", self.handle)
+        return app
+
+    async def start(self) -> int:
+        runner = web.AppRunner(self.make_app())
+        await runner.setup()
+        site = web.TCPSite(runner, "0.0.0.0", self.port)
+        await site.start()
+        self._runner = runner
+        self.port = runner.addresses[0][1]
+        return self.port
+
+    async def stop(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+
+def deployment_scaler(master: str, deployment: str, namespace: str,
+                      token: Optional[str] = None,
+                      in_cluster: bool = False):
+    """scale_up callback patching Deployment replicas to >=1 through the
+    apiserver (the in-cluster trigger; KEDA scales back down on idle)."""
+    from .api.http_transport import HTTPCluster
+
+    cluster = (HTTPCluster(master, token=token) if master
+               else HTTPCluster("", in_cluster=in_cluster))
+
+    async def scale_up():
+        def _patch():
+            dep = cluster.get("Deployment", deployment, namespace)
+            if dep is None:
+                raise web.HTTPServiceUnavailable(
+                    text=f"deployment {namespace}/{deployment} not found")
+            if int(dep.get("spec", {}).get("replicas") or 0) < 1:
+                dep["spec"]["replicas"] = 1
+                cluster.apply(dep)
+
+        await asyncio.to_thread(_patch)
+
+    return scale_up
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="kserve-tpu activator")
+    parser.add_argument("--backend", required=True)
+    parser.add_argument("--port", type=int, default=8012)
+    parser.add_argument("--deployment", default=None,
+                        help="Deployment to wake (with --master/--in-cluster)")
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument("--master", default=None)
+    parser.add_argument("--in-cluster", action="store_true")
+    parser.add_argument("--readiness-path", default="/v2/health/ready")
+    parser.add_argument("--wake-timeout", type=float, default=120.0)
+    args = parser.parse_args(argv)
+
+    scale_up = None
+    if args.deployment:
+        scale_up = deployment_scaler(args.master, args.deployment,
+                                     args.namespace,
+                                     in_cluster=args.in_cluster)
+    activator = Activator(
+        args.backend, scale_up=scale_up, port=args.port,
+        readiness_path=args.readiness_path, wake_timeout=args.wake_timeout,
+    )
+
+    async def run():
+        port = await activator.start()
+        logger.info("activator on :%d -> %s", port, args.backend)
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -378,6 +378,7 @@ class ControllerManager:
         else:
             return
         for d in desired:
+            self._preserve_autoscaled_replicas(d)
             self.cluster.apply(d)
         self._prune_owned(obj, desired)
         self.cluster.update_status(
@@ -393,6 +394,24 @@ class ControllerManager:
         "VirtualService", "InferencePool", "OpenTelemetryCollector",
         "Job", "PersistentVolume", "PersistentVolumeClaim",
     )
+
+    def _preserve_autoscaled_replicas(self, desired: dict) -> None:
+        """A Deployment whose replica count an external autoscaler (KEDA/
+        HPA) owns keeps its LIVE replicas across re-reconciles — resetting
+        it would fight the autoscaler and undo a 0->1 wake-up
+        (parity: the reference omits replicas when an HPA exists)."""
+        from .crds import AUTOSCALED_REPLICAS_ANNOTATION
+
+        if desired.get("kind") != "Deployment":
+            return
+        meta = desired.get("metadata", {})
+        if meta.get("annotations", {}).get(
+                AUTOSCALED_REPLICAS_ANNOTATION) != "true":
+            return
+        live = self.cluster.get(
+            "Deployment", meta.get("name", ""), meta.get("namespace", ""))
+        if live is not None and "replicas" in live.get("spec", {}):
+            desired["spec"]["replicas"] = live["spec"]["replicas"]
 
     def _prune_owned(self, owner_obj, desired: List[dict]) -> None:
         """Garbage-collect children owned by this object that are no longer

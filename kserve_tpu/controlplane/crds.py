@@ -28,6 +28,9 @@ V1ALPHA2 = f"{GROUP}/v1alpha2"
 DEPLOYMENT_MODE_ANNOTATION = f"{GROUP}/deploymentMode"
 AUTOSCALER_CLASS_ANNOTATION = f"{GROUP}/autoscalerClass"
 STOP_ANNOTATION = f"{GROUP}/stop"
+# set by the reconciler on Deployments whose replica count an external
+# autoscaler (HPA/KEDA) owns: re-reconciles preserve the live value
+AUTOSCALED_REPLICAS_ANNOTATION = f"{GROUP}/autoscaler-owned-replicas"
 
 TPU_RESOURCE = "google.com/tpu"
 TPU_TOPOLOGY_SELECTOR = "cloud.google.com/gke-tpu-topology"

@@ -20,6 +20,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Tuple
 
 from .crds import (
+    AUTOSCALED_REPLICAS_ANNOTATION,
     AUTOSCALER_CLASS_ANNOTATION,
     DEPLOYMENT_MODE_ANNOTATION,
     STOP_ANNOTATION,
@@ -82,10 +83,22 @@ class InferenceServiceReconciler:
         component_urls: Dict[str, str] = {}
         canary_pct: Optional[int] = None
         canary_has_stable = False
+        scale_to_zero: set = set()
         for component in COMPONENTS:
             spec = getattr(isvc.spec, component, None)
             if spec is None:
                 continue
+            if (component == "predictor"
+                    and spec.canaryTrafficPercent is not None
+                    and self._scales_to_zero(isvc, spec)):
+                # the activator proxies ONE backend; a weighted canary
+                # split at zero would route to empty Services with nothing
+                # to fire the wake — reject loudly instead
+                raise ReconcileError(
+                    "canaryTrafficPercent with minReplicas=0 (scale-to-"
+                    "zero) is not supported: set minReplicas>=1 for the "
+                    "duration of the rollout"
+                )
             if component == "predictor" and spec.canaryTrafficPercent is not None:
                 # canary rollout (parity: predictor.go:886-913 raw-mode
                 # traffic split): the NEW spec deploys as {name}-canary; the
@@ -116,6 +129,8 @@ class InferenceServiceReconciler:
                 set_condition(status, "PredictorReady", True, reason="Reconciled")
                 continue
             objs, url = self._reconcile_component(isvc, component, spec)
+            if self._scales_to_zero(isvc, spec):
+                scale_to_zero.add(component)
             if component == "predictor":
                 # promotion point: this spec becomes the stable snapshot the
                 # next canary rollout serves alongside
@@ -128,6 +143,7 @@ class InferenceServiceReconciler:
             self._route(
                 isvc, component_urls,
                 canary_pct=canary_pct, canary_has_stable=canary_has_stable,
+                activator_entries=scale_to_zero,
             )
         )
         if canary_pct is not None:
@@ -159,7 +175,14 @@ class InferenceServiceReconciler:
         if component == "predictor":
             pod_spec, plan = self._predictor_pod_spec(isvc, spec)
         else:
-            predictor_host = f"{self._component_name(isvc, 'predictor')}.{namespace}"
+            predictor_name = self._component_name(isvc, "predictor")
+            if isvc.spec.predictor is not None and self._scales_to_zero(
+                    isvc, isvc.spec.predictor):
+                # a scaled-to-zero predictor is only reachable through its
+                # activator — calling the bare Service would hit zero
+                # endpoints and nothing would fire the wake
+                predictor_name = f"{predictor_name}-activator"
+            predictor_host = f"{predictor_name}.{namespace}"
             if not spec.containers:
                 if component == "explainer":
                     # default explainer runtime (runtimes/explainer_server):
@@ -322,8 +345,62 @@ class InferenceServiceReconciler:
                 "serving.kserve.io/tpu-num-slices": str(plan.num_slices),
             }
             objects.append(headless)
-        objects.append(self._autoscaler(isvc, name, spec))
+        autoscaler = self._autoscaler(isvc, name, spec)
+        if autoscaler is not None:
+            # an external autoscaler owns spec.replicas from here on; the
+            # controller must not reset it on re-reconcile (a KEDA 0->1
+            # wake-up would be fought back to 0) — cluster.reconcile_object
+            # preserves the live value for annotated deployments
+            deployment["metadata"].setdefault("annotations", {})[
+                AUTOSCALED_REPLICAS_ANNOTATION] = "true"
+            objects.append(autoscaler)
+        if self._scales_to_zero(isvc, spec):
+            objects.extend(self._activator_objects(isvc, name, labels))
         return [o for o in objects if o is not None]
+
+    @staticmethod
+    def _scales_to_zero(isvc, spec) -> bool:
+        klass = isvc.metadata.annotations.get(AUTOSCALER_CLASS_ANNOTATION, "hpa")
+        return bool(klass == "keda" and spec.minReplicas == 0
+                    and spec.maxReplicas)
+
+    def _activator_objects(self, isvc, name: str, labels: dict) -> List[dict]:
+        """Scale-to-zero data path (KPA/activator semantics without
+        Knative, activator.py): routed-to while the workload sleeps; wakes
+        the Deployment through the apiserver and forwards when ready."""
+        namespace = isvc.metadata.namespace
+        act_name = f"{name}-activator"
+        act_labels = {"app": act_name,
+                      "serving.kserve.io/inferenceservice": isvc.metadata.name}
+        deployment = make_object(
+            "apps/v1", "Deployment", act_name, namespace, labels=act_labels,
+            spec={
+                "replicas": 1,
+                "selector": {"matchLabels": {"app": act_name}},
+                "template": {
+                    "metadata": {"labels": dict(act_labels)},
+                    "spec": {"containers": [{
+                        "name": "activator",
+                        "image": "kserve-tpu/activator:latest",
+                        "command": ["python", "-m", "kserve_tpu.activator"],
+                        "args": [
+                            f"--backend=http://{name}.{namespace}:80",
+                            f"--deployment={name}",
+                            f"--namespace={namespace}",
+                            "--in-cluster",
+                            "--port=8012",
+                        ],
+                        "ports": [{"containerPort": 8012}],
+                    }]},
+                },
+            },
+        )
+        service = make_object(
+            "v1", "Service", act_name, namespace, labels=act_labels,
+            spec={"selector": {"app": act_name},
+                  "ports": [{"name": "http", "port": 80, "targetPort": 8012}]},
+        )
+        return [deployment, service]
 
     def _autoscaler(self, isvc, name: str, spec) -> Optional[dict]:
         klass = isvc.metadata.annotations.get(AUTOSCALER_CLASS_ANNOTATION, "hpa")
@@ -373,7 +450,8 @@ class InferenceServiceReconciler:
 
     def _route(self, isvc, component_urls: Dict[str, str],
                canary_pct: Optional[int] = None,
-               canary_has_stable: bool = False) -> dict:
+               canary_has_stable: bool = False,
+               activator_entries=frozenset()) -> dict:
         """Gateway-API HTTPRoute: traffic enters at transformer when present,
         else predictor; :predict/:explain split to explainer (parity:
         ingress_reconciler.go semantics on HTTPRoute instead of Istio VS).
@@ -393,6 +471,10 @@ class InferenceServiceReconciler:
                 backend_refs = [
                     {"name": f"{entry_name}-canary", "port": 80, "weight": 100}
                 ]
+        elif entry in activator_entries:
+            # scaled-to-zero: the activator is the data path (buffers the
+            # wake-up request, forwards once the workload is ready)
+            backend_refs = [{"name": f"{entry_name}-activator", "port": 80}]
         else:
             backend_refs = [{"name": entry_name, "port": 80}]
         rules = [
@@ -402,12 +484,15 @@ class InferenceServiceReconciler:
             }
         ]
         if "explainer" in component_urls:
+            explainer_backend = self._component_name(isvc, "explainer")
+            if "explainer" in activator_entries:
+                explainer_backend = f"{explainer_backend}-activator"
             rules.insert(0, {
                 "matches": [
                     {"path": {"type": "RegularExpression", "value": r"^/v1/models/[^/]+:explain$"}}
                 ],
                 "backendRefs": [
-                    {"name": self._component_name(isvc, "explainer"), "port": 80}
+                    {"name": explainer_backend, "port": 80}
                 ],
             })
         return make_object(

@@ -133,6 +133,11 @@ def pipeline_forward(
     B = x.shape[0]
     if B % n_microbatches != 0:
         raise ValueError(f"batch {B} not divisible by {n_microbatches} microbatches")
+    S = mesh.shape[axis_name]
+    n_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    if n_layers % S != 0:
+        raise ValueError(
+            f"n_layers={n_layers} not divisible by {S} pipeline stages")
     mb = B // n_microbatches
     microbatches = x.reshape((n_microbatches, mb) + x.shape[1:])
 

@@ -148,8 +148,12 @@ class TestManagerOverHTTP:
         wait_for(lambda: cluster.get("Deployment", "respec-predictor"))
         obj["spec"]["predictor"]["minReplicas"] = 2
         cluster.apply(obj)
-        wait_for(lambda: (cluster.get("Deployment", "respec-predictor")
-                          or {}).get("spec", {}).get("replicas") == 2)
+        # replica ownership: with an autoscaler present the minReplicas
+        # change flows to the HPA (the Deployment's live count is
+        # autoscaler-owned and preserved across reconciles)
+        wait_for(lambda: (cluster.get(
+            "HorizontalPodAutoscaler", "respec-predictor")
+            or {}).get("spec", {}).get("minReplicas") == 2)
 
     def test_delete_cascades_to_children(self, stack):
         cluster = stack["cluster"]

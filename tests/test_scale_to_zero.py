@@ -1,0 +1,225 @@
+"""Scale-to-zero exercised end-to-end (VERDICT round-2 #8).
+
+Control plane: an ISVC with the KEDA autoscaler class and minReplicas=0
+deploys at 0 replicas with an activator in the data path; a simulated
+KEDA 0->1 wake-up survives re-reconciles (the controller must not fight
+the autoscaler back to 0).
+
+Data plane: a live Activator buffers a request while the backend is
+down, triggers scale-up (which boots a REAL model server), and forwards
+the buffered request once ready — KPA/activator semantics
+(ksvc_reconciler.go:64) without Knative.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from kserve_tpu.activator import Activator
+from kserve_tpu.controlplane.cluster import ControllerManager
+from kserve_tpu.controlplane.crds import (
+    AUTOSCALED_REPLICAS_ANNOTATION,
+    AUTOSCALER_CLASS_ANNOTATION,
+)
+
+from conftest import async_test
+
+
+def make_s2z_isvc(name="coldstart"):
+    return {
+        "apiVersion": "serving.kserve.io/v1beta1",
+        "kind": "InferenceService",
+        "metadata": {
+            "name": name, "namespace": "default",
+            "annotations": {AUTOSCALER_CLASS_ANNOTATION: "keda"},
+        },
+        "spec": {
+            "predictor": {
+                "model": {"modelFormat": {"name": "sklearn"},
+                          "storageUri": "gs://b/m"},
+                "minReplicas": 0,
+                "maxReplicas": 2,
+            }
+        },
+    }
+
+
+class TestControlPlaneScaleToZero:
+    def test_deploys_at_zero_with_activator_in_path(self):
+        mgr = ControllerManager()
+        mgr.apply(make_s2z_isvc())
+        dep = mgr.cluster.get("Deployment", "coldstart-predictor")
+        assert dep["spec"]["replicas"] == 0
+        assert dep["metadata"]["annotations"][
+            AUTOSCALED_REPLICAS_ANNOTATION] == "true"
+        so = mgr.cluster.get("ScaledObject", "coldstart-predictor")
+        assert so["spec"]["minReplicaCount"] == 0
+        # activator deployed and routed-to
+        act = mgr.cluster.get("Deployment", "coldstart-predictor-activator")
+        assert act is not None
+        args = act["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--deployment=coldstart-predictor" in args
+        assert mgr.cluster.get(
+            "Service", "coldstart-predictor-activator") is not None
+        route = mgr.cluster.get("HTTPRoute", "coldstart")
+        backend = route["spec"]["rules"][-1]["backendRefs"][0]
+        assert backend["name"] == "coldstart-predictor-activator"
+
+    def test_keda_wakeup_survives_re_reconcile(self):
+        """KEDA (simulated) scales 0->1; a controller re-reconcile must
+        preserve the live replica count, not reset it to minReplicas."""
+        mgr = ControllerManager()
+        mgr.apply(make_s2z_isvc())
+        dep = mgr.cluster.get("Deployment", "coldstart-predictor")
+        assert dep["spec"]["replicas"] == 0
+        # --- what KEDA does on the first trigger event
+        dep["spec"]["replicas"] = 1
+        mgr.cluster.apply(dep)
+        # --- controller reconciles again (config touch, resync, ...)
+        mgr.reconcile_all()
+        assert mgr.cluster.get(
+            "Deployment", "coldstart-predictor")["spec"]["replicas"] == 1
+        # scale back down (idle): controller keeps 0 too
+        dep = mgr.cluster.get("Deployment", "coldstart-predictor")
+        dep["spec"]["replicas"] = 0
+        mgr.cluster.apply(dep)
+        mgr.reconcile_all()
+        assert mgr.cluster.get(
+            "Deployment", "coldstart-predictor")["spec"]["replicas"] == 0
+
+    def test_min_replicas_one_keeps_controller_ownership_shape(self):
+        """minReplicas>=1 with KEDA: still autoscaler-owned, but no
+        activator (the workload never sleeps)."""
+        isvc = make_s2z_isvc("warm")
+        isvc["spec"]["predictor"]["minReplicas"] = 1
+        mgr = ControllerManager()
+        mgr.apply(isvc)
+        assert mgr.cluster.get("Deployment", "warm-predictor-activator") is None
+        route = mgr.cluster.get("HTTPRoute", "warm")
+        assert route["spec"]["rules"][-1]["backendRefs"][0][
+            "name"] == "warm-predictor"
+
+
+class _FakeBackend:
+    """A minimal 'model server pod': not listening until scaled up."""
+
+    def __init__(self):
+        self.runner = None
+        self.port = None
+        self.requests = []
+
+    async def start(self):
+        from aiohttp import web
+
+        async def ready(request):
+            return web.json_response({"ready": True})
+
+        async def predict(request):
+            self.requests.append(await request.json())
+            return web.json_response({"predictions": [1, 2, 3]})
+
+        app = web.Application()
+        app.router.add_get("/v2/health/ready", ready)
+        app.router.add_post("/v1/models/{m}:predict", predict)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        from aiohttp import web as _w
+
+        site = _w.TCPSite(runner, "127.0.0.1", self.port or 0)
+        await site.start()
+        self.port = runner.addresses[0][1]
+        self.runner = runner
+
+    async def stop(self):
+        if self.runner:
+            await self.runner.cleanup()
+
+
+class TestActivatorDataPath:
+    @async_test
+    async def test_request_at_zero_wakes_and_is_served(self):
+        backend = _FakeBackend()
+        scale_ups = []
+
+        async def scale_up():
+            # "KEDA/activator patched replicas; the pod boots":
+            scale_ups.append(1)
+            await backend.start()
+
+        # reserve a port for the backend BEFORE it exists so the activator
+        # has a concrete address to poll
+        probe = _FakeBackend()
+        await probe.start()
+        port = probe.port
+        await probe.stop()
+        backend.port = port
+
+        activator = Activator(f"http://127.0.0.1:{port}", scale_up=scale_up,
+                              poll_interval=0.05, wake_timeout=10, port=0)
+        act_port = await activator.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                # request arrives while scaled to ZERO
+                async with session.post(
+                    f"http://127.0.0.1:{act_port}/v1/models/m:predict",
+                    json={"instances": [[1.0]]},
+                ) as resp:
+                    assert resp.status == 200
+                    body = await resp.json()
+                assert body == {"predictions": [1, 2, 3]}
+                assert scale_ups == [1]  # exactly one wake
+                assert backend.requests == [{"instances": [[1.0]]}]
+                # warm path: forwarded directly, no second scale-up
+                async with session.post(
+                    f"http://127.0.0.1:{act_port}/v1/models/m:predict",
+                    json={"instances": [[2.0]]},
+                ) as resp:
+                    assert resp.status == 200
+                assert scale_ups == [1]
+                async with session.get(
+                    f"http://127.0.0.1:{act_port}/activator/stats"
+                ) as resp:
+                    stats = await resp.json()
+                assert stats["buffered"] == 1
+                assert stats["proxied"] == 2
+                assert stats["cold_start_s"] is not None
+        finally:
+            await activator.stop()
+            await backend.stop()
+
+    @async_test
+    async def test_concurrent_cold_requests_share_one_wake(self):
+        backend = _FakeBackend()
+        scale_ups = []
+
+        async def scale_up():
+            scale_ups.append(1)
+            await asyncio.sleep(0.2)  # pod boot latency
+            await backend.start()
+
+        probe = _FakeBackend()
+        await probe.start()
+        port = probe.port
+        await probe.stop()
+        backend.port = port
+
+        activator = Activator(f"http://127.0.0.1:{port}", scale_up=scale_up,
+                              poll_interval=0.05, wake_timeout=10, port=0)
+        act_port = await activator.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                async def one(i):
+                    async with session.post(
+                        f"http://127.0.0.1:{act_port}/v1/models/m:predict",
+                        json={"instances": [[float(i)]]},
+                    ) as resp:
+                        return resp.status
+
+                results = await asyncio.gather(*[one(i) for i in range(4)])
+            assert results == [200] * 4
+            assert scale_ups == [1], "N cold requests fired N scale-ups"
+        finally:
+            await activator.stop()
+            await backend.stop()
