@@ -30,6 +30,7 @@ import numpy as np
 from ..logging import logger
 from ..metrics import (
     ENGINE_BATCH_OCCUPANCY,
+    ENGINE_KV_DISK_BYTES,
     ENGINE_KV_OFFLOAD_BYTES,
     ENGINE_KV_PAGES_FREE,
     ENGINE_PREEMPTIONS,
@@ -76,15 +77,22 @@ class EngineConfig:
     # over it; decode state is replicated across it)
     sp: int = 1
     dtype: str = "bfloat16"
-    # host-RAM KV tier: "none" re-prefills preempted sequences on resume;
-    # "host" spills their KV pages to host RAM (within kv_offload_gib) and
-    # re-injects on resume — no recompute
+    # tiered KV offload (kv_tiers.py; parity: KVCacheOffloadingSpec,
+    # llm_inference_service_types.go:188-260): "none" re-prefills preempted
+    # sequences on resume; "host" spills their KV pages to a host-RAM tier
+    # (within kv_offload_gib) fronted over an optional disk tier
+    # (kv_offload_disk_gib > 0) with lru/arc eviction between them, and
+    # re-injects on resume — no recompute.  Entries dropped under pressure
+    # re-prefill (performance event, not an error).
     kv_offload: str = "none"
     kv_offload_gib: float = 0.0
+    kv_offload_disk_gib: float = 0.0
+    kv_offload_dir: str = "/tmp/kserve-tpu-kv"
+    kv_offload_policy: str = "lru"  # lru | arc
     # int8 KV quantization (kvcache.py): halves decode KV traffic and
     # doubles capacity; per-row absmax scales ride a parallel array.
-    # Incompatible (today) with the pallas kernel, P/D transfer and host
-    # offload spill — those paths stay bf16.
+    # Composes with tiered offload (tuple payloads spill/inject both
+    # tensors); still incompatible with the pallas kernel and the P/D wire.
     kv_quant: str = "none"  # none | int8
     # None = auto (ops/attention.py): the fused Pallas kernel for
     # long-context decode (page-table width >= PALLAS_MIN_PAGES, head_dim %
@@ -330,10 +338,6 @@ class LLMEngine:
                 f"unknown kv_quant {engine_config.kv_quant!r}; supported: none, int8"
             )
         if engine_config.kv_quant == "int8":
-            if engine_config.kv_offload == "host":
-                raise NotImplementedError(
-                    "kv_quant=int8 with host offload spill is not supported yet"
-                )
             if engine_config.use_pallas:
                 # fail at init, not inside the jitted decode trace where the
                 # error would kill the engine loop for all traffic
@@ -367,13 +371,18 @@ class LLMEngine:
         self._task: Optional[asyncio.Task] = None
         self._pipeline_busy = False
         self._deferred_free: List[int] = []
-        # host-RAM KV tier accounting (kv_offload="host")
-        self._offload_bytes = 0
-        self._offload_budget = (
-            int(engine_config.kv_offload_gib * (1 << 30))
-            if engine_config.kv_offload == "host"
-            else 0
-        )
+        # tiered KV offload store (kv_offload="host": RAM tier + optional
+        # disk tier with lru/arc demotion — kv_tiers.py)
+        self._kv_store = None
+        if engine_config.kv_offload == "host":
+            from .kv_tiers import KVTierStore, TierConfig
+
+            self._kv_store = KVTierStore(TierConfig(
+                host_bytes=int(engine_config.kv_offload_gib * (1 << 30)),
+                disk_bytes=int(engine_config.kv_offload_disk_gib * (1 << 30)),
+                disk_dir=engine_config.kv_offload_dir,
+                policy=engine_config.kv_offload_policy,
+            ))
         self.preemption_count = 0
         # wedge detection: device fetches run on a DAEMON worker with a
         # deadline; a timeout flips `wedged` (liveness).  Daemon, not a
@@ -568,6 +577,15 @@ class LLMEngine:
                 for i, layer in enumerate(kv_pages)
             ]
 
+        def _inject_q(kv_pages, q, s, ids):
+            """Quantized-cache variant: scatter int8 pages AND their
+            scales (tier-store resume over kv_quant=int8)."""
+            return [
+                (pages.at[ids].set(q[i].astype(pages.dtype)),
+                 scales.at[ids].set(s[i].astype(scales.dtype)))
+                for i, (pages, scales) in enumerate(kv_pages)
+            ]
+
         def _prefill_chunk(params, tokens, chunk_start, valid_len, kv_pages,
                            page_ids, adapter_ids):
             return llama.prefill_chunk(
@@ -612,6 +630,7 @@ class LLMEngine:
             _make_decode(True, with_logprobs=True), donate_argnums=(n_kv_args, 12)
         )
         self._inject_fn = jax.jit(_inject, donate_argnums=(0,))
+        self._inject_q_fn = jax.jit(_inject_q, donate_argnums=(0,))
 
     # ---------------- public API ----------------
 
@@ -647,6 +666,8 @@ class LLMEngine:
         # through _fetch must reach a live worker (close-first would stall
         # the drain a full step deadline, then false-flag a wedge)
         self._fetcher.close()
+        if self._kv_store is not None:
+            self._kv_store.close()
 
     @property
     def running(self) -> bool:
@@ -657,6 +678,14 @@ class LLMEngine:
         """True once a device fetch blew the step deadline (a wedged device
         tunnel); consumed by liveness so the pod restarts."""
         return self._wedged
+
+    def _set_offload_gauges(self) -> None:
+        if self._kv_store is None:
+            return
+        ENGINE_KV_OFFLOAD_BYTES.labels(model_name=self._mlabel).set(
+            self._kv_store.host_used)
+        ENGINE_KV_DISK_BYTES.labels(model_name=self._mlabel).set(
+            self._kv_store.disk_used)
 
     def _fetch(self, x) -> np.ndarray:
         """Device->host fetch with the wedge deadline (see step_deadline_s)."""
@@ -899,11 +928,10 @@ class LLMEngine:
             if r.request_id != request_id:
                 kept.append(r)
             elif r.resume is not None and r.resume["kv"] is not None:
-                # return the spilled bytes to the host-tier budget
-                self._offload_bytes -= r.resume["kv"].nbytes
-                ENGINE_KV_OFFLOAD_BYTES.labels(model_name=self._mlabel).set(
-                    self._offload_bytes
-                )
+                # release the spill from the tier store
+                if self._kv_store is not None:
+                    self._kv_store.discard(r.resume["kv"])
+                    self._set_offload_gauges()
         self._waiting = kept
         for i, slot in enumerate(self._slots):
             if slot.request_id == request_id:
@@ -1391,13 +1419,29 @@ class LLMEngine:
         idx = self._free_slot_index()
         if idx is None:
             return False
-        kv = req.resume["kv"] if req.resume is not None else req.kv_data
         total = req.kv_len
         need = pages_needed(total + 1, self.config.page_size)
         if need > self.config.max_pages_per_seq:
             return False
         if not self._ensure_allocatable(self._admission_pages(req, need)):
             return False
+        # fetch AFTER the capacity checks — get() consumes the spill, and a
+        # transient no-capacity return must leave it stored
+        if req.resume is not None:
+            payload = (self._kv_store.get(req.resume["kv"])
+                       if self._kv_store is not None else None)
+            if payload is None:
+                # dropped under tier pressure: recompute on the normal
+                # re-prefill path (returning True = progress; the next
+                # admission pass takes the prefill branch)
+                req.resume["kv"] = None
+                self._set_offload_gauges()
+                return True
+            self._set_offload_gauges()
+        else:
+            payload = {"kv": req.kv_data}
+        quantized = "kv_q" in payload
+        kv = payload["kv_q"] if quantized else payload["kv"]
         self._waiting.remove(req)
         pages = self.allocator.allocate(need)
         P = kv.shape[1]
@@ -1405,18 +1449,24 @@ class LLMEngine:
         bucket = self.config.page_bucket(P)
         ids = np.zeros((bucket,), np.int32)
         ids[:P] = pages[:P]
-        kvp = np.zeros(kv.shape[:1] + (bucket,) + kv.shape[2:], kv.dtype)
-        kvp[:, :P] = kv
-        self.kv_pages = self._inject_fn(
-            self.kv_pages, jnp.asarray(kvp), jnp.asarray(ids)
-        )
+
+        def pad(arr):
+            out = np.zeros(arr.shape[:1] + (bucket,) + arr.shape[2:], arr.dtype)
+            out[:, :P] = arr
+            return out
+
+        if quantized:
+            self.kv_pages = self._inject_q_fn(
+                self.kv_pages, jnp.asarray(pad(kv)),
+                jnp.asarray(pad(payload["kv_s"])), jnp.asarray(ids)
+            )
+        else:
+            self.kv_pages = self._inject_fn(
+                self.kv_pages, jnp.asarray(pad(kv)), jnp.asarray(ids)
+            )
         slot = self._slots[idx]
         if req.resume is not None:
             self._seat_resumed(slot, req, pages)
-            self._offload_bytes -= kv.nbytes
-            ENGINE_KV_OFFLOAD_BYTES.labels(model_name=self._mlabel).set(
-                self._offload_bytes
-            )
             self._mark_penalty_dirty(idx)
             return True
         self._seat_fresh(slot, req, pages, req.first_token)
@@ -1522,21 +1572,28 @@ class LLMEngine:
         (llm_inference_service_types.go:188-232)."""
         pos = slot.pos  # KV on device covers positions 0..pos-1
         P = pages_needed(pos, self.config.page_size)
-        kv = None
+        kv_key = None
         nbytes = (
             P * self.model_config.n_layers * self.cache_config.bytes_per_page()
         )
-        # spill when the budget allows; otherwise chunked re-prefill
-        # recomputes the KV on resume (quantized caches always recompute —
-        # spill extraction is bf16-only today, and init rejects
-        # int8+offload so the budget is 0 here)
-        if self._offload_budget and self._offload_bytes + nbytes <= self._offload_budget:
+        # spill into the tier store when it can fit; otherwise chunked
+        # re-prefill recomputes the KV on resume.  Quantized caches spill
+        # both tensors (int8 pages + scales) as one payload.
+        if self._kv_store is not None and self._kv_store.would_fit(nbytes):
             ids = jnp.asarray(np.asarray(slot.pages[:P], np.int32))
-            kv = np.asarray(jnp.stack([layer[ids] for layer in self.kv_pages]))
-            self._offload_bytes += kv.nbytes
-            ENGINE_KV_OFFLOAD_BYTES.labels(model_name=self._mlabel).set(
-                self._offload_bytes
-            )
+            if self.config.kv_quant == "int8":
+                payload = {
+                    "kv_q": self._fetch(
+                        jnp.stack([layer[0][ids] for layer in self.kv_pages])),
+                    "kv_s": self._fetch(
+                        jnp.stack([layer[1][ids] for layer in self.kv_pages])),
+                }
+            else:
+                payload = {"kv": self._fetch(
+                    jnp.stack([layer[ids] for layer in self.kv_pages]))}
+            if self._kv_store.put(slot.request_id, payload):
+                kv_key = slot.request_id
+            self._set_offload_gauges()
         req = _QueuedRequest(slot.request_id, slot.prompt_ids, slot.params, slot.queue,
                              adapter_id=slot.adapter_id)
         req.resume = {
@@ -1545,7 +1602,9 @@ class LLMEngine:
             "stop_texts": slot.stop_texts,
             "pos": pos,
             "admitted_at": slot.admitted_at,
-            "kv": kv,
+            # the spill, if stored, lives in the tier store under this key
+            # (None = recompute on resume)
+            "kv": kv_key,
         }
         self._free_pages(slot.pages)
         self._mark_penalty_dirty(self._slots.index(slot))
@@ -1555,7 +1614,8 @@ class LLMEngine:
         ENGINE_PREEMPTIONS.labels(model_name=self._mlabel).inc()
         logger.info(
             "preempted %s at pos=%d (%s)", req.request_id, pos,
-            "KV spilled to host" if kv is not None else "will re-prefill",
+            "KV spilled to tier store" if kv_key is not None
+            else "will re-prefill",
         )
 
     def _free_pages(self, pages: List[int]) -> None:

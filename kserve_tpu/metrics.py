@@ -51,6 +51,10 @@ ENGINE_KV_OFFLOAD_BYTES = Gauge(
     "engine_kv_offload_bytes",
     "KV bytes currently parked in the host-RAM tier", ["model_name"],
 )
+ENGINE_KV_DISK_BYTES = Gauge(
+    "engine_kv_disk_bytes",
+    "KV bytes currently parked in the disk tier", ["model_name"],
+)
 
 
 def get_labels(model_name: str) -> dict:
