@@ -32,62 +32,139 @@ if _platform_spec.split(",")[0] == "cpu":
 BASELINE_TOK_S_PER_CHIP = 1000.0
 WATCHDOG_SECONDS = 1200  # a wedged device tunnel must yield a result line,
 # not hang the driver (normal TPU run incl. warmup is ~4 min)
+# the preflight keeps probing across this window before declaring the
+# tunnel wedged (rounds 2+3 both scored 0.0 off a single 75s probe while
+# the chip produced 1850 tok/s mid-round — flakiness is transient, so
+# one probe is not a verdict)
+PREFLIGHT_WINDOW_S = float(os.environ.get("BENCH_PREFLIGHT_WINDOW_S", "900"))
+PREFLIGHT_RETRY_GAP_S = float(os.environ.get("BENCH_PREFLIGHT_GAP_S", "45"))
+
+
+def _kill_stale_device_holders():
+    """Best-effort recovery: kill leftover processes from *earlier* bench or
+    probe runs that may still hold the device client (a half-dead holder
+    keeps the tunnel allocated and every new init blocks).  Matches only our
+    own entrypoints by cmdline; never touches self, ancestors, or anything
+    unrecognised.  Returns the pids killed (for the attempt log)."""
+    me = os.getpid()
+    ancestors = set()
+    pid = me
+    for _ in range(16):
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                pid = int(f.read().split(")")[-1].split()[1])  # ppid
+        except (OSError, ValueError, IndexError):
+            break
+        if pid <= 1:
+            break
+        ancestors.add(pid)
+    patterns = ("chipcheck.py", "bench.py", "__graft_entry__")
+    killed = []
+    try:
+        pids = [int(d) for d in os.listdir("/proc") if d.isdigit()]
+    except OSError:
+        return killed
+    for p in pids:
+        if p == me or p in ancestors:
+            continue
+        try:
+            with open(f"/proc/{p}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(errors="replace")
+        except OSError:
+            continue
+        if "python" not in cmd or not any(pat in cmd for pat in patterns):
+            continue
+        try:
+            os.kill(p, 15)
+            killed.append(p)
+        except (ProcessLookupError, PermissionError):
+            continue
+    if killed:
+        time.sleep(2.0)  # grace for SIGTERM before any re-probe
+        for p in killed:
+            try:
+                os.kill(p, 9)
+            except (ProcessLookupError, PermissionError):
+                pass
+    return killed
 
 
 def _preflight():
-    """Fast chip-health check BEFORE arming the long watchdog.
+    """Chip-health gate with retry/recovery BEFORE the bench touches jax.
 
     A wedged device tunnel (round-2 incident: a mid-compile SIGKILL left the
     remote compile service hung; even ``jnp.ones()`` blocked forever) is
-    reported as a distinct ``wedged-tunnel`` error JSON within ~90s instead
-    of burning the full 1200s watchdog. Only runs when a TPU is expected —
-    CPU smoke mode skips it.
-    """
+    probed in a disposable subprocess.  Unlike rounds 2-3, one failed probe
+    is not a verdict: we clean up stale device holders, then re-probe every
+    ~45s across a 15-minute window, logging every attempt.  Only runs when
+    a TPU is expected — CPU smoke mode skips it.  Returns the attempt log
+    for inclusion in the result detail."""
     if _platform_spec.split(",")[0] == "cpu":
-        return
+        return []
     sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"))
-    try:
-        from chipcheck import probe  # noqa: PLC0415
+    from chipcheck import probe  # noqa: PLC0415
 
-        result = probe()
-    except Exception as exc:  # noqa: BLE001 — the result-line contract
-        # (one JSON line, always) outranks diagnosing a broken probe here
-        result = {"healthy": False, "error": f"{type(exc).__name__}: {exc}"}
-    if result.get("healthy") and result.get("backend") != "tpu":
-        # a silent CPU fallback (plugin failed to load, chip unenumerated)
-        # must not pass the chip-health gate and run the bench off-chip
-        result = {
-            "healthy": False,
-            "error": f"wrong-backend:{result.get('backend')}",
-            "preflight_was": result,
-        }
-    if not result.get("healthy"):
+    t0 = time.time()
+    attempts = []
+    killed = _kill_stale_device_holders()
+    while True:
+        try:
+            result = probe()
+        except Exception as exc:  # noqa: BLE001 — the result-line contract
+            # (one JSON line, always) outranks diagnosing a broken probe
+            result = {"healthy": False, "error": f"{type(exc).__name__}: {exc}"}
+        if result.get("healthy") and result.get("backend") != "tpu":
+            # a silent CPU fallback (plugin failed to load, chip
+            # unenumerated) must not pass the gate and run off-chip
+            result = {
+                "healthy": False,
+                "error": f"wrong-backend:{result.get('backend')}",
+                "preflight_was": result,
+            }
+        attempts.append({
+            "t_s": round(time.time() - t0, 1),
+            "healthy": bool(result.get("healthy")),
+            "error": result.get("error"),
+        })
+        if result.get("healthy"):
+            return attempts
+        remaining = PREFLIGHT_WINDOW_S - (time.time() - t0)
+        if remaining <= PREFLIGHT_RETRY_GAP_S:
+            break
         print(json.dumps({
-            "metric": "llama3_1b_decode_throughput",
-            "value": 0.0,
-            "unit": "tok/s/chip",
-            "vs_baseline": 0.0,
-            "detail": {
-                "error": result.get("error", "probe-failed"),
-                "preflight": result,
-            },
-        }), flush=True)
-        sys.exit(4)
+            "event": "preflight-retry", "attempt": len(attempts),
+            "remaining_s": round(remaining, 0), "last_error": result.get("error"),
+        }), file=sys.stderr, flush=True)
+        time.sleep(PREFLIGHT_RETRY_GAP_S)
+    print(json.dumps({
+        "metric": "llama3_1b_decode_throughput",
+        "value": 0.0,
+        "unit": "tok/s/chip",
+        "vs_baseline": 0.0,
+        "detail": {
+            "error": result.get("error", "probe-failed"),
+            "preflight": result,
+            "attempts": attempts,
+            "window_s": PREFLIGHT_WINDOW_S,
+            "stale_holders_killed": killed,
+        },
+    }), flush=True)
+    sys.exit(4)
 
 
-def _arm_watchdog():
+def _arm_watchdog(budget_s):
     def fire():
         print(json.dumps({
             "metric": "llama3_1b_decode_throughput",
             "value": 0.0,
             "unit": "tok/s/chip",
             "vs_baseline": 0.0,
-            "detail": {"error": f"watchdog: no result within {WATCHDOG_SECONDS}s "
+            "detail": {"error": f"watchdog: no result within {budget_s}s "
                                 "(device tunnel hung?)"},
         }), flush=True)
         os._exit(3)
 
-    timer = threading.Timer(WATCHDOG_SECONDS, fire)
+    timer = threading.Timer(budget_s, fire)
     timer.daemon = True
     timer.start()
     return timer
@@ -180,10 +257,13 @@ async def run_bench():
 
 
 if __name__ == "__main__":
-    watchdog = _arm_watchdog()  # armed BEFORE the preflight so a hang inside
-    # the probe machinery itself (D-state child, inherited pipes) still
-    # yields a result line
-    _preflight()
+    # armed BEFORE the preflight so a hang inside the probe machinery itself
+    # (D-state child, inherited pipes) still yields a result line; budget
+    # covers the full retry window plus the bench proper
+    watchdog = _arm_watchdog(PREFLIGHT_WINDOW_S + WATCHDOG_SECONDS)
+    attempts = _preflight()
     result = asyncio.run(run_bench())
+    if attempts:
+        result.setdefault("detail", {})["preflight_attempts"] = attempts
     watchdog.cancel()
     print(json.dumps(result))

@@ -679,6 +679,15 @@ class LLMEngine:
         tunnel); consumed by liveness so the pod restarts."""
         return self._wedged
 
+    @property
+    def _offload_bytes(self) -> int:
+        """Bytes currently parked in the offload tiers (host + disk).
+        Returns to 0 once every spilled sequence has been restored or
+        discarded — the observable the spill/restore tests assert on."""
+        if self._kv_store is None:
+            return 0
+        return int(self._kv_store.host_used + self._kv_store.disk_used)
+
     def _set_offload_gauges(self) -> None:
         if self._kv_store is None:
             return

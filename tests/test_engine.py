@@ -630,11 +630,40 @@ class TestInt8KVCache:
                 np.zeros((2, 1, 2, 2, 8, 16), np.float32), 5,
             )
 
-    def test_offload_combination_rejected(self):
-        import pytest
-
-        with pytest.raises(NotImplementedError, match="offload"):
-            make_engine(kv_quant="int8", kv_offload="host", kv_offload_gib=1.0)
+    @async_test
+    async def test_int8_composes_with_host_offload(self):
+        # kv_tiers payloads are dicts of arrays, so the (pages, scales)
+        # int8 cache spills and restores as a unit.  A squeezed engine
+        # must preempt, park quantized pages host-side, and reproduce
+        # the roomy engine's greedy output exactly.
+        params = SamplingParams(max_tokens=44, temperature=0.0, ignore_eos=True)
+        prompts = [[1, 2, 3, 4], [9, 10, 11, 12]]
+        roomy = make_engine(
+            kv_quant="int8", num_pages=64, max_pages_per_seq=8, max_batch_size=4
+        )
+        await roomy.start()
+        try:
+            want = [
+                [o.token_id for o in await collect(roomy, p, params)]
+                for p in prompts
+            ]
+        finally:
+            await roomy.stop()
+        engine = make_engine(
+            kv_quant="int8", num_pages=8, max_pages_per_seq=8,
+            max_batch_size=4, kv_offload="host", kv_offload_gib=1.0,
+        )
+        await engine.start()
+        try:
+            results = await asyncio.gather(
+                *[collect(engine, p, params) for p in prompts]
+            )
+        finally:
+            await engine.stop()
+        for outs, want_tokens in zip(results, want):
+            assert [o.token_id for o in outs] == want_tokens
+        assert engine.preemption_count > 0
+        assert engine._offload_bytes == 0
 
     def test_pallas_combination_rejected_at_init(self):
         import pytest
