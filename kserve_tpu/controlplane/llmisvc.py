@@ -326,13 +326,28 @@ class LLMISVCReconciler:
                     "spec": {
                         "containers": [
                             {
+                                # the picker ships in this repo
+                                # (kserve_tpu/scheduler/epp.py), so it runs
+                                # from the same image as the runtime — no
+                                # phantom scheduler image
                                 "name": "epp",
-                                "image": "kserve-tpu/scheduler:latest",
+                                "image": GENERATIVE_IMAGE,
+                                "command": ["python", "-m", "kserve_tpu.scheduler.epp"],
                                 "args": [
-                                    f"--pool-selector=serving.kserve.io/llminferenceservice={llm.metadata.name}",
+                                    f"--pool-selector=serving.kserve.io/llminferenceservice={llm.metadata.name},kserve.io/component=decode",
                                     "--strategy=prefix-cache,queue-depth",
+                                    "--port=9002",
+                                    "--target-port=8080",
                                 ],
-                                "ports": [{"containerPort": 9002, "name": "grpc-ext-proc"}],
+                                "ports": [{"containerPort": 9002, "name": "ext-proc"}],
+                                "env": [{
+                                    "name": "POD_NAMESPACE",
+                                    "valueFrom": {"fieldRef": {
+                                        "fieldPath": "metadata.namespace"}},
+                                }],
+                                "readinessProbe": {
+                                    "httpGet": {"path": "/healthz", "port": 9002}
+                                },
                             }
                         ]
                     },

@@ -680,6 +680,27 @@ class LLMEngine:
         return self._wedged
 
     @property
+    def queue_depth(self) -> int:
+        """Requests waiting for admission (the EPP's primary load signal)."""
+        return len(self._waiting)
+
+    def scheduler_state(self, max_digests: int = 512) -> dict:
+        """Snapshot for the EPP endpoint picker: live load plus the
+        hottest prefix-cache digests (hex, most-recently-used first) so
+        the picker can route prefix-sharing requests back here.  Parity:
+        the role the GIE EPP's metrics scrape plays for the reference
+        (ref llmisvc/scheduler.go:73-521)."""
+        digests = [k.hex() for k in list(self._prefix_cache.keys())[-max_digests:]]
+        return {
+            "queue_depth": self.queue_depth,
+            "free_pages": self.allocator.free_pages,
+            "page_size": self.config.page_size,
+            "running": self.running,
+            "wedged": self._wedged,
+            "prefix_digests": digests,
+        }
+
+    @property
     def _offload_bytes(self) -> int:
         """Bytes currently parked in the offload tiers (host + disk).
         Returns to 0 once every spilled sequence has been restored or
@@ -1198,21 +1219,11 @@ class LLMEngine:
     def _prefix_keys(self, seq: List[int], for_lookup: bool) -> List[bytes]:
         """Digest-chained page keys for page-aligned prefixes of `seq`
         (blake2b over prev_digest || page tokens: O(page) per key, no
-        nested-tuple rehash blowup).  Lookup leaves at least one token to
-        prefill (the sampler needs logits); registration may include the
-        final exactly-full page."""
-        import hashlib
+        nested-tuple rehash blowup).  Shared with the EPP scheduler
+        (scheduler/prefix.py) so the picker's digests match the cache's."""
+        from ..scheduler.prefix import token_prefix_digests
 
-        ps = self.config.page_size
-        count = (len(seq) - 1) // ps if for_lookup else len(seq) // ps
-        keys = []
-        digest = b""
-        for i in range(count):
-            h = hashlib.blake2b(digest, digest_size=16)
-            h.update(np.asarray(seq[i * ps : (i + 1) * ps], np.int64).tobytes())
-            digest = h.digest()
-            keys.append(digest)
-        return keys
+        return token_prefix_digests(seq, self.config.page_size, for_lookup)
 
     def _prefix_cache_lookup(self, seq: List[int]) -> List[int]:
         """Longest cached page run for this sequence (pages NOT yet shared)."""

@@ -133,7 +133,26 @@ class RESTServer:
         from ..pd import PDEndpoints
 
         PDEndpoints(self.dataplane.model_registry).register(app)
+        app.router.add_get(
+            "/v1/internal/scheduler/state", self._scheduler_state_handler
+        )
         return app
+
+    async def _scheduler_state_handler(self, request: web.Request) -> web.Response:
+        """Per-replica load + prefix-cache snapshot consumed by the EPP
+        endpoint picker (scheduler/picker.py).  Models without an engine
+        report queue_depth 0 — the picker then degrades to round-robin."""
+        models = {}
+        for name, model in self.dataplane.model_registry.get_models().items():
+            engine = getattr(model, "engine", None)
+            if engine is not None and hasattr(engine, "scheduler_state"):
+                models[name] = engine.scheduler_state()
+        agg = {
+            "queue_depth": sum(m["queue_depth"] for m in models.values()),
+            "free_pages": sum(m["free_pages"] for m in models.values()),
+            "models": models,
+        }
+        return web.json_response(agg)
 
     async def start(self) -> None:
         app = self.create_application()

@@ -1,0 +1,11 @@
+"""Endpoint-picker (EPP) scheduler: smart LLM request routing.
+
+Parity: the reference wires the Gateway-API Inference Extension
+endpoint picker (ref pkg/controller/v1alpha2/llmisvc/scheduler.go:73-521
+deploys the GIE EPP next to an InferencePool).  Here the picker is an
+in-repo service (`kserve_tpu.scheduler.epp`) that scores decode replicas
+by live queue depth and prefix-cache affinity and proxies/picks per
+request.
+"""
+
+from .picker import EndpointPicker, Replica  # noqa: F401

@@ -1,0 +1,250 @@
+"""EPP scheduler service: the in-repo endpoint-picker deployment target.
+
+Parity: the EPP Deployment the reference's LLMISVC controller creates
+(ref pkg/controller/v1alpha2/llmisvc/scheduler.go:73-521 — GIE
+endpoint-picker + InferencePool).  The GIE EPP is an Envoy ext-proc gRPC
+server; this one fronts the replicas directly as a streaming reverse
+proxy (the activator/data-path pattern already used for scale-to-zero),
+plus a `/pick` API for gateways that only need the routing decision.
+
+Routes:
+  GET  /healthz              liveness
+  GET  /state                picker snapshot (per-replica load/affinity)
+  POST /pick                 {"prompt_ids": [...]} | {"prompt": "..."}
+                             -> {"endpoint": "<url>"} routing decision
+  *    /{any}                proxy: pick a replica, forward the request,
+                             stream the response back (SSE-safe)
+
+Replica set comes from --replicas (static, tests) or --pool-selector
+(in-cluster EndpointSlice watch via the apiserver binding, when
+available).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+from typing import Optional
+
+from aiohttp import web
+
+from ..logging import logger
+from .picker import EndpointPicker
+
+HOP_HEADERS = {
+    "connection", "keep-alive", "proxy-authenticate", "proxy-authorization",
+    "te", "trailers", "transfer-encoding", "upgrade", "host",
+    "content-length",
+}
+
+
+def extract_affinity(payload: dict) -> tuple:
+    """Best-effort (prompt_ids, prompt_text) from a request body across the
+    protocols this framework serves (OpenAI chat/completions, /pick)."""
+    prompt_ids = None
+    text = None
+    if isinstance(payload.get("prompt_ids"), list):
+        prompt_ids = payload["prompt_ids"]
+    p = payload.get("prompt")
+    if isinstance(p, str):
+        text = p
+    elif isinstance(p, list) and p and isinstance(p[0], int):
+        prompt_ids = p
+    msgs = payload.get("messages")
+    if isinstance(msgs, list):
+        parts = []
+        for m in msgs:
+            c = m.get("content") if isinstance(m, dict) else None
+            if isinstance(c, str):
+                parts.append(c)
+            elif isinstance(c, list):  # multimodal content blocks
+                parts.extend(
+                    b.get("text", "") for b in c if isinstance(b, dict)
+                )
+        text = "\x1e".join(parts)  # separator so role boundaries chunk apart
+    return prompt_ids, text
+
+
+class EPPServer:
+    def __init__(self, picker: EndpointPicker):
+        self.picker = picker
+        self._client = None
+
+    def create_application(self) -> web.Application:
+        app = web.Application(client_max_size=1024**3)
+        app.router.add_get("/healthz", self.healthz)
+        app.router.add_get("/state", self.state)
+        app.router.add_post("/pick", self.pick)
+        app.router.add_route("*", "/{tail:.*}", self.proxy)
+        app.on_cleanup.append(self._cleanup)
+        return app
+
+    async def _cleanup(self, app) -> None:
+        await self.picker.close()
+        if self._client is not None:
+            await self._client.close()
+            self._client = None
+
+    async def healthz(self, request: web.Request) -> web.Response:
+        return web.json_response({"ok": True})
+
+    async def state(self, request: web.Request) -> web.Response:
+        return web.json_response({"replicas": self.picker.snapshot()})
+
+    async def _read_affinity(self, request: web.Request) -> tuple:
+        body = await request.read()  # every method: the proxy must forward
+        # PUT/PATCH bodies too, and read() is b"" for body-less requests
+        if request.method != "POST":
+            return None, None, body
+        try:
+            payload = json.loads(body)
+        except ValueError:
+            return None, None, body
+        if not isinstance(payload, dict):
+            return None, None, body
+        ids, text = extract_affinity(payload)
+        return ids, text, body
+
+    async def pick(self, request: web.Request) -> web.Response:
+        ids, text, _ = await self._read_affinity(request)
+        replica = self.picker.pick(prompt_ids=ids, prompt_text=text)
+        if replica is None:
+            return web.json_response(
+                {"error": "no healthy replica"}, status=503
+            )
+        return web.json_response({
+            "endpoint": replica.url,
+            "queue_depth": replica.queue_depth,
+        })
+
+    async def proxy(self, request: web.Request) -> web.StreamResponse:
+        import aiohttp
+
+        ids, text, body = await self._read_affinity(request)
+        replica = self.picker.pick(prompt_ids=ids, prompt_text=text)
+        if replica is None:
+            return web.json_response(
+                {"error": "no healthy replica"}, status=503
+            )
+        if self._client is None:
+            # no total timeout: generative streams legitimately run minutes
+            self._client = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=None, sock_connect=10)
+            )
+        headers = {
+            k: v for k, v in request.headers.items()
+            if k.lower() not in HOP_HEADERS
+        }
+        url = replica.url + request.rel_url.path_qs
+        try:
+            async with self._client.request(
+                request.method, url, headers=headers, data=body or None
+            ) as upstream:
+                out = web.StreamResponse(
+                    status=upstream.status,
+                    headers={
+                        k: v for k, v in upstream.headers.items()
+                        if k.lower() not in HOP_HEADERS
+                    },
+                )
+                await out.prepare(request)
+                async for chunk in upstream.content.iter_any():
+                    await out.write(chunk)
+                await out.write_eof()
+                return out
+        except (aiohttp.ClientError, OSError, asyncio.TimeoutError) as exc:
+            logger.warning("epp proxy to %s failed: %s", replica.url, exc)
+            self.picker.observe_failure(replica.url)
+            return web.json_response(
+                {"error": f"upstream {replica.url} failed"}, status=502
+            )
+
+
+def discover_endpoints(cluster, selector: str, namespace: str,
+                       target_port: int = 8080) -> list:
+    """Replica urls for a label selector, from the in-cluster apiserver
+    (the InferencePool selector → ready pod IPs, the role the GIE
+    InferencePool endpoint watch plays in the reference).  Scoped to the
+    EPP's own namespace so same-named LLMISVCs in other namespaces never
+    cross-route; selection is server-side."""
+    urls = []
+    for pod in cluster.list("Pod", namespace=namespace, label_selector=selector):
+        ip = (pod.get("status") or {}).get("podIP")
+        phase = (pod.get("status") or {}).get("phase")
+        if ip and phase == "Running":
+            urls.append(f"http://{ip}:{target_port}")
+    return urls
+
+
+def build_picker(args) -> EndpointPicker:
+    strategies = {s.strip() for s in args.strategy.split(",") if s.strip()}
+    return EndpointPicker(
+        replica_urls=[u for u in args.replicas.split(",") if u],
+        poll_interval_s=args.poll_interval,
+        queue_weight=1.0 if "queue-depth" in strategies else 0.0,
+        prefix_weight=4.0 if "prefix-cache" in strategies else 0.0,
+    )
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser("kserve-tpu-epp")
+    parser.add_argument("--port", type=int, default=9002)
+    parser.add_argument(
+        "--replicas", default="",
+        help="comma-separated replica base urls (static replica set)",
+    )
+    parser.add_argument(
+        "--pool-selector", default="",
+        help="label selector for in-cluster endpoint discovery",
+    )
+    parser.add_argument("--strategy", default="prefix-cache,queue-depth")
+    parser.add_argument("--poll-interval", type=float, default=2.0)
+    parser.add_argument("--target-port", type=int, default=8080)
+    parser.add_argument(
+        "--namespace",
+        default=os.environ.get("POD_NAMESPACE", "default"),
+        help="namespace scope for --pool-selector discovery",
+    )
+    return parser
+
+
+async def serve(args) -> None:
+    picker = build_picker(args)
+    if args.pool_selector and not args.replicas:
+        # in-cluster: resolve the selector against the apiserver (one
+        # client, server-side selection) and re-reconcile on an interval
+        from ..api.http_transport import HTTPCluster
+
+        cluster = HTTPCluster("", in_cluster=True)
+
+        async def rediscover():
+            while True:
+                try:
+                    picker.set_replicas(discover_endpoints(
+                        cluster, args.pool_selector, args.namespace,
+                        args.target_port,
+                    ))
+                except Exception as exc:  # noqa: BLE001 — discovery is best-effort
+                    logger.warning("epp endpoint discovery failed: %s", exc)
+                await asyncio.sleep(10.0)
+
+        asyncio.get_running_loop().create_task(rediscover())
+    await picker.start_polling()
+    server = EPPServer(picker)
+    runner = web.AppRunner(server.create_application(), access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "0.0.0.0", args.port)
+    await site.start()
+    logger.info("EPP scheduler listening on :%d", args.port)
+    await asyncio.Event().wait()
+
+
+def main() -> None:
+    args = build_arg_parser().parse_args()
+    asyncio.run(serve(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,252 @@
+"""Endpoint picker: scores decode replicas by queue depth and
+prefix-cache affinity.
+
+Parity: the GIE endpoint-picker the reference deploys per
+LLMInferenceService (ref pkg/controller/v1alpha2/llmisvc/scheduler.go:73
+`--strategy` analogue) — rebuilt as a first-class in-repo component
+instead of an external image.
+
+Two affinity signals, combined:
+
+1. **Advertised digests** — each replica's `/v1/internal/scheduler/state`
+   returns the hottest prefix-cache page digests straight from the
+   engine (engine.scheduler_state()).  An incoming `/pick` request with
+   token ids is chained through the same blake2b digest
+   (scheduler/prefix.py) and scored by longest leading run present in a
+   replica's set.  Exact — the digests ARE the cache keys.
+
+2. **Learned text affinity** — OpenAI-protocol requests carry text, not
+   token ids, and the picker has no tokenizer.  The picker chunk-hashes
+   the prompt text and remembers which replica each chunk chain was
+   routed to; future prompts sharing a byte-prefix route to the same
+   replica.  Approximate but self-reinforcing (the routed replica builds
+   real cache for that prefix).
+
+Score = prefix_hit_pages * prefix_weight - queue_depth * queue_weight,
+ties broken by free pages then round-robin.  Unhealthy replicas (failed
+poll, engine wedged) are filtered; all-unhealthy yields 503 upstream.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ..logging import logger
+from .prefix import text_prefix_digests, token_prefix_digests
+
+
+@dataclass
+class Replica:
+    url: str  # base url, e.g. http://decode-0:8080
+    healthy: bool = True
+    queue_depth: int = 0
+    free_pages: int = 0
+    # per-model (page_size, digest set) — kept separate so a multi-model
+    # replica never scores one model's prompt against another's cache
+    models: Dict[str, tuple] = field(default_factory=dict)
+    last_poll: float = 0.0
+    consecutive_failures: int = 0
+
+    @property
+    def digests(self) -> frozenset:
+        out = set()
+        for _, d in self.models.values():
+            out |= d
+        return frozenset(out)
+
+
+class EndpointPicker:
+    MAX_TEXT_AFFINITY = 8192  # learned text-chunk entries (LRU-bounded)
+
+    def __init__(
+        self,
+        replica_urls: Sequence[str],
+        poll_interval_s: float = 2.0,
+        queue_weight: float = 1.0,
+        prefix_weight: float = 4.0,
+        unhealthy_after: int = 2,
+        state_path: str = "/v1/internal/scheduler/state",
+    ):
+        self.replicas: Dict[str, Replica] = {
+            u.rstrip("/"): Replica(url=u.rstrip("/")) for u in replica_urls
+        }
+        self.poll_interval_s = poll_interval_s
+        self.queue_weight = queue_weight
+        self.prefix_weight = prefix_weight
+        self.unhealthy_after = unhealthy_after
+        self.state_path = state_path
+        # text-chunk digest -> replica url (LRU)
+        self._text_affinity: "OrderedDict[bytes, str]" = OrderedDict()
+        self._rr = 0
+        self._poll_task: Optional[asyncio.Task] = None
+        self._session = None
+
+    # ---------------- replica state ----------------
+
+    def set_replicas(self, urls: Sequence[str]) -> None:
+        """Reconcile the replica set (EndpointSlice watch / static flag)."""
+        urls = {u.rstrip("/") for u in urls}
+        for u in list(self.replicas):
+            if u not in urls:
+                del self.replicas[u]
+        for u in urls:
+            self.replicas.setdefault(u, Replica(url=u))
+
+    def observe_state(self, url: str, state: dict) -> None:
+        """Ingest one replica's /state payload (also the test seam)."""
+        r = self.replicas.get(url.rstrip("/"))
+        if r is None:
+            return
+        r.queue_depth = int(state.get("queue_depth", 0))
+        r.free_pages = int(state.get("free_pages", 0))
+        models: Dict[str, tuple] = {}
+        wedged = False
+        for name, m in (state.get("models") or {}).items():
+            models[name] = (
+                int(m.get("page_size", 16)),
+                frozenset(bytes.fromhex(d) for d in m.get("prefix_digests", ())),
+            )
+            wedged = wedged or bool(m.get("wedged"))
+        # flat form (engine.scheduler_state() given directly, tests)
+        if "prefix_digests" in state or "page_size" in state:
+            models[""] = (
+                int(state.get("page_size", 16)),
+                frozenset(
+                    bytes.fromhex(d) for d in state.get("prefix_digests", ())
+                ),
+            )
+        wedged = wedged or bool(state.get("wedged"))
+        r.models = models
+        r.healthy = not wedged
+        r.consecutive_failures = 0
+        r.last_poll = time.monotonic()
+
+    def observe_failure(self, url: str) -> None:
+        r = self.replicas.get(url.rstrip("/"))
+        if r is None:
+            return
+        r.consecutive_failures += 1
+        if r.consecutive_failures >= self.unhealthy_after:
+            r.healthy = False
+
+    async def refresh_once(self) -> None:
+        import aiohttp
+
+        if self._session is None:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=2.0)
+            )
+        async def one(r: Replica):
+            try:
+                async with self._session.get(r.url + self.state_path) as resp:
+                    if resp.status != 200:
+                        raise OSError(f"status {resp.status}")
+                    self.observe_state(r.url, await resp.json())
+            except (aiohttp.ClientError, OSError, asyncio.TimeoutError,
+                    ValueError) as exc:
+                logger.debug("epp poll %s failed: %s", r.url, exc)
+                self.observe_failure(r.url)
+
+        await asyncio.gather(*[one(r) for r in self.replicas.values()])
+
+    async def start_polling(self) -> None:
+        async def loop():
+            while True:
+                try:
+                    await self.refresh_once()
+                except Exception as exc:  # noqa: BLE001 — the poll loop
+                    # must survive anything; dead polling means routing on
+                    # frozen state forever
+                    logger.warning("epp poll cycle failed: %s", exc)
+                await asyncio.sleep(self.poll_interval_s)
+
+        self._poll_task = asyncio.get_running_loop().create_task(loop())
+
+    async def close(self) -> None:
+        if self._poll_task is not None:
+            self._poll_task.cancel()
+            self._poll_task = None
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    # ---------------- picking ----------------
+
+    def _prefix_hits(self, r: Replica, prompt_ids: Optional[Sequence[int]]) -> int:
+        """Longest leading page run cached on `r`, scored per model so a
+        multi-model replica's page sizes and digest sets never mix."""
+        if not prompt_ids:
+            return 0
+        best = 0
+        chains: Dict[int, List[bytes]] = {}
+        for page_size, digests in r.models.values():
+            if not digests:
+                continue
+            keys = chains.setdefault(
+                page_size,
+                token_prefix_digests(prompt_ids, page_size, for_lookup=True),
+            )
+            hits = 0
+            for key in keys:
+                if key not in digests:
+                    break
+                hits += 1
+            best = max(best, hits)
+        return best
+
+    def _text_hits(self, r: Replica, text: Optional[str]) -> int:
+        if not text:
+            return 0
+        hits = 0
+        for key in text_prefix_digests(text):
+            if self._text_affinity.get(key) != r.url:
+                break
+            hits += 1
+        return hits
+
+    def pick(
+        self,
+        prompt_ids: Optional[Sequence[int]] = None,
+        prompt_text: Optional[str] = None,
+    ) -> Optional[Replica]:
+        """Best replica for this request, or None when none is healthy."""
+        healthy = [r for r in self.replicas.values() if r.healthy]
+        if not healthy:
+            return None
+        scored = []
+        for i, r in enumerate(healthy):
+            hits = max(
+                self._prefix_hits(r, prompt_ids), self._text_hits(r, prompt_text)
+            )
+            score = hits * self.prefix_weight - r.queue_depth * self.queue_weight
+            # free pages as a mild tiebreak, round-robin as the final one
+            scored.append((score, r.free_pages, -((i - self._rr) % len(healthy)), r))
+        scored.sort(key=lambda t: t[:3], reverse=True)
+        best = scored[0][3]
+        self._rr = (self._rr + 1) % max(len(healthy), 1)
+        if prompt_text:
+            self._learn_text(best.url, prompt_text)
+        return best
+
+    def _learn_text(self, url: str, text: str) -> None:
+        for key in text_prefix_digests(text):
+            self._text_affinity[key] = url
+            self._text_affinity.move_to_end(key)
+        while len(self._text_affinity) > self.MAX_TEXT_AFFINITY:
+            self._text_affinity.popitem(last=False)
+
+    def snapshot(self) -> List[dict]:
+        return [
+            {
+                "url": r.url,
+                "healthy": r.healthy,
+                "queue_depth": r.queue_depth,
+                "free_pages": r.free_pages,
+                "digests": len(r.digests),
+            }
+            for r in self.replicas.values()
+        ]

@@ -1,0 +1,61 @@
+"""Stable page-aligned prefix digests, shared by the engine's prefix
+cache and the EPP scheduler.
+
+The engine keys its prefix cache by digest-chained page keys
+(blake2b(prev_digest || page_tokens)); the EPP computes the same chain
+for an incoming prompt and scores each replica by how many leading pages
+appear in the replica's advertised digest set.  blake2b is stable across
+processes (unlike Python's seeded ``hash``), so digests computed in the
+picker match digests advertised by any replica with the same page size.
+
+Text affinity (OpenAI requests, where the picker has no tokenizer) uses
+the same chaining over fixed-size byte chunks of the UTF-8 prompt: an
+approximation — two prompts sharing a byte-prefix almost always share a
+token-prefix — good enough for cache-affinity routing.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Sequence
+
+TEXT_CHUNK_BYTES = 64  # ~16 tokens of typical English text
+
+
+def token_prefix_digests(
+    seq: Sequence[int], page_size: int, for_lookup: bool = True
+) -> List[bytes]:
+    """Digest-chained keys for page-aligned prefixes of a token sequence.
+
+    Lookup leaves at least one token to prefill (the sampler needs
+    logits); registration may include the final exactly-full page.
+    """
+    count = (len(seq) - 1) // page_size if for_lookup else len(seq) // page_size
+    keys: List[bytes] = []
+    digest = b""
+    for i in range(count):
+        h = hashlib.blake2b(digest, digest_size=16)
+        h.update(_tokens_bytes(seq[i * page_size : (i + 1) * page_size]))
+        digest = h.digest()
+        keys.append(digest)
+    return keys
+
+
+def _tokens_bytes(tokens: Sequence[int]) -> bytes:
+    import numpy as np
+
+    return np.asarray(tokens, np.int64).tobytes()
+
+
+def text_prefix_digests(text: str, chunk_bytes: int = TEXT_CHUNK_BYTES) -> List[bytes]:
+    """Digest-chained keys over fixed-size byte chunks of `text` (complete
+    chunks only, so a shared prefix yields a shared key run)."""
+    raw = text.encode("utf-8", errors="replace")
+    keys: List[bytes] = []
+    digest = b""
+    for i in range(len(raw) // chunk_bytes):
+        h = hashlib.blake2b(digest, digest_size=16)
+        h.update(raw[i * chunk_bytes : (i + 1) * chunk_bytes])
+        digest = h.digest()
+        keys.append(digest)
+    return keys

@@ -42,3 +42,28 @@ def async_test(fn):
 @pytest.fixture
 def run_async():
     return asyncio.run
+
+
+# Modules dominated by compiled-engine loops (measured: each >30s of the
+# ~10-minute full suite).  `pytest -m "not slow"` is the <2-minute signal
+# to run between milestones; the full suite still gates every round-end
+# commit (VERDICT round-3 weak #6).
+SLOW_MODULES = {
+    "test_engine",
+    "test_pd_disagg",
+    "test_sp_ep_engine",
+    "test_lora",
+    "test_dp_engine",
+    "test_llama_model",
+    "test_pallas_attention",
+    "test_multihost",
+    "test_encoder",
+    "test_pipeline_parallel",
+    "test_apiserver_binding",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.module.__name__ in SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
