@@ -170,12 +170,110 @@ def _arm_watchdog(budget_s):
     return timer
 
 
+async def _measure(model_config, engine_config, prompt_len, max_tokens,
+                   n_requests, warmup=15):
+    """Throughput of one engine config: aggregate decode tok/s."""
+    import random
+
+    from kserve_tpu.engine.engine import LLMEngine
+    from kserve_tpu.engine.sampling import SamplingParams
+    from kserve_tpu.engine.tokenizer import ByteTokenizer
+
+    tokenizer = ByteTokenizer(model_config.vocab_size)
+    engine = LLMEngine(model_config, engine_config, tokenizer, rng_seed=0)
+    await engine.start()
+    rng = random.Random(0)
+
+    def prompt():
+        return [rng.randrange(3, 255) for _ in range(prompt_len)]
+
+    params = SamplingParams(max_tokens=max_tokens, temperature=0.0,
+                            ignore_eos=True)
+
+    async def one(p):
+        n = 0
+        async for out in engine.generate(p, params):
+            n = out.num_generated
+        return n
+
+    await asyncio.gather(*[one(prompt()) for _ in range(warmup)])
+    start = time.perf_counter()
+    counts = await asyncio.gather(*[one(prompt()) for _ in range(n_requests)])
+    elapsed = time.perf_counter() - start
+    await engine.stop()
+    tok_s = sum(counts) / elapsed
+    # free device buffers NOW: the caller may bench a second model that
+    # needs the whole chip (stop() halts tasks but frees nothing)
+    del engine
+    import gc
+
+    gc.collect()
+    return tok_s, elapsed
+
+
+async def _bench_8b_int8():
+    """Second metric (VERDICT round-3 #4): an 8B-class model on ONE v5e
+    chip via int8 weights (models/quant.py).  bf16 8B is ~16.1 GB of
+    params alone — it cannot fit next to a KV cache on a 16-GB chip; int8
+    is ~8.1 GB, leaving ~6 GB for KV."""
+    from kserve_tpu.engine.engine import EngineConfig
+    from kserve_tpu.models.llama import LlamaConfig
+    from kserve_tpu.models.quant import param_bytes
+
+    config = LlamaConfig.llama3_8b()
+    engine_config = EngineConfig(
+        max_batch_size=32,
+        page_size=16,
+        num_pages=2048,  # 32k tokens of bf16 KV ≈ 4.3 GB
+        max_pages_per_seq=64,
+        max_prefill_len=512,
+        prefill_buckets=(128, 256, 512),
+        dtype="bfloat16",
+        use_pallas=None,
+        weight_quant="int8",
+        steps_per_sync=64,
+        prefill_batch=8,
+    )
+    tok_s, elapsed = await _measure(
+        config, engine_config, prompt_len=128, max_tokens=128, n_requests=64,
+        warmup=8,
+    )
+    return {
+        "metric": "llama3_8b_int8_decode_throughput",
+        "value": round(tok_s, 2),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(tok_s / BASELINE_TOK_S_PER_CHIP, 4),
+        "elapsed_s": round(elapsed, 2),
+        "param_bytes_int8": param_bytes(config, "int8"),
+        "param_bytes_bf16": param_bytes(config, "none"),
+    }
+
+
+def _v5e8_projection(tok_s_1chip_8b: float) -> dict:
+    """BASELINE.json north star is Llama-3-8B on a v5e-8 slice.  The
+    documented arithmetic for the 8-chip projection from the measured
+    single-chip number: with tp=8 over ICI, per-step weight traffic per
+    chip drops 8x while adding two all-reduces per layer (~h bytes/token
+    each over 3D ICI, latency-hidden at batch>=32), so aggregate
+    throughput scales ~6.5-7x of the single-chip number (XLA collective
+    efficiency 0.81-0.88 measured on the 8-dev CPU-mesh dryrun is not
+    hardware-representative; 0.85 is the standard planning factor for
+    bandwidth-bound decode under tp on v5e ICI)."""
+    return {
+        "config": "llama3-8b int8, tp=8, v5e-8 (projected, not measured)",
+        "per_chip_measured": tok_s_1chip_8b,
+        "scaling_factor": 8 * 0.85,
+        "projected_aggregate_tok_s": round(tok_s_1chip_8b * 8 * 0.85, 1),
+        "note": "multi-chip hardware unavailable in this environment; "
+                "dryrun_multichip validates the tp=8 program compiles+runs "
+                "on a virtual mesh",
+    }
+
+
 async def run_bench():
     import jax
 
-    from kserve_tpu.engine.engine import EngineConfig, LLMEngine
-    from kserve_tpu.engine.sampling import SamplingParams
-    from kserve_tpu.engine.tokenizer import ByteTokenizer
+    from kserve_tpu.engine.engine import EngineConfig
     from kserve_tpu.models.llama import LlamaConfig
 
     on_tpu = jax.default_backend() == "tpu"
@@ -212,35 +310,15 @@ async def run_bench():
         steps_per_sync=64,
         prefill_batch=16,
     )
-    tokenizer = ByteTokenizer(model_config.vocab_size)
-    engine = LLMEngine(model_config, engine_config, tokenizer, rng_seed=0)
-    await engine.start()
-
-    rng = __import__("random").Random(0)
-
-    def prompt():
-        return [rng.randrange(3, 255) for _ in range(prompt_len)]
-
-    params = SamplingParams(max_tokens=max_tokens, temperature=0.0, ignore_eos=True)
-
-    async def one(p):
-        n = 0
-        async for out in engine.generate(p, params):
-            n = out.num_generated
-        return n
-
-    # warmup: compile decode + every prefill batch shape (pow2 padding means
-    # Bp in {1,2,4,8} all occur; 15 staggered requests hit each of them)
-    await asyncio.gather(*[one(prompt()) for _ in range(15)])
-
-    start = time.perf_counter()
-    counts = await asyncio.gather(*[one(prompt()) for _ in range(n_requests)])
-    elapsed = time.perf_counter() - start
-    await engine.stop()
-
-    total_tokens = sum(counts)
-    tok_s = total_tokens / elapsed
-    return {
+    # warmup 15: compiles decode + every prefill batch shape (pow2 padding
+    # means Bp in {1,2,4,8} all occur across 15 staggered requests).
+    # _measure owns the engine's lifetime, so its device buffers are
+    # dropped before the 8B bench allocates (16-GB HBM fits one at a time).
+    tok_s, elapsed = await _measure(
+        model_config, engine_config, prompt_len, max_tokens, n_requests,
+        warmup=15,
+    )
+    result = {
         "metric": "llama3_1b_decode_throughput" if on_tpu else "tiny_decode_throughput_cpu",
         "value": round(tok_s, 2),
         "unit": "tok/s/chip",
@@ -254,6 +332,21 @@ async def run_bench():
             "backend": jax.default_backend(),
         },
     }
+    if on_tpu:
+        # second metric: 8B-class via int8 weights, plus the v5e-8
+        # projection arithmetic against the BASELINE.json north star.
+        # Failure here must not cost the recorded 1B number.
+        try:
+            second = await _bench_8b_int8()
+            result["detail"]["llama3_8b_int8"] = second
+            result["detail"]["v5e8_projection"] = _v5e8_projection(
+                second["value"]
+            )
+        except Exception as exc:  # noqa: BLE001
+            result["detail"]["llama3_8b_int8"] = {
+                "error": f"{type(exc).__name__}: {exc}"
+            }
+    return result
 
 
 if __name__ == "__main__":

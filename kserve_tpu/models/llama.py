@@ -35,6 +35,13 @@ from ..ops.attention import (
 from ..ops.norms import rms_norm
 from ..ops.rotary import apply_rope
 from .lora import lora_delta
+from .quant import (
+    LINEAR_KEYS,
+    dense,
+    embed_lookup,
+    quantize_array_np,
+    tied_head_matmul,
+)
 
 Params = Dict[str, Any]
 
@@ -171,16 +178,32 @@ class LlamaConfig:
         )
 
 
-def init_params(config: LlamaConfig, rng: jax.Array, scale: float = 0.02) -> Params:
+def init_params(config: LlamaConfig, rng: jax.Array, scale: float = 0.02,
+                weight_quant: str = "none") -> Params:
     """Random-initialized parameter pytree (bench/tests; real serving loads
-    checkpoints via load_hf_weights)."""
+    checkpoints via load_hf_weights).
+
+    weight_quant="int8" emits quantized leaves DIRECTLY (random int8 +
+    constant scales matching `scale`'s distribution) — an 8B random init
+    must never stage the bf16 tree on a 16-GB chip just to quantize it."""
     dtype = jnp.dtype(config.dtype)
     h, hd = config.hidden_size, config.head_dim
     nq, nkv = config.n_heads, config.n_kv_heads
     keys = jax.random.split(rng, config.n_layers + 2)
 
-    def dense(key, shape):
+    def dense_f32(key, shape):
         return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+    def dense_q(key, shape, channel_axis=-1):
+        # uniform int8 has std ~73; s maps that back onto N(0, scale)
+        q = jax.random.randint(key, shape, -127, 128, jnp.int8)
+        s_shape = (shape[channel_axis],)
+        return {"q": q, "s": jnp.full(s_shape, scale / 73.0, jnp.float32)}
+
+    quant = weight_quant == "int8"
+    if quant and config.n_experts > 0:
+        raise NotImplementedError("weight_quant over MoE experts")
+    dense = (lambda key, shape: dense_q(key, shape)) if quant else dense_f32
 
     layers = []
     for i in range(config.n_layers):
@@ -209,7 +232,13 @@ def init_params(config: LlamaConfig, rng: jax.Array, scale: float = 0.02) -> Par
             layer["bv"] = jnp.zeros((nkv * hd,), dtype)
         layers.append(layer)
     params: Params = {
-        "embed": dense(keys[-2], (config.vocab_size, h)),
+        # tied quantized embeddings carry per-ROW scales (they serve as the
+        # transposed lm_head); untied embeddings stay bf16 (gather-only)
+        "embed": (
+            dense_q(keys[-2], (config.vocab_size, h), channel_axis=0)
+            if quant and config.tie_word_embeddings
+            else dense_f32(keys[-2], (config.vocab_size, h))
+        ),
         "final_norm": jnp.ones((h,), dtype),
         "layers": layers,
     }
@@ -226,9 +255,9 @@ def _maybe_add(y: jnp.ndarray, delta) -> jnp.ndarray:
 def _qkv(layer: Params, x: jnp.ndarray, config: LlamaConfig, onehot=None):
     B, T, _ = x.shape
     lora = layer.get("lora")
-    q = _maybe_add(x @ layer["wq"], lora_delta(lora, "wq", x, onehot))
-    k = _maybe_add(x @ layer["wk"], lora_delta(lora, "wk", x, onehot))
-    v = _maybe_add(x @ layer["wv"], lora_delta(lora, "wv", x, onehot))
+    q = _maybe_add(dense(x, layer["wq"]), lora_delta(lora, "wq", x, onehot))
+    k = _maybe_add(dense(x, layer["wk"]), lora_delta(lora, "wk", x, onehot))
+    v = _maybe_add(dense(x, layer["wv"]), lora_delta(lora, "wv", x, onehot))
     if config.attention_bias:
         q = q + layer["bq"]
         k = k + layer["bk"]
@@ -252,19 +281,22 @@ def _mlp(layer: Params, x: jnp.ndarray, config: LlamaConfig, onehot=None) -> jnp
         return moe_mlp(layer, x, moe_cfg)
     lora = layer.get("lora")
     gate = jax.nn.silu(
-        _maybe_add(x @ layer["w_gate"], lora_delta(lora, "w_gate", x, onehot))
+        _maybe_add(dense(x, layer["w_gate"]), lora_delta(lora, "w_gate", x, onehot))
     )
-    up = _maybe_add(x @ layer["w_up"], lora_delta(lora, "w_up", x, onehot))
+    up = _maybe_add(dense(x, layer["w_up"]), lora_delta(lora, "w_up", x, onehot))
     h = gate * up
-    return _maybe_add(h @ layer["w_down"], lora_delta(lora, "w_down", h, onehot))
+    return _maybe_add(
+        dense(h, layer["w_down"]), lora_delta(lora, "w_down", h, onehot)
+    )
 
 
 def _logits(params: Params, x: jnp.ndarray, config: LlamaConfig) -> jnp.ndarray:
     x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:
-        head = params["embed"].T
-    logits = (x @ head).astype(jnp.float32)
+        logits = tied_head_matmul(x, params["embed"]).astype(jnp.float32)
+    else:
+        logits = dense(x, head).astype(jnp.float32)
     if config.logit_softcap > 0.0:
         logits = jnp.tanh(logits / config.logit_softcap) * config.logit_softcap
     return logits
@@ -308,7 +340,7 @@ def transformer_block(
     attn = attention_fn(q, k, v, valid_len, config.logit_softcap)
     attn_flat = attn.reshape(B, T, -1)
     attn = _maybe_add(
-        attn_flat @ layer["wo"],
+        dense(attn_flat, layer["wo"]),
         lora_delta(layer.get("lora"), "wo", attn_flat, onehot),
     )
     x = residual + attn
@@ -336,7 +368,7 @@ def prefill(
     B, T = tokens.shape
     onehot = _adapter_onehot(params, adapter_ids, B)
     positions = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, axis=0)
-    x = params["embed"][tokens].astype(jnp.dtype(config.dtype))
+    x = embed_lookup(params["embed"], tokens, jnp.dtype(config.dtype))
     new_pages = []
     for layer, pages in zip(params["layers"], kv_pages):
         x, k, v = transformer_block(
@@ -370,7 +402,7 @@ def prefill_chunk(
     B, C = tokens.shape
     onehot = _adapter_onehot(params, adapter_ids, B)
     positions = chunk_start[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
-    x = params["embed"][tokens].astype(jnp.dtype(config.dtype))
+    x = embed_lookup(params["embed"], tokens, jnp.dtype(config.dtype))
     new_pages = []
     for layer, pages in zip(params["layers"], kv_pages):
         residual = x
@@ -384,7 +416,7 @@ def prefill_chunk(
         )
         attn_flat = attn.reshape(B, C, -1)
         attn = _maybe_add(
-            attn_flat @ layer["wo"],
+            dense(attn_flat, layer["wo"]),
             lora_delta(layer.get("lora"), "wo", attn_flat, onehot),
         )
         x = residual + attn
@@ -417,7 +449,7 @@ def decode_step(
     """One decode token per sequence; returns ([B, vocab] logits, new pages)."""
     B = tokens.shape[0]
     onehot = _adapter_onehot(params, adapter_ids, B)
-    x = params["embed"][tokens][:, None, :].astype(jnp.dtype(config.dtype))  # [B,1,h]
+    x = embed_lookup(params["embed"], tokens, jnp.dtype(config.dtype))[:, None, :]  # [B,1,h]
     positions = pos[:, None]
     seq_lens = jnp.where(active, pos + 1, 0)
     new_pages = []
@@ -443,7 +475,7 @@ def decode_step(
             )
         attn_flat = attn.reshape(B, 1, -1)
         attn = _maybe_add(
-            attn_flat @ layer["wo"],
+            dense(attn_flat, layer["wo"]),
             lora_delta(layer.get("lora"), "wo", attn_flat, onehot),
         )
         x = residual + attn
@@ -474,12 +506,19 @@ _HF_LAYER_MAP = {
 _TRANSPOSED = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
 
 
-def load_hf_weights(model_dir: str, config: LlamaConfig) -> Params:
+def load_hf_weights(model_dir: str, config: LlamaConfig,
+                    weight_quant: str = "none") -> Params:
     """Load a local HuggingFace safetensors checkpoint (no torch needed:
     safetensors.numpy) into the functional param pytree.  HF Linear stores
-    [out, in]; our layout is [in, out], hence the transposes."""
+    [out, in]; our layout is [in, out], hence the transposes.
+
+    weight_quant="int8" quantizes tensor-by-tensor ON THE HOST before
+    device placement, so an 8B load peaks at one bf16 tensor of host RAM
+    extra — the device only ever sees int8 + scales."""
     from safetensors import safe_open
 
+    if weight_quant == "int8" and config.n_experts > 0:
+        raise NotImplementedError("weight_quant over MoE experts")
     dtype = jnp.dtype(config.dtype)
     files = sorted(
         os.path.join(model_dir, f)
@@ -499,20 +538,39 @@ def load_hf_weights(model_dir: str, config: LlamaConfig) -> Params:
             arr = arr.T
         return jnp.asarray(arr).astype(dtype)
 
+    def to_jnp_q(arr: np.ndarray, transpose: bool, channel_axis: int = -1):
+        """Host-quantize, then place: int8 + float32 scale on device."""
+        if transpose:
+            arr = arr.T
+        axis = 1 - (channel_axis % 2)  # reduce over the non-channel axis
+        qd = quantize_array_np(arr, axis=axis)
+        return {"q": jnp.asarray(qd["q"]), "s": jnp.asarray(qd["s"])}
+
+    quant = weight_quant == "int8"
     params: Params = {
-        "embed": to_jnp(tensors["model.embed_tokens.weight"], False),
+        "embed": (
+            to_jnp_q(tensors["model.embed_tokens.weight"], False, channel_axis=0)
+            if quant and config.tie_word_embeddings
+            else to_jnp(tensors["model.embed_tokens.weight"], False)
+        ),
         "final_norm": to_jnp(tensors["model.norm.weight"], False),
         "layers": [],
     }
     if "lm_head.weight" in tensors and not config.tie_word_embeddings:
-        params["lm_head"] = to_jnp(tensors["lm_head.weight"], True)
+        params["lm_head"] = (
+            to_jnp_q(tensors["lm_head.weight"], True) if quant
+            else to_jnp(tensors["lm_head.weight"], True)
+        )
     for i in range(config.n_layers):
         prefix = f"model.layers.{i}."
         layer: Params = {}
         for hf_suffix, ours in _HF_LAYER_MAP.items():
             key = prefix + hf_suffix
             if key in tensors:
-                layer[ours] = to_jnp(tensors[key], ours in _TRANSPOSED)
+                if quant and ours in LINEAR_KEYS:
+                    layer[ours] = to_jnp_q(tensors[key], True)
+                else:
+                    layer[ours] = to_jnp(tensors[key], ours in _TRANSPOSED)
         if config.n_experts > 0:
             # MixtralForCausalLM: block_sparse_moe.gate + per-expert w1/w3/w2
             # (HF w1=gate, w3=up, w2=down; Linear stores [out, in] -> stack

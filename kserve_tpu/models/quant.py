@@ -1,0 +1,124 @@
+"""Int8 weight-only quantization for the generative models.
+
+Decode is weight-bandwidth-bound on TPU (every step streams the full
+weight set from HBM), so int8 weights halve the bytes per step — and
+halve the resident footprint, which is what lets an 8B-class model fit
+a single 16-GB v5e chip next to its KV cache (bf16 8B alone is ~16 GB).
+
+Scheme: symmetric per-output-channel int8.  A quantized weight is a
+pytree dict ``{"q": int8 [in, out], "s": float32 [out]}``; the matmul
+applies the scale AFTER the contraction (per-output scaling commutes
+with the contraction), so the weight is read from HBM as int8 and the
+dequant multiply fuses into the matmul epilogue — no bf16 weight copy
+ever materializes.  Embeddings quantize per-row ([V, h] with s [V]),
+which serves both the gather (row scale) and, for tied embeddings, the
+transposed lm_head matmul (output-channel scale) with one tensor.
+
+Parity: the reference delegates weight quantization to vLLM
+(--quantization flag surfaced via huggingfaceserver); here it is a
+first-class engine knob (EngineConfig.weight_quant) built on the same
+per-channel pattern as the int8 KV cache (engine/kvcache.py scales).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# layer-dict keys eligible for quantization ([in, out] linears)
+LINEAR_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def is_quantized(w: Any) -> bool:
+    return isinstance(w, dict) and "q" in w and "s" in w
+
+
+def dense(x: jnp.ndarray, w: Any) -> jnp.ndarray:
+    """``x @ w`` for a plain or int8-quantized weight."""
+    if is_quantized(w):
+        return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
+    return x @ w
+
+
+def quantize_array(w: jnp.ndarray, axis: int = 0) -> Dict[str, jnp.ndarray]:
+    """Symmetric int8 over `axis` (the contraction axis); scales attach to
+    the remaining (channel) axis."""
+    w32 = w.astype(jnp.float32)
+    s = jnp.max(jnp.abs(w32), axis=axis) / 127.0
+    s = jnp.maximum(s, 1e-12)
+    q = jnp.clip(jnp.round(w32 / jnp.expand_dims(s, axis)), -127, 127)
+    return {"q": q.astype(jnp.int8), "s": s}
+
+
+def quantize_array_np(w: np.ndarray, axis: int = 0) -> Dict[str, np.ndarray]:
+    """Host-side twin of quantize_array for the checkpoint loader — an 8B
+    checkpoint must quantize tensor-by-tensor on the host, never staging
+    the full bf16 pytree on device."""
+    w32 = np.asarray(w, np.float32)
+    s = np.abs(w32).max(axis=axis) / 127.0
+    s = np.maximum(s, 1e-12)
+    q = np.clip(np.round(w32 / np.expand_dims(s, axis)), -127, 127)
+    return {"q": q.astype(np.int8), "s": s.astype(np.float32)}
+
+
+def quantize_params(params: Dict[str, Any], config) -> Dict[str, Any]:
+    """Quantize a loaded param pytree in place-shape (returns a new tree):
+    all layer linears, plus lm_head (untied) or embed (tied — it plays the
+    lm_head role transposed).  Norms, biases, routers and LoRA stacks stay
+    in the compute dtype.  MoE expert stacks are not quantized yet."""
+    if config.n_experts > 0:
+        raise NotImplementedError("weight_quant over MoE experts")
+    out = dict(params)
+    out["layers"] = []
+    for layer in params["layers"]:
+        qlayer = dict(layer)
+        for key in LINEAR_KEYS:
+            if key in qlayer and not is_quantized(qlayer[key]):
+                qlayer[key] = quantize_array(qlayer[key], axis=0)
+        out["layers"].append(qlayer)
+    if "lm_head" in params and not is_quantized(params["lm_head"]):
+        out["lm_head"] = quantize_array(params["lm_head"], axis=0)
+    elif config.tie_word_embeddings and not is_quantized(params["embed"]):
+        out["embed"] = quantize_array(params["embed"], axis=1)  # s per row [V]
+    return out
+
+
+def embed_lookup(embed: Any, tokens: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Embedding gather for a plain or row-quantized embedding table."""
+    if is_quantized(embed):
+        rows = embed["q"][tokens].astype(dtype)
+        return rows * embed["s"][tokens][..., None].astype(dtype)
+    return embed[tokens].astype(dtype)
+
+
+def tied_head_matmul(x: jnp.ndarray, embed: Any) -> jnp.ndarray:
+    """``x @ embed.T`` for the tied lm_head; row scales become output-channel
+    scales under the transpose."""
+    if is_quantized(embed):
+        return (x @ embed["q"].T.astype(x.dtype)) * embed["s"].astype(x.dtype)
+    return x @ embed.T
+
+
+def param_bytes(config, weight_quant: str = "none") -> int:
+    """Analytic parameter footprint (bytes) — the arithmetic behind the
+    single-chip-fit claim in the bench detail."""
+    h, hd = config.hidden_size, config.head_dim
+    nq, nkv, f = config.n_heads, config.n_kv_heads, config.intermediate_size
+    per_layer = h * (nq * hd) + 2 * h * (nkv * hd) + (nq * hd) * h + 3 * h * f
+    linears = config.n_layers * per_layer
+    embed = config.vocab_size * h
+    head = 0 if config.tie_word_embeddings else config.vocab_size * h
+    norms = (2 * config.n_layers + 1) * h
+    elt = 2  # bfloat16
+    if weight_quant == "int8":
+        scales = config.n_layers * (nq * hd + 2 * nkv * hd + h + 2 * f) * 4
+        quantized = linears + head
+        tied_embed = embed if config.tie_word_embeddings else 0
+        if config.tie_word_embeddings:
+            scales += config.vocab_size * 4
+        plain_embed = 0 if config.tie_word_embeddings else embed
+        return (quantized + tied_embed) * 1 + plain_embed * elt + norms * elt + scales
+    return (linears + embed + head + norms) * elt

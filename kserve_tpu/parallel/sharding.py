@@ -99,9 +99,31 @@ def kv_pages_pspec() -> P:
     return P(None, None, MODEL_AXIS, None, None)
 
 
+def _expand_quant_specs(p, s, key=None):
+    """Match the spec pytree to int8-quantized weight leaves: a quantized
+    weight {"q", "s"} takes the plain weight's spec for q and the spec of
+    its channel axis for s (per-output-channel scales shard with the
+    output; per-row embed scales shard with the vocab)."""
+    from ..models.quant import is_quantized
+
+    if isinstance(s, P):
+        if is_quantized(p):
+            if key == "embed":
+                s_spec = P(s[0]) if len(s) > 0 else P()
+            else:
+                s_spec = P(s[1]) if len(s) > 1 else P()
+            return {"q": s, "s": s_spec}
+        return s
+    if isinstance(p, dict):
+        return {k: _expand_quant_specs(p[k], s[k], k) for k in p}
+    if isinstance(p, list):
+        return [_expand_quant_specs(pi, si) for pi, si in zip(p, s)]
+    return s
+
+
 def shard_params(params, config: LlamaConfig, mesh: Mesh):
     """Place a param pytree onto the mesh according to param_pspecs."""
-    specs = param_pspecs(config)
+    specs = _expand_quant_specs(params, param_pspecs(config))
     return jax.tree.map(
         lambda arr, spec: jax.device_put(arr, NamedSharding(mesh, spec)),
         params,

@@ -103,7 +103,10 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
         if self.random_weights or not self.model_dir:
             self._params = None  # engine random-initializes
         else:
-            self._params = llama.load_hf_weights(self.model_dir, self._model_config)
+            self._params = llama.load_hf_weights(
+                self.model_dir, self._model_config,
+                weight_quant=self.engine_config.weight_quant,
+            )
         return True  # ready flips in start_engine
 
     async def start_engine(self):
@@ -532,6 +535,10 @@ def main(argv=None):
     parser.add_argument("--max_prefill_len", default=1024, type=int)
     parser.add_argument("--kv_dtype", default="bfloat16", type=str)
     parser.add_argument("--kv_quant", default="none", choices=("none", "int8"))
+    parser.add_argument(
+        "--weight_quant", default="none", choices=("none", "int8"),
+        help="int8 weight-only quantization (fits 8B on one v5e chip)",
+    )
     parser.add_argument("--kv_offload", default="none", choices=("none", "host"))
     parser.add_argument("--kv_offload_gib", default=0.0, type=float)
     parser.add_argument(
@@ -552,6 +559,7 @@ def main(argv=None):
         sp=args.sequence_parallel_size,
         dtype=args.kv_dtype,
         kv_quant=args.kv_quant,
+        weight_quant=args.weight_quant,
         kv_offload=args.kv_offload,
         kv_offload_gib=args.kv_offload_gib,
     )

@@ -60,6 +60,7 @@ SLOW_MODULES = {
     "test_encoder",
     "test_pipeline_parallel",
     "test_apiserver_binding",
+    "test_weight_quant",
 }
 
 

@@ -222,3 +222,102 @@ async def test_file_sink_csv_marshaller(agent_binary, tmp_path):
     finally:
         proc.terminate()
         await runner.cleanup()
+
+
+@async_test
+async def test_sse_stream_passes_through_live(agent_binary):
+    """VERDICT round-3 weak #5: the OpenAI streaming path must survive the
+    injected sidecar.  The backend emits SSE events with delays; the proxy
+    must relay them AS THEY ARRIVE (first event observed well before the
+    stream finishes), byte-identical."""
+    backend_port = free_port()
+    agent_port = free_port()
+
+    async def stream(request):
+        resp = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream"}
+        )
+        await resp.prepare(request)
+        for i in range(3):
+            await resp.write(f"data: {{\"n\": {i}}}\n\n".encode())
+            await asyncio.sleep(0.25)
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
+
+    app = web.Application()
+    app.router.add_post("/openai/v1/chat/completions", stream)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    await web.TCPSite(runner, "127.0.0.1", backend_port).start()
+    proc = subprocess.Popen(
+        [agent_binary, "--port", str(agent_port),
+         "--component_port", str(backend_port)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        await asyncio.sleep(0.3)
+        chunks = []
+        t0 = time.perf_counter()
+        first_at = None
+        async with httpx.AsyncClient() as client:
+            async with client.stream(
+                "POST",
+                f"http://127.0.0.1:{agent_port}/openai/v1/chat/completions",
+                json={"messages": [{"role": "user", "content": "hi"}],
+                      "stream": True},
+                timeout=15,
+            ) as resp:
+                assert resp.status_code == 200
+                assert resp.headers["content-type"] == "text/event-stream"
+                async for chunk in resp.aiter_bytes():
+                    if first_at is None:
+                        first_at = time.perf_counter() - t0
+                    chunks.append(chunk)
+        total = time.perf_counter() - t0
+        text = b"".join(chunks).decode()
+        assert text.count("data:") == 4 and "[DONE]" in text
+        # live relay: the first event arrived long before the stream ended
+        assert first_at is not None and first_at < total - 0.4, (
+            f"first chunk at {first_at:.2f}s of {total:.2f}s — buffered?"
+        )
+    finally:
+        proc.terminate()
+        await runner.cleanup()
+
+
+@async_test
+async def test_chunked_request_body_accepted(agent_binary):
+    """Chunked REQUESTS (no Content-Length) de-chunk at the agent and
+    re-frame upstream."""
+    backend = _Backend()
+    backend_port = free_port()
+    agent_port = free_port()
+    runner = web.AppRunner(backend.app())
+    await runner.setup()
+    await web.TCPSite(runner, "127.0.0.1", backend_port).start()
+    proc = subprocess.Popen(
+        [agent_binary, "--port", str(agent_port),
+         "--component_port", str(backend_port)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        await asyncio.sleep(0.3)
+
+        async def gen():
+            yield b'{"instances": '
+            await asyncio.sleep(0.05)
+            yield b"[[2, 3]]}"
+
+        async with httpx.AsyncClient() as client:
+            r = await client.post(
+                f"http://127.0.0.1:{agent_port}/v1/models/stub:predict",
+                content=gen(),  # httpx sends Transfer-Encoding: chunked
+                headers={"Content-Type": "application/json"},
+                timeout=10,
+            )
+        assert r.status_code == 200
+        assert r.json()["predictions"] == [5]
+    finally:
+        proc.terminate()
+        await runner.cleanup()
