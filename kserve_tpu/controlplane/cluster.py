@@ -90,7 +90,11 @@ class ControllerManager:
 
     def __init__(self, cluster: Optional[FakeCluster] = None,
                  install_default_runtimes: bool = True,
-                 ingress_domain: str = "example.com"):
+                 ingress_domain: str = "example.com",
+                 ingress_class: str = "gateway-api",
+                 domain_template: str = "{name}.{namespace}.{domain}",
+                 path_template: str = "",
+                 kube_ingress_class_name: str = "nginx"):
         self.cluster = cluster or FakeCluster()
         self._default_domain = ingress_domain
         self.registry = RuntimeRegistry()
@@ -111,10 +115,15 @@ class ControllerManager:
             storage_containers=lambda: self.cluster.list("ClusterStorageContainer"),
         )
         self.isvc_reconciler = InferenceServiceReconciler(
-            self.registry, mutator=mutator, ingress_domain=ingress_domain
+            self.registry, mutator=mutator, ingress_domain=ingress_domain,
+            ingress_class=ingress_class, domain_template=domain_template,
+            path_template=path_template,
+            kube_ingress_class_name=kube_ingress_class_name,
         )
         self.llm_reconciler = LLMISVCReconciler(
-            mutator=mutator, ingress_domain=ingress_domain
+            mutator=mutator, ingress_domain=ingress_domain,
+            ingress_class=ingress_class, domain_template=domain_template,
+            kube_ingress_class_name=kube_ingress_class_name,
         )
         # node-group membership comes from Node labels in a live cluster;
         # tests/operators set it directly

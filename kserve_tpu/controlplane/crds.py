@@ -31,6 +31,13 @@ STOP_ANNOTATION = f"{GROUP}/stop"
 # set by the reconciler on Deployments whose replica count an external
 # autoscaler (HPA/KEDA) owns: re-reconciles preserve the live value
 AUTOSCALED_REPLICAS_ANNOTATION = f"{GROUP}/autoscaler-owned-replicas"
+# metrics aggregation (parity: pkg/webhook/admission/pod/
+# metrics_aggregate_injector.go + qpext): aggregate every in-pod /metrics
+# behind the agent's port, and optionally point prometheus.io/* at it
+ENABLE_METRIC_AGGREGATION_ANNOTATION = f"{GROUP}/enable-metric-aggregation"
+ENABLE_PROMETHEUS_SCRAPING_ANNOTATION = f"{GROUP}/enable-prometheus-scraping"
+AGGREGATE_METRICS_PORT_ANNOTATION = f"{GROUP}/aggregate-prometheus-metrics-port"
+AGENT_METRICS_PORT = 9081
 
 TPU_RESOURCE = "google.com/tpu"
 TPU_TOPOLOGY_SELECTOR = "cloud.google.com/gke-tpu-topology"

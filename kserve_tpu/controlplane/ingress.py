@@ -1,0 +1,214 @@
+"""Ingress backends: Gateway-API HTTPRoute, Istio VirtualService, and
+vanilla Kubernetes Ingress, selected by config (per-ISVC annotation
+override), all synthesized from one routing intent.
+
+Parity: the reference's three ingress reconcilers —
+pkg/controller/v1beta1/inferenceservice/reconcilers/ingress/
+ingress_reconciler.go:237 (Istio VS), httproute_reconciler.go (GW-API),
+kube_ingress_reconciler.go (vanilla) — plus the domain/path templates
+(domain.go, path.go).  The TPU rebuild routes the same three ways so a
+cluster without Gateway-API still gets traffic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .objects import make_object
+
+GATEWAY_API = "gateway-api"
+ISTIO = "istio"
+KUBE_INGRESS = "kubernetes"
+INGRESS_CLASSES = (GATEWAY_API, ISTIO, KUBE_INGRESS)
+
+INGRESS_CLASS_ANNOTATION = "serving.kserve.io/ingressClass"
+
+EXPLAIN_PATH_REGEX = r"^/v1/models/[^/]+:explain$"
+
+
+@dataclass
+class RouteIntent:
+    """Everything an ingress backend needs, independent of its API."""
+
+    name: str
+    namespace: str
+    host: str
+    # weighted entry backends: [(service_name, weight)] — weight None means
+    # the single unweighted backend
+    backends: List[Tuple[str, Optional[int]]]
+    explainer_backend: Optional[str] = None
+    # explainer's own host (vanilla Ingress cannot regex-match :explain,
+    # so it gets a per-component host — kube_ingress_reconciler.go style)
+    explainer_host: Optional[str] = None
+    # path-based routing on a shared host (reference path.go pathTemplate);
+    # empty = host-based.  In prefix mode every backend sees the prefix
+    # STRIPPED (each synthesizer adds its rewrite mechanism) and the
+    # explainer :explain split is host-only — no core routing API can both
+    # regex-match and prefix-strip, so prefix-mode explainer traffic uses
+    # the explainer's own host.
+    path_prefix: str = ""
+    # IngressClass for the vanilla backend (cluster-dependent: nginx,
+    # traefik, gce, ...)
+    kube_ingress_class_name: str = "nginx"
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+def render_domain(template: str, name: str, namespace: str, domain: str) -> str:
+    """Domain template (reference domain.go: {{.Name}}.{{.Namespace}}.
+    {{.IngressDomain}} by default) with python formatting."""
+    return template.format(name=name, namespace=namespace, domain=domain)
+
+
+def render_path(template: str, name: str, namespace: str) -> str:
+    """Path template (reference path.go urlPath): e.g.
+    /serving/{namespace}/{name}."""
+    if not template:
+        return ""
+    return template.format(name=name, namespace=namespace).rstrip("/")
+
+
+def synthesize(ingress_class: str, intent: RouteIntent) -> dict:
+    if ingress_class == GATEWAY_API:
+        return gateway_httproute(intent)
+    if ingress_class == ISTIO:
+        return istio_virtualservice(intent)
+    if ingress_class == KUBE_INGRESS:
+        return kube_ingress(intent)
+    raise ValueError(
+        f"unknown ingress class {ingress_class!r}; expected one of "
+        f"{INGRESS_CLASSES}"
+    )
+
+
+def _prefix(intent: RouteIntent) -> str:
+    return intent.path_prefix or ""
+
+
+def gateway_httproute(intent: RouteIntent) -> dict:
+    backend_refs = [
+        {"name": svc, "port": 80, **({"weight": w} if w is not None else {})}
+        for svc, w in intent.backends
+    ]
+    prefix = _prefix(intent)
+    main_rule = {
+        "matches": [{"path": {
+            "type": "PathPrefix", "value": prefix or "/"}}],
+        "backendRefs": backend_refs,
+    }
+    if prefix:
+        # strip the routing prefix before the backend (backends serve /v1,
+        # /v2, /openai at the root)
+        main_rule["filters"] = [{
+            "type": "URLRewrite",
+            "urlRewrite": {"path": {
+                "type": "ReplacePrefixMatch", "replacePrefixMatch": "/"}},
+        }]
+    rules = [main_rule]
+    if intent.explainer_backend and not prefix:
+        rules.insert(0, {
+            "matches": [{"path": {
+                "type": "RegularExpression", "value": EXPLAIN_PATH_REGEX,
+            }}],
+            "backendRefs": [{"name": intent.explainer_backend, "port": 80}],
+        })
+    return make_object(
+        "gateway.networking.k8s.io/v1", "HTTPRoute", intent.name,
+        intent.namespace, labels=dict(intent.labels),
+        spec={"hostnames": [intent.host], "rules": rules},
+    )
+
+
+def istio_virtualservice(intent: RouteIntent) -> dict:
+    """VirtualService with weighted destinations (parity:
+    ingress_reconciler.go:237 createIngress route building — regex match
+    for :explain, weighted canary routes, cluster-local service hosts)."""
+    def dest(svc: str, weight: Optional[int]) -> dict:
+        d = {"destination": {
+            "host": f"{svc}.{intent.namespace}.svc.cluster.local",
+            "port": {"number": 80},
+        }}
+        if weight is not None:
+            d["weight"] = weight
+        return d
+
+    prefix = _prefix(intent)
+    http = []
+    if intent.explainer_backend and not prefix:
+        http.append({
+            "match": [{"uri": {"regex": EXPLAIN_PATH_REGEX}}],
+            "route": [dest(intent.explainer_backend, None)],
+        })
+    entry = {"route": [dest(svc, w) for svc, w in intent.backends]}
+    if prefix:
+        entry["match"] = [{"uri": {"prefix": prefix + "/"}}]
+        # prefix-match rewrite replaces the matched prefix, so the backend
+        # sees /v1/... at the root
+        entry["rewrite"] = {"uri": "/"}
+    http.append(entry)
+    return make_object(
+        "networking.istio.io/v1beta1", "VirtualService", intent.name,
+        intent.namespace, labels=dict(intent.labels),
+        spec={
+            "hosts": [intent.host],
+            "gateways": ["knative-serving/knative-ingress-gateway",
+                         "mesh"],
+            "http": http,
+        },
+    )
+
+
+def kube_ingress(intent: RouteIntent) -> dict:
+    """Vanilla networking.k8s.io/v1 Ingress (parity:
+    kube_ingress_reconciler.go).  No weighted backends in the core API:
+    the highest-weight backend serves (the reference's vanilla path has
+    the same canary limitation).  No regex matches either, so the
+    explainer routes on its own per-component host."""
+    top = max(
+        intent.backends,
+        key=lambda t: (t[1] if t[1] is not None else 101),
+    )[0]
+    prefix = _prefix(intent)
+    annotations = {}
+    if prefix:
+        # standard controller rewrite recipe: capture the remainder and
+        # serve it at the backend root
+        annotations["nginx.ingress.kubernetes.io/rewrite-target"] = "/$2"
+        path_entry = {
+            "path": prefix + "(/|$)(.*)",
+            "pathType": "ImplementationSpecific",
+            "backend": {"service": {"name": top, "port": {"number": 80}}},
+        }
+    else:
+        path_entry = {
+            "path": "/",
+            "pathType": "Prefix",
+            "backend": {"service": {"name": top, "port": {"number": 80}}},
+        }
+    rules = [{
+        "host": intent.host,
+        "http": {"paths": [path_entry]},
+    }]
+    if intent.explainer_backend and intent.explainer_host:
+        rules.append({
+            "host": intent.explainer_host,
+            "http": {"paths": [{
+                "path": "/",
+                "pathType": "Prefix",
+                "backend": {"service": {
+                    "name": intent.explainer_backend,
+                    "port": {"number": 80},
+                }},
+            }]},
+        })
+    obj = make_object(
+        "networking.k8s.io/v1", "Ingress", intent.name, intent.namespace,
+        labels=dict(intent.labels),
+        spec={
+            "ingressClassName": intent.kube_ingress_class_name,
+            "rules": rules,
+        },
+    )
+    if annotations:
+        obj["metadata"].setdefault("annotations", {}).update(annotations)
+    return obj

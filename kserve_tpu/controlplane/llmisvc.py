@@ -36,10 +36,16 @@ GENERATIVE_IMAGE = "kserve-tpu/generative:latest"
 class LLMISVCReconciler:
     def __init__(self, presets: Optional[Dict[str, LLMInferenceServiceConfig]] = None,
                  mutator: Optional[PodMutator] = None,
-                 ingress_domain: str = "example.com"):
+                 ingress_domain: str = "example.com",
+                 ingress_class: str = "gateway-api",
+                 domain_template: str = "{name}.{namespace}.{domain}",
+                 kube_ingress_class_name: str = "nginx"):
         self.presets = presets or {}
         self.mutator = mutator or PodMutator()
         self.ingress_domain = ingress_domain
+        self.ingress_class = ingress_class
+        self.domain_template = domain_template
+        self.kube_ingress_class_name = kube_ingress_class_name
 
     def reconcile(self, llm: LLMInferenceService) -> Tuple[List[dict], dict]:
         spec = self._merge_presets(llm)
@@ -366,21 +372,26 @@ class LLMISVCReconciler:
         return [epp, pool]
 
     def _route(self, llm, spec) -> dict:
+        """Routing for the configured ingress backend (controlplane/
+        ingress.py — the same three-way dispatch as the ISVC reconciler,
+        so a cluster without Gateway-API still routes LLM traffic)."""
+        from . import ingress as ing
+
         name = llm.metadata.name
         namespace = llm.metadata.namespace
-        backend = f"{name}-kserve"
-        return make_object(
-            "gateway.networking.k8s.io/v1", "HTTPRoute", name, namespace,
-            spec={
-                "hostnames": [f"{name}.{namespace}.{self.ingress_domain}"],
-                "rules": [
-                    {
-                        "matches": [{"path": {"type": "PathPrefix", "value": "/"}}],
-                        "backendRefs": [{"name": backend, "port": 80}],
-                    }
-                ],
-            },
+        klass = (llm.metadata.annotations or {}).get(
+            ing.INGRESS_CLASS_ANNOTATION, self.ingress_class
         )
+        intent = ing.RouteIntent(
+            name=name,
+            namespace=namespace,
+            host=ing.render_domain(
+                self.domain_template, name, namespace, self.ingress_domain
+            ),
+            backends=[(f"{name}-kserve", None)],
+            kube_ingress_class_name=self.kube_ingress_class_name,
+        )
+        return ing.synthesize(klass, intent)
 
     def _scaling(self, llm, workload: WorkloadSpec) -> Optional[dict]:
         name = f"{llm.metadata.name}-kserve"

@@ -61,10 +61,21 @@ def isvc_object(isvc: InferenceService) -> dict:
 
 class InferenceServiceReconciler:
     def __init__(self, registry: RuntimeRegistry, mutator: Optional[PodMutator] = None,
-                 ingress_domain: str = "example.com"):
+                 ingress_domain: str = "example.com",
+                 ingress_class: str = "gateway-api",
+                 domain_template: str = "{name}.{namespace}.{domain}",
+                 path_template: str = "",
+                 kube_ingress_class_name: str = "nginx"):
         self.registry = registry
         self.mutator = mutator or PodMutator()
         self.ingress_domain = ingress_domain
+        # ingress backend selection + domain/path templates (parity:
+        # inferenceservice-config ingress section — ingressClassName,
+        # domainTemplate, pathTemplate)
+        self.ingress_class = ingress_class
+        self.domain_template = domain_template
+        self.path_template = path_template
+        self.kube_ingress_class_name = kube_ingress_class_name
 
     # ---------------- top level ----------------
 
@@ -154,9 +165,16 @@ class InferenceServiceReconciler:
         status["components"] = {
             c: {"url": u} for c, u in component_urls.items()
         }
-        status["url"] = (
-            f"http://{isvc.metadata.name}.{isvc.metadata.namespace}.{self.ingress_domain}"
+        from .ingress import render_domain, render_path
+
+        host = render_domain(
+            self.domain_template, isvc.metadata.name,
+            isvc.metadata.namespace, self.ingress_domain,
         )
+        prefix = render_path(
+            self.path_template, isvc.metadata.name, isvc.metadata.namespace
+        )
+        status["url"] = f"http://{host}{prefix}"
         set_condition(status, "IngressReady", True, reason="Reconciled")
         set_condition(status, "Ready", True, reason="Reconciled")
         for obj in objects:
@@ -227,7 +245,11 @@ class InferenceServiceReconciler:
             service_account=getattr(spec, "serviceAccountName", None) or "default",
         )
         objects = self._raw_objects(isvc, name, spec, pod_spec, plan)
-        url = f"http://{name}.{namespace}.{self.ingress_domain}"
+        from .ingress import render_domain
+
+        url = "http://" + render_domain(
+            self.domain_template, name, namespace, self.ingress_domain
+        )
         return objects, url
 
     def _predictor_pod_spec(self, isvc, spec: PredictorSpec) -> Tuple[dict, Optional[SlicePlan]]:
@@ -307,13 +329,19 @@ class InferenceServiceReconciler:
         replicas = spec.minReplicas if spec.minReplicas is not None else 1
         if pod_spec.get("containers"):
             ensure_probes(pod_spec["containers"][0])
+        template_meta: dict = {"labels": dict(labels)}
+        pod_ann = self.mutator.pod_annotations(
+            isvc.metadata.annotations or {}
+        )
+        if pod_ann:
+            template_meta["annotations"] = pod_ann
         deployment = make_object(
             "apps/v1", "Deployment", name, namespace, labels=dict(labels),
             spec={
                 "replicas": replicas,
                 "selector": {"matchLabels": {"app": name}},
                 "template": {
-                    "metadata": {"labels": dict(labels)},
+                    "metadata": template_meta,
                     "spec": pod_spec,
                 },
             },
@@ -452,53 +480,55 @@ class InferenceServiceReconciler:
                canary_pct: Optional[int] = None,
                canary_has_stable: bool = False,
                activator_entries=frozenset()) -> dict:
-        """Gateway-API HTTPRoute: traffic enters at transformer when present,
-        else predictor; :predict/:explain split to explainer (parity:
-        ingress_reconciler.go semantics on HTTPRoute instead of Istio VS).
-        canaryTrafficPercent becomes weighted backendRefs on the predictor
-        entry (first rollout with no promoted stable gets 100% canary)."""
+        """Routing object for the configured ingress backend (controlplane/
+        ingress.py: Gateway-API HTTPRoute | Istio VirtualService | vanilla
+        Ingress — parity with the reference's three ingress reconcilers).
+        Traffic enters at transformer when present, else predictor;
+        :explain splits to the explainer; canaryTrafficPercent becomes
+        weighted backends (first rollout with no promoted stable gets 100%
+        canary)."""
+        from . import ingress as ing
+
         name = isvc.metadata.name
         namespace = isvc.metadata.namespace
         entry = "transformer" if "transformer" in component_urls else "predictor"
         entry_name = self._component_name(isvc, entry)
         if canary_pct is not None and entry == "predictor":
             if canary_has_stable:
-                backend_refs = [
-                    {"name": entry_name, "port": 80, "weight": 100 - canary_pct},
-                    {"name": f"{entry_name}-canary", "port": 80, "weight": canary_pct},
+                backends = [
+                    (entry_name, 100 - canary_pct),
+                    (f"{entry_name}-canary", canary_pct),
                 ]
             else:
-                backend_refs = [
-                    {"name": f"{entry_name}-canary", "port": 80, "weight": 100}
-                ]
+                backends = [(f"{entry_name}-canary", 100)]
         elif entry in activator_entries:
             # scaled-to-zero: the activator is the data path (buffers the
             # wake-up request, forwards once the workload is ready)
-            backend_refs = [{"name": f"{entry_name}-activator", "port": 80}]
+            backends = [(f"{entry_name}-activator", None)]
         else:
-            backend_refs = [{"name": entry_name, "port": 80}]
-        rules = [
-            {
-                "matches": [{"path": {"type": "PathPrefix", "value": "/"}}],
-                "backendRefs": backend_refs,
-            }
-        ]
+            backends = [(entry_name, None)]
+        explainer_backend = explainer_host = None
         if "explainer" in component_urls:
             explainer_backend = self._component_name(isvc, "explainer")
             if "explainer" in activator_entries:
                 explainer_backend = f"{explainer_backend}-activator"
-            rules.insert(0, {
-                "matches": [
-                    {"path": {"type": "RegularExpression", "value": r"^/v1/models/[^/]+:explain$"}}
-                ],
-                "backendRefs": [
-                    {"name": explainer_backend, "port": 80}
-                ],
-            })
-        return make_object(
-            "gateway.networking.k8s.io/v1", "HTTPRoute", name, namespace,
-            spec={
-                "hostnames": [f"{name}.{namespace}.{self.ingress_domain}"],
-                "rules": rules,
-            },
+            explainer_host = ing.render_domain(
+                self.domain_template, f"{name}-explainer", namespace,
+                self.ingress_domain,
+            )
+        klass = (isvc.metadata.annotations or {}).get(
+            ing.INGRESS_CLASS_ANNOTATION, self.ingress_class
         )
+        intent = ing.RouteIntent(
+            name=name,
+            namespace=namespace,
+            host=ing.render_domain(
+                self.domain_template, name, namespace, self.ingress_domain
+            ),
+            backends=backends,
+            explainer_backend=explainer_backend,
+            explainer_host=explainer_host,
+            path_prefix=ing.render_path(self.path_template, name, namespace),
+            kube_ingress_class_name=self.kube_ingress_class_name,
+        )
+        return ing.synthesize(klass, intent)

@@ -16,7 +16,13 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
-from .crds import ModelSpec
+from .crds import (
+    AGENT_METRICS_PORT,
+    AGGREGATE_METRICS_PORT_ANNOTATION,
+    ENABLE_METRIC_AGGREGATION_ANNOTATION,
+    ENABLE_PROMETHEUS_SCRAPING_ANNOTATION,
+    ModelSpec,
+)
 from .topology import SlicePlan, inject_tpu_resources
 
 STORAGE_INITIALIZER_IMAGE = "kserve-tpu/storage-initializer:latest"
@@ -89,7 +95,72 @@ class PodMutator:
             logger_spec = getattr(component_spec, "logger", None)
             if batcher or logger_spec:
                 pod_spec = self.inject_agent(pod_spec, batcher, logger_spec)
+        pod_spec = self.inject_metrics_aggregation(
+            pod_spec, isvc_metadata.get("annotations") or {}
+        )
         return pod_spec
+
+    def inject_metrics_aggregation(self, pod_spec: dict,
+                                   isvc_annotations: Dict[str, str]) -> dict:
+        """Metric aggregation (mutator item 5; parity:
+        metrics_aggregate_injector.go + the qpext role): when the ISVC
+        opts in, every in-pod /metrics is served merged on the agent's
+        port — the agent scrapes the component plus any extra named
+        container ports.  Injects a metrics-only agent when no
+        batcher/logger already did."""
+        if isvc_annotations.get(
+            ENABLE_METRIC_AGGREGATION_ANNOTATION, ""
+        ).lower() != "true":
+            return pod_spec
+        containers = pod_spec.setdefault("containers", [])
+        agent = next(
+            (c for c in containers if c.get("name") == "kserve-agent"), None
+        )
+        if agent is None:
+            agent = {
+                "name": "kserve-agent",
+                "image": self.agent_image,
+                "args": ["--component_port=8080",
+                         f"--port={AGENT_METRICS_PORT}"],
+                "ports": [{"containerPort": AGENT_METRICS_PORT,
+                           "name": "agent"}],
+            }
+            containers.append(agent)
+        # scrape every other container port that names itself *metrics*
+        # (engine workers, OTel sidecars) in addition to the component
+        targets = []
+        for c in containers:
+            if c is agent:
+                continue
+            for p in c.get("ports", ()):
+                if "metrics" in str(p.get("name", "")):
+                    targets.append(f"{p['containerPort']}:/metrics")
+        if targets:
+            agent.setdefault("args", []).append(
+                "--metrics-targets=" + ",".join(targets)
+            )
+        return pod_spec
+
+    def pod_annotations(self, isvc_annotations: Dict[str, str]) -> Dict[str, str]:
+        """Pod-template annotations for the scrape path: the aggregate
+        port marker, plus prometheus.io/* pointed at the agent (or the
+        component when aggregation is off)."""
+        out: Dict[str, str] = {}
+        aggregating = isvc_annotations.get(
+            ENABLE_METRIC_AGGREGATION_ANNOTATION, ""
+        ).lower() == "true"
+        if aggregating:
+            out[ENABLE_METRIC_AGGREGATION_ANNOTATION] = "true"
+            out[AGGREGATE_METRICS_PORT_ANNOTATION] = str(AGENT_METRICS_PORT)
+        if isvc_annotations.get(
+            ENABLE_PROMETHEUS_SCRAPING_ANNOTATION, ""
+        ).lower() == "true":
+            out["prometheus.io/scrape"] = "true"
+            out["prometheus.io/port"] = (
+                str(AGENT_METRICS_PORT) if aggregating else "8080"
+            )
+            out["prometheus.io/path"] = "/metrics"
+        return out
 
     def inject_storage_initializer(
         self, pod_spec: dict, storage_uri: str,

@@ -53,6 +53,10 @@ struct Options {
   std::string log_format = "json";   // json | csv (file sink marshaller)
   int log_batch_size = 16;           // events per flushed file
   int log_flush_interval_ms = 2000;  // partial-batch flush deadline
+  // qpext parity (qpext/cmd/qpext/main.go ScrapeConfigurations): extra
+  // "port:path" scrape targets merged into /metrics alongside the
+  // component's own /metrics and the agent counters
+  std::string metrics_targets;
 };
 
 Options g_opts;
@@ -783,6 +787,21 @@ Batcher g_batcher;
 
 // ----------------------------------------------------------- metrics merge
 
+bool scrape_target(const std::string& host, int port, const std::string& path,
+                   std::string* body) {
+  int fd = connect_to(host, port);
+  if (fd < 0) return false;
+  std::ostringstream req;
+  req << "GET " << path << " HTTP/1.1\r\nHost: " << host
+      << "\r\nConnection: close\r\n\r\n";
+  HttpMessage resp;
+  bool ok = send_all(fd, req.str()) && read_http(fd, &resp, true) &&
+            resp.start_line.find("200") != std::string::npos;
+  ::close(fd);
+  if (ok) *body = resp.body;
+  return ok;
+}
+
 std::string merged_metrics() {
   std::ostringstream out;
   out << "# TYPE agent_requests_total counter\n"
@@ -792,11 +811,27 @@ std::string merged_metrics() {
       << "# TYPE agent_batched_requests_total counter\n"
       << "agent_batched_requests_total " << g_batched_requests_total.load()
       << "\n";
-  HttpMessage upstream;
-  if (call_component("GET", "/metrics", "", &upstream) &&
-      upstream.start_line.find("200") != std::string::npos) {
-    out << upstream.body;
-    if (!upstream.body.empty() && upstream.body.back() != '\n') out << "\n";
+  std::string body;
+  if (scrape_target(g_opts.component_host, g_opts.component_port, "/metrics",
+                    &body)) {
+    out << body;
+    if (!body.empty() && body.back() != '\n') out << "\n";
+  }
+  // extra scrape targets: "port:path,port:path" (engine workers, OTel
+  // sidecars, anything else co-scheduled in the pod)
+  std::istringstream targets(g_opts.metrics_targets);
+  std::string item;
+  while (std::getline(targets, item, ',')) {
+    if (item.empty()) continue;
+    auto colon = item.find(':');
+    int port = std::atoi(item.substr(0, colon).c_str());
+    std::string path =
+        colon == std::string::npos ? "/metrics" : item.substr(colon + 1);
+    if (port <= 0 || port == g_opts.component_port) continue;
+    if (scrape_target("127.0.0.1", port, path, &body)) {
+      out << body;
+      if (!body.empty() && body.back() != '\n') out << "\n";
+    }
   }
   return out.str();
 }
@@ -870,7 +905,20 @@ void handle_connection(int client_fd) {
 int main(int argc, char** argv) {
   for (int i = 1; i < argc; i++) {
     std::string arg = argv[i];
-    auto next = [&]() -> std::string { return i + 1 < argc ? argv[++i] : ""; };
+    // accept both "--flag value" and "--flag=value" (the webhook injects
+    // the '=' form)
+    std::string inline_value;
+    bool has_inline = false;
+    auto eq = arg.find('=');
+    if (arg.rfind("--", 0) == 0 && eq != std::string::npos) {
+      inline_value = arg.substr(eq + 1);
+      arg = arg.substr(0, eq);
+      has_inline = true;
+    }
+    auto next = [&]() -> std::string {
+      if (has_inline) return inline_value;
+      return i + 1 < argc ? argv[++i] : "";
+    };
     if (arg == "--port") g_opts.port = std::stoi(next());
     else if (arg == "--component_port") g_opts.component_port = std::stoi(next());
     else if (arg == "--component_host") g_opts.component_host = next();
@@ -883,6 +931,7 @@ int main(int argc, char** argv) {
     else if (arg == "--log-format") g_opts.log_format = next();
     else if (arg == "--log-batch-size") g_opts.log_batch_size = std::stoi(next());
     else if (arg == "--log-flush-interval") g_opts.log_flush_interval_ms = std::stoi(next());
+    else if (arg == "--metrics-targets") g_opts.metrics_targets = next();
     else {
       std::cerr << "unknown flag: " << arg << "\n";
       return 2;

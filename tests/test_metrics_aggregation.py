@@ -1,0 +1,164 @@
+"""Metrics aggregation (VERDICT round-3 #6, qpext role): one scrape port
+serving the agent's own counters + the component's /metrics + any extra
+in-pod metrics ports, driven by the webhook's aggregation annotations.
+
+Parity: qpext/cmd/qpext/main.go:312 (handleStats merge) and
+pkg/webhook/admission/pod/metrics_aggregate_injector.go."""
+
+import asyncio
+import subprocess
+
+import httpx
+from aiohttp import web
+
+from kserve_tpu.controlplane.cluster import ControllerManager
+from kserve_tpu.controlplane.crds import (
+    AGGREGATE_METRICS_PORT_ANNOTATION,
+    ENABLE_METRIC_AGGREGATION_ANNOTATION,
+    ENABLE_PROMETHEUS_SCRAPING_ANNOTATION,
+)
+from kserve_tpu.controlplane.webhook import PodMutator
+
+from conftest import async_test
+from test_controlplane import make_isvc
+from test_native_agent import agent_binary, free_port  # noqa: F401
+
+
+class TestWebhookAnnotations:
+    def test_metrics_only_agent_injected(self):
+        mutator = PodMutator()
+        pod = {"containers": [{
+            "name": "kserve-container",
+            "ports": [{"containerPort": 8080, "name": "http"},
+                      {"containerPort": 9090, "name": "engine-metrics"}],
+        }]}
+        out = mutator.inject_metrics_aggregation(
+            pod, {ENABLE_METRIC_AGGREGATION_ANNOTATION: "true"}
+        )
+        agent = next(c for c in out["containers"] if c["name"] == "kserve-agent")
+        assert "--metrics-targets=9090:/metrics" in agent["args"]
+
+    def test_existing_agent_reused(self):
+        mutator = PodMutator()
+        pod = {"containers": [
+            {"name": "kserve-container", "ports": []},
+            {"name": "kserve-agent", "args": ["--enable-logger"]},
+        ]}
+        out = mutator.inject_metrics_aggregation(
+            pod, {ENABLE_METRIC_AGGREGATION_ANNOTATION: "true"}
+        )
+        agents = [c for c in out["containers"] if c["name"] == "kserve-agent"]
+        assert len(agents) == 1
+
+    def test_noop_without_annotation(self):
+        mutator = PodMutator()
+        pod = {"containers": [{"name": "kserve-container"}]}
+        out = mutator.inject_metrics_aggregation(pod, {})
+        assert all(c["name"] != "kserve-agent" for c in out["containers"])
+
+    def test_pod_annotations_point_at_agent(self):
+        mutator = PodMutator()
+        ann = mutator.pod_annotations({
+            ENABLE_METRIC_AGGREGATION_ANNOTATION: "true",
+            ENABLE_PROMETHEUS_SCRAPING_ANNOTATION: "true",
+        })
+        assert ann["prometheus.io/port"] == "9081"
+        assert ann[AGGREGATE_METRICS_PORT_ANNOTATION] == "9081"
+        # scraping without aggregation points at the component directly
+        ann2 = mutator.pod_annotations({
+            ENABLE_PROMETHEUS_SCRAPING_ANNOTATION: "true",
+        })
+        assert ann2["prometheus.io/port"] == "8080"
+        assert ENABLE_METRIC_AGGREGATION_ANNOTATION not in ann2
+
+    def test_reconciler_stamps_template_annotations(self):
+        mgr = ControllerManager()
+        isvc = make_isvc(name="scraped")
+        isvc["metadata"]["annotations"] = {
+            ENABLE_METRIC_AGGREGATION_ANNOTATION: "true",
+            ENABLE_PROMETHEUS_SCRAPING_ANNOTATION: "true",
+        }
+        mgr.apply(isvc)
+        dep = mgr.cluster.get("Deployment", "scraped-predictor", "default")
+        meta = dep["spec"]["template"]["metadata"]
+        assert meta["annotations"]["prometheus.io/port"] == "9081"
+        containers = dep["spec"]["template"]["spec"]["containers"]
+        assert any(c["name"] == "kserve-agent" for c in containers)
+
+
+@async_test
+async def test_agent_merges_all_metrics_sources(agent_binary):  # noqa: F811
+    """qpext e2e: the agent's /metrics returns its own counters, the
+    component's families, and an extra target's families in one scrape."""
+    component_port, extra_port, agent_port = free_port(), free_port(), free_port()
+
+    def metrics_app(family):
+        app = web.Application()
+
+        async def metrics(request):
+            return web.Response(
+                text=f"# TYPE {family} counter\n{family} 42\n",
+                content_type="text/plain",
+            )
+
+        app.router.add_get("/metrics", metrics)
+        return app
+
+    runners = []
+    for port, family in ((component_port, "component_requests_total"),
+                        (extra_port, "engine_tokens_total")):
+        runner = web.AppRunner(metrics_app(family))
+        await runner.setup()
+        await web.TCPSite(runner, "127.0.0.1", port).start()
+        runners.append(runner)
+    proc = subprocess.Popen(
+        [agent_binary, "--port", str(agent_port),
+         "--component_port", str(component_port),
+         "--metrics-targets", f"{extra_port}:/metrics"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        await asyncio.sleep(0.3)
+        async with httpx.AsyncClient() as client:
+            r = await client.get(f"http://127.0.0.1:{agent_port}/metrics")
+        assert r.status_code == 200
+        text = r.text
+        assert "agent_requests_total" in text
+        assert "component_requests_total 42" in text
+        assert "engine_tokens_total 42" in text
+    finally:
+        proc.terminate()
+        for runner in runners:
+            await runner.cleanup()
+
+
+@async_test
+async def test_agent_accepts_webhook_style_flags(agent_binary):  # noqa: F811
+    """The webhook injects '--flag=value' args; the binary must accept
+    both that and the space-separated form."""
+    component_port, agent_port = free_port(), free_port()
+
+    app = web.Application()
+
+    async def metrics(request):
+        return web.Response(text="x_total 1\n", content_type="text/plain")
+
+    app.router.add_get("/metrics", metrics)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    await web.TCPSite(runner, "127.0.0.1", component_port).start()
+    proc = subprocess.Popen(
+        [agent_binary, f"--port={agent_port}",
+         f"--component_port={component_port}",
+         "--metrics-targets=1:/nope"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        await asyncio.sleep(0.3)
+        assert proc.poll() is None, "agent exited on '=' style flags"
+        async with httpx.AsyncClient() as client:
+            r = await client.get(f"http://127.0.0.1:{agent_port}/metrics")
+        assert "x_total 1" in r.text
+    finally:
+        proc.terminate()
+        await runner.cleanup()
