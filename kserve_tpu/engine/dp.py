@@ -43,10 +43,10 @@ class DataParallelEngine:
         if dp < 2:
             raise ValueError("DataParallelEngine needs dp >= 2; use LLMEngine")
         devices = list(devices) if devices is not None else list(jax.devices())
-        per_replica = engine_config.tp * engine_config.sp
+        per_replica = engine_config.tp * engine_config.sp * engine_config.pp
         if dp * per_replica > len(devices):
             raise ValueError(
-                f"dp={dp} x (tp*sp)={per_replica} needs {dp * per_replica} "
+                f"dp={dp} x (tp*sp*pp)={per_replica} needs {dp * per_replica} "
                 f"devices, have {len(devices)}"
             )
         self.config = engine_config

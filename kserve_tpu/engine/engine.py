@@ -98,6 +98,15 @@ class EngineConfig:
     # traffic per decode step and the resident footprint — the knob that
     # fits an 8B model on one 16-GB v5e chip.  Orthogonal to kv_quant.
     weight_quant: str = "none"  # none | int8
+    # pipeline parallelism (parallel/pipeline.py): layers shard over the
+    # `pipe` mesh axis; prefill/decode stream GPipe microbatches through
+    # the stages (parity: Parallelism.Pipeline,
+    # llm_inference_service_types.go:679-700).  For models that exceed one
+    # slice's HBM — within a slice prefer tp.  pp>1 currently requires
+    # tp/sp==1 and excludes kv offload/quant, prefix cache, LoRA and the
+    # P/D wire (each raises at init or call time).
+    pp: int = 1
+    pp_microbatches: int = 0  # 0 = auto (pp when it divides the batch)
     # None = auto (ops/attention.py): the fused Pallas kernel for
     # long-context decode (page-table width >= PALLAS_MIN_PAGES, head_dim %
     # 128 == 0), the XLA gather for short context — each where it measures
@@ -289,8 +298,38 @@ class LLMEngine:
                     f"prefill buckets {bad} not divisible by sp={engine_config.sp} "
                     "(ring-attention prefill shards the prompt dim over seq)"
                 )
+        if engine_config.pp > 1:
+            # supported composition today: pp alone (x dp via disjoint
+            # replica meshes).  Everything else raises loudly here rather
+            # than inside a jitted trace.
+            bad = []
+            if engine_config.tp > 1:
+                bad.append("tp")
+            if engine_config.sp > 1:
+                bad.append("sp")
+            if engine_config.kv_quant != "none":
+                bad.append("kv_quant")
+            if engine_config.kv_offload != "none":
+                bad.append("kv_offload")
+            if engine_config.weight_quant != "none":
+                bad.append("weight_quant")
+            if lora_adapters or lora_stacked:
+                bad.append("lora")
+            if bad:
+                raise NotImplementedError(
+                    f"pp>1 does not compose with {bad} yet")
+            if model_config.n_layers % engine_config.pp != 0:
+                raise ValueError(
+                    f"n_layers={model_config.n_layers} not divisible by "
+                    f"pp={engine_config.pp}")
+            if engine_config.prefix_cache:
+                # prefix-cache hits admit via chunked prefill, which has no
+                # staged variant yet
+                logger.info("pp>1: prefix cache disabled")
+                engine_config.prefix_cache = False
         self.mesh = shd.create_mesh(
-            tp=engine_config.tp, dp=1, sp=engine_config.sp, devices=devices
+            tp=engine_config.tp, dp=1, sp=engine_config.sp,
+            pp=engine_config.pp, devices=devices,
         )
         self._base_rng = jax.random.PRNGKey(rng_seed)
         self._step_counter = 0
@@ -310,7 +349,24 @@ class LLMEngine:
                 if isinstance(v, dict)
             ):
                 params = quantize_params(params, model_config)
-        self.params = shd.shard_params(params, model_config, self.mesh)
+        if engine_config.pp > 1:
+            # stage-sharded layers: the per-layer list stacks into one
+            # pytree with a leading L axis placed on the pipe mesh axis;
+            # embed/final_norm/lm_head stay pipe-replicated
+            params = llama.stack_layer_params(params)
+            specs = {
+                k: (shd.stacked_layer_pspecs(v) if k == "layers"
+                    else jax.sharding.PartitionSpec())
+                for k, v in params.items()
+            }
+            self.params = jax.tree.map(
+                lambda arr, spec: jax.device_put(
+                    arr, shd.named(self.mesh, spec)),
+                params, specs,
+                is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
+            )
+        else:
+            self.params = shd.shard_params(params, model_config, self.mesh)
 
         # multi-adapter LoRA: stacked [n_adapters, ...] tensors attached per
         # layer; a per-slot id selects at runtime (models/lora.py)
@@ -373,6 +429,16 @@ class LLMEngine:
             )
             scales = init_kv_scales(cache_cfg, scale_sharding)
             self.kv_pages = list(zip(pages, scales))
+        elif engine_config.pp > 1:
+            # pipeline mode: one stacked [L, ...] array, layer axis on pipe
+            shape = (
+                model_config.n_layers, cache_cfg.num_pages, 2,
+                cache_cfg.n_kv_heads, cache_cfg.page_size, cache_cfg.head_dim,
+            )
+            self.kv_pages = jax.device_put(
+                jnp.zeros(shape, jnp.dtype(cache_cfg.dtype)),
+                shd.named(self.mesh, shd.stacked_kv_pages_pspec()),
+            )
         else:
             self.kv_pages = shd.shard_kv_pages(init_kv_pages(cache_cfg), self.mesh)
         self.allocator = PageAllocator(cache_cfg.num_pages)
@@ -475,6 +541,14 @@ class LLMEngine:
             )
             attention_fn = lambda q, k, v, vl, softcap: ring_fn(q, k, v, vl)  # noqa: E731
 
+        def _pp_microbatches(B: int) -> int:
+            """Largest divisor of B not above the requested microbatch
+            count (pp by default) — static per compiled shape."""
+            m = min(cfg.pp_microbatches or cfg.pp, B)
+            while B % m:
+                m -= 1
+            return max(m, 1)
+
         def _make_prefill(with_logprobs: bool):
             def fn(params, tokens, valid_len, kv_pages, page_ids, state, rng,
                    adapter_ids):
@@ -482,10 +556,17 @@ class LLMEngine:
                     tokens = jax.lax.with_sharding_constraint(
                         tokens, shd.named(mesh, jax.sharding.PartitionSpec(None, shd.SEQ_AXIS))
                     )
-                logits, kv_pages = llama.prefill(
-                    params, mc, tokens, valid_len, kv_pages, page_ids, cfg.page_size,
-                    attention_fn=attention_fn, adapter_ids=adapter_ids,
-                )
+                if cfg.pp > 1:
+                    logits, kv_pages = llama.prefill_pp(
+                        params, mc, tokens, valid_len, kv_pages, page_ids,
+                        cfg.page_size, mesh,
+                        _pp_microbatches(tokens.shape[0]),
+                    )
+                else:
+                    logits, kv_pages = llama.prefill(
+                        params, mc, tokens, valid_len, kv_pages, page_ids, cfg.page_size,
+                        attention_fn=attention_fn, adapter_ids=adapter_ids,
+                    )
                 # vLLM-parity: repetition_penalty counts prompt tokens as
                 # "seen" for the very first sampled token.  Rows with default
                 # penalties are bit-identical to the unpenalized math.
@@ -540,12 +621,18 @@ class LLMEngine:
                     else:
                         tokens, pos, counters, kv_pages = carry
                     live = active & (pos < capacity)
-                    logits, kv_pages = llama.decode_step(
-                        params, mc, tokens, pos, kv_pages, page_table, live,
-                        cfg.page_size, use_pallas=cfg.use_pallas,
-                        adapter_ids=adapter_ids,
-                        attention_fn=decode_attention_fn,
-                    )
+                    if cfg.pp > 1:
+                        logits, kv_pages = llama.decode_step_pp(
+                            params, mc, tokens, pos, kv_pages, page_table,
+                            live, cfg.page_size, mesh, _pp_microbatches(B),
+                        )
+                    else:
+                        logits, kv_pages = llama.decode_step(
+                            params, mc, tokens, pos, kv_pages, page_table, live,
+                            cfg.page_size, use_pallas=cfg.use_pallas,
+                            adapter_ids=adapter_ids,
+                            attention_fn=decode_attention_fn,
+                        )
                     if with_penalties:
                         logits = apply_penalties(
                             logits, counts,
@@ -803,6 +890,11 @@ class LLMEngine:
             raise NotImplementedError(
                 "KV injection over a quantized cache is not supported yet"
             )
+        if self.config.pp > 1:
+            raise NotImplementedError(
+                "KV injection into a stage-sharded (pp>1) cache is not "
+                "supported yet"
+            )
         # validation runs HERE (sync), not at first __anext__: a shape
         # mismatch inside _run_loop would kill the engine for all traffic,
         # not just this request (version-skewed prefill peer)
@@ -863,6 +955,11 @@ class LLMEngine:
             raise NotImplementedError(
                 "detached prefill (P/D transfer) over a quantized KV cache "
                 "is not supported yet"
+            )
+        if self.config.pp > 1:
+            raise NotImplementedError(
+                "detached prefill (P/D transfer) from a stage-sharded "
+                "(pp>1) cache is not supported yet"
             )
         if params.logprobs is not None:
             # the P/D wire format carries (kv, first_token) only; the decode
@@ -1284,6 +1381,11 @@ class LLMEngine:
         attending to the cached history (ops/attention.py
         chunked_prefill_attention).  Unblocks prompts up to max_model_len
         without sequence parallelism."""
+        if self.config.pp > 1:
+            raise NotImplementedError(
+                "chunked prefill has no pipeline-parallel variant yet; "
+                "raise max_prefill_len to cover the prompt or use tp"
+            )
         idx = self._free_slot_index()
         if idx is None:
             return False

@@ -486,6 +486,119 @@ def decode_step(
     return _logits(params, x, config)[:, 0], new_pages
 
 
+# ---------------- pipeline-parallel execution (engine pp > 1) ----------------
+
+
+def stack_layer_params(params: Params) -> Params:
+    """Per-layer list -> stacked pytree with leading layer axis (sharded
+    over the pipe mesh axis by parallel/sharding.stacked_layer_pspecs)."""
+    out = dict(params)
+    out["layers"] = jax.tree.map(lambda *xs: jnp.stack(xs), *params["layers"])
+    return out
+
+
+def _pp_prefill_block(config: LlamaConfig, page_size: int):
+    """One transformer block + prompt-KV scatter as a pipeline block_fn.
+    Invalid (warm-up/drain) microbatches write to the null page (page 0)."""
+
+    def block_fn(layer, pages_l, x, aux, valid):
+        B, T = x.shape[0], x.shape[1]
+        positions = jnp.broadcast_to(
+            jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+        valid_len = aux["valid_len"]
+        x_out, k, v = transformer_block(
+            layer, x, positions, valid_len, config)
+        page_ids = jnp.where(valid, aux["page_ids"], 0)
+        pages_l = write_prompt_kv_batch(
+            pages_l, k, v, page_ids, valid_len, page_size)
+        return x_out, pages_l
+
+    return block_fn
+
+
+def _pp_decode_block(config: LlamaConfig, page_size: int):
+    """One decode step per sequence against this stage's paged cache.
+    `live` folds in the pipeline validity mask, so warm-up/drain steps
+    append to the null page and read zero-length sequences."""
+
+    def block_fn(layer, pages_l, x, aux, valid):
+        B = x.shape[0]
+        pos, page_table = aux["pos"], aux["page_table"]
+        live = aux["live"] & valid
+        positions = pos[:, None]
+        residual = x
+        h = rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
+        q, k, v = _qkv(layer, h, config)
+        q = apply_rope(q, positions, config.rope_theta, config.rope_scaling)
+        k = apply_rope(k, positions, config.rope_theta, config.rope_scaling)
+        pages_l = append_token_kv(
+            pages_l, k[:, 0], v[:, 0], page_table, pos, live, page_size)
+        seq_lens = jnp.where(live, pos + 1, 0)
+        attn = paged_attention(
+            q[:, 0], pages_l, page_table, seq_lens,
+            logit_softcap=config.logit_softcap, use_pallas=False,
+        )
+        attn_flat = attn.reshape(B, 1, -1)
+        x = residual + dense(attn_flat, layer["wo"])
+        residual = x
+        h = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
+        return residual + _mlp(layer, h, config), pages_l
+
+    return block_fn
+
+
+def prefill_pp(
+    params: Params,
+    config: LlamaConfig,
+    tokens: jnp.ndarray,  # [B, T]
+    valid_len: jnp.ndarray,  # [B]
+    kv_pages: jnp.ndarray,  # stacked [L, num_pages, 2, nkv, ps, d]
+    page_ids: jnp.ndarray,  # [B, max_pages]
+    page_size: int,
+    mesh,
+    n_microbatches: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pipeline-parallel prefill: params["layers"] is the stacked pytree,
+    stages stream microbatches GPipe-style (parallel/pipeline.py).
+    Embedding and logits run pipe-replicated outside the staged stack."""
+    from ..parallel.pipeline import pipeline_blocks
+
+    B = tokens.shape[0]
+    x = embed_lookup(params["embed"], tokens, jnp.dtype(config.dtype))
+    aux = {"valid_len": valid_len, "page_ids": page_ids}
+    x, new_pages = pipeline_blocks(
+        params["layers"], kv_pages, x, aux,
+        _pp_prefill_block(config, page_size), mesh, n_microbatches,
+    )
+    last = jnp.maximum(valid_len - 1, 0)
+    x_last = x[jnp.arange(B), last]
+    return _logits(params, x_last[:, None], config)[:, 0], new_pages
+
+
+def decode_step_pp(
+    params: Params,
+    config: LlamaConfig,
+    tokens: jnp.ndarray,  # [B]
+    pos: jnp.ndarray,  # [B]
+    kv_pages: jnp.ndarray,  # stacked [L, ...]
+    page_table: jnp.ndarray,  # [B, max_pages]
+    active: jnp.ndarray,  # [B] bool
+    page_size: int,
+    mesh,
+    n_microbatches: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pipeline-parallel decode step (engine pp>1)."""
+    from ..parallel.pipeline import pipeline_blocks
+
+    x = embed_lookup(params["embed"], tokens, jnp.dtype(config.dtype))[:, None, :]
+    aux = {"pos": pos, "page_table": page_table, "live": active}
+    x, new_pages = pipeline_blocks(
+        params["layers"], kv_pages, x, aux,
+        _pp_decode_block(config, page_size), mesh, n_microbatches,
+    )
+    return _logits(params, x, config)[:, 0], new_pages
+
+
 # ---------------- HF checkpoint loading ----------------
 
 _HF_LAYER_MAP = {

@@ -99,6 +99,104 @@ def _pipeline_local(
     return jax.lax.psum(outs, axis_name)
 
 
+def _pipeline_local_stateful(
+    stacked_local,  # pytree, leading axis = L/S local layers
+    local_pages,  # [L/S, num_pages, 2, nkv, ps, d] this stage's KV
+    mbs_x: jnp.ndarray,  # [M, mb, ...] microbatched activations
+    mbs_aux,  # pytree of [M, mb, ...] per-row tensors riding with each mb
+    block_fn,  # (layer, pages_l, x, aux, valid) -> (x_out, pages_l_new)
+    axis_name: str,
+    S: int,
+):
+    """GPipe schedule with per-stage KV state.  Unlike _pipeline_local,
+    each microbatch's aux (positions, page tables, live masks) must TRAVEL
+    with its activations through the ppermute ring — stage s at step t is
+    processing microbatch t-s, so indexing aux by t would feed it a later
+    microbatch's page tables.  `valid` (0 <= t-s < M) tells block_fn to
+    mask KV writes (null page / live=False) during warm-up/drain."""
+    stage = jax.lax.axis_index(axis_name)
+    M = mbs_x.shape[0]
+
+    def run_stage(x, pages, aux, valid):
+        def body(h, inp):
+            layer, pages_l = inp
+            h, pages_l = block_fn(layer, pages_l, h, aux, valid)
+            return h, pages_l
+
+        out, new_pages = jax.lax.scan(body, x, (stacked_local, pages))
+        return out, new_pages
+
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def step(carry, t):
+        buf_x, buf_aux, pages = carry
+        m = t - stage
+        valid = (m >= 0) & (m < M)
+        idx = jnp.clip(t, 0, M - 1)
+        x_in = jnp.where(stage == 0, mbs_x[idx], buf_x)
+        aux_in = jax.tree.map(
+            lambda mb_a, buf_a: jnp.where(stage == 0, mb_a[idx], buf_a),
+            mbs_aux, buf_aux,
+        )
+        y, pages = run_stage(x_in, pages, aux_in, valid)
+        buf_x_next = jax.lax.ppermute(y, axis_name, perm)
+        buf_aux_next = jax.tree.map(
+            lambda a: jax.lax.ppermute(a, axis_name, perm), aux_in
+        )
+        out = jnp.where(stage == S - 1, y, jnp.zeros_like(y))
+        return (buf_x_next, buf_aux_next, pages), out
+
+    steps = M + S - 1
+    carry0 = (
+        jnp.zeros_like(mbs_x[0]),
+        jax.tree.map(lambda a: jnp.zeros_like(a[0]), mbs_aux),
+        local_pages,
+    )
+    (_, _, pages_final), outs = jax.lax.scan(step, carry0, jnp.arange(steps))
+    outs = outs[S - 1:]
+    return jax.lax.psum(outs, axis_name), pages_final
+
+
+def pipeline_blocks(
+    stacked_layers,  # pytree with leading axis L, sharded P(pipe)
+    stacked_pages: jnp.ndarray,  # [L, num_pages, 2, nkv, ps, d], P(pipe)
+    x: jnp.ndarray,  # [B, ...] activations after embedding (pipe-replicated)
+    aux,  # pytree of [B, ...] tensors each microbatch carries
+    block_fn,
+    mesh: Mesh,
+    n_microbatches: int,
+    axis_name: str = PIPE_AXIS,
+):
+    """Stage-sharded transformer stack WITH paged-KV state: the serving
+    engine's pipeline-parallel execution path (engine pp>1).  Returns
+    ([B, ...] outputs replicated over pipe, updated stacked pages)."""
+    from jax import shard_map
+
+    B = x.shape[0]
+    if B % n_microbatches != 0:
+        raise ValueError(
+            f"batch {B} not divisible by {n_microbatches} microbatches")
+    S = mesh.shape[axis_name]
+    mb = B // n_microbatches
+    mbs_x = x.reshape((n_microbatches, mb) + x.shape[1:])
+    mbs_aux = jax.tree.map(
+        lambda a: a.reshape((n_microbatches, mb) + a.shape[1:]), aux
+    )
+    layer_spec = jax.tree.map(lambda _: P(axis_name), stacked_layers)
+    fn = shard_map(
+        partial(_pipeline_local_stateful, block_fn=block_fn,
+                axis_name=axis_name, S=S),
+        mesh=mesh,
+        in_specs=(layer_spec, P(axis_name), P(), jax.tree.map(
+            lambda _: P(), mbs_aux)),
+        out_specs=(P(), P(axis_name)),
+        axis_names={axis_name},
+        check_vma=False,
+    )
+    outs, new_pages = fn(stacked_layers, stacked_pages, mbs_x, mbs_aux)
+    return outs.reshape((B,) + outs.shape[2:]), new_pages
+
+
 def llama_block_layer_fn(config):
     """One full llama transformer block (prefill form, no KV cache) as a
     pipeline `layer_fn` — delegates to llama.transformer_block, the single

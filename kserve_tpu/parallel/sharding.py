@@ -26,22 +26,28 @@ from ..models.llama import LlamaConfig
 
 DATA_AXIS = "data"
 SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
 MODEL_AXIS = "model"
 
 
 def create_mesh(
-    tp: int = 1, dp: int = 1, sp: int = 1, devices: Optional[list] = None
+    tp: int = 1, dp: int = 1, sp: int = 1, pp: int = 1,
+    devices: Optional[list] = None,
 ) -> Mesh:
-    """(dp, sp, tp) mesh. TP should map to ICI-adjacent devices: jax device
-    order within a slice is topology-contiguous, so tp is the fastest-varying
-    axis; the seq axis (ring-attention sequence parallelism) sits between so
-    its ppermute neighbours are also ICI-adjacent."""
+    """(dp, sp, pp, tp) mesh. TP should map to ICI-adjacent devices: jax
+    device order within a slice is topology-contiguous, so tp is the
+    fastest-varying axis; pipe sits just outside it so each stage's tp
+    group is contiguous and the stage->stage ppermute hop is one step (or
+    crosses DCN exactly once between pods); the seq axis (ring-attention
+    sequence parallelism) sits outside pipe."""
     devices = devices if devices is not None else jax.devices()
-    need = tp * dp * sp
+    need = tp * dp * sp * pp
     if need > len(devices):
-        raise ValueError(f"mesh {dp}x{sp}x{tp} needs {need} devices, have {len(devices)}")
-    grid = np.asarray(devices[:need]).reshape(dp, sp, tp)
-    return Mesh(grid, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
+        raise ValueError(
+            f"mesh {dp}x{sp}x{pp}x{tp} needs {need} devices, have {len(devices)}"
+        )
+    grid = np.asarray(devices[:need]).reshape(dp, sp, pp, tp)
+    return Mesh(grid, (DATA_AXIS, SEQ_AXIS, PIPE_AXIS, MODEL_AXIS))
 
 
 def validate_tp(config: LlamaConfig, tp: int) -> None:
@@ -97,6 +103,19 @@ def param_pspecs(config: LlamaConfig) -> Dict[str, Any]:
 def kv_pages_pspec() -> P:
     """[num_pages, 2, n_kv, ps, d] — shard KV heads over model axis."""
     return P(None, None, MODEL_AXIS, None, None)
+
+
+def stacked_kv_pages_pspec() -> P:
+    """[L, num_pages, 2, n_kv, ps, d] — pipeline mode: the layer axis
+    shards over pipe (each stage holds its own layers' KV)."""
+    return P(PIPE_AXIS, None, None, None, None, None)
+
+
+def stacked_layer_pspecs(stacked_layers) -> dict:
+    """Spec pytree for PP-stacked layer params: every leaf gains the pipe
+    axis on dim 0 (weights stay tp-unsharded in pp mode — pp requires
+    tp==1 today)."""
+    return jax.tree.map(lambda _: P(PIPE_AXIS), stacked_layers)
 
 
 def _expand_quant_specs(p, s, key=None):

@@ -50,9 +50,11 @@ struct Options {
   bool enable_logger = false;
   std::string log_url;           // http://collector/ OR file:///dir (blob sink)
   std::string log_mode = "all";  // all | request | response
-  std::string log_format = "json";   // json | csv (file sink marshaller)
+  std::string log_format = "json";   // json | csv | parquet (file sink)
   int log_batch_size = 16;           // events per flushed file
   int log_flush_interval_ms = 2000;  // partial-batch flush deadline
+  // immediate | size | timed | hybrid (reference batch_*.go strategies)
+  std::string log_batch_strategy = "hybrid";
   // qpext parity (qpext/cmd/qpext/main.go ScrapeConfigurations): extra
   // "port:path" scrape targets merged into /metrics alongside the
   // component's own /metrics and the agent counters
@@ -490,6 +492,184 @@ std::string csv_escape(const std::string& s) {
   return out;
 }
 
+// ------------------------- minimal parquet writer -------------------------
+// Single row group, PLAIN encoding, uncompressed, required flat columns
+// (id INT64; type/path/payload UTF8).  Parity: the reference's parquet
+// marshaller (pkg/logger/marshaller_parquet.go) — here written against the
+// parquet-format spec directly (thrift compact protocol footer) so the
+// sidecar stays dependency-free.
+namespace pq {
+
+// thrift compact primitives
+void varint(std::string* out, uint64_t v) {
+  while (v >= 0x80) {
+    out->push_back(static_cast<char>(v | 0x80));
+    v >>= 7;
+  }
+  out->push_back(static_cast<char>(v));
+}
+uint64_t zigzag(int64_t v) {
+  return (static_cast<uint64_t>(v) << 1) ^ static_cast<uint64_t>(v >> 63);
+}
+// field header: delta-encoded id + wire type (I32=5, I64=6, BINARY=8,
+// LIST=9, STRUCT=12)
+void field(std::string* out, int* last, int id, int type) {
+  int delta = id - *last;
+  if (delta > 0 && delta <= 15) {
+    out->push_back(static_cast<char>((delta << 4) | type));
+  } else {
+    out->push_back(static_cast<char>(type));
+    varint(out, zigzag(id));
+  }
+  *last = id;
+}
+void wi32(std::string* out, int* last, int id, int64_t v) {
+  field(out, last, id, 5);
+  varint(out, zigzag(v));
+}
+void wi64(std::string* out, int* last, int id, int64_t v) {
+  field(out, last, id, 6);
+  varint(out, zigzag(v));
+}
+void wstr(std::string* out, int* last, int id, const std::string& s) {
+  field(out, last, id, 8);
+  varint(out, s.size());
+  out->append(s);
+}
+void wlist(std::string* out, int* last, int id, int elem_type, size_t n) {
+  field(out, last, id, 9);
+  if (n < 15) {
+    out->push_back(static_cast<char>((n << 4) | elem_type));
+  } else {
+    out->push_back(static_cast<char>(0xF0 | elem_type));
+    varint(out, n);
+  }
+}
+void endstruct(std::string* out) { out->push_back(0); }
+
+constexpr int kInt64 = 2;      // parquet Type
+constexpr int kByteArray = 6;  // parquet Type
+
+// SchemaElement: 1 type, 3 repetition (0=REQUIRED), 4 name,
+// 5 num_children, 6 converted_type (0=UTF8)
+std::string schema_element(const std::string& name, int type, bool utf8,
+                           int num_children) {
+  std::string s;
+  int last = 0;
+  if (num_children == 0) {
+    wi32(&s, &last, 1, type);
+    wi32(&s, &last, 3, 0);
+  }
+  wstr(&s, &last, 4, name);
+  if (num_children > 0) wi32(&s, &last, 5, num_children);
+  if (utf8) wi32(&s, &last, 6, 0);
+  endstruct(&s);
+  return s;
+}
+
+// PageHeader: 1 type (0=DATA_PAGE), 2/3 sizes, 5 DataPageHeader{num_values,
+// encoding PLAIN=0, def/rep level encodings RLE=3}
+std::string page_header(int num_values, size_t size) {
+  std::string h;
+  int last = 0;
+  wi32(&h, &last, 1, 0);
+  wi32(&h, &last, 2, static_cast<int64_t>(size));
+  wi32(&h, &last, 3, static_cast<int64_t>(size));
+  field(&h, &last, 5, 12);
+  {
+    std::string d;
+    int l2 = 0;
+    wi32(&d, &l2, 1, num_values);
+    wi32(&d, &l2, 2, 0);
+    wi32(&d, &l2, 3, 3);
+    wi32(&d, &l2, 4, 3);
+    endstruct(&d);
+    h += d;
+  }
+  endstruct(&h);
+  return h;
+}
+
+struct Column {
+  std::string name;
+  int type;            // kInt64 | kByteArray
+  std::string data;    // PLAIN-encoded values
+  size_t page_offset = 0;
+  size_t total_size = 0;
+};
+
+void put_le32(std::string* out, uint32_t v) {
+  for (int i = 0; i < 4; i++) out->push_back(static_cast<char>(v >> (8 * i)));
+}
+void put_le64(std::string* out, uint64_t v) {
+  for (int i = 0; i < 8; i++) out->push_back(static_cast<char>(v >> (8 * i)));
+}
+
+std::string write_file(std::vector<Column>& cols, int64_t num_rows) {
+  std::string body = "PAR1";
+  for (auto& c : cols) {
+    c.page_offset = body.size();
+    std::string header = page_header(static_cast<int>(num_rows), c.data.size());
+    body += header;
+    body += c.data;
+    c.total_size = header.size() + c.data.size();
+  }
+  // FileMetaData: 1 version, 2 schema, 3 num_rows, 4 row_groups
+  std::string f;
+  int last = 0;
+  wi32(&f, &last, 1, 1);
+  wlist(&f, &last, 2, 12, cols.size() + 1);
+  f += schema_element("schema", 0, false, static_cast<int>(cols.size()));
+  for (const auto& c : cols)
+    f += schema_element(c.name, c.type, c.type == kByteArray, 0);
+  wi64(&f, &last, 3, num_rows);
+  wlist(&f, &last, 4, 12, 1);
+  {
+    std::string rg;
+    int lr = 0;
+    wlist(&rg, &lr, 1, 12, cols.size());
+    int64_t total = 0;
+    for (const auto& c : cols) {
+      // ColumnChunk: 2 file_offset, 3 ColumnMetaData
+      std::string cc;
+      int lc = 0;
+      wi64(&cc, &lc, 2, static_cast<int64_t>(c.page_offset));
+      field(&cc, &lc, 3, 12);
+      {
+        std::string m;
+        int lm = 0;
+        wi32(&m, &lm, 1, c.type);
+        wlist(&m, &lm, 2, 5, 1);
+        varint(&m, zigzag(0));  // encodings: [PLAIN]
+        wlist(&m, &lm, 3, 8, 1);
+        varint(&m, c.name.size());
+        m += c.name;  // path_in_schema
+        wi32(&m, &lm, 4, 0);  // codec: UNCOMPRESSED
+        wi64(&m, &lm, 5, num_rows);
+        wi64(&m, &lm, 6, static_cast<int64_t>(c.total_size));
+        wi64(&m, &lm, 7, static_cast<int64_t>(c.total_size));
+        wi64(&m, &lm, 9, static_cast<int64_t>(c.page_offset));
+        endstruct(&m);
+        cc += m;
+      }
+      endstruct(&cc);
+      rg += cc;
+      total += static_cast<int64_t>(c.total_size);
+    }
+    wi64(&rg, &lr, 2, total);
+    wi64(&rg, &lr, 3, num_rows);
+    endstruct(&rg);
+    f += rg;
+  }
+  endstruct(&f);
+  body += f;
+  put_le32(&body, static_cast<uint32_t>(f.size()));
+  body += "PAR1";
+  return body;
+}
+
+}  // namespace pq
+
 class PayloadLogger {
  public:
   // true on success; a sink dir we cannot create must fail startup loudly
@@ -559,22 +739,37 @@ class PayloadLogger {
   }
 
   // blob-store sink (reference pkg/logger/store.go:82-125 +
-  // marshaller_{json,csv}.go): events buffer into batches and each batch
-  // is written as one file (json-lines or csv) under the file:// dir —
-  // in-cluster, that dir is a mounted bucket/PVC
+  // marshaller_{json,csv,parquet}.go, batch_{immediate,size,timed}.go):
+  // events buffer per the configured strategy and each batch is written
+  // as one file under the file:// dir — in-cluster, a mounted bucket/PVC.
+  //   immediate: one file per event (no buffering)
+  //   size:      flush only on a full batch
+  //   timed:     flush on the interval, whatever has arrived
+  //   hybrid:    size OR interval, whichever first (default)
   void run_file_sink() {
+    const std::string& strat = g_opts.log_batch_strategy;
+    const bool immediate = strat == "immediate";
+    const bool by_size = strat == "size" || strat == "hybrid";
+    const bool by_time = strat == "timed" || strat == "hybrid";
+    const int batch_limit = immediate ? 1 : g_opts.log_batch_size;
     std::vector<LogEvent> batch;
     for (;;) {
       {
         std::unique_lock<std::mutex> lk(mu_);
-        cv_.wait_for(lk,
-                     std::chrono::milliseconds(g_opts.log_flush_interval_ms),
-                     [this] {
-                       return static_cast<int>(queue_.size()) >=
-                              g_opts.log_batch_size;
-                     });
+        auto full = [&] {
+          return static_cast<int>(queue_.size()) >= batch_limit;
+        };
+        if (immediate) {
+          cv_.wait(lk, [&] { return !queue_.empty(); });
+        } else if (by_time) {
+          cv_.wait_for(
+              lk, std::chrono::milliseconds(g_opts.log_flush_interval_ms),
+              [&] { return by_size && full(); });
+        } else {  // size-only: wait for a full batch, no deadline
+          cv_.wait(lk, full);
+        }
         while (!queue_.empty() &&
-               static_cast<int>(batch.size()) < g_opts.log_batch_size) {
+               static_cast<int>(batch.size()) < batch_limit) {
           batch.push_back(std::move(queue_.front()));
           queue_.pop_front();
         }
@@ -587,7 +782,9 @@ class PayloadLogger {
   }
 
   void write_batch(const std::vector<LogEvent>& batch) {
-    const bool csv = g_opts.log_format == "csv";
+    const std::string& fmt = g_opts.log_format;
+    const char* ext = fmt == "csv" ? ".csv"
+                      : fmt == "parquet" ? ".parquet" : ".jsonl";
     // filename carries wall time + pid: the sink dir persists across agent
     // restarts and replicas (mounted bucket/PVC), so a process-local
     // sequence alone would overwrite earlier batches
@@ -596,19 +793,35 @@ class PayloadLogger {
                       .count();
     std::ostringstream name;
     name << dir_ << "/payloads-" << now_ms << "-" << ::getpid() << "-"
-         << batch.front().id << "-" << batch.back().id
-         << (csv ? ".csv" : ".jsonl");
-    std::ofstream out(name.str());
+         << batch.front().id << "-" << batch.back().id << ext;
+    std::ofstream out(name.str(), std::ios::binary);
     if (!out) {
       std::cerr << "[agent] cannot write log batch to " << name.str() << "\n";
       return;
     }
-    if (csv) {
+    if (fmt == "csv") {
       out << "id,type,path,payload\n";
       for (const auto& e : batch) {
         out << e.id << "," << e.type << "," << csv_escape(e.path) << ","
             << csv_escape(e.payload) << "\n";
       }
+    } else if (fmt == "parquet") {
+      std::vector<pq::Column> cols(4);
+      cols[0] = {"id", pq::kInt64, "", 0, 0};
+      cols[1] = {"type", pq::kByteArray, "", 0, 0};
+      cols[2] = {"path", pq::kByteArray, "", 0, 0};
+      cols[3] = {"payload", pq::kByteArray, "", 0, 0};
+      auto put_str = [](std::string* data, const std::string& s) {
+        pq::put_le32(data, static_cast<uint32_t>(s.size()));
+        data->append(s);
+      };
+      for (const auto& e : batch) {
+        pq::put_le64(&cols[0].data, e.id);
+        put_str(&cols[1].data, e.type);
+        put_str(&cols[2].data, e.path);
+        put_str(&cols[3].data, e.payload);
+      }
+      out << pq::write_file(cols, static_cast<int64_t>(batch.size()));
     } else {
       for (const auto& e : batch) out << make_cloudevent(e) << "\n";
     }
@@ -931,6 +1144,7 @@ int main(int argc, char** argv) {
     else if (arg == "--log-format") g_opts.log_format = next();
     else if (arg == "--log-batch-size") g_opts.log_batch_size = std::stoi(next());
     else if (arg == "--log-flush-interval") g_opts.log_flush_interval_ms = std::stoi(next());
+    else if (arg == "--log-batch-strategy") g_opts.log_batch_strategy = next();
     else if (arg == "--metrics-targets") g_opts.metrics_targets = next();
     else {
       std::cerr << "unknown flag: " << arg << "\n";
