@@ -37,6 +37,21 @@ from jax.sharding import Mesh, PartitionSpec as P
 PIPE_AXIS = "pipe"
 
 
+def _psum_last_stage(outs: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Broadcast the last stage's outputs to every stage.  `outs` is zero
+    everywhere except stage S-1, so the psum is exact in any dtype — but
+    XLA's SPMD partitioner (CPU backend, jax 0.9) hits a fatal
+    "Invalid binary instruction opcode copy" building a sub-f32 all-reduce
+    inside a partial-auto shard_map over a multi-axis mesh.  Reducing in
+    f32 sidesteps the crash and is bit-identical (x + 0.0 round-trips
+    exactly through the widen/narrow).  CPU-only: on TPU the sub-f32
+    all-reduce partitions fine and the upcast would double the
+    stage-broadcast bytes on the hot path."""
+    if outs.dtype == jnp.float32 or jax.default_backend() != "cpu":
+        return jax.lax.psum(outs, axis_name)
+    return jax.lax.psum(outs.astype(jnp.float32), axis_name).astype(outs.dtype)
+
+
 def create_pp_mesh(pp: int, devices=None) -> Mesh:
     """A (pipe,) mesh.  Stages should map contiguously onto the device
     order so the ppermute hop is ICI-adjacent (or crosses DCN exactly once
@@ -96,7 +111,7 @@ def _pipeline_local(
     # warm-up garbage
     outs = outs[S - 1:]
     # broadcast the last stage's outputs to every device (replicated out)
-    return jax.lax.psum(outs, axis_name)
+    return _psum_last_stage(outs, axis_name)
 
 
 def _pipeline_local_stateful(
@@ -154,7 +169,7 @@ def _pipeline_local_stateful(
     )
     (_, _, pages_final), outs = jax.lax.scan(step, carry0, jnp.arange(steps))
     outs = outs[S - 1:]
-    return jax.lax.psum(outs, axis_name), pages_final
+    return _psum_last_stage(outs, axis_name), pages_final
 
 
 def pipeline_blocks(

@@ -107,15 +107,19 @@ def kv_pages_pspec() -> P:
 
 def stacked_kv_pages_pspec() -> P:
     """[L, num_pages, 2, n_kv, ps, d] — pipeline mode: the layer axis
-    shards over pipe (each stage holds its own layers' KV)."""
-    return P(PIPE_AXIS, None, None, None, None, None)
+    shards over pipe (each stage holds its own layers' KV) and the KV-head
+    axis over model, so pp composes with tp without resharding."""
+    return P(PIPE_AXIS, None, None, MODEL_AXIS, None, None)
 
 
-def stacked_layer_pspecs(stacked_layers) -> dict:
-    """Spec pytree for PP-stacked layer params: every leaf gains the pipe
-    axis on dim 0 (weights stay tp-unsharded in pp mode — pp requires
-    tp==1 today)."""
-    return jax.tree.map(lambda _: P(PIPE_AXIS), stacked_layers)
+def stacked_layer_pspecs(config: LlamaConfig) -> dict:
+    """Spec pytree for PP-stacked layer params: each leaf takes its
+    megatron TP spec from param_pspecs with the pipe axis prepended on the
+    new leading layer dim — so pp>1 composes with tp>1 (the pipeline
+    shard_map is manual over `pipe` only; XLA inserts the TP collectives
+    inside each stage as it does for pp==1)."""
+    layer_specs = param_pspecs(config)["layers"][0]
+    return {k: P(PIPE_AXIS, *spec) for k, spec in layer_specs.items()}
 
 
 def _expand_quant_specs(p, s, key=None):

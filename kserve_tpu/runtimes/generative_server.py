@@ -521,6 +521,11 @@ def main(argv=None):
     parser.add_argument("--data_parallel_size", "--dp", default=1, type=int)
     parser.add_argument("--sequence_parallel_size", "--sp", default=1, type=int)
     parser.add_argument(
+        "--pipeline_parallel_size", "--pp", default=1, type=int,
+        help="layer stages over the pipe mesh axis (composes with --tp; "
+        "for models beyond one slice's HBM — within a slice prefer --tp)",
+    )
+    parser.add_argument(
         "--role", default="both", choices=("both", "prefill", "decode"),
         help="P/D disaggregation role; decode needs --prefill_url",
     )
@@ -557,6 +562,7 @@ def main(argv=None):
         tp=args.tensor_parallel_size,
         dp=args.data_parallel_size,
         sp=args.sequence_parallel_size,
+        pp=args.pipeline_parallel_size,
         dtype=args.kv_dtype,
         kv_quant=args.kv_quant,
         weight_quant=args.weight_quant,
