@@ -46,7 +46,9 @@ class RouteIntent:
     # STRIPPED (each synthesizer adds its rewrite mechanism) and the
     # explainer :explain split is host-only — no core routing API can both
     # regex-match and prefix-strip, so prefix-mode explainer traffic uses
-    # the explainer's own host.
+    # the explainer's own host (every backend: vanilla Ingress gets a
+    # second host rule, Istio an authority-matched route, Gateway-API a
+    # companion HTTPRoute since hostnames are route-wide).
     path_prefix: str = ""
     # IngressClass for the vanilla backend (cluster-dependent: nginx,
     # traefik, gce, ...)
@@ -68,13 +70,15 @@ def render_path(template: str, name: str, namespace: str) -> str:
     return template.format(name=name, namespace=namespace).rstrip("/")
 
 
-def synthesize(ingress_class: str, intent: RouteIntent) -> dict:
+def synthesize(ingress_class: str, intent: RouteIntent) -> List[dict]:
+    """All routing objects for the intent (usually one; Gateway-API emits a
+    companion explainer-host HTTPRoute in path-prefix mode)."""
     if ingress_class == GATEWAY_API:
         return gateway_httproute(intent)
     if ingress_class == ISTIO:
-        return istio_virtualservice(intent)
+        return [istio_virtualservice(intent)]
     if ingress_class == KUBE_INGRESS:
-        return kube_ingress(intent)
+        return [kube_ingress(intent)]
     raise ValueError(
         f"unknown ingress class {ingress_class!r}; expected one of "
         f"{INGRESS_CLASSES}"
@@ -85,7 +89,7 @@ def _prefix(intent: RouteIntent) -> str:
     return intent.path_prefix or ""
 
 
-def gateway_httproute(intent: RouteIntent) -> dict:
+def gateway_httproute(intent: RouteIntent) -> List[dict]:
     backend_refs = [
         {"name": svc, "port": 80, **({"weight": w} if w is not None else {})}
         for svc, w in intent.backends
@@ -112,11 +116,30 @@ def gateway_httproute(intent: RouteIntent) -> dict:
             }}],
             "backendRefs": [{"name": intent.explainer_backend, "port": 80}],
         })
-    return make_object(
+    objects = [make_object(
         "gateway.networking.k8s.io/v1", "HTTPRoute", intent.name,
         intent.namespace, labels=dict(intent.labels),
         spec={"hostnames": [intent.host], "rules": rules},
-    )
+    )]
+    if intent.explainer_backend and prefix and intent.explainer_host:
+        # prefix mode: :explain cannot regex-match AND prefix-strip on the
+        # shared host, so the explainer rides its own host — HTTPRoute
+        # hostnames are route-wide, hence a companion route
+        objects.append(make_object(
+            "gateway.networking.k8s.io/v1", "HTTPRoute",
+            f"{intent.name}-explainer", intent.namespace,
+            labels=dict(intent.labels),
+            spec={
+                "hostnames": [intent.explainer_host],
+                "rules": [{
+                    "matches": [{"path": {
+                        "type": "PathPrefix", "value": "/"}}],
+                    "backendRefs": [
+                        {"name": intent.explainer_backend, "port": 80}],
+                }],
+            },
+        ))
+    return objects
 
 
 def istio_virtualservice(intent: RouteIntent) -> dict:
@@ -133,10 +156,19 @@ def istio_virtualservice(intent: RouteIntent) -> dict:
         return d
 
     prefix = _prefix(intent)
+    hosts = [intent.host]
     http = []
     if intent.explainer_backend and not prefix:
         http.append({
             "match": [{"uri": {"regex": EXPLAIN_PATH_REGEX}}],
+            "route": [dest(intent.explainer_backend, None)],
+        })
+    elif intent.explainer_backend and prefix and intent.explainer_host:
+        # prefix mode: the explainer rides its own host (see RouteIntent);
+        # an authority match splits it inside the one VirtualService
+        hosts.append(intent.explainer_host)
+        http.append({
+            "match": [{"authority": {"exact": intent.explainer_host}}],
             "route": [dest(intent.explainer_backend, None)],
         })
     entry = {"route": [dest(svc, w) for svc, w in intent.backends]}
@@ -150,7 +182,7 @@ def istio_virtualservice(intent: RouteIntent) -> dict:
         "networking.istio.io/v1beta1", "VirtualService", intent.name,
         intent.namespace, labels=dict(intent.labels),
         spec={
-            "hosts": [intent.host],
+            "hosts": hosts,
             "gateways": ["knative-serving/knative-ingress-gateway",
                          "mesh"],
             "http": http,

@@ -69,7 +69,7 @@ class LLMISVCReconciler:
 
         if spec.router is not None:
             objects.extend(self._scheduler(llm, spec))
-            objects.append(self._route(llm, spec))
+            objects.extend(self._route(llm, spec))
             set_condition(status, "RouterReady", True, reason="Reconciled")
 
         scaler = self._scaling(llm, spec.workload or WorkloadSpec())
@@ -371,7 +371,7 @@ class LLMISVCReconciler:
         )
         return [epp, pool]
 
-    def _route(self, llm, spec) -> dict:
+    def _route(self, llm, spec) -> List[dict]:
         """Routing for the configured ingress backend (controlplane/
         ingress.py — the same three-way dispatch as the ISVC reconciler,
         so a cluster without Gateway-API still routes LLM traffic)."""

@@ -150,7 +150,7 @@ class InferenceServiceReconciler:
             component_urls[component] = url
             set_condition(status, f"{component.capitalize()}Ready", True, reason="Reconciled")
 
-        objects.append(
+        objects.extend(
             self._route(
                 isvc, component_urls,
                 canary_pct=canary_pct, canary_has_stable=canary_has_stable,
@@ -479,8 +479,8 @@ class InferenceServiceReconciler:
     def _route(self, isvc, component_urls: Dict[str, str],
                canary_pct: Optional[int] = None,
                canary_has_stable: bool = False,
-               activator_entries=frozenset()) -> dict:
-        """Routing object for the configured ingress backend (controlplane/
+               activator_entries=frozenset()) -> List[dict]:
+        """Routing objects for the configured ingress backend (controlplane/
         ingress.py: Gateway-API HTTPRoute | Istio VirtualService | vanilla
         Ingress — parity with the reference's three ingress reconcilers).
         Traffic enters at transformer when present, else predictor;

@@ -138,6 +138,7 @@ class EPPServer:
             if k.lower() not in HOP_HEADERS
         }
         url = replica.url + request.rel_url.path_qs
+        out = None
         try:
             async with self._client.request(
                 request.method, url, headers=headers, data=body or None
@@ -157,6 +158,13 @@ class EPPServer:
         except (aiohttp.ClientError, OSError, asyncio.TimeoutError) as exc:
             logger.warning("epp proxy to %s failed: %s", replica.url, exc)
             self.picker.observe_failure(replica.url)
+            if out is not None and out.prepared:
+                # headers already sent: a second response is impossible, so
+                # abort the stream — the client sees the truncation instead
+                # of a confusing handler exception (ADVICE r4)
+                if request.transport is not None:
+                    request.transport.close()
+                return out
             return web.json_response(
                 {"error": f"upstream {replica.url} failed"}, status=502
             )

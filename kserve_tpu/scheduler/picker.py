@@ -176,22 +176,32 @@ class EndpointPicker:
 
     # ---------------- picking ----------------
 
-    def _prefix_hits(self, r: Replica, prompt_ids: Optional[Sequence[int]]) -> int:
+    def _prefix_hits(
+        self,
+        r: Replica,
+        prompt_ids: Optional[Sequence[int]],
+        chains: Dict[int, List[bytes]],
+    ) -> int:
         """Longest leading page run cached on `r`, scored per model so a
-        multi-model replica's page sizes and digest sets never mix."""
+        multi-model replica's page sizes and digest sets never mix.
+        `chains` memoizes the per-page-size digest chain across every
+        replica/model of one pick() — blake2b over the whole prompt is
+        O(prompt_len), so recomputing it per replica would make a pick
+        O(replicas x models x prompt_len) on long prompts (ADVICE r4:
+        setdefault always evaluated its default eagerly, defeating the
+        cache it was meant to be)."""
         if not prompt_ids:
             return 0
         best = 0
-        chains: Dict[int, List[bytes]] = {}
         for page_size, digests in r.models.values():
             if not digests:
                 continue
-            keys = chains.setdefault(
-                page_size,
-                token_prefix_digests(prompt_ids, page_size, for_lookup=True),
-            )
+            if page_size not in chains:
+                chains[page_size] = token_prefix_digests(
+                    prompt_ids, page_size, for_lookup=True
+                )
             hits = 0
-            for key in keys:
+            for key in chains[page_size]:
                 if key not in digests:
                     break
                 hits += 1
@@ -218,9 +228,11 @@ class EndpointPicker:
         if not healthy:
             return None
         scored = []
+        chains: Dict[int, List[bytes]] = {}
         for i, r in enumerate(healthy):
             hits = max(
-                self._prefix_hits(r, prompt_ids), self._text_hits(r, prompt_text)
+                self._prefix_hits(r, prompt_ids, chains),
+                self._text_hits(r, prompt_text),
             )
             score = hits * self.prefix_weight - r.queue_depth * self.queue_weight
             # free pages as a mild tiebreak, round-robin as the final one

@@ -17,6 +17,7 @@
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <signal.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <unistd.h>
@@ -693,12 +694,25 @@ class PayloadLogger {
       }
     }
     worker_ = std::thread([this] { run(); });
-    // the worker loops for the process lifetime; detach so an early exit
-    // path (e.g. bind failure) destroys a non-joinable thread instead of
-    // calling std::terminate (SIGABRT instead of the intended exit code)
-    worker_.detach();
     return true;
   }
+
+  // drain + join: buffered events are flushed, not dropped, and the worker
+  // can no longer race static destruction (ADVICE r4: the detached thread
+  // could touch the queue/ofstream while statics were being destroyed).
+  // Safe on every path — before start() or after a prior stop() the thread
+  // is simply not joinable.
+  void stop() {
+    if (!worker_.joinable()) return;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    worker_.join();
+  }
+
+  ~PayloadLogger() { stop(); }
   void log(const std::string& type, const std::string& path,
            const std::string& payload) {
     if (!g_opts.enable_logger) return;
@@ -730,7 +744,8 @@ class PayloadLogger {
       LogEvent event;
       {
         std::unique_lock<std::mutex> lk(mu_);
-        cv_.wait(lk, [this] { return !queue_.empty(); });
+        cv_.wait(lk, [this] { return !queue_.empty() || stop_; });
+        if (queue_.empty()) return;  // stopping and fully drained
         event = std::move(queue_.front());
         queue_.pop_front();
       }
@@ -754,30 +769,33 @@ class PayloadLogger {
     const int batch_limit = immediate ? 1 : g_opts.log_batch_size;
     std::vector<LogEvent> batch;
     for (;;) {
+      bool draining = false;
       {
         std::unique_lock<std::mutex> lk(mu_);
         auto full = [&] {
           return static_cast<int>(queue_.size()) >= batch_limit;
         };
         if (immediate) {
-          cv_.wait(lk, [&] { return !queue_.empty(); });
+          cv_.wait(lk, [&] { return !queue_.empty() || stop_; });
         } else if (by_time) {
           cv_.wait_for(
               lk, std::chrono::milliseconds(g_opts.log_flush_interval_ms),
-              [&] { return by_size && full(); });
+              [&] { return (by_size && full()) || stop_; });
         } else {  // size-only: wait for a full batch, no deadline
-          cv_.wait(lk, full);
+          cv_.wait(lk, [&] { return full() || stop_; });
         }
         while (!queue_.empty() &&
                static_cast<int>(batch.size()) < batch_limit) {
           batch.push_back(std::move(queue_.front()));
           queue_.pop_front();
         }
+        draining = stop_ && queue_.empty();
       }
       if (!batch.empty()) {
         write_batch(batch);
         batch.clear();
       }
+      if (draining) return;  // stop requested and the queue is flushed
     }
   }
 
@@ -843,6 +861,13 @@ class PayloadLogger {
     int port = colon == std::string::npos ? 80 : std::stoi(hostport.substr(colon + 1));
     int fd = connect_to(host, port);
     if (fd < 0) return;
+    // bounded socket ops: a half-dead collector (accepts, never responds)
+    // must not pin the worker forever — stop() joins this thread, so an
+    // unbounded read here would turn graceful shutdown into a SIGKILL
+    struct timeval tv {};
+    tv.tv_sec = 10;
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
     std::ostringstream req;
     req << "POST " << path << " HTTP/1.1\r\nHost: " << host
         << "\r\nContent-Type: application/cloudevents+json\r\nContent-Length: "
@@ -857,11 +882,20 @@ class PayloadLogger {
   std::condition_variable cv_;
   std::deque<LogEvent> queue_;
   std::thread worker_;
+  bool stop_ = false;  // guarded by mu_
   bool file_sink_ = false;
   std::string dir_;
 };
 
-PayloadLogger g_logger;
+// immortal singleton (intentionally leaked): detached connection threads
+// may still call log() while main returns and statics are destroyed — a
+// static instance's mutex/deque would be destructed under them (UB).  The
+// leaked instance stays valid forever; stop() has already flushed, so
+// post-shutdown events are simply queued and never written.
+PayloadLogger& g_logger = *new PayloadLogger;
+
+// flipped by the SIGTERM/SIGINT handler; the accept loop checks it
+std::atomic<int> g_shutdown{0};
 
 // ---------------------------------------------------------------- batcher
 
@@ -1151,6 +1185,25 @@ int main(int argc, char** argv) {
       return 2;
     }
   }
+  // SIGTERM/SIGINT (pod shutdown) must reach the MAIN thread while it is
+  // parked in pselect() — a process-directed signal may otherwise be
+  // delivered to any thread whose mask allows it, leaving the accept wait
+  // blocked forever.  Block them BEFORE any thread spawns (children
+  // inherit the mask), install the flag-setting handler, and unblock
+  // atomically only inside pselect(): no check-then-block race.
+  struct sigaction sa {};
+  sa.sa_handler = [](int) { g_shutdown.store(1); };
+  ::sigaction(SIGTERM, &sa, nullptr);
+  ::sigaction(SIGINT, &sa, nullptr);
+  sigset_t blocked, orig;
+  sigemptyset(&blocked);
+  sigaddset(&blocked, SIGTERM);
+  sigaddset(&blocked, SIGINT);
+  ::pthread_sigmask(SIG_BLOCK, &blocked, &orig);
+  sigset_t wait_mask = orig;
+  sigdelset(&wait_mask, SIGTERM);
+  sigdelset(&wait_mask, SIGINT);
+
   if (!g_logger.start()) return 1;
 
   int server_fd = ::socket(AF_INET, SOCK_STREAM, 0);
@@ -1169,9 +1222,19 @@ int main(int argc, char** argv) {
             << g_opts.component_host << ":" << g_opts.component_port
             << (g_opts.enable_batcher ? " [batcher]" : "")
             << (g_opts.enable_logger ? " [logger]" : "") << "\n";
-  for (;;) {
+  while (!g_shutdown.load()) {
+    fd_set rfds;
+    FD_ZERO(&rfds);
+    FD_SET(server_fd, &rfds);
+    int n = ::pselect(server_fd + 1, &rfds, nullptr, nullptr, nullptr,
+                      &wait_mask);
+    if (n < 0) continue;  // EINTR: loop re-checks g_shutdown
     int client = ::accept(server_fd, nullptr, nullptr);
     if (client < 0) continue;
     std::thread(handle_connection, client).detach();
   }
+  ::close(server_fd);
+  std::cerr << "[agent] shutting down (flushing logger)\n";
+  g_logger.stop();
+  return 0;
 }

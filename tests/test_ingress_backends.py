@@ -28,7 +28,7 @@ def make_intent(**kw):
 
 class TestSynthesizers:
     def test_gateway_httproute_weighted_canary(self):
-        obj = synthesize("gateway-api", make_intent(
+        (obj,) = synthesize("gateway-api", make_intent(
             backends=[("iris-predictor", 80), ("iris-predictor-canary", 20)],
         ))
         assert obj["kind"] == "HTTPRoute"
@@ -37,7 +37,7 @@ class TestSynthesizers:
             ("iris-predictor", 80), ("iris-predictor-canary", 20)]
 
     def test_istio_virtualservice_weighted_and_explain(self):
-        obj = synthesize("istio", make_intent(
+        (obj,) = synthesize("istio", make_intent(
             backends=[("iris-predictor", 90), ("iris-predictor-canary", 10)],
             explainer_backend="iris-explainer",
         ))
@@ -54,7 +54,7 @@ class TestSynthesizers:
                            ("iris-predictor-canary", 10)]
 
     def test_kube_ingress_hosts(self):
-        obj = synthesize("kubernetes", make_intent(
+        (obj,) = synthesize("kubernetes", make_intent(
             explainer_backend="iris-explainer",
             explainer_host="iris-explainer.default.example.com",
         ))
@@ -66,7 +66,7 @@ class TestSynthesizers:
         assert backend == "iris-predictor"
 
     def test_kube_ingress_canary_serves_majority(self):
-        obj = synthesize("kubernetes", make_intent(
+        (obj,) = synthesize("kubernetes", make_intent(
             backends=[("iris-predictor", 90), ("iris-predictor-canary", 10)],
         ))
         backend = obj["spec"]["rules"][0]["http"]["paths"][0]["backend"]
@@ -79,18 +79,18 @@ class TestSynthesizers:
     def test_path_template_routing_strips_prefix(self):
         prefix = render_path("/serving/{namespace}/{name}", "iris", "default")
         assert prefix == "/serving/default/iris"
-        gw = synthesize("gateway-api", make_intent(path_prefix=prefix))
+        (gw,) = synthesize("gateway-api", make_intent(path_prefix=prefix))
         rule = gw["spec"]["rules"][-1]
         assert rule["matches"][0]["path"]["value"] == prefix
         # the backend serves /v1 at its root: the route must strip
         rewrite = rule["filters"][0]["urlRewrite"]["path"]
         assert rewrite == {"type": "ReplacePrefixMatch",
                            "replacePrefixMatch": "/"}
-        vs = synthesize("istio", make_intent(path_prefix=prefix))
+        (vs,) = synthesize("istio", make_intent(path_prefix=prefix))
         default = vs["spec"]["http"][-1]
         assert default["match"][0]["uri"]["prefix"] == prefix + "/"
         assert default["rewrite"] == {"uri": "/"}
-        ing = synthesize("kubernetes", make_intent(path_prefix=prefix))
+        (ing,) = synthesize("kubernetes", make_intent(path_prefix=prefix))
         path = ing["spec"]["rules"][0]["http"]["paths"][0]
         assert path["path"] == prefix + "(/|$)(.*)"
         assert path["pathType"] == "ImplementationSpecific"
@@ -99,17 +99,33 @@ class TestSynthesizers:
 
     def test_prefix_mode_explainer_is_host_only(self):
         # no routing API can regex-match AND prefix-strip: prefix mode
-        # must not emit an un-stripped explainer rule
+        # must not emit an un-stripped explainer rule on the shared host —
+        # the explainer rides its own host instead (ADVICE r4: previously
+        # HTTPRoute/VS dropped explainer routing entirely in prefix mode)
         prefix = "/serving/default/iris"
-        gw = synthesize("gateway-api", make_intent(
-            path_prefix=prefix, explainer_backend="iris-explainer"))
+        ehost = "iris-explainer.default.example.com"
+        gw, gw_exp = synthesize("gateway-api", make_intent(
+            path_prefix=prefix, explainer_backend="iris-explainer",
+            explainer_host=ehost))
         assert len(gw["spec"]["rules"]) == 1
-        vs = synthesize("istio", make_intent(
+        assert gw_exp["spec"]["hostnames"] == [ehost]
+        ref = gw_exp["spec"]["rules"][0]["backendRefs"][0]
+        assert ref["name"] == "iris-explainer"
+        (vs,) = synthesize("istio", make_intent(
+            path_prefix=prefix, explainer_backend="iris-explainer",
+            explainer_host=ehost))
+        assert vs["spec"]["hosts"] == ["iris.default.example.com", ehost]
+        exp_route, default = vs["spec"]["http"]
+        assert exp_route["match"][0]["authority"]["exact"] == ehost
+        assert exp_route["route"][0]["destination"]["host"].startswith(
+            "iris-explainer.")
+        # without an explainer host there is nothing to route: one rule
+        (gw2,) = synthesize("gateway-api", make_intent(
             path_prefix=prefix, explainer_backend="iris-explainer"))
-        assert len(vs["spec"]["http"]) == 1
+        assert len(gw2["spec"]["rules"]) == 1
 
     def test_kube_ingress_class_name_knob(self):
-        obj = synthesize("kubernetes", make_intent(
+        (obj,) = synthesize("kubernetes", make_intent(
             kube_ingress_class_name="traefik"))
         assert obj["spec"]["ingressClassName"] == "traefik"
 

@@ -184,6 +184,48 @@ async def test_file_sink_jsonl_batching(agent_binary, tmp_path):
 
 
 @async_test
+async def test_sigterm_flushes_buffered_batch(agent_binary, tmp_path):
+    """Graceful shutdown drains the logger: a partial batch (below
+    --log-batch-size, size-only strategy so no timer flush) must be
+    written on SIGTERM, not dropped (ADVICE r4: the detached worker
+    discarded it and could race static destruction)."""
+    backend = _Backend()
+    backend_port = free_port()
+    agent_port = free_port()
+    runner = web.AppRunner(backend.app())
+    await runner.setup()
+    await web.TCPSite(runner, "127.0.0.1", backend_port).start()
+    log_dir = tmp_path / "payloads"
+    proc = subprocess.Popen(
+        [agent_binary, "--port", str(agent_port), "--component_port", str(backend_port),
+         "--enable-logger", "--log-url", f"file://{log_dir}",
+         "--log-batch-size", "100", "--log-batch-strategy", "size"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        await asyncio.sleep(0.3)
+        async with httpx.AsyncClient() as client:
+            r = await client.post(
+                f"http://127.0.0.1:{agent_port}/v1/models/stub:predict",
+                json={"instances": [[7, 7]]}, timeout=10,
+            )
+            assert r.status_code == 200
+        # the 2 events sit buffered (batch of 100 never fills); SIGTERM
+        # must flush them on the way out
+        assert not list(log_dir.glob("payloads-*")), "batch flushed early?"
+        proc.terminate()
+        assert proc.wait(timeout=5) == 0
+        files = sorted(log_dir.glob("payloads-*.jsonl"))
+        assert files, "buffered batch dropped on SIGTERM"
+        events = [json.loads(line) for line in files[0].read_text().splitlines()]
+        assert len(events) == 2
+        assert events[0]["data"]["instances"] == [[7, 7]]
+    finally:
+        proc.terminate()
+        await runner.cleanup()
+
+
+@async_test
 async def test_file_sink_csv_marshaller(agent_binary, tmp_path):
     backend = _Backend()
     backend_port = free_port()
