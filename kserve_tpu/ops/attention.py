@@ -170,6 +170,31 @@ def paged_attention_xla(
 PALLAS_MIN_PAGES = 64
 
 
+def _should_use_pallas(d: int, quantized: bool, table_width: int, batch: int,
+                       backend: str, page_size) -> bool:
+    """The use_pallas=None auto-dispatch predicate (factored out so tests
+    assert the production decision, not a re-inlined copy)."""
+    from .pallas_paged_attention import _pick_sb
+
+    supported_head = (
+        d % 128 == 0
+        # d=64 runs the packed two-tokens-per-row kernel, which needs an
+        # even page_size; auto must fall back to the gather, not raise
+        or (d == 64 and page_size is not None and page_size % 2 == 0)
+    )
+    return (
+        supported_head
+        and not quantized  # kernel reads bf16 pages only (today)
+        and table_width >= PALLAS_MIN_PAGES
+        # a batch with no divisor <= MAX_SB would run the serialized
+        # sb=1 kernel shape, which loses to the gather
+        and _pick_sb(batch) > 1
+        # Mosaic only lowers on TPU; CPU smoke runs of a real model at
+        # long context must take the gather, not fail to compile
+        and backend == "tpu"
+    )
+
+
 def make_sharded_paged_attention(
     mesh,
     logit_softcap: float = 0.0,
@@ -239,15 +264,10 @@ def paged_attention(
     d = q.shape[-1]
     quantized = isinstance(kv_pages, tuple)
     if use_pallas is None:
-        from .pallas_paged_attention import _pick_sb
-
-        use_pallas = (
-            d % 128 == 0
-            and not quantized  # kernel reads bf16 pages only (today)
-            and page_table.shape[1] >= PALLAS_MIN_PAGES
-            # a batch with no divisor <= MAX_SB would run the serialized
-            # sb=1 kernel shape, which loses to the gather
-            and _pick_sb(q.shape[0]) > 1
+        page_size = None if quantized else int(kv_pages.shape[3])
+        use_pallas = _should_use_pallas(
+            d, quantized, int(page_table.shape[1]), int(q.shape[0]),
+            jax.default_backend(), page_size,
         )
     if use_pallas:
         if quantized:

@@ -40,25 +40,73 @@ def assert_paths_match(q, kv, pt, lens, **kwargs):
 
 
 class TestPallasPagedAttention:
+    """d=64 cases run the PACKED kernel (two tokens per 128-lane row —
+    the real Llama-3.2-1B/Qwen head_dim, VERDICT r4 #4); d=128 cases run
+    the main 128-aligned kernel."""
+
     @pytest.mark.parametrize("seed", [0, 1])
-    def test_matches_xla_full_block(self, seed):
+    @pytest.mark.parametrize("d", [64, 128])
+    def test_matches_xla_full_block(self, seed, d):
         # B == MAX_SB: one grid step owns the whole batch
-        assert_paths_match(*make_case(B=8, seed=seed))
+        assert_paths_match(*make_case(B=8, seed=seed, d=d))
 
     @pytest.mark.parametrize("B", [6, 5, 16])
-    def test_matches_xla_other_batches(self, B):
-        assert_paths_match(*make_case(B=B, seed=2))
+    @pytest.mark.parametrize("d", [64, 128])
+    def test_matches_xla_other_batches(self, B, d):
+        assert_paths_match(*make_case(B=B, seed=2, d=d))
 
-    def test_gqa_groups(self):
-        assert_paths_match(*make_case(nq=16, nkv=2))
+    @pytest.mark.parametrize("d", [64, 128])
+    def test_gqa_groups(self, d):
+        assert_paths_match(*make_case(nq=16, nkv=2, d=d))
 
-    def test_single_token_sequence(self):
-        q, kv, pt, _ = make_case()
+    @pytest.mark.parametrize("d", [64, 128])
+    def test_single_token_sequence(self, d):
+        # an odd valid length exercises the packed kernel's parity masking
+        # (the odd half of the last row must be masked out)
+        q, kv, pt, _ = make_case(d=d)
         lens = jnp.ones((q.shape[0],), jnp.int32)
         assert_paths_match(q, kv, pt, lens)
 
-    def test_softcap(self):
-        assert_paths_match(*make_case(), logit_softcap=30.0)
+    @pytest.mark.parametrize("d", [64, 128])
+    def test_softcap(self, d):
+        assert_paths_match(*make_case(d=d), logit_softcap=30.0)
+
+    def test_packed_bf16_cache(self):
+        # production dtype: bf16 pages, f32 accumulate, bf16 out
+        q, kv, pt, lens = make_case(d=64, dtype=jnp.bfloat16)
+        ref = paged_attention_xla(q, kv, pt, lens)
+        got = paged_attention_pallas(q, kv, pt, lens, interpret=True)
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(ref, np.float32),
+            rtol=2e-2, atol=2e-2,
+        )
+
+    def test_packed_requires_even_page_size(self):
+        q, kv, pt, lens = make_case(d=64, ps=7, max_pages=4, num_pages=80)
+        with pytest.raises(ValueError, match="even page_size"):
+            paged_attention_pallas(q, kv, pt, lens, interpret=True)
+
+    def test_auto_dispatch_predicate(self):
+        """The production predicate (attention._should_use_pallas) must
+        auto-select the kernel for llama3_1b-class d=64 at long context on
+        TPU — and fall back on every disqualifier."""
+        from kserve_tpu.ops.attention import PALLAS_MIN_PAGES, _should_use_pallas
+
+        W = PALLAS_MIN_PAGES
+        ok = dict(d=64, quantized=False, table_width=W, batch=48,
+                  backend="tpu", page_size=16)
+        assert _should_use_pallas(**ok)
+        assert _should_use_pallas(**{**ok, "d": 128})
+        assert _should_use_pallas(**{**ok, "d": 256})
+        # disqualifiers, one at a time
+        assert not _should_use_pallas(**{**ok, "d": 96})
+        assert not _should_use_pallas(**{**ok, "page_size": 7})  # odd ps @ d=64
+        assert _should_use_pallas(**{**ok, "d": 128, "page_size": 7})  # main kernel: ps free
+        assert not _should_use_pallas(**{**ok, "quantized": True})
+        assert not _should_use_pallas(**{**ok, "table_width": W - 1})
+        assert not _should_use_pallas(**{**ok, "batch": 13})  # prime > MAX_SB
+        assert not _should_use_pallas(**{**ok, "backend": "cpu"})
 
     def test_pick_sb_covers_odd_batches(self):
         assert _pick_sb(48) == 8
