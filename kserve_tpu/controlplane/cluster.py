@@ -255,6 +255,22 @@ class ControllerManager:
             section("storageInitializer").get("image") or STORAGE_INITIALIZER_IMAGE
         )
         mutator.agent_image = section("agent").get("image") or AGENT_IMAGE
+        # per-provider credential defaults + storage-spec knobs (reference
+        # GetCredentialConfig over the `credentials` JSON block)
+        if mutator.credentials is not None:
+            from .credentials import CredentialConfig
+
+            raw_creds = data.get("credentials")
+            if isinstance(raw_creds, dict):
+                raw_creds = _json.dumps(raw_creds)
+            try:
+                mutator.credentials.config = CredentialConfig.from_json(
+                    raw_creds or "")
+            except (ValueError, TypeError):
+                logger.warning(
+                    "inferenceservice-config `credentials` is not valid "
+                    "JSON; keeping defaults")
+                mutator.credentials.config = CredentialConfig()
         domain = section("ingress").get("ingressDomain") or self._default_domain
         self.isvc_reconciler.ingress_domain = domain
         self.llm_reconciler.ingress_domain = domain

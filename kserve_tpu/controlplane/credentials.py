@@ -1,119 +1,418 @@
 """Credentials builder: ServiceAccount-attached Secrets -> env/volume wiring
 on the storage-initializer container, so in-cluster model pulls can reach
-private s3/gcs/azure/hf storage.
+private s3/gcs/azure/hdfs/https/hf storage.
 
 Parity: pkg/credentials/service_account_credentials.go (BuildCredentials
-:66, s3 env :101, gcs volume :211) — the reference walks the component's
-ServiceAccount, finds its attached Secrets, and injects per-provider env
-vars (secretKeyRef, never literal values) or a credential-file volume.
-Provider detection is by well-known secret data keys plus the reference's
-serving.kserve.io/* annotations for S3 endpoint options.
+:66, storage-spec secret JSON :101, per-provider dispatch :211) plus the
+per-provider builders (pkg/credentials/{s3,gcs,azure,hdfs,https,hf}).  The
+reference walks the component's ServiceAccount, finds its attached
+Secrets, and injects per-provider env vars (secretKeyRef, never literal
+values) or a credential-file volume; provider detection is by well-known
+secret data keys, first match wins.  S3 endpoint options ride
+serving.kserve.io/* annotations on the Secret, with configurable global
+defaults (the `credentials` JSON block of inferenceservice-config).
 """
 
 from __future__ import annotations
 
+import json
+from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
+
+# ---------------- provider constants (reference data keys) ----------------
+
+# s3 (s3/s3_secret.go): camelCase data keys, configurable via S3Config
+S3_ACCESS_KEY_ID_NAME = "awsAccessKeyID"
+S3_SECRET_ACCESS_KEY_NAME = "awsSecretAccessKey"
+# this rebuild also accepts env-style uppercase data keys (round-3 shape)
+_S3_LEGACY_KEYS = ("AWS_ACCESS_KEY_ID", "AWS_SECRET_ACCESS_KEY")
 
 GCS_CREDS_KEY = "gcloud-application-credentials.json"
 GCS_MOUNT_PATH = "/var/secrets/gcs"
 
-# secret data key -> env var injected as a secretKeyRef
-_ENV_KEYS = (
-    # S3 / any AWS-compatible store
-    "AWS_ACCESS_KEY_ID",
-    "AWS_SECRET_ACCESS_KEY",
-    "AWS_SESSION_TOKEN",
-    # HuggingFace hub
-    "HF_TOKEN",
-    "HF_HUB_TOKEN",
-    # Azure service principal / storage
-    "AZ_CLIENT_ID",
-    "AZ_CLIENT_SECRET",
-    "AZ_SUBSCRIPTION_ID",
-    "AZ_TENANT_ID",
+# azure (azure/azure_secret.go): legacy AZ_* and AZURE_* key sets
+AZURE_LEGACY_MAP = {
+    "AZURE_SUBSCRIPTION_ID": "AZ_SUBSCRIPTION_ID",
+    "AZURE_TENANT_ID": "AZ_TENANT_ID",
+    "AZURE_CLIENT_ID": "AZ_CLIENT_ID",
+    "AZURE_CLIENT_SECRET": "AZ_CLIENT_SECRET",
+}
+AZURE_ENV_KEYS = (
+    "AZURE_SUBSCRIPTION_ID",
+    "AZURE_TENANT_ID",
+    "AZURE_CLIENT_ID",
+    "AZURE_CLIENT_SECRET",
     "AZURE_STORAGE_ACCESS_KEY",
     "AZURE_STORAGE_SAS_TOKEN",
-    # HDFS simple auth
-    "HDFS_USER",
+    "AZURE_ACCESS_TOKEN",
+    "AZURE_ACCESS_EXPIRES_ON_SECONDS",
+    "AZURE_ACCOUNT_NAME",
+    "AZURE_SERVICE_URL",
 )
 
-# reference s3 secret annotations -> plain env on the initializer
+# hdfs (hdfs/hdfs_secret.go): the whole secret mounts as a volume so the
+# kerberos keytab / TLS material ride along as files
+HDFS_NAMENODE_KEY = "HDFS_NAMENODE"
+HDFS_USER_KEY = "HDFS_USER"
+HDFS_MOUNT_PATH = "/var/secrets/kserve-hdfscreds"
+HDFS_VOLUME_NAME = "hdfs-secrets"
+
+# https (https/https_secret.go)
+HTTPS_HOST_KEY = "https-host"
+HTTPS_HEADERS_KEY = "headers"
+
+# hf (hf/hf_secret.go)
+HF_TOKEN_KEYS = ("HF_TOKEN", "HF_HUB_TOKEN")
+
+# reference s3 secret annotations -> env on the initializer
 _S3_ANNOTATIONS = {
     "serving.kserve.io/s3-endpoint": "AWS_ENDPOINT_URL",
     "serving.kserve.io/s3-region": "AWS_DEFAULT_REGION",
     "serving.kserve.io/s3-usehttps": "S3_USE_HTTPS",
     "serving.kserve.io/s3-verifyssl": "S3_VERIFY_SSL",
+    "serving.kserve.io/s3-usevirtualbucket": "S3_USE_VIRTUAL_BUCKET",
+    "serving.kserve.io/s3-useaccelerate": "S3_USE_ACCELERATE",
     "serving.kserve.io/s3-useanoncredential": "AWS_ANONYMOUS_CREDENTIAL",
+    "serving.kserve.io/s3-cabundle": "AWS_CA_BUNDLE",
+    "serving.kserve.io/s3-cabundle-configmap": "AWS_CA_BUNDLE_CONFIGMAP",
 }
+
+# storage-spec secret (CreateStorageSpecSecretEnvs :101)
+STORAGE_CONFIG_ENV = "STORAGE_CONFIG"
+STORAGE_OVERRIDE_CONFIG_ENV = "STORAGE_OVERRIDE_CONFIG"
+DEFAULT_STORAGE_SECRET = "storage-config"
+DEFAULT_STORAGE_SECRET_KEY = "default"
+URI_SCHEME_PLACEHOLDER = "<scheme-placeholder>"
+SUPPORTED_STORAGE_SPEC_TYPES = ("s3", "hdfs", "webhdfs")
+STORAGE_BUCKET_TYPES = ("s3",)
+
+# IRSA (service_account_credentials.go AwsIrsaAnnotationKey)
+AWS_IRSA_ANNOTATION = "eks.amazonaws.com/role-arn"
+
+
+@dataclass
+class CredentialConfig:
+    """The `credentials` JSON block of inferenceservice-config
+    (GetCredentialConfig): global provider defaults + storage-spec knobs."""
+
+    s3_access_key_id_name: str = S3_ACCESS_KEY_ID_NAME
+    s3_secret_access_key_name: str = S3_SECRET_ACCESS_KEY_NAME
+    s3_endpoint: str = ""
+    s3_region: str = ""
+    s3_use_https: str = ""
+    s3_verify_ssl: str = ""
+    s3_use_anonymous_credential: str = ""
+    gcs_credential_file_name: str = GCS_CREDS_KEY
+    storage_spec_secret_name: str = DEFAULT_STORAGE_SECRET
+    storage_secret_name_annotation: str = ""
+    extra: Dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def from_json(cls, raw: str) -> "CredentialConfig":
+        """Parse the reference config shape:
+        {"s3": {"s3AccessKeyIDName": ..., "s3Endpoint": ...},
+         "gcs": {"gcsCredentialFileName": ...},
+         "storageSpecSecretName": ..., "storageSecretNameAnnotation": ...}
+        """
+        cfg = cls()
+        if not raw:
+            return cfg
+        data = json.loads(raw)
+        s3 = data.get("s3", {}) or {}
+        cfg.s3_access_key_id_name = s3.get(
+            "s3AccessKeyIDName", cfg.s3_access_key_id_name)
+        cfg.s3_secret_access_key_name = s3.get(
+            "s3SecretAccessKeyName", cfg.s3_secret_access_key_name)
+        cfg.s3_endpoint = s3.get("s3Endpoint", "")
+        cfg.s3_region = s3.get("s3Region", "")
+        cfg.s3_use_https = s3.get("s3UseHttps", "")
+        cfg.s3_verify_ssl = s3.get("s3VerifySSL", "")
+        cfg.s3_use_anonymous_credential = s3.get("s3UseAnonymousCredential", "")
+        gcs = data.get("gcs", {}) or {}
+        cfg.gcs_credential_file_name = gcs.get(
+            "gcsCredentialFileName", cfg.gcs_credential_file_name)
+        cfg.storage_spec_secret_name = data.get(
+            "storageSpecSecretName", cfg.storage_spec_secret_name) or cfg.storage_spec_secret_name
+        cfg.storage_secret_name_annotation = data.get(
+            "storageSecretNameAnnotation", "")
+        return cfg
+
 
 SecretGetter = Callable[[str, str], Optional[dict]]
 
 
+def _secret_key_ref(env_name: str, secret_name: str, key: str) -> dict:
+    return {
+        "name": env_name,
+        "valueFrom": {"secretKeyRef": {"name": secret_name, "key": key}},
+    }
+
+
 class CredentialsBuilder:
     """`build()` mutates a container (+pod volumes) with the credentials
-    reachable from a ServiceAccount."""
+    reachable from a ServiceAccount; `build_storage_spec()` implements the
+    storage: spec secret-JSON path."""
 
     def __init__(self, secret_getter: SecretGetter,
-                 service_account_getter: Optional[SecretGetter] = None):
+                 service_account_getter: Optional[SecretGetter] = None,
+                 config: Optional[CredentialConfig] = None):
         self.secret_getter = secret_getter
         self.service_account_getter = service_account_getter
+        self.config = config or CredentialConfig()
 
-    def secrets_for(self, service_account: str, namespace: str) -> List[dict]:
-        names: List[str] = []
+    # ---------------- SA-secret path (BuildCredentials :66) ----------------
+
+    def build(self, service_account: Optional[str], namespace: str,
+              container: dict, volumes: List[dict],
+              annotations: Optional[Dict[str, str]] = None) -> None:
+        """annotations: the ISVC's — when the configured
+        storageSecretNameAnnotation is present it names the ONE secret to
+        mount, taking precedence over the ServiceAccount walk."""
+        anno_key = self.config.storage_secret_name_annotation
+        if annotations and anno_key and anno_key in annotations:
+            secret = self.secret_getter(annotations[anno_key], namespace)
+            if secret is not None:
+                self._apply_secret(secret, container, volumes)
+            return
+        if not service_account:
+            return
+        sa = None
         if self.service_account_getter is not None:
             sa = self.service_account_getter(service_account, namespace)
-            if sa:
-                names = [s.get("name") for s in sa.get("secrets", []) if s.get("name")]
-        if not names:
+        if sa:
+            # IRSA: the role-arn annotation signals ambient AWS identity;
+            # inject the configured S3 endpoint options so the initializer
+            # still knows where/how to talk (BuildServiceAccountEnvs)
+            if AWS_IRSA_ANNOTATION in (
+                sa.get("metadata", {}).get("annotations", {}) or {}
+            ):
+                self._add_s3_option_envs(container, {})
+            names = [s.get("name") for s in sa.get("secrets", []) if s.get("name")]
+        else:
             # no ServiceAccount object (or empty): fall back to a secret
             # named after the account, the common direct-reference pattern
+            names = []
+        if not names:
             names = [service_account]
-        out = []
         for name in names:
             secret = self.secret_getter(name, namespace)
             if secret is not None:
-                out.append(secret)
-        return out
+                self._apply_secret(secret, container, volumes)
 
-    def build(self, service_account: Optional[str], namespace: str,
-              container: dict, volumes: List[dict]) -> None:
-        if not service_account:
-            return
-        for secret in self.secrets_for(service_account, namespace):
-            self._apply_secret(secret, container, volumes)
-
-    def _apply_secret(self, secret: dict, container: dict, volumes: List[dict]) -> None:
+    # provider dispatch (mountSecretCredential :269): first match wins
+    def _apply_secret(self, secret: dict, container: dict,
+                      volumes: List[dict]) -> None:
         name = secret.get("metadata", {}).get("name", "")
         data = secret.get("data", {}) or secret.get("stringData", {}) or {}
         annotations = secret.get("metadata", {}).get("annotations", {}) or {}
+        if (self.config.s3_secret_access_key_name in data
+                or any(k in data for k in _S3_LEGACY_KEYS)):
+            self._s3_envs(name, data, annotations, container)
+        elif self.config.gcs_credential_file_name in data:
+            self._gcs_volume(name, container, volumes)
+        elif any(k in data for k in AZURE_LEGACY_MAP.values()) or any(
+                k in data for k in AZURE_ENV_KEYS):
+            self._azure_envs(name, data, container)
+        elif HTTPS_HOST_KEY in data:
+            self._https_envs(name, data, container)
+        elif HDFS_NAMENODE_KEY in data or HDFS_USER_KEY in data:
+            self._hdfs_secret(name, data, container, volumes)
+        elif any(k in data for k in HF_TOKEN_KEYS):
+            self._hf_envs(name, data, container)
+        # else: unsupported secret, skipped (reference logs at V(5))
+
+    # ---------------- per-provider builders ----------------
+
+    @staticmethod
+    def _add_env(container: dict, entry: dict) -> None:
         env: List[dict] = container.setdefault("env", [])
-        have = {e.get("name") for e in env}
+        if entry["name"] not in {e.get("name") for e in env}:
+            env.append(entry)
 
-        def add_env(entry: dict) -> None:
-            if entry["name"] not in have:
-                env.append(entry)
-                have.add(entry["name"])
-
-        for key in _ENV_KEYS:
-            if key in data:
-                add_env({
-                    "name": key,
-                    "valueFrom": {"secretKeyRef": {"name": name, "key": key}},
-                })
+    def _add_s3_option_envs(self, container: dict,
+                            annotations: Dict[str, str]) -> None:
+        """Secret annotations override the global config defaults."""
+        defaults = {
+            "AWS_ENDPOINT_URL": self.config.s3_endpoint,
+            "AWS_DEFAULT_REGION": self.config.s3_region,
+            "S3_USE_HTTPS": self.config.s3_use_https,
+            "S3_VERIFY_SSL": self.config.s3_verify_ssl,
+            "AWS_ANONYMOUS_CREDENTIAL": self.config.s3_use_anonymous_credential,
+        }
+        seen = {}
         for anno, env_name in _S3_ANNOTATIONS.items():
             if anno in annotations:
-                add_env({"name": env_name, "value": str(annotations[anno])})
-        if GCS_CREDS_KEY in data:
-            volume_name = f"{name}-gcs-creds"
-            if not any(v.get("name") == volume_name for v in volumes):
-                volumes.append(
-                    {"name": volume_name, "secret": {"secretName": name}}
-                )
-                container.setdefault("volumeMounts", []).append(
-                    {"name": volume_name, "mountPath": GCS_MOUNT_PATH,
-                     "readOnly": True}
-                )
-            add_env({
-                "name": "GOOGLE_APPLICATION_CREDENTIALS",
-                "value": f"{GCS_MOUNT_PATH}/{GCS_CREDS_KEY}",
+                seen[env_name] = str(annotations[anno])
+        for env_name, value in defaults.items():
+            if value and env_name not in seen:
+                seen[env_name] = value
+        for env_name, value in seen.items():
+            self._add_env(container, {"name": env_name, "value": value})
+
+    def _s3_envs(self, name: str, data: dict, annotations: dict,
+                 container: dict) -> None:
+        # each credential resolves its data key independently (configured
+        # camelCase name first, env-style legacy second) so mixed-shape
+        # secrets still inject both halves
+        for env_name, candidates in (
+            ("AWS_ACCESS_KEY_ID",
+             (self.config.s3_access_key_id_name, "AWS_ACCESS_KEY_ID")),
+            ("AWS_SECRET_ACCESS_KEY",
+             (self.config.s3_secret_access_key_name, "AWS_SECRET_ACCESS_KEY")),
+            ("AWS_SESSION_TOKEN", ("AWS_SESSION_TOKEN",)),
+        ):
+            for key in candidates:
+                if key in data:
+                    self._add_env(container, _secret_key_ref(env_name, name, key))
+                    break
+        self._add_s3_option_envs(container, annotations)
+
+    def _gcs_volume(self, name: str, container: dict,
+                    volumes: List[dict]) -> None:
+        volume_name = f"{name}-gcs-creds"
+        if not any(v.get("name") == volume_name for v in volumes):
+            volumes.append({"name": volume_name, "secret": {"secretName": name}})
+            container.setdefault("volumeMounts", []).append(
+                {"name": volume_name, "mountPath": GCS_MOUNT_PATH,
+                 "readOnly": True}
+            )
+        self._add_env(container, {
+            "name": "GOOGLE_APPLICATION_CREDENTIALS",
+            "value": f"{GCS_MOUNT_PATH}/{self.config.gcs_credential_file_name}",
+        })
+
+    def _azure_envs(self, name: str, data: dict, container: dict) -> None:
+        for env_name in AZURE_ENV_KEYS:
+            legacy = AZURE_LEGACY_MAP.get(env_name)
+            if legacy and legacy in data:
+                self._add_env(container, _secret_key_ref(env_name, name, legacy))
+                # legacy consumers read the AZ_* name too
+                self._add_env(container, _secret_key_ref(legacy, name, legacy))
+            elif env_name in data:
+                self._add_env(container, _secret_key_ref(env_name, name, env_name))
+
+    def _https_envs(self, name: str, data: dict, container: dict) -> None:
+        """Per-host header injection (https/https_secret.go): env named
+        "<host>-headers" carries the newline-separated header lines the
+        downloader adds to requests for that host — as a secretKeyRef, so
+        tokens never appear literally in the pod spec."""
+        host = data.get(HTTPS_HOST_KEY)
+        if not host or HTTPS_HEADERS_KEY not in data:
+            return
+        self._add_env(container, _secret_key_ref(
+            f"{host}-headers", name, HTTPS_HEADERS_KEY))
+
+    def _hdfs_secret(self, name: str, data: dict, container: dict,
+                     volumes: List[dict]) -> None:
+        """The whole secret mounts as files (namenode address, kerberos
+        keytab + krb5 conf, TLS material) — hdfs/hdfs_secret.go — AND the
+        simple-auth identity rides as env: this repo's WebHDFS downloader
+        (storage/storage.py) authenticates via the HDFS_USER env, so the
+        volume alone would leave it anonymous."""
+        for key in (HDFS_USER_KEY, HDFS_NAMENODE_KEY):
+            if key in data:
+                self._add_env(container, _secret_key_ref(key, name, key))
+        if not any(v.get("name") == HDFS_VOLUME_NAME for v in volumes):
+            volumes.append(
+                {"name": HDFS_VOLUME_NAME, "secret": {"secretName": name}})
+            container.setdefault("volumeMounts", []).append(
+                {"name": HDFS_VOLUME_NAME, "mountPath": HDFS_MOUNT_PATH,
+                 "readOnly": True}
+            )
+
+    def _hf_envs(self, name: str, data: dict, container: dict) -> None:
+        for key in HF_TOKEN_KEYS:
+            if key in data:
+                self._add_env(container, _secret_key_ref("HF_TOKEN", name, key))
+                break
+
+    # ------- storage-spec secret JSON (CreateStorageSpecSecretEnvs :101) -------
+
+    def build_storage_spec(
+        self,
+        namespace: str,
+        annotations: Optional[Dict[str, str]],
+        storage_key: str,
+        override_params: Dict[str, str],
+        container: dict,
+    ) -> None:
+        """The `storage:` spec path: a cluster-level secret holds named
+        JSON configs; the chosen entry rides to the initializer as a
+        STORAGE_CONFIG secretKeyRef and the container args' scheme
+        placeholder is rewritten from the config's type/bucket.
+
+        Raises ValueError on the reference's error cases (missing key,
+        unsupported type, missing bucket) so admission rejects the ISVC
+        instead of launching a pod that cannot download."""
+        stype = override_params.get("type", "")
+        bucket = override_params.get("bucket", "")
+        secret_name = self.config.storage_spec_secret_name
+        anno_key = self.config.storage_secret_name_annotation
+        if annotations and anno_key and anno_key in annotations:
+            secret_name = annotations[anno_key]
+        secret = self.secret_getter(secret_name, namespace)
+        storage_data = None
+        if secret is not None:
+            data = secret.get("data", {}) or secret.get("stringData", {}) or {}
+            if storage_key:
+                storage_data = data.get(storage_key)
+                if storage_data is None:
+                    raise ValueError(
+                        f"specified storage key {storage_key} not found in "
+                        f"storage secret {secret_name}")
+            else:
+                storage_key = (
+                    f"{DEFAULT_STORAGE_SECRET_KEY}_{stype}" if stype
+                    else DEFAULT_STORAGE_SECRET_KEY)
+                storage_data = data.get(storage_key)  # fallback may miss: ok
+        elif storage_key:
+            raise ValueError(f"can't read storage secret {secret_name}")
+
+        if storage_data is not None:
+            # parse unconditionally: override params supplying `type` must
+            # not skip the secret's bucket/cabundle or the type check
+            try:
+                parsed = json.loads(storage_data)
+            except (TypeError, ValueError) as exc:
+                raise ValueError(
+                    f"invalid json in key {storage_key} of storage "
+                    f"secret {secret_name}: {exc}") from exc
+            stype = stype or parsed.get("type", "")
+            if not bucket:
+                bucket = parsed.get("bucket", "")
+            if parsed.get("cabundle_configmap"):
+                self._add_env(container, {
+                    "name": "AWS_CA_BUNDLE_CONFIGMAP",
+                    "value": parsed["cabundle_configmap"],
+                })
+            self._add_env(container, _secret_key_ref(
+                STORAGE_CONFIG_ENV, secret_name, storage_key))
+
+        if not stype:
+            raise ValueError("unable to determine storage type")
+        if stype not in SUPPORTED_STORAGE_SPEC_TYPES:
+            raise ValueError(
+                "storage type must be one of "
+                f"{list(SUPPORTED_STORAGE_SPEC_TYPES)}; got {stype!r}")
+
+        args = container.get("args", [])
+        placeholder = URI_SCHEME_PLACEHOLDER + "://"
+        if args and args[0].startswith(placeholder):
+            for i in range(0, len(args), 2):
+                if not args[i].startswith(placeholder):
+                    continue
+                path = args[i][len(placeholder):]
+                if stype in STORAGE_BUCKET_TYPES:
+                    if not bucket:
+                        raise ValueError(
+                            f"format [{stype}] requires a bucket but none "
+                            "was found in storage data or parameters")
+                    args[i] = f"{stype}://{bucket}/{path}"
+                else:
+                    args[i] = f"{stype}://{path}"
+
+        if override_params:
+            self._add_env(container, {
+                "name": STORAGE_OVERRIDE_CONFIG_ENV,
+                "value": json.dumps(override_params, sort_keys=True),
             })

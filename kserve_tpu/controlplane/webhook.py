@@ -84,11 +84,23 @@ class PodMutator:
             pod_spec = inject_tpu_resources(pod_spec, slice_plan)
         if model is not None and (model.storageUri or model.storage):
             uri = model.storageUri or (model.storage.storageUri if model.storage else None)
+            storage_spec = None
+            if uri is None and model.storage and model.storage.path is not None:
+                # storage: spec path — the scheme placeholder is rewritten
+                # by the credentials builder from the storage secret's
+                # type/bucket (ref CreateStorageSpecSecretEnvs)
+                from .credentials import URI_SCHEME_PLACEHOLDER
+
+                storage_spec = model.storage
+                uri = (f"{URI_SCHEME_PLACEHOLDER}://"
+                       f"{model.storage.path.lstrip('/')}")
             if uri:
                 pod_spec = self.inject_storage_initializer(
                     pod_spec, uri,
                     service_account=service_account,
                     namespace=isvc_metadata.get("namespace", "default"),
+                    storage_spec=storage_spec,
+                    isvc_annotations=isvc_metadata.get("annotations") or {},
                 )
         if component_spec is not None:
             batcher = getattr(component_spec, "batcher", None)
@@ -165,6 +177,8 @@ class PodMutator:
     def inject_storage_initializer(
         self, pod_spec: dict, storage_uri: str,
         service_account: Optional[str] = None, namespace: str = "default",
+        storage_spec=None,  # crds.StorageSpec for the storage: path
+        isvc_annotations: Optional[dict] = None,
     ) -> dict:
         """pvc:// mounts the claim read-only; other schemes get a download
         init container sharing an emptyDir with the runtime container.
@@ -210,7 +224,24 @@ class PodMutator:
             for key in ("image", "env", "resources", "command"):
                 if key in custom:
                     init[key] = custom[key]
-        self.apply_initializer_credentials(init, volumes, service_account, namespace)
+        self.apply_initializer_credentials(
+            init, volumes, service_account, namespace,
+            isvc_annotations=isvc_annotations,
+        )
+        if storage_spec is not None:
+            if self.credentials is None:
+                # nothing can resolve the scheme placeholder: fail at
+                # admission, not with an unparseable URI in the initializer
+                raise ValueError(
+                    "storage: spec requires a credentials builder (no "
+                    "secret access configured on this mutator)"
+                )
+            self.credentials.build_storage_spec(
+                namespace, isvc_annotations,
+                storage_spec.key or "",
+                dict(storage_spec.parameters or {}),
+                init,
+            )
         pod_spec.setdefault("initContainers", []).append(init)
         containers[0].setdefault("volumeMounts", []).append(
             {"name": "model-dir", "mountPath": MODEL_MOUNT_PATH, "readOnly": True}
@@ -220,13 +251,15 @@ class PodMutator:
     def apply_initializer_credentials(
         self, init: dict, volumes: list,
         service_account: Optional[str], namespace: str,
+        isvc_annotations: Optional[dict] = None,
     ) -> None:
         """Credentials + CA-bundle wiring shared by every download-style
         init container (the model storage-initializer AND LoRA adapter
         downloads) — bypassing this for one of them would leave it unable
         to reach private storage."""
         if self.credentials is not None:
-            self.credentials.build(service_account, namespace, init, volumes)
+            self.credentials.build(service_account, namespace, init, volumes,
+                                   annotations=isvc_annotations)
         if self.ca_bundle_configmap:
             if not any(v.get("name") == "cabundle" for v in volumes):
                 volumes.append({

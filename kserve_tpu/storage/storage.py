@@ -68,6 +68,46 @@ def _safe_rel(key: str, prefix: str) -> str:
     return norm
 
 
+# STORAGE_CONFIG json field -> env var the downloaders read.  The control
+# plane's storage-spec path (controlplane/credentials.py
+# build_storage_spec, ref CreateStorageSpecSecretEnvs) delivers the chosen
+# storage secret entry as a STORAGE_CONFIG secretKeyRef plus literal
+# STORAGE_OVERRIDE_CONFIG params; this maps them onto the same knobs the
+# per-scheme downloaders already consume.
+_STORAGE_CONFIG_ENV_MAP = {
+    "access_key_id": "AWS_ACCESS_KEY_ID",
+    "secret_access_key": "AWS_SECRET_ACCESS_KEY",
+    "session_token": "AWS_SESSION_TOKEN",
+    "endpoint_url": "AWS_ENDPOINT_URL",
+    "region": "AWS_DEFAULT_REGION",
+    "anonymous": "AWS_ANONYMOUS_CREDENTIAL",
+    "verify_ssl": "S3_VERIFY_SSL",
+    "certificate": "AWS_CA_BUNDLE",
+    "user_name": "HDFS_USER",
+    "hdfs_namenode": "HDFS_NAMENODE",
+    "access_key": "AZURE_STORAGE_ACCESS_KEY",
+}
+
+
+def _apply_storage_config_env() -> None:
+    """Fold STORAGE_CONFIG (secret JSON) + STORAGE_OVERRIDE_CONFIG
+    (storage.parameters, wins) into the downloader env.  Explicitly chosen
+    storage-spec values override ambient env — the operator selected this
+    config for this pull."""
+    merged: Dict[str, str] = {}
+    for env_name in ("STORAGE_CONFIG", "STORAGE_OVERRIDE_CONFIG"):
+        raw = os.getenv(env_name)
+        if not raw:
+            continue
+        try:
+            merged.update(json.loads(raw))
+        except (TypeError, ValueError):
+            raise StorageError(f"{env_name} is not valid JSON")
+    for field, env_name in _STORAGE_CONFIG_ENV_MAP.items():
+        if field in merged and merged[field] is not None:
+            os.environ[env_name] = str(merged[field])
+
+
 class Storage:
     """`Storage.download(uri, out_dir)` -> local directory with artifacts."""
 
@@ -77,6 +117,7 @@ class Storage:
             out_dir = tempfile.mkdtemp()
         os.makedirs(out_dir, exist_ok=True)
         logger.info("Downloading %s to %s", uri, out_dir)
+        _apply_storage_config_env()
         if uri.startswith(_LOCAL_PREFIX) or uri.startswith("/"):
             return Storage._download_local(uri, out_dir)
         if uri.startswith(_PVC_PREFIX):

@@ -367,6 +367,36 @@ class TestConfigReloadAndAdmission:
         isvc = mgr.cluster.get("InferenceService", "cfg")
         assert isvc["status"]["url"].endswith("models.corp")
 
+    def test_credentials_config_section_hot_reloads(self):
+        """The `credentials` JSON block (ref GetCredentialConfig) sets
+        provider defaults: custom s3 key names + global endpoint."""
+        mgr = ControllerManager()
+        mgr.apply({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "inferenceservice-config",
+                         "namespace": "kserve-system"},
+            "data": {
+                "credentials": '{"s3": {"s3AccessKeyIDName": "customId", '
+                               '"s3SecretAccessKeyName": "customKey", '
+                               '"s3Endpoint": "minio.corp:9000"}}',
+            },
+        })
+        mgr.apply({
+            "apiVersion": "v1", "kind": "Secret",
+            "metadata": {"name": "creds", "namespace": "default"},
+            "data": {"customId": "eA==", "customKey": "eA=="},
+        })
+        isvc = self._isvc()
+        isvc["spec"]["predictor"]["serviceAccountName"] = "creds"
+        isvc["spec"]["predictor"]["model"]["storageUri"] = "s3://b/m"
+        mgr.apply(isvc)
+        init = mgr.cluster.get("Deployment", "cfg-predictor")[
+            "spec"]["template"]["spec"]["initContainers"][0]
+        env = {e["name"]: e for e in init["env"]}
+        assert env["AWS_ACCESS_KEY_ID"]["valueFrom"]["secretKeyRef"]["key"] == (
+            "customId")
+        assert env["AWS_ENDPOINT_URL"]["value"] == "minio.corp:9000"
+
     def test_ca_bundle_configmap_mounts_on_initializer(self):
         mgr = ControllerManager()
         mgr.apply(self._isvc())

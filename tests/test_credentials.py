@@ -92,6 +92,190 @@ class TestCredentialsBuilder:
         init, _ = initializer_of(mgr)
         assert not init.get("env")
 
+    def test_s3_camelcase_keys_reference_shape(self):
+        """The reference secret shape: awsAccessKeyID/awsSecretAccessKey
+        data keys (s3_secret.go) -> AWS_* envs via secretKeyRef."""
+        mgr = ControllerManager()
+        mgr.apply({
+            "apiVersion": "v1", "kind": "Secret",
+            "metadata": {"name": "s3c", "namespace": "default"},
+            "data": {"awsAccessKeyID": "eA==", "awsSecretAccessKey": "eA=="},
+        })
+        mgr.apply(make_isvc(sa="s3c"))
+        init, _ = initializer_of(mgr)
+        env = {e["name"]: e for e in init["env"]}
+        assert env["AWS_ACCESS_KEY_ID"]["valueFrom"]["secretKeyRef"]["key"] == (
+            "awsAccessKeyID")
+        assert env["AWS_SECRET_ACCESS_KEY"]["valueFrom"]["secretKeyRef"]["key"] == (
+            "awsSecretAccessKey")
+
+    def test_azure_service_principal_envs(self):
+        """Legacy AZ_* data keys map to both AZURE_* and AZ_* env names
+        (azure_secret.go legacy mapping)."""
+        mgr = ControllerManager()
+        mgr.apply({
+            "apiVersion": "v1", "kind": "Secret",
+            "metadata": {"name": "az", "namespace": "default"},
+            "data": {"AZ_CLIENT_ID": "eA==", "AZ_CLIENT_SECRET": "eA==",
+                     "AZ_TENANT_ID": "eA=="},
+        })
+        mgr.apply(make_isvc(sa="az", uri="https://acct.blob.core.windows.net/c/m"))
+        init, _ = initializer_of(mgr)
+        env = {e["name"]: e for e in init["env"]}
+        for name in ("AZURE_CLIENT_ID", "AZ_CLIENT_ID", "AZURE_TENANT_ID",
+                     "AZURE_CLIENT_SECRET"):
+            assert env[name]["valueFrom"]["secretKeyRef"]["name"] == "az", name
+        # modern key shape
+        mgr.apply({
+            "apiVersion": "v1", "kind": "Secret",
+            "metadata": {"name": "az2", "namespace": "default"},
+            "data": {"AZURE_STORAGE_ACCESS_KEY": "eA=="},
+        })
+        mgr.apply({
+            "apiVersion": "serving.kserve.io/v1beta1",
+            "kind": "InferenceService",
+            "metadata": {"name": "m2", "namespace": "default"},
+            "spec": {"predictor": {
+                "serviceAccountName": "az2",
+                "model": {"modelFormat": {"name": "sklearn"},
+                          "storageUri": "https://a.blob.core.windows.net/c/m"},
+            }},
+        })
+        init2, _ = initializer_of(mgr, "m2-predictor")
+        env2 = {e["name"]: e for e in init2["env"]}
+        assert env2["AZURE_STORAGE_ACCESS_KEY"]["valueFrom"]["secretKeyRef"] == {
+            "name": "az2", "key": "AZURE_STORAGE_ACCESS_KEY"}
+
+    def test_hdfs_secret_mounts_as_volume(self):
+        """HDFS (krb5 keytab and friends) mounts the whole secret at the
+        well-known path (hdfs_secret.go MountPath)."""
+        mgr = ControllerManager()
+        mgr.apply({
+            "apiVersion": "v1", "kind": "Secret",
+            "metadata": {"name": "hdfs-creds", "namespace": "default"},
+            "data": {"HDFS_NAMENODE": "eA==", "KERBEROS_KEYTAB": "eA=="},
+        })
+        mgr.apply(make_isvc(sa="hdfs-creds", uri="hdfs://nn/models/m"))
+        init, dep = initializer_of(mgr)
+        mounts = {m["name"]: m for m in init["volumeMounts"]}
+        assert mounts["hdfs-secrets"]["mountPath"] == (
+            "/var/secrets/kserve-hdfscreds")
+        vols = {v["name"]: v for v in dep["spec"]["template"]["spec"]["volumes"]}
+        assert vols["hdfs-secrets"]["secret"]["secretName"] == "hdfs-creds"
+        # the WebHDFS downloader authenticates via env, not the mounted
+        # files — HDFS_NAMENODE/HDFS_USER must also ride as secretKeyRefs
+        env = {e["name"]: e for e in init["env"]}
+        assert env["HDFS_NAMENODE"]["valueFrom"]["secretKeyRef"] == {
+            "name": "hdfs-creds", "key": "HDFS_NAMENODE"}
+
+    def test_https_host_headers_env(self):
+        mgr = ControllerManager()
+        mgr.apply({
+            "apiVersion": "v1", "kind": "Secret",
+            "metadata": {"name": "web", "namespace": "default"},
+            "data": {"https-host": "models.example.com",
+                     "headers": "Authorization: Bearer zzz"},
+        })
+        mgr.apply(make_isvc(sa="web", uri="https://models.example.com/m.tar"))
+        init, dep = initializer_of(mgr)
+        env = {e["name"]: e for e in init["env"]}
+        ref = env["models.example.com-headers"]["valueFrom"]["secretKeyRef"]
+        assert ref == {"name": "web", "key": "headers"}
+        # header VALUES never appear literally in the pod spec
+        assert "Bearer zzz" not in str(dep)
+
+
+class TestStorageSpec:
+    """storage: spec secret-JSON path (ref CreateStorageSpecSecretEnvs
+    service_account_credentials.go:101)."""
+
+    def _base(self, mgr, storage, annotations=None):
+        isvc = {
+            "apiVersion": "serving.kserve.io/v1beta1",
+            "kind": "InferenceService",
+            "metadata": {"name": "sp", "namespace": "default"},
+            "spec": {"predictor": {"model": {
+                "modelFormat": {"name": "sklearn"}, "storage": storage}}},
+        }
+        if annotations:
+            isvc["metadata"]["annotations"] = annotations
+        mgr.apply(isvc)
+        return initializer_of(mgr, "sp-predictor")
+
+    def _storage_secret(self, mgr, name="storage-config", **entries):
+        import json as _json
+
+        mgr.apply({
+            "apiVersion": "v1", "kind": "Secret",
+            "metadata": {"name": name, "namespace": "default"},
+            "stringData": {k: _json.dumps(v) for k, v in entries.items()},
+        })
+
+    def test_bucket_and_type_from_secret_json(self):
+        mgr = ControllerManager()
+        self._storage_secret(
+            mgr, minio={"type": "s3", "bucket": "models",
+                        "endpoint_url": "http://minio:9000"})
+        init, _ = self._base(mgr, {"key": "minio", "path": "flowers/v1"})
+        # scheme placeholder rewritten from the secret's type+bucket
+        assert init["args"][0] == "s3://models/flowers/v1"
+        env = {e["name"]: e for e in init["env"]}
+        assert env["STORAGE_CONFIG"]["valueFrom"]["secretKeyRef"] == {
+            "name": "storage-config", "key": "minio"}
+
+    def test_override_params_and_default_key(self):
+        mgr = ControllerManager()
+        self._storage_secret(mgr, default_s3={"type": "s3"})
+        init, dep = self._base(mgr, {
+            "path": "m/v2",
+            "parameters": {"type": "s3", "bucket": "override-bucket"}})
+        assert init["args"][0] == "s3://override-bucket/m/v2"
+        env = {e["name"]: e for e in init["env"]}
+        assert env["STORAGE_CONFIG"]["valueFrom"]["secretKeyRef"]["key"] == (
+            "default_s3")
+        import json as _json
+
+        override = _json.loads(env["STORAGE_OVERRIDE_CONFIG"]["value"])
+        assert override == {"type": "s3", "bucket": "override-bucket"}
+
+    def test_non_bucket_type_webhdfs(self):
+        mgr = ControllerManager()
+        self._storage_secret(mgr, hdfs={"type": "webhdfs"})
+        init, _ = self._base(mgr, {"key": "hdfs", "path": "models/m"})
+        assert init["args"][0] == "webhdfs://models/m"
+
+    def test_missing_key_rejected(self):
+        import pytest
+
+        mgr = ControllerManager()
+        self._storage_secret(mgr, other={"type": "s3", "bucket": "b"})
+        with pytest.raises(ValueError, match="storage key"):
+            self._base(mgr, {"key": "nope", "path": "x"})
+
+    def test_unsupported_type_rejected(self):
+        import pytest
+
+        mgr = ControllerManager()
+        self._storage_secret(mgr, bad={"type": "ftp"})
+        with pytest.raises(ValueError, match="storage type"):
+            self._base(mgr, {"key": "bad", "path": "x"})
+
+    def test_missing_bucket_rejected(self):
+        import pytest
+
+        mgr = ControllerManager()
+        self._storage_secret(mgr, nob={"type": "s3"})
+        with pytest.raises(ValueError, match="bucket"):
+            self._base(mgr, {"key": "nob", "path": "x"})
+
+    def test_cabundle_configmap_env(self):
+        mgr = ControllerManager()
+        self._storage_secret(mgr, ca={"type": "s3", "bucket": "b",
+                                      "cabundle_configmap": "my-ca"})
+        init, _ = self._base(mgr, {"key": "ca", "path": "m"})
+        env = {e["name"]: e.get("value") for e in init["env"]}
+        assert env["AWS_CA_BUNDLE_CONFIGMAP"] == "my-ca"
+
 
 class TestClusterStorageContainer:
     def test_apply_no_longer_raises_and_overrides_initializer(self):

@@ -204,3 +204,42 @@ class TestWebHdfs:
         )
         assert (tmp_path / "weights.bin").read_bytes() == b"W" * 64
         assert (tmp_path / "sub" / "config.json").read_bytes() == b"{}"
+
+
+class TestStorageConfigEnv:
+    """STORAGE_CONFIG/STORAGE_OVERRIDE_CONFIG (the storage: spec secret
+    JSON the control plane injects) folds into the downloader env —
+    without this the storage-spec path would be control-plane-only
+    plumbing and private pulls would run unauthenticated."""
+
+    def test_config_maps_to_env(self, monkeypatch):
+        import json as _json
+
+        from kserve_tpu.storage.storage import _apply_storage_config_env
+
+        monkeypatch.delenv("AWS_ACCESS_KEY_ID", raising=False)
+        monkeypatch.setenv("STORAGE_CONFIG", _json.dumps({
+            "type": "s3", "access_key_id": "AKID", "secret_access_key": "SK",
+            "endpoint_url": "http://minio:9000", "region": "us-x-1",
+        }))
+        monkeypatch.setenv("STORAGE_OVERRIDE_CONFIG", _json.dumps({
+            "region": "eu-y-2", "user_name": "alice",
+        }))
+        _apply_storage_config_env()
+        import os as _os
+
+        assert _os.environ["AWS_ACCESS_KEY_ID"] == "AKID"
+        assert _os.environ["AWS_SECRET_ACCESS_KEY"] == "SK"
+        assert _os.environ["AWS_ENDPOINT_URL"] == "http://minio:9000"
+        assert _os.environ["AWS_DEFAULT_REGION"] == "eu-y-2"  # override wins
+        assert _os.environ["HDFS_USER"] == "alice"
+
+    def test_invalid_json_is_loud(self, monkeypatch):
+        from kserve_tpu.storage.storage import (
+            StorageError,
+            _apply_storage_config_env,
+        )
+
+        monkeypatch.setenv("STORAGE_CONFIG", "{not json")
+        with pytest.raises(StorageError, match="STORAGE_CONFIG"):
+            _apply_storage_config_env()
