@@ -84,6 +84,18 @@ class PodMutator:
             pod_spec = inject_tpu_resources(pod_spec, slice_plan)
         if model is not None and (model.storageUri or model.storage):
             uri = model.storageUri or (model.storage.storageUri if model.storage else None)
+            if uri and (
+                uri.startswith("oci://")
+                or (uri.startswith("oci+") and not uri.startswith("oci+fetch://"))
+            ):
+                # modelcar/native modes replace the initializer; oci+fetch
+                # falls through to the storage-initializer download path
+                # (storage.py handles the scheme).  The rest of the mutator
+                # chain (agent, metrics aggregation) still applies.
+                return self._finish_mutate(
+                    self.inject_modelcar(pod_spec, uri),
+                    isvc_metadata, component_spec,
+                )
             storage_spec = None
             if uri is None and model.storage and model.storage.path is not None:
                 # storage: spec path — the scheme placeholder is rewritten
@@ -102,6 +114,12 @@ class PodMutator:
                     storage_spec=storage_spec,
                     isvc_annotations=isvc_metadata.get("annotations") or {},
                 )
+        return self._finish_mutate(pod_spec, isvc_metadata, component_spec)
+
+    def _finish_mutate(self, pod_spec: dict, isvc_metadata: dict,
+                       component_spec: Any) -> dict:
+        """Tail of the mutator chain (agent sidecar + metrics aggregation)
+        — shared by every storage path, modelcar included."""
         if component_spec is not None:
             batcher = getattr(component_spec, "batcher", None)
             logger_spec = getattr(component_spec, "logger", None)
@@ -246,6 +264,101 @@ class PodMutator:
         containers[0].setdefault("volumeMounts", []).append(
             {"name": "model-dir", "mountPath": MODEL_MOUNT_PATH, "readOnly": True}
         )
+        return pod_spec
+
+    # modelcar resource defaults (ref constants.go:215)
+    MODELCAR_CPU = "10m"
+    MODELCAR_MEMORY = "15Mi"
+
+    def inject_modelcar(self, pod_spec: dict, storage_uri: str) -> dict:
+        """OCI weight delivery (ref storage_initializer_injector.go:201
+        InjectModelcar + utils/storage.go ConfigureModelcarToContainer).
+
+        Modes, selected by URI scheme (ref ParseOciScheme):
+        - oci:// or oci+modelcar:// — a sidecar running the model image
+          symlinks its /models into a shared emptyDir via the proc
+          filesystem (shareProcessNamespace), plus an init container that
+          pre-fetches the image and validates /models exists; the serving
+          container gets MODEL_INIT_MODE=async so it retries until the
+          symlink appears.
+        - oci+native:// — a Kubernetes ImageVolume (featureGate
+          ImageVolume) mounts the image read-only at /mnt/models; no
+          sidecar needed.
+        """
+        mode = "modelcar"
+        uri = storage_uri
+        if uri.startswith("oci+"):
+            mode, _, rest = uri[len("oci+"):].partition("://")
+            uri = "oci://" + rest
+        image = uri[len("oci://"):]
+        if not image:
+            raise ValueError(f"empty image reference in {storage_uri!r}")
+        containers = pod_spec.get("containers", [])
+        if not containers:
+            return pod_spec
+        serving = containers[0]
+        volumes = pod_spec.setdefault("volumes", [])
+
+        def mount_once(container, mount):
+            mounts = container.setdefault("volumeMounts", [])
+            if not any(m.get("name") == mount["name"] for m in mounts):
+                mounts.append(mount)
+
+        if mode == "native":
+            if not any(v.get("name") == "model-image" for v in volumes):
+                volumes.append({
+                    "name": "model-image",
+                    "image": {"reference": image, "pullPolicy": "IfNotPresent"},
+                })
+            mount_once(serving, {
+                "name": "model-image", "mountPath": MODEL_MOUNT_PATH,
+                "readOnly": True,
+            })
+            return pod_spec
+        if mode != "modelcar":
+            raise ValueError(
+                f"unknown oci mode {mode!r}; expected modelcar or native")
+
+        resources = {
+            "limits": {"cpu": self.MODELCAR_CPU, "memory": self.MODELCAR_MEMORY},
+            "requests": {"cpu": self.MODELCAR_CPU, "memory": self.MODELCAR_MEMORY},
+        }
+        # the sidecar symlinks through /proc/<pid>/root, which is only
+        # visible with a shared process namespace
+        pod_spec["shareProcessNamespace"] = True
+        if not any(v.get("name") == "modelcar" for v in volumes):
+            volumes.append({"name": "modelcar", "emptyDir": {}})
+        parent = MODEL_MOUNT_PATH.rsplit("/", 1)[0] or "/"
+        mount_once(serving,
+                   {"name": "modelcar", "mountPath": parent, "readOnly": False})
+        env = serving.setdefault("env", [])
+        if not any(e.get("name") == "MODEL_INIT_MODE" for e in env):
+            env.append({"name": "MODEL_INIT_MODE", "value": "async"})
+        if not any(c.get("name") == "modelcar" for c in containers):
+            containers.append({
+                "name": "modelcar",
+                "image": image,
+                "args": ["sh", "-c",
+                         f"ln -sf /proc/$$/root/models {MODEL_MOUNT_PATH} "
+                         "&& sleep infinity"],
+                "volumeMounts": [
+                    {"name": "modelcar", "mountPath": parent,
+                     "readOnly": False}],
+                "resources": resources,
+                "terminationMessagePolicy": "FallbackToLogsOnError",
+            })
+        inits = pod_spec.setdefault("initContainers", [])
+        if not any(c.get("name") == "modelcar-init" for c in inits):
+            inits.append({
+                "name": "modelcar-init",
+                "image": image,
+                "args": ["sh", "-c",
+                         f"echo 'Pre-fetching modelcar {image}:' && "
+                         "[ -d /models ] && [ \"$(ls -A /models)\" ] && "
+                         "echo 'OK ... valid (/models exists)' || "
+                         "(echo 'NOK ... /models missing or empty' && exit 1)"],
+                "resources": resources,
+            })
         return pod_spec
 
     def apply_initializer_credentials(

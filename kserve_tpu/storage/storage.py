@@ -135,9 +135,12 @@ class Storage:
             return Storage._download_hdfs(uri, out_dir)
         if uri.startswith("hf://"):
             return Storage._download_hf(uri, out_dir)
+        if uri.startswith(("oci://", "oci+fetch://")):
+            return Storage._download_oci(uri, out_dir)
         raise StorageError(
             f"Cannot recognize storage type for {uri!r}; supported prefixes: "
-            "[file://, pvc://, gs://, s3://, hdfs://, webhdfs://, hf://, http(s)://]"
+            "[file://, pvc://, gs://, s3://, hdfs://, webhdfs://, hf://, "
+            "oci://, http(s)://]"
         )
 
     @staticmethod
@@ -206,6 +209,152 @@ class Storage:
         snapshot_download(
             repo_id=repo, revision=revision or None, local_dir=out_dir
         )
+        return out_dir
+
+    @staticmethod
+    def _download_oci(uri: str, out_dir: str) -> str:
+        """oci://registry/repo[:tag|@sha256:...] — the `fetch` delivery
+        mode: pull the model image via the OCI distribution HTTP API
+        (anonymous or bearer-token) and extract each layer's /models tree.
+
+        The modelcar-image convention puts weights under /models; layers
+        apply in manifest order so later layers overwrite earlier ones.
+        Registry auth: a 401 with WWW-Authenticate: Bearer triggers the
+        standard token dance (OCI_REGISTRY_TOKEN / DOCKER_AUTH basic creds
+        honored).  TLS unless OCI_REGISTRY_PLAIN_HTTP=true (local/test
+        registries).  Parity: the reference's oci+fetch mode; the
+        modelcar/native modes are webhook-level (controlplane/webhook.py
+        inject_modelcar)."""
+        import httpx
+
+        ref = uri.split("://", 1)[1]
+        registry, _, rest = ref.partition("/")
+        if not rest:
+            raise StorageError(f"oci uri needs registry/repository: {uri!r}")
+        if "@" in rest:
+            repo, _, digest_ref = rest.partition("@")
+            tag = digest_ref
+        else:
+            repo, _, tag = rest.rpartition(":")
+            if not repo:  # no tag given
+                repo, tag = rest, "latest"
+        scheme = ("http" if os.getenv("OCI_REGISTRY_PLAIN_HTTP", "").lower()
+                  in ("1", "true") else "https")
+        base = f"{scheme}://{registry}/v2/{repo}"
+        accept = ", ".join((
+            "application/vnd.oci.image.manifest.v1+json",
+            "application/vnd.docker.distribution.manifest.v2+json",
+            "application/vnd.oci.image.index.v1+json",
+            "application/vnd.docker.distribution.manifest.list.v2+json",
+        ))
+        headers: Dict[str, str] = {}
+        token = os.getenv("OCI_REGISTRY_TOKEN", "")
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+
+        with httpx.Client(follow_redirects=True, timeout=600) as client:
+            def _authorize(r):
+                """On 401, run the standard bearer-token dance from
+                WWW-Authenticate; True if a token was obtained."""
+                if r.status_code != 401 or "Authorization" in headers:
+                    return False
+                challenge = r.headers.get("www-authenticate", "")
+                if not challenge.lower().startswith("bearer "):
+                    return False
+                fields = dict(
+                    part.split("=", 1)
+                    for part in challenge[7:].replace('"', "").split(",")
+                    if "=" in part
+                )
+                realm = fields.pop("realm", "")
+                if not realm:
+                    return False
+                tr = client.get(realm, params=fields)
+                if tr.status_code != 200:
+                    return False
+                headers["Authorization"] = f"Bearer {tr.json().get('token', '')}"
+                return True
+
+            def get(url, extra=None):
+                h = dict(headers)
+                h.update(extra or {})
+                r = client.get(url, headers=h)
+                if _authorize(r):
+                    h = dict(headers)
+                    h.update(extra or {})
+                    r = client.get(url, headers=h)
+                if r.status_code != 200:
+                    raise StorageError(f"GET {url} -> HTTP {r.status_code}")
+                return r
+
+            def fetch_blob(url) -> str:
+                """Stream a layer blob to a temp file (multi-GB weights
+                must never buffer in the initializer's RAM)."""
+                h = dict(headers)
+                with client.stream("GET", url, headers=h) as r:
+                    if _authorize(r):
+                        r.close()
+                        return fetch_blob(url)
+                    if r.status_code != 200:
+                        raise StorageError(f"GET {url} -> HTTP {r.status_code}")
+                    fd, tmp = tempfile.mkstemp(prefix="oci-layer-")
+                    with os.fdopen(fd, "wb") as f:
+                        for chunk in r.iter_bytes():
+                            f.write(chunk)
+                    return tmp
+
+            manifest = get(f"{base}/manifests/{tag}",
+                           extra={"Accept": accept}).json()
+            if "manifests" in manifest:  # image index: pick linux/amd64-ish
+                chosen = manifest["manifests"][0]
+                for m in manifest["manifests"]:
+                    plat = m.get("platform", {})
+                    if plat.get("os") == "linux":
+                        chosen = m
+                        break
+                manifest = get(f"{base}/manifests/{chosen['digest']}",
+                               extra={"Accept": accept}).json()
+            layers = manifest.get("layers", [])
+            if not layers:
+                raise StorageError(f"manifest for {uri!r} has no layers")
+            found = 0
+            for layer in layers:
+                tmp = fetch_blob(f"{base}/blobs/{layer['digest']}")
+                try:
+                    media = layer.get("mediaType", "")
+                    with open(tmp, "rb") as probe:
+                        magic = probe.read(2)
+                    if "zstd" in media:
+                        raise StorageError("zstd OCI layers are not supported")
+                    mode = "r:gz" if ("gzip" in media or magic == b"\x1f\x8b") else "r:"
+                    with tarfile.open(tmp, mode=mode) as tf:
+                        for member in tf:
+                            path = member.name.lstrip("./")
+                            if not path.startswith("models/"):
+                                continue
+                            rel = _safe_rel(path, "models")
+                            dest = os.path.join(out_dir, rel)
+                            if member.isdir():
+                                os.makedirs(dest, exist_ok=True)
+                                continue
+                            if member.issym() or member.islnk():
+                                continue  # links inside images: skip (unsafe)
+                            if not member.isfile():
+                                continue
+                            os.makedirs(os.path.dirname(dest) or out_dir,
+                                        exist_ok=True)
+                            src = tf.extractfile(member)
+                            if src is None:
+                                continue
+                            with open(dest, "wb") as f:
+                                shutil.copyfileobj(src, f)
+                            found += 1
+                finally:
+                    os.unlink(tmp)
+            if found == 0:
+                raise StorageError(
+                    f"image {uri!r} has no files under /models — not a "
+                    "modelcar image")
         return out_dir
 
     # ---------------- SDK-gated providers ----------------

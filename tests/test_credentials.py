@@ -333,3 +333,84 @@ class TestKServeClient:
         assert client.get("Deployment", "m-predictor") is None
         assert client.get("Service", "m-predictor") is None
         assert client.get("HTTPRoute", "m") is None
+
+
+class TestModelcar:
+    """OCI weight delivery (ref storage_initializer_injector.go:201
+    InjectModelcar + utils/storage.go ConfigureModelcarToContainer)."""
+
+    def _apply(self, uri):
+        mgr = ControllerManager()
+        mgr.apply(make_isvc(uri=uri))
+        dep = mgr.cluster.get("Deployment", "m-predictor")
+        return dep["spec"]["template"]["spec"]
+
+    def test_modelcar_sidecar_and_shared_volume(self):
+        pod = self._apply("oci://ghcr.io/org/model:v1")
+        assert pod["shareProcessNamespace"] is True
+        names = [c["name"] for c in pod["containers"]]
+        assert "modelcar" in names
+        car = next(c for c in pod["containers"] if c["name"] == "modelcar")
+        assert car["image"] == "ghcr.io/org/model:v1"
+        assert "ln -sf /proc/$$/root/models /mnt/models" in car["args"][2]
+        assert car["resources"]["limits"]["memory"] == "15Mi"
+        # serving container shares the emptyDir parent dir + async init
+        serving = pod["containers"][0]
+        mounts = {m["name"]: m for m in serving["volumeMounts"]}
+        assert mounts["modelcar"]["mountPath"] == "/mnt"
+        env = {e["name"]: e.get("value") for e in serving["env"]}
+        assert env["MODEL_INIT_MODE"] == "async"
+        vols = {v["name"]: v for v in pod["volumes"]}
+        assert vols["modelcar"] == {"name": "modelcar", "emptyDir": {}}
+        # prefetch init container validates /models
+        inits = {c["name"]: c for c in pod["initContainers"]}
+        assert inits["modelcar-init"]["image"] == "ghcr.io/org/model:v1"
+        # no storage-initializer for oci URIs
+        assert "storage-initializer" not in inits
+
+    def test_native_mode_image_volume(self):
+        pod = self._apply("oci+native://ghcr.io/org/model:v1")
+        vols = {v["name"]: v for v in pod["volumes"]}
+        assert vols["model-image"]["image"]["reference"] == "ghcr.io/org/model:v1"
+        serving = pod["containers"][0]
+        mounts = {m["name"]: m for m in serving["volumeMounts"]}
+        assert mounts["model-image"]["mountPath"] == "/mnt/models"
+        assert "modelcar" not in {c["name"] for c in pod["containers"]}
+
+    def test_idempotent_reinvocation(self):
+        """reinvocationPolicy IfNeeded: mutating twice must not duplicate
+        the sidecar/volumes (ref InjectModelcar idempotency)."""
+        from kserve_tpu.controlplane.webhook import PodMutator
+
+        mutator = PodMutator()
+        pod = {"containers": [{"name": "kserve-container"}]}
+        mutator.inject_modelcar(pod, "oci://r/m:1")
+        mutator.inject_modelcar(pod, "oci://r/m:1")
+        assert [c["name"] for c in pod["containers"]].count("modelcar") == 1
+        assert len([v for v in pod["volumes"] if v["name"] == "modelcar"]) == 1
+        assert len(pod["initContainers"]) == 1
+        # duplicate mounts on the serving container would be rejected by
+        # the apiserver ("Duplicate value" on mountPath)
+        serving_mounts = [m["name"] for m in pod["containers"][0]["volumeMounts"]]
+        assert serving_mounts.count("modelcar") == 1
+
+    def test_oci_fetch_uses_storage_initializer(self):
+        """oci+fetch:// takes the download path (storage.py), not the
+        sidecar — and the mutator chain (metrics agent etc.) still runs."""
+        pod = self._apply("oci+fetch://ghcr.io/org/model:v1")
+        inits = {c["name"] for c in pod.get("initContainers", [])}
+        assert "storage-initializer" in inits
+        assert "modelcar" not in {c["name"] for c in pod["containers"]}
+
+    def test_modelcar_still_gets_metrics_agent(self):
+        """The modelcar path must not short-circuit the rest of the
+        mutator chain: metric aggregation still injects the agent."""
+        mgr = ControllerManager()
+        isvc = make_isvc(uri="oci://ghcr.io/org/model:v1")
+        isvc["metadata"]["annotations"] = {
+            "serving.kserve.io/enable-metric-aggregation": "true"}
+        mgr.apply(isvc)
+        pod = mgr.cluster.get("Deployment", "m-predictor")[
+            "spec"]["template"]["spec"]
+        names = {c["name"] for c in pod["containers"]}
+        assert "modelcar" in names and "kserve-agent" in names

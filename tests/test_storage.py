@@ -243,3 +243,168 @@ class TestStorageConfigEnv:
         monkeypatch.setenv("STORAGE_CONFIG", "{not json")
         with pytest.raises(StorageError, match="STORAGE_CONFIG"):
             _apply_storage_config_env()
+
+
+class TestOciFetch:
+    """oci:// fetch mode: pull the model image via the OCI distribution
+    API and extract the /models tree (modelcar image convention)."""
+
+    @pytest.fixture
+    def fake_registry_port(self):
+        import asyncio
+        import gzip as _gzip
+        import hashlib
+        import io
+        import socket
+        import tarfile as _tarfile
+        import threading
+
+        from aiohttp import web
+
+        # build a layer: /models/weights.bin + /models/sub/config.json
+        buf = io.BytesIO()
+        with _tarfile.open(fileobj=buf, mode="w") as tf:
+            for name, payload in (("models/weights.bin", b"W" * 32),
+                                  ("models/sub/config.json", b"{}"),
+                                  ("etc/passwd", b"nope")):
+                info = _tarfile.TarInfo(name)
+                info.size = len(payload)
+                tf.addfile(info, io.BytesIO(payload))
+        layer = _gzip.compress(buf.getvalue())
+        digest = "sha256:" + hashlib.sha256(layer).hexdigest()
+        manifest = {
+            "schemaVersion": 2,
+            "mediaType": "application/vnd.oci.image.manifest.v1+json",
+            "layers": [{
+                "mediaType": "application/vnd.oci.image.layer.v1.tar+gzip",
+                "digest": digest, "size": len(layer),
+            }],
+        }
+        token_holder = {"challenged": False}
+
+        async def manifests(request):
+            if "Authorization" not in request.headers:
+                token_holder["challenged"] = True
+                port = request.url.port
+                return web.Response(status=401, headers={
+                    "WWW-Authenticate":
+                        f'Bearer realm="http://127.0.0.1:{port}/token",'
+                        'service="reg",scope="repository:org/model:pull"'})
+            return web.json_response(manifest)
+
+        async def blobs(request):
+            if request.match_info["digest"] != digest:
+                return web.Response(status=404)
+            return web.Response(body=layer)
+
+        async def token(request):
+            return web.json_response({"token": "tok123"})
+
+        app = web.Application()
+        app.router.add_get("/v2/org/model/manifests/{tag}", manifests)
+        app.router.add_get("/v2/org/model/blobs/{digest}", blobs)
+        app.router.add_get("/token", token)
+
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+        loop = asyncio.new_event_loop()
+        started = threading.Event()
+
+        def serve():
+            asyncio.set_event_loop(loop)
+            runner = web.AppRunner(app)
+            loop.run_until_complete(runner.setup())
+            loop.run_until_complete(
+                web.TCPSite(runner, "127.0.0.1", port).start())
+            started.set()
+            loop.run_forever()
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        assert started.wait(5)
+        yield port, token_holder
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=5)
+
+    def test_pull_with_token_auth(self, tmp_path, fake_registry_port,
+                                  monkeypatch):
+        port, token_holder = fake_registry_port
+        monkeypatch.setenv("OCI_REGISTRY_PLAIN_HTTP", "true")
+        out = Storage.download(
+            f"oci://127.0.0.1:{port}/org/model:v1", str(tmp_path))
+        assert (tmp_path / "weights.bin").read_bytes() == b"W" * 32
+        assert (tmp_path / "sub" / "config.json").read_bytes() == b"{}"
+        # only the /models tree extracts — never arbitrary image paths
+        assert not (tmp_path / "etc").exists()
+        assert not (tmp_path / "passwd").exists()
+        assert token_holder["challenged"]  # auth dance actually exercised
+        assert out == str(tmp_path)
+
+    def test_bad_uri_is_loud(self, tmp_path):
+        from kserve_tpu.storage.storage import StorageError
+
+        with pytest.raises(StorageError, match="registry/repository"):
+            Storage.download("oci://onlyregistry", str(tmp_path))
+
+    def test_not_a_modelcar_image_is_loud(self, tmp_path, monkeypatch):
+        """An image whose layers carry no /models tree must error, not
+        succeed with an empty out_dir."""
+        import gzip as _gzip
+        import hashlib
+        import io
+        import tarfile as _tarfile
+        import threading
+        import asyncio
+        import socket
+
+        from aiohttp import web
+        from kserve_tpu.storage.storage import StorageError
+
+        buf = io.BytesIO()
+        with _tarfile.open(fileobj=buf, mode="w") as tf:
+            info = _tarfile.TarInfo("app/bin")
+            payload = b"x"
+            info.size = len(payload)
+            tf.addfile(info, io.BytesIO(payload))
+        layer = _gzip.compress(buf.getvalue())
+        digest = "sha256:" + hashlib.sha256(layer).hexdigest()
+        manifest = {"schemaVersion": 2, "layers": [{
+            "mediaType": "application/vnd.oci.image.layer.v1.tar+gzip",
+            "digest": digest, "size": len(layer)}]}
+
+        async def manifests(request):
+            return web.json_response(manifest)
+
+        async def blobs(request):
+            return web.Response(body=layer)
+
+        app = web.Application()
+        app.router.add_get("/v2/org/empty/manifests/{tag}", manifests)
+        app.router.add_get("/v2/org/empty/blobs/{digest}", blobs)
+        sock = socket.socket(); sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]; sock.close()
+        loop = asyncio.new_event_loop()
+        started = threading.Event()
+
+        def serve():
+            asyncio.set_event_loop(loop)
+            runner = web.AppRunner(app)
+            loop.run_until_complete(runner.setup())
+            loop.run_until_complete(
+                web.TCPSite(runner, "127.0.0.1", port).start())
+            started.set()
+            loop.run_forever()
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        assert started.wait(5)
+        try:
+            monkeypatch.setenv("OCI_REGISTRY_PLAIN_HTTP", "true")
+            with pytest.raises(StorageError, match="no files under /models"):
+                Storage.download(
+                    f"oci://127.0.0.1:{port}/org/empty:v1", str(tmp_path))
+        finally:
+            loop.call_soon_threadsafe(loop.stop)
+            t.join(timeout=5)
