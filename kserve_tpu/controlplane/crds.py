@@ -312,12 +312,51 @@ class LLMModelSpec(K8sModel):
     loraAdapters: List[Dict[str, Any]] = Field(default_factory=list)
 
 
+class EmptyDirTierSpec(K8sModel):
+    """Node-local ephemeral disk tier (emptyDir.sizeLimit); the controller
+    also requests this amount as ephemeral-storage on the engine container
+    so the scheduler accounts for it."""
+
+    size: str  # k8s quantity, e.g. "50Gi"
+
+
+class PVCRefTierSpec(K8sModel):
+    name: str
+    path: Optional[str] = None  # subPath within the PVC
+
+
+class PVCTierSpec(K8sModel):
+    """Exactly one of spec (ephemeral per-pod PVC) or ref (pre-existing)."""
+
+    spec: Optional[Dict[str, Any]] = None
+    ref: Optional[PVCRefTierSpec] = None
+
+
+class FileSystemTierSpec(K8sModel):
+    """POSIX disk tier backed by a volume; one of emptyDir or pvc."""
+
+    emptyDir: Optional[EmptyDirTierSpec] = None
+    pvc: Optional[PVCTierSpec] = None
+
+
+class SecondaryTierSpec(K8sModel):
+    """One secondary KV tier (parity: SecondaryTierSpec,
+    llm_inference_service_types.go:208 — fileSystem only today, array
+    shape reserved for object-store tiers)."""
+
+    fileSystem: Optional[FileSystemTierSpec] = None
+
+
 class KVCacheOffloadingSpec(K8sModel):
-    """HBM -> host RAM KV tiering (parity: llm_inference_service_types.go:188)."""
+    """HBM -> host RAM (-> disk) KV tiering (parity:
+    llm_inference_service_types.go:188-260; engine/kv_tiers.py is the
+    runtime)."""
 
     enabled: bool = False
     hostMemoryGi: Optional[int] = None
     evictionPolicy: Literal["lru", "arc"] = "lru"
+    # ordered secondary tiers; the engine cascades host RAM -> disk
+    secondary: List[SecondaryTierSpec] = Field(default_factory=list)
 
 
 class WorkloadSpec(K8sModel):

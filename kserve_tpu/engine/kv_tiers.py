@@ -140,11 +140,45 @@ class KVTierStore:
 
     def _ensure_dir(self) -> str:
         if self._dir is None:
+            self._sweep_stale_dirs()
             path = os.path.join(
                 self.config.disk_dir, f"kv-{os.getpid()}-{uuid.uuid4().hex[:8]}")
             os.makedirs(path, exist_ok=True)
             self._dir = path
         return self._dir
+
+    def _sweep_stale_dirs(self) -> None:
+        """Remove spill dirs left by DEAD processes.  Spill files are only
+        unlinked by in-memory accounting, so a crashed pod leaks its
+        kv-<pid>-<rand> subdir; on a persistent volume (PVC tier) those
+        leaks accumulate across restarts until the claim fills and
+        np.savez dies with ENOSPC.  A dir whose embedded pid is still
+        alive (a concurrent engine on a shared RWX claim) is left alone."""
+        import re as _re
+        import shutil as _shutil
+
+        try:
+            names = os.listdir(self.config.disk_dir)
+        except OSError:
+            return
+        for name in names:
+            m = _re.fullmatch(r"kv-(\d+)-[0-9a-f]+", name)
+            if not m:
+                continue
+            pid = int(m.group(1))
+            alive = True
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                alive = False
+            except PermissionError:
+                pass  # exists, owned by someone else: alive
+            if alive:
+                # a live process — possibly another store in THIS process
+                # (dp replicas share the dir): never touch it
+                continue
+            _shutil.rmtree(
+                os.path.join(self.config.disk_dir, name), ignore_errors=True)
 
     def _host_keys_coldest_first(self):
         return [k for k, e in self._entries.items() if e.tier == "host"]

@@ -547,6 +547,14 @@ def main(argv=None):
     parser.add_argument("--kv_offload", default="none", choices=("none", "host"))
     parser.add_argument("--kv_offload_gib", default=0.0, type=float)
     parser.add_argument(
+        "--kv_offload_disk_gib", default=0.0, type=float,
+        help="secondary disk tier budget (GiB) under --kv_offload_dir; "
+        "entries demote host->disk per --kv_offload_policy",
+    )
+    parser.add_argument("--kv_offload_dir", default="/tmp/kserve-tpu-kv")
+    parser.add_argument(
+        "--kv_offload_policy", default="lru", choices=("lru", "arc"))
+    parser.add_argument(
         "--lora_adapters", default=None,
         help="comma-separated name=/local/adapter/dir (HF PEFT format)",
     )
@@ -568,6 +576,9 @@ def main(argv=None):
         weight_quant=args.weight_quant,
         kv_offload=args.kv_offload,
         kv_offload_gib=args.kv_offload_gib,
+        kv_offload_disk_gib=args.kv_offload_disk_gib,
+        kv_offload_dir=args.kv_offload_dir,
+        kv_offload_policy=args.kv_offload_policy,
     )
     lora_adapters = None
     if args.lora_adapters:

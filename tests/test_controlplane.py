@@ -470,3 +470,124 @@ class TestConfigReloadAndAdmission:
         assert env["OTEL_EXPORTER_OTLP_ENDPOINT"] == (
             "http://tr-otel-collector.default:4317"
         )
+
+
+class TestKVDiskTier:
+    """CRD -> engine disk-tier plumbing (VERDICT r4 weak #9; parity:
+    SecondaryTierSpec, llm_inference_service_types.go:208-260)."""
+
+    def _deploy(self, kv):
+        from kserve_tpu.controlplane.crds import LLMInferenceService
+        from kserve_tpu.controlplane.llmisvc import LLMISVCReconciler
+
+        llm = LLMInferenceService.model_validate({
+            "apiVersion": "serving.kserve.io/v1alpha2",
+            "kind": "LLMInferenceService",
+            "metadata": {"name": "kvd", "namespace": "default"},
+            "spec": {"model": {"uri": "hf://org/m", "name": "m"},
+                     "workload": {"kvCacheOffloading": kv}},
+        })
+        objects, _ = LLMISVCReconciler().reconcile(llm)
+        dep = next(o for o in objects if o["kind"] == "Deployment")
+        return dep["spec"]["template"]["spec"]
+
+    def test_emptydir_tier_volume_args_and_scheduling(self):
+        pod = self._deploy({
+            "enabled": True, "hostMemoryGi": 4, "evictionPolicy": "arc",
+            "secondary": [{"fileSystem": {"emptyDir": {"size": "50Gi"}}}],
+        })
+        main = next(c for c in pod["containers"] if c["name"] == "main")
+        args = main["args"]
+        assert "--kv_offload=host" in args
+        assert "--kv_offload_gib=4" in args
+        assert "--kv_offload_policy=arc" in args
+        assert "--kv_offload_disk_gib=50.0" in args
+        assert "--kv_offload_dir=/var/cache/kserve-tpu-kv" in args
+        vols = {v["name"]: v for v in pod["volumes"]}
+        assert vols["kv-disk-cache"]["emptyDir"]["sizeLimit"] == "50Gi"
+        mounts = {m["name"]: m for m in main["volumeMounts"]}
+        assert mounts["kv-disk-cache"]["mountPath"] == "/var/cache/kserve-tpu-kv"
+        # scheduler accounts for node-local disk
+        assert main["resources"]["requests"]["ephemeral-storage"] == "50Gi"
+
+    def test_pvc_ref_tier(self):
+        pod = self._deploy({
+            "enabled": True,
+            "secondary": [{"fileSystem": {"pvc": {
+                "ref": {"name": "kv-cache-pvc", "path": "shard-a"}}}}],
+        })
+        main = next(c for c in pod["containers"] if c["name"] == "main")
+        vols = {v["name"]: v for v in pod["volumes"]}
+        assert vols["kv-disk-cache"]["persistentVolumeClaim"]["claimName"] == (
+            "kv-cache-pvc")
+        mounts = {m["name"]: m for m in main["volumeMounts"]}
+        assert mounts["kv-disk-cache"]["subPath"] == "shard-a"
+        # PVC capacity governs; the engine budget is effectively unbounded
+        assert "--kv_offload_disk_gib=1048576" in main["args"]
+
+    def test_no_secondary_no_disk_flags(self):
+        pod = self._deploy({"enabled": True, "hostMemoryGi": 2})
+        main = next(c for c in pod["containers"] if c["name"] == "main")
+        assert not any(a.startswith("--kv_offload_disk") for a in main["args"])
+        assert "kv-disk-cache" not in {v["name"] for v in pod.get("volumes", [])}
+
+    def test_ephemeral_pvc_tier(self):
+        """pvc.spec: a per-pod ephemeral PVC (volumeClaimTemplate) whose
+        storage request sizes the engine budget."""
+        claim_spec = {
+            "accessModes": ["ReadWriteOnce"],
+            "resources": {"requests": {"storage": "20Gi"}},
+            "storageClassName": "fast-ssd",
+        }
+        pod = self._deploy({
+            "enabled": True,
+            "secondary": [{"fileSystem": {"pvc": {"spec": claim_spec}}}],
+        })
+        main = next(c for c in pod["containers"] if c["name"] == "main")
+        vols = {v["name"]: v for v in pod["volumes"]}
+        tmpl = vols["kv-disk-cache"]["ephemeral"]["volumeClaimTemplate"]
+        assert tmpl["spec"] == claim_spec
+        assert "--kv_offload_disk_gib=20.0" in main["args"]
+
+    def test_kv_disk_survives_lora_adapters(self):
+        """Regression: the adapters branch assigned (not appended) pod
+        volumes/mounts, dropping the kv disk tier when both were set."""
+        from kserve_tpu.controlplane.crds import LLMInferenceService
+        from kserve_tpu.controlplane.llmisvc import LLMISVCReconciler
+
+        llm = LLMInferenceService.model_validate({
+            "apiVersion": "serving.kserve.io/v1alpha2",
+            "kind": "LLMInferenceService",
+            "metadata": {"name": "kvl", "namespace": "default"},
+            "spec": {
+                "model": {"uri": "hf://org/m", "name": "m",
+                          "loraAdapters": [
+                              {"name": "ad1", "uri": "hf://org/ad1"}]},
+                "workload": {"kvCacheOffloading": {
+                    "enabled": True,
+                    "secondary": [{"fileSystem": {
+                        "emptyDir": {"size": "8Gi"}}}]}},
+            },
+        })
+        objects, _ = LLMISVCReconciler().reconcile(llm)
+        dep = next(o for o in objects if o["kind"] == "Deployment")
+        pod = dep["spec"]["template"]["spec"]
+        vols = {v["name"] for v in pod["volumes"]}
+        assert {"kv-disk-cache", "lora-adapters"} <= vols
+        main = next(c for c in pod["containers"] if c["name"] == "main")
+        mounts = {m["name"] for m in main["volumeMounts"]}
+        assert {"kv-disk-cache", "lora-adapters"} <= mounts
+
+    def test_quantity_parsing(self):
+        import pytest as _pytest
+
+        from kserve_tpu.controlplane.llmisvc import _quantity_gib
+
+        assert _quantity_gib("1Gi") == 1.0
+        assert _quantity_gib("512Mi") == 0.5
+        assert _quantity_gib("1Pi") == 1024 * 1024
+        assert abs(_quantity_gib("1G") - 1e9 / (1 << 30)) < 1e-9
+        assert abs(_quantity_gib("500k") - 5e5 / (1 << 30)) < 1e-12
+        assert _quantity_gib(str(1 << 30)) == 1.0  # bare bytes
+        with _pytest.raises(ValueError, match="quantity"):
+            _quantity_gib("tenGi")

@@ -676,3 +676,33 @@ class TestInt8KVCache:
 
         with pytest.raises(ValueError, match="kv_quant"):
             make_engine(kv_quant="fp8")
+
+
+class TestKVTierStaleSweep:
+    def test_dead_process_spill_dirs_removed_live_kept(self, tmp_path):
+        """PVC-tier leak guard: spill dirs from dead pids are swept at
+        first spill; dirs of live processes (concurrent engines on a
+        shared RWX claim) are untouched."""
+        import os
+
+        import numpy as np
+
+        from kserve_tpu.engine.kv_tiers import KVTierStore, TierConfig
+
+        base = str(tmp_path)
+        stale = os.path.join(base, "kv-999999-deadbeef")  # pid surely dead
+        os.makedirs(stale)
+        with open(os.path.join(stale, "x.npz"), "wb") as f:
+            f.write(b"stale")
+        live = os.path.join(base, f"kv-{os.getpid()}-cafecafe")
+        os.makedirs(live)
+        unrelated = os.path.join(base, "not-a-spill-dir")
+        os.makedirs(unrelated)
+
+        store = KVTierStore(TierConfig(
+            host_bytes=1, disk_bytes=1 << 20, disk_dir=base, policy="lru"))
+        # host budget of 1 byte forces the put straight to disk
+        store.put("k1", {"a": np.zeros((4,), np.float32)})
+        assert not os.path.exists(stale), "dead-pid dir not swept"
+        assert os.path.exists(live), "live-pid dir wrongly removed"
+        assert os.path.exists(unrelated), "non-spill dir wrongly removed"
