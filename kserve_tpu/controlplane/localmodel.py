@@ -35,6 +35,7 @@ class LocalModelCacheReconciler:
         name = cache.metadata.name
         objects: List[dict] = []
         node_copies = []
+        key = storage_key(cache.spec.sourceModelUri)
         for group in cache.spec.nodeGroups:
             pv_name = f"{name}-{group}"
             pv = make_object(
@@ -42,7 +43,9 @@ class LocalModelCacheReconciler:
                 spec={
                     "capacity": {"storage": cache.spec.modelSize or "50Gi"},
                     "accessModes": ["ReadWriteOnce"],
-                    "hostPath": {"path": f"{CACHE_BASE_PATH}/{name}"},
+                    # the shared cache base: copies live in hash-keyed
+                    # subdirs so caches sharing a URI share one download
+                    "hostPath": {"path": CACHE_BASE_PATH},
                     "storageClassName": "local-model-cache",
                 },
             )
@@ -57,8 +60,13 @@ class LocalModelCacheReconciler:
             )
             objects.extend([pv, pvc])
             for node in self.node_groups.get(group, []):
+                # keyed by the STORAGE key, not the cache name: two caches
+                # sharing a sourceModelUri converge on one Job per node
+                # (same object name), so the shared hash dir is written by
+                # exactly one downloader
                 job = make_object(
-                    "batch/v1", "Job", f"{name}-{node}", "kserve-localmodel-jobs",
+                    "batch/v1", "Job", f"dl-{key[:12]}-{node}",
+                    "kserve-localmodel-jobs",
                     spec={
                         "template": {
                             "spec": {
@@ -71,9 +79,12 @@ class LocalModelCacheReconciler:
                                         "command": [
                                             "python", "-m", "kserve_tpu.storage.initializer",
                                         ],
+                                        # --manifest: the node agent
+                                        # verifies cached files against it
                                         "args": [
+                                            "--manifest",
                                             cache.spec.sourceModelUri,
-                                            f"{CACHE_BASE_PATH}/{name}",
+                                            f"{CACHE_BASE_PATH}/{key}",
                                         ],
                                         "volumeMounts": [
                                             {"name": "cache", "mountPath": CACHE_BASE_PATH}
@@ -107,25 +118,129 @@ class LocalModelCacheReconciler:
         return objects, status
 
 
+def storage_key(uri: str) -> str:
+    """Hash-based folder name for a source URI (parity:
+    v1alpha1.GetStorageKey): CRs sharing a URI share one on-disk copy."""
+    import hashlib
+
+    return hashlib.sha256(uri.encode()).hexdigest()[:16]
+
+
+# per-model states (parity: v1alpha1.ModelStatus)
+DOWNLOADED = "Downloaded"
+DOWNLOADING = "Downloading"
+DOWNLOAD_PENDING = "DownloadPending"
+DOWNLOAD_ERROR = "DownloadError"
+
+
 class LocalModelNodeAgent:
-    """Per-node reconcile (the DaemonSet agent's logic): verify cached model
-    dirs exist, delete models no longer desired.  Parity:
-    localmodelnode/controller.go downloadModels:347 / deleteModels:450."""
+    """Per-node reconcile (the DaemonSet agent's logic).  Parity:
+    localmodelnode/controller.go downloadModels:347 / deleteModels:450,
+    with verification strengthened beyond the reference's folder-exists
+    check: the download Job writes a `.kserve_manifest.json` (initializer
+    --manifest) and the agent validates every cached file against it —
+    a missing manifest (interrupted download) or a missing/truncated file
+    (corruption) deletes the copy and schedules a re-download.
+
+    reconcile() is PURE w.r.t. the cluster: it returns the Jobs to create
+    and the per-model status; the caller (DaemonSet main loop / tests)
+    applies them.  Filesystem effects (deleting stale or corrupt copies)
+    happen directly, as on the reference's node agent."""
 
     def __init__(self, cache_base: str = CACHE_BASE_PATH):
         self.cache_base = cache_base
 
-    def reconcile(self, desired_models: List[str]) -> dict:
+    # ---------------- verification ----------------
+
+    def verify_copy(self, key: str) -> str:
+        """'' if the cached copy verifies; else a reason string."""
+        import json
+        import os
+
+        path = os.path.join(self.cache_base, key)
+        if not os.path.isdir(path):
+            return "missing"
+        manifest_path = os.path.join(path, ".kserve_manifest.json")
+        try:
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+        except FileNotFoundError:
+            return "no-manifest (interrupted download?)"
+        except (OSError, ValueError) as exc:
+            return f"unreadable manifest: {exc}"
+        for rel, size in (manifest.get("files") or {}).items():
+            full = os.path.join(path, rel)
+            if not os.path.isfile(full):
+                return f"missing file {rel}"
+            actual = os.path.getsize(full)
+            if actual != size:
+                return f"size mismatch {rel}: {actual} != {size}"
+        return ""
+
+    # ---------------- reconcile ----------------
+
+    def reconcile(
+        self,
+        local_models: List[dict],  # [{"name": ..., "uri": ...}]
+        job_status: Optional[Dict[str, dict]] = None,  # key -> JobStatus-ish
+    ) -> dict:
+        """Returns {"status": {model: state}, "jobs": [keys to (re)launch],
+        "removed": [stale keys], "redownloads": {key: reason}}."""
         import os
         import shutil
 
+        job_status = job_status or {}
         os.makedirs(self.cache_base, exist_ok=True)
-        actual = set(os.listdir(self.cache_base))
-        desired = set(desired_models)
-        removed = []
-        for stale in sorted(actual - desired):
-            shutil.rmtree(os.path.join(self.cache_base, stale), ignore_errors=True)
-            removed.append(stale)
-        missing = sorted(desired - actual)
-        present = sorted(desired & actual)
-        return {"present": present, "missing": missing, "removed": removed}
+
+        status: Dict[str, str] = {}
+        processed: Dict[str, str] = {}  # storage key -> state (dedupe)
+        jobs: List[str] = []
+        redownloads: Dict[str, str] = {}
+        desired_keys = set()
+        for model in local_models:
+            name, uri = model["name"], model["uri"]
+            key = storage_key(uri)
+            desired_keys.add(key)
+            if key in processed:
+                # another CR shares the URI: one download, shared status
+                status[name] = processed[key]
+                continue
+            problem = self.verify_copy(key)
+            js = job_status.get(key)
+            if not problem:
+                # the manifest is written last: a copy that verifies is
+                # complete regardless of what (possibly stale) job status
+                # says
+                state = DOWNLOADED
+            elif js and js.get("failed"):
+                # the Job retried up to backoffLimit and failed: surface
+                # the error, do not hot-loop new jobs (operator deletes
+                # the failed Job to retry — reference behavior)
+                state = DOWNLOAD_ERROR
+            elif js and (js.get("active") or js.get("ready")):
+                state = DOWNLOADING
+            else:
+                # missing or corrupt with no live job: (re)download.  A
+                # stale succeeded job must NOT mask the wiped copy as
+                # Downloaded — the files are gone until the new job runs.
+                if problem != "missing":
+                    # corrupt/interrupted: remove before re-downloading so
+                    # the initializer starts clean
+                    shutil.rmtree(os.path.join(self.cache_base, key),
+                                  ignore_errors=True)
+                    redownloads[key] = problem
+                jobs.append(key)
+                state = DOWNLOAD_PENDING
+            status[name] = state
+            processed[key] = state
+
+        # deleteModels (:450): folders on disk not desired by any CR
+        removed: List[str] = []
+        for entry in sorted(os.listdir(self.cache_base)):
+            full = os.path.join(self.cache_base, entry)
+            if not os.path.isdir(full) or entry in desired_keys:
+                continue
+            shutil.rmtree(full, ignore_errors=True)
+            removed.append(entry)
+        return {"status": status, "jobs": jobs, "removed": removed,
+                "redownloads": redownloads}

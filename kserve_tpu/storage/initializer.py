@@ -3,23 +3,49 @@ runtime container starts.
 
 Parity: reference python/storage-initializer/scripts/initializer-entrypoint.
 
-Usage: python -m kserve_tpu.storage.initializer <src-uri> <dest-dir> [...]
+Usage: python -m kserve_tpu.storage.initializer [--manifest] <src> <dest> [...]
+
+--manifest: after each download, write `.kserve_manifest.json` ({relative
+path: size}) into the dest dir.  The LocalModelNode agent verifies cached
+copies against it (missing/truncated files -> corrupt -> re-download),
+and its absence marks an interrupted download.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import sys
 
 from ..logging import configure_logging, logger
 from .storage import Storage
 
+MANIFEST_NAME = ".kserve_manifest.json"
+
+
+def write_manifest(dest: str) -> None:
+    files = {}
+    for root, _, names in os.walk(dest):
+        for name in names:
+            if name == MANIFEST_NAME:
+                continue
+            path = os.path.join(root, name)
+            files[os.path.relpath(path, dest)] = os.path.getsize(path)
+    with open(os.path.join(dest, MANIFEST_NAME), "w") as f:
+        json.dump({"files": files}, f, sort_keys=True)
+
 
 def main(argv=None) -> int:
     configure_logging()
     args = list(argv if argv is not None else sys.argv[1:])
+    manifest = False
+    if args and args[0] == "--manifest":
+        manifest = True
+        args = args[1:]
     if len(args) < 2 or len(args) % 2 != 0:
         print(
-            "usage: initializer <src-uri> <dest-dir> [<src-uri> <dest-dir> ...]",
+            "usage: initializer [--manifest] <src-uri> <dest-dir> "
+            "[<src-uri> <dest-dir> ...]",
             file=sys.stderr,
         )
         return 2
@@ -27,6 +53,8 @@ def main(argv=None) -> int:
     for src, dest in pairs:
         logger.info("initializer: %s -> %s", src, dest)
         Storage.download(src, dest)
+        if manifest:
+            write_manifest(dest)
     return 0
 
 

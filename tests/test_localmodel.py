@@ -25,14 +25,24 @@ class TestLocalModelCache:
         kinds = [(o["kind"], o["metadata"]["name"]) for o in objects]
         assert ("PersistentVolume", "llama-cache-tpu-v5e") in kinds
         assert ("PersistentVolumeClaim", "llama-cache-tpu-v5e") in kinds
+        from kserve_tpu.controlplane.localmodel import storage_key
+
+        key12 = storage_key("hf://meta-llama/Llama-3.2-1B")[:12]
         jobs = [o for o in objects if o["kind"] == "Job"]
+        # job names keyed by STORAGE key: caches sharing a URI converge on
+        # one Job per node instead of racing writers in the shared dir
         assert {j["metadata"]["name"] for j in jobs} == {
-            "llama-cache-node-a", "llama-cache-node-b",
+            f"dl-{key12}-node-a", f"dl-{key12}-node-b",
         }
         job = jobs[0]
         pod = job["spec"]["template"]["spec"]
         assert pod["nodeName"] in ("node-a", "node-b")
-        assert pod["containers"][0]["args"][0] == "hf://meta-llama/Llama-3.2-1B"
+        args = pod["containers"][0]["args"]
+        assert args[0] == "--manifest"
+        assert args[1] == "hf://meta-llama/Llama-3.2-1B"
+        from kserve_tpu.controlplane.localmodel import storage_key
+
+        assert args[2].endswith(storage_key("hf://meta-llama/Llama-3.2-1B"))
         assert status["copies"] == {"total": 2, "available": 0}
         conds = {c["type"]: c["status"] for c in status["conditions"]}
         assert conds["Ready"] == "False"
@@ -47,13 +57,131 @@ class TestLocalModelCache:
         assert conds["Ready"] == "True"
 
 
+def _write_copy(base, uri, files=None, manifest=True, truncate=None):
+    """A cached copy as the download Job leaves it (optionally corrupt)."""
+    import json
+    import os
+
+    from kserve_tpu.controlplane.localmodel import storage_key
+
+    key = storage_key(uri)
+    path = base / key
+    path.mkdir(parents=True, exist_ok=True)
+    files = files or {"weights.bin": 64, "config.json": 2}
+    for rel, size in files.items():
+        full = path / rel
+        full.parent.mkdir(parents=True, exist_ok=True)
+        full.write_bytes(b"x" * size)
+    if manifest:
+        (path / ".kserve_manifest.json").write_text(
+            json.dumps({"files": dict(files)}))
+    if truncate:
+        (path / truncate).write_bytes(b"x")  # corrupt: wrong size
+    return key
+
+
 class TestNodeAgent:
-    def test_deletes_stale_reports_missing(self, tmp_path):
-        (tmp_path / "keep-me").mkdir()
-        (tmp_path / "stale").mkdir()
-        agent = LocalModelNodeAgent(cache_base=str(tmp_path))
-        result = agent.reconcile(["keep-me", "not-here-yet"])
-        assert result["present"] == ["keep-me"]
-        assert result["missing"] == ["not-here-yet"]
-        assert result["removed"] == ["stale"]
-        assert not (tmp_path / "stale").exists()
+    """Parity: localmodelnode/controller.go downloadModels:347 (verify)
+    and deleteModels:450 (stale cleanup), plus manifest-based corruption
+    detection beyond the reference's folder-exists check."""
+
+    URI = "hf://org/model-a"
+
+    def _agent(self, tmp_path):
+        return LocalModelNodeAgent(cache_base=str(tmp_path))
+
+    def test_verified_copy_is_downloaded(self, tmp_path):
+        _write_copy(tmp_path, self.URI)
+        out = self._agent(tmp_path).reconcile(
+            [{"name": "m-a", "uri": self.URI}])
+        assert out["status"] == {"m-a": "Downloaded"}
+        assert out["jobs"] == [] and out["redownloads"] == {}
+
+    def test_missing_copy_schedules_job(self, tmp_path):
+        from kserve_tpu.controlplane.localmodel import storage_key
+
+        out = self._agent(tmp_path).reconcile(
+            [{"name": "m-a", "uri": self.URI}])
+        assert out["status"] == {"m-a": "DownloadPending"}
+        assert out["jobs"] == [storage_key(self.URI)]
+
+    def test_corrupt_file_triggers_redownload(self, tmp_path):
+        """A truncated weights file (size != manifest) deletes the copy
+        and schedules a fresh download."""
+        from kserve_tpu.controlplane.localmodel import storage_key
+
+        key = _write_copy(tmp_path, self.URI, truncate="weights.bin")
+        out = self._agent(tmp_path).reconcile(
+            [{"name": "m-a", "uri": self.URI}])
+        assert out["jobs"] == [storage_key(self.URI)]
+        assert "size mismatch" in out["redownloads"][key]
+        assert not (tmp_path / key).exists()  # wiped before re-download
+
+    def test_interrupted_download_no_manifest_redownloads(self, tmp_path):
+        key = _write_copy(tmp_path, self.URI, manifest=False)
+        out = self._agent(tmp_path).reconcile(
+            [{"name": "m-a", "uri": self.URI}])
+        assert out["jobs"] == [key]
+        assert "no-manifest" in out["redownloads"][key]
+
+    def test_removed_cache_cleanup(self, tmp_path):
+        """Folders no CR wants anymore are deleted (deleteModels :450)."""
+        stale_key = _write_copy(tmp_path, "hf://org/old-model")
+        keep_key = _write_copy(tmp_path, self.URI)
+        out = self._agent(tmp_path).reconcile(
+            [{"name": "m-a", "uri": self.URI}])
+        assert out["removed"] == [stale_key]
+        assert not (tmp_path / stale_key).exists()
+        assert (tmp_path / keep_key).exists()
+
+    def test_failed_job_surfaces_error_without_hot_loop(self, tmp_path):
+        """Job failed after its own backoffLimit retries: the status is
+        DownloadError and no new job spawns (operator deletes the Job to
+        retry — reference behavior)."""
+        from kserve_tpu.controlplane.localmodel import storage_key
+
+        key = storage_key(self.URI)
+        out = self._agent(tmp_path).reconcile(
+            [{"name": "m-a", "uri": self.URI}],
+            job_status={key: {"failed": 3}},
+        )
+        assert out["status"] == {"m-a": "DownloadError"}
+        assert out["jobs"] == []
+
+    def test_active_job_reports_downloading(self, tmp_path):
+        from kserve_tpu.controlplane.localmodel import storage_key
+
+        key = storage_key(self.URI)
+        out = self._agent(tmp_path).reconcile(
+            [{"name": "m-a", "uri": self.URI}],
+            job_status={key: {"active": 1}},
+        )
+        assert out["status"] == {"m-a": "Downloading"}
+        assert out["jobs"] == []
+
+    def test_shared_uri_dedupes_download(self, tmp_path):
+        """Two CRs pointing at one URI share the copy: one job, shared
+        status (processedStorageKeys in the reference)."""
+        out = self._agent(tmp_path).reconcile([
+            {"name": "m-a", "uri": self.URI},
+            {"name": "m-b", "uri": self.URI},
+        ])
+        assert len(out["jobs"]) == 1
+        assert out["status"] == {"m-a": "DownloadPending",
+                                 "m-b": "DownloadPending"}
+
+    def test_agent_verifies_real_initializer_manifest(self, tmp_path):
+        """End-to-end: a real initializer run with --manifest produces a
+        copy the agent verifies green."""
+        from kserve_tpu.controlplane.localmodel import storage_key
+        from kserve_tpu.storage.initializer import main as init_main
+
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "weights.bin").write_bytes(b"W" * 128)
+        key = storage_key(f"file://{src}")
+        dest = tmp_path / "cache" / key
+        assert init_main(["--manifest", f"file://{src}", str(dest)]) == 0
+        agent = LocalModelNodeAgent(cache_base=str(tmp_path / "cache"))
+        out = agent.reconcile([{"name": "m", "uri": f"file://{src}"}])
+        assert out["status"] == {"m": "Downloaded"}
