@@ -1,13 +1,21 @@
-"""Time-series protocol head: /timeseries/v1/forecast.
+"""Time-series forecasting protocol (the OpenAI-pattern mirror for
+forecasting runtimes).
 
-Parity: reference python/kserve/kserve/protocol/rest/timeseries/ (the
-OpenAI-pattern mirror for forecasting runtimes — typed request/response,
-model ABC, aiohttp routes)."""
+Parity: reference python/kserve/kserve/protocol/rest/timeseries/
+(types.py — univariate/multivariate series, Frequency enum + step math,
+quantile forecasts, per-output status; endpoints.py — POST
+/v1/timeseries/forecast + GET /v1/timeseries/models; dataplane.py;
+error.py), rebuilt on aiohttp + pydantic v2.
+"""
 
 from __future__ import annotations
 
 import json
-from typing import Dict, List, Optional
+import time
+import uuid
+from datetime import datetime, timedelta
+from enum import Enum
+from typing import Dict, List, Optional, Union
 
 from aiohttp import web
 from pydantic import BaseModel, ConfigDict, Field, ValidationError
@@ -15,44 +23,247 @@ from pydantic import BaseModel, ConfigDict, Field, ValidationError
 from ..errors import InvalidInput, ModelNotFound, ModelNotReady
 from ..model import BaseModel as ServableModel
 
+# List[float] (univariate) or List[List[float]] (multivariate, one inner
+# list per timestep)
+TimeSeries = Union[List[float], List[List[float]]]
 
-class TimeSeries(BaseModel):
+
+class Error(BaseModel):
+    code: Optional[str] = None
+    message: str
+    param: Optional[str] = None
+    type: str
+
+
+class ErrorResponse(BaseModel):
+    error: Error
+
+
+class Frequency(str, Enum):
+    SECOND = "second"
+    SECOND_SHORT = "S"
+    MINUTE = "minute"
+    MINUTE_SHORT = "T"
+    HOUR = "hour"
+    HOUR_SHORT = "H"
+    DAY = "day"
+    DAY_SHORT = "D"
+    WEEK = "week"
+    WEEK_SHORT = "W"
+    MONTH = "month"
+    MONTH_SHORT = "M"
+    QUARTER = "quarter"
+    QUARTER_SHORT = "Q"
+    YEAR = "year"
+    YEAR_SHORT = "Y"
+
+
+def _month_add(dt: datetime, months: int) -> datetime:
+    import calendar
+
+    month = dt.month - 1 + months
+    year = dt.year + month // 12
+    month = month % 12 + 1
+    # clamp the day (Jan 31 + 1 month -> Feb 28/29)
+    return dt.replace(
+        year=year, month=month,
+        day=min(dt.day, calendar.monthrange(year, month)[1]))
+
+
+FREQUENCY_MAP = {
+    "S": lambda steps: timedelta(seconds=steps),
+    "second": lambda steps: timedelta(seconds=steps),
+    "T": lambda steps: timedelta(minutes=steps),
+    "minute": lambda steps: timedelta(minutes=steps),
+    "H": lambda steps: timedelta(hours=steps),
+    "hour": lambda steps: timedelta(hours=steps),
+    "D": lambda steps: timedelta(days=steps),
+    "day": lambda steps: timedelta(days=steps),
+    "W": lambda steps: timedelta(weeks=steps),
+    "week": lambda steps: timedelta(weeks=steps),
+}
+_MONTHLY = {"M": 1, "month": 1, "Q": 3, "quarter": 3, "Y": 12, "year": 12}
+
+
+def _parse_iso(ts: str) -> datetime:
+    # py3.10's fromisoformat rejects the common 'Z' UTC suffix
+    return datetime.fromisoformat(ts.replace("Z", "+00:00"))
+
+
+def advance_timestamp(start: str, frequency: Frequency, steps: int) -> str:
+    """ISO8601 start + N frequency steps (a forecast's start is the
+    observation window's end + one step)."""
+    dt = _parse_iso(start)
+    freq = frequency.value
+    if freq in _MONTHLY:
+        return _month_add(dt, _MONTHLY[freq] * steps).isoformat()
+    return (dt + FREQUENCY_MAP[freq](steps)).isoformat()
+
+
+class Status(str, Enum):
+    COMPLETED = "completed"
+    ERROR = "error"
+    PENDING = "pending"
+    PARTIAL = "partial"
+
+
+class TimeSeriesType(str, Enum):
+    UNIVARIATE = "univariate_time_series"
+    MULTIVARIATE = "multivariate_time_series"
+
+
+class TimeSeriesInput(BaseModel):
     model_config = ConfigDict(extra="allow")
-    timestamps: List[str] = Field(default_factory=list)
-    values: List[float] = Field(default_factory=list)
-    id: Optional[str] = None
+    type: TimeSeriesType
+    name: str
+    series: TimeSeries
+    frequency: Frequency
+    start_timestamp: Optional[str] = None
+
+
+class ForecastOptions(BaseModel):
+    model_config = ConfigDict(extra="allow")
+    horizon: int
+    quantiles: Optional[List[float]] = None
+
+
+class Metadata(BaseModel):
+    model_config = ConfigDict(extra="allow")
 
 
 class ForecastRequest(BaseModel):
     model_config = ConfigDict(extra="allow")
     model: str
-    inputs: List[TimeSeries]
-    horizon: int = 1
-    quantiles: Optional[List[float]] = None
-    parameters: Dict[str, object] = Field(default_factory=dict)
+    inputs: List[TimeSeriesInput]
+    options: ForecastOptions
+    metadata: Optional[Metadata] = None
 
 
-class Forecast(BaseModel):
-    id: Optional[str] = None
-    values: List[float] = Field(default_factory=list)
-    quantile_values: Optional[Dict[str, List[float]]] = None
+class TimeSeriesForecast(BaseModel):
+    model_config = ConfigDict(extra="allow")
+    type: TimeSeriesType
+    name: str
+    mean_forecast: TimeSeries
+    frequency: Frequency
+    start_timestamp: str
+    quantiles: Optional[Dict[str, TimeSeries]] = None
+
+
+class ForecastOutput(BaseModel):
+    model_config = ConfigDict(extra="allow")
+    type: str = "forecast"
+    id: str = Field(default_factory=lambda: f"fo-{uuid.uuid4().hex}")
+    status: Status
+    content: List[TimeSeriesForecast]
+    error: Optional[Error] = None
+
+
+class Usage(BaseModel):
+    model_config = ConfigDict(extra="allow")
+    prompt_tokens: int
+    completion_tokens: int
+    total_tokens: int
 
 
 class ForecastResponse(BaseModel):
-    model: str = ""
-    forecasts: List[Forecast] = Field(default_factory=list)
+    model_config = ConfigDict(extra="allow")
+    id: str
+    created_at: int
+    status: Status
+    error: Optional[Error] = None
+    model: str
+    outputs: List[ForecastOutput]
+    usage: Optional[Usage] = None
+
+
+def make_forecast_response(model: str, outputs: List[ForecastOutput],
+                           usage: Optional[Usage] = None) -> ForecastResponse:
+    """Response envelope with id/timestamp/aggregate status filled in."""
+    if outputs and all(o.status == Status.COMPLETED for o in outputs):
+        status = Status.COMPLETED
+    elif any(o.status == Status.COMPLETED for o in outputs):
+        status = Status.PARTIAL
+    else:
+        status = Status.ERROR
+    return ForecastResponse(
+        id=f"forecast-{uuid.uuid4().hex}",
+        created_at=int(time.time()),
+        status=status,
+        model=model,
+        outputs=outputs,
+        usage=usage,
+    )
 
 
 class TimeSeriesModel(ServableModel):
     """Forecasting runtimes implement create_forecast."""
 
-    async def create_forecast(self, request: ForecastRequest, context=None) -> ForecastResponse:
+    async def create_forecast(self, request: ForecastRequest,
+                              context=None) -> ForecastResponse:
         raise NotImplementedError()
+
+
+def _validate_series(inputs: List[TimeSeriesInput]) -> None:
+    for ts in inputs:
+        if not ts.series:
+            raise InvalidInput(f"series {ts.name!r} is empty")
+        first = ts.series[0]
+        if ts.type == TimeSeriesType.MULTIVARIATE:
+            if not isinstance(first, list):
+                raise InvalidInput(
+                    f"series {ts.name!r} is multivariate but rows are scalars")
+            width = len(first)
+            if width == 0:
+                raise InvalidInput(
+                    f"series {ts.name!r} rows are empty (0 variables)")
+            if any(not isinstance(row, list) or len(row) != width
+                   for row in ts.series):
+                raise InvalidInput(
+                    f"series {ts.name!r} rows must all have {width} variables")
+        elif isinstance(first, list):
+            raise InvalidInput(
+                f"series {ts.name!r} is univariate but rows are lists")
+        if ts.start_timestamp is not None:
+            try:
+                _parse_iso(ts.start_timestamp)
+            except ValueError:
+                raise InvalidInput(
+                    f"series {ts.name!r} start_timestamp is not ISO8601")
+
+
+class TimeSeriesDataPlane:
+    """Validation + model dispatch (ref dataplane.py)."""
+
+    def __init__(self, model_registry):
+        self._registry = model_registry
+
+    async def forecast(self, request: ForecastRequest) -> ForecastResponse:
+        model = self._registry.get_model(request.model)
+        if model is None:
+            raise ModelNotFound(request.model)
+        if not await self._registry.is_model_ready(request.model):
+            raise ModelNotReady(request.model)
+        if not isinstance(model, TimeSeriesModel):
+            raise InvalidInput(
+                f"model {request.model} does not support forecasting")
+        if request.options.horizon < 1:
+            raise InvalidInput("options.horizon must be >= 1")
+        for q in request.options.quantiles or []:
+            if not 0.0 < q < 1.0:
+                raise InvalidInput(f"quantile {q} outside (0, 1)")
+        _validate_series(request.inputs)
+        return await model.create_forecast(request)
+
+    async def models(self) -> List[str]:
+        return [
+            name for name in self._registry.get_models()
+            if isinstance(self._registry.get_model(name), TimeSeriesModel)
+        ]
 
 
 class TimeSeriesEndpoints:
     def __init__(self, model_registry):
-        self._registry = model_registry
+        self.dataplane = TimeSeriesDataPlane(model_registry)
 
     async def forecast(self, request: web.Request) -> web.Response:
         try:
@@ -63,15 +274,12 @@ class TimeSeriesEndpoints:
             params = ForecastRequest.model_validate(body)
         except ValidationError as e:
             raise InvalidInput(str(e))
-        model = self._registry.get_model(params.model)
-        if model is None:
-            raise ModelNotFound(params.model)
-        if not await self._registry.is_model_ready(params.model):
-            raise ModelNotReady(params.model)
-        if not isinstance(model, TimeSeriesModel):
-            raise InvalidInput(f"model {params.model} does not support forecasting")
-        result = await model.create_forecast(params)
+        result = await self.dataplane.forecast(params)
         return web.json_response(result.model_dump(exclude_none=True))
 
+    async def models(self, request: web.Request) -> web.Response:
+        return web.json_response(await self.dataplane.models())
+
     def register(self, app: web.Application) -> None:
-        app.router.add_post("/timeseries/v1/forecast", self.forecast)
+        app.router.add_post("/v1/timeseries/forecast", self.forecast)
+        app.router.add_get("/v1/timeseries/models", self.models)

@@ -1,0 +1,189 @@
+"""Time-series forecasting runtime: a jitted seasonal-naive-with-drift
+forecaster serving the /v1/timeseries protocol.
+
+Role parity: the reference's timeseries protocol is served by external
+forecasting runtimes; this ships a credible default the way
+predictive_server ships sklearn-style models — the forecast math runs as
+one jitted JAX program (batch of series padded to a bucket), so large
+batches ride the TPU instead of a Python loop.
+
+Method: classical seasonal-naive with drift.  For season length m (auto:
+the best of the candidate periods by last-window autocorrelation, or 1 =
+plain naive):
+    forecast[t] = y[T - m + (t mod m)] + drift * (t // m + 1)
+    drift = (y[T-1] - y[T-1-m]) / m per-season trend (0 when m >= T)
+Quantiles come from the empirical residuals of the one-season-back
+in-sample prediction, scaled by sqrt(step) (random-walk widening).
+"""
+
+from __future__ import annotations
+
+import argparse
+from functools import partial
+from typing import List, Optional
+
+import numpy as np
+
+from ..logging import logger
+from ..model_server import ModelServer, build_arg_parser
+from ..protocol.timeseries import (
+    ForecastOutput,
+    ForecastRequest,
+    ForecastResponse,
+    Status,
+    TimeSeriesForecast,
+    TimeSeriesModel,
+    TimeSeriesType,
+    advance_timestamp,
+    make_forecast_response,
+)
+
+_SEASON_CANDIDATES = (1, 4, 7, 12, 24)
+
+
+def _pick_season(y: np.ndarray) -> int:
+    """Best candidate period by lag autocorrelation over the tail."""
+    T = len(y)
+    best, best_r = 1, -np.inf
+    yc = y - y.mean()
+    denom = float(np.dot(yc, yc)) or 1.0
+    for m in _SEASON_CANDIDATES:
+        if m >= T:
+            continue
+        r = float(np.dot(yc[m:], yc[:-m])) / denom
+        if r > best_r:
+            best, best_r = m, r
+    return best
+
+
+def _jit_forecast():
+    import jax
+    import jax.numpy as jnp
+
+    @partial(jax.jit, static_argnums=(2, 3))
+    def forecast(y: jnp.ndarray, valid_len: jnp.ndarray, m: int, horizon: int):
+        """y: [T] padded series; returns ([horizon] mean, [T] residuals of
+        the in-sample seasonal-naive, masked)."""
+        T = y.shape[0]
+        last = valid_len - 1
+        season_ok = m < valid_len
+        drift = jnp.where(
+            season_ok, (y[last] - y[jnp.maximum(last - m, 0)]) / m, 0.0)
+        t = jnp.arange(horizon)
+        src = jnp.where(
+            season_ok,
+            valid_len - m + (t % m),
+            last,  # m >= T: plain last-value naive
+        )
+        mean = y[jnp.clip(src, 0, T - 1)] + drift * (t // m + 1)
+        # in-sample one-season-back residuals for quantile spread
+        idx = jnp.arange(T)
+        pred = y[jnp.clip(idx - m, 0, T - 1)]
+        resid = jnp.where((idx >= m) & (idx < valid_len), y - pred, 0.0)
+        return mean, resid
+
+    return forecast
+
+
+class SeasonalNaiveForecaster(TimeSeriesModel):
+    def __init__(self, name: str = "forecaster"):
+        super().__init__(name)
+        self._forecast = None
+
+    def load(self) -> bool:
+        self._forecast = _jit_forecast()
+        self.ready = True
+        return True
+
+    @staticmethod
+    def _bucket(n: int, lo: int = 8) -> int:
+        b = lo
+        while b < n:
+            b *= 2
+        return b
+
+    def _one(self, values: np.ndarray, horizon: int,
+             quantiles: Optional[List[float]]):
+        import jax.numpy as jnp
+
+        m = _pick_season(values)
+        T = len(values)
+        # pad to pow2 buckets: repeat requests with nearby lengths and
+        # horizons reuse the compiled program (valid_len carries the
+        # actual length; the padding is masked)
+        Tb = self._bucket(T)
+        Hb = self._bucket(horizon)
+        padded = np.zeros((Tb,), np.float32)
+        padded[:T] = values
+        mean, resid = self._forecast(
+            jnp.asarray(padded), jnp.asarray(T, jnp.int32), m, Hb)
+        mean = np.asarray(mean, np.float64)[:horizon]
+        qmap = None
+        if quantiles:
+            r = np.asarray(resid, np.float64)[:T]
+            r = r[m:T] if T > m else np.zeros((1,))
+            if r.size == 0:
+                r = np.zeros((1,))
+            steps = np.sqrt(np.arange(1, horizon + 1, dtype=np.float64))
+            qmap = {
+                str(q): (mean + np.quantile(r, q) * steps).tolist()
+                for q in quantiles
+            }
+        return mean.tolist(), qmap
+
+    async def create_forecast(self, request: ForecastRequest,
+                              context=None) -> ForecastResponse:
+        horizon = request.options.horizon
+        quantiles = request.options.quantiles
+        content = []
+        for ts in request.inputs:
+            series = np.asarray(ts.series, np.float64)
+            if ts.type == TimeSeriesType.MULTIVARIATE:
+                # forecast each variable independently ([T, V] columns)
+                means = []
+                qmaps: dict = {}
+                for v in range(series.shape[1]):
+                    mean_v, qmap_v = self._one(series[:, v], horizon, quantiles)
+                    means.append(mean_v)
+                    for q, vals in (qmap_v or {}).items():
+                        qmaps.setdefault(q, []).append(vals)
+                mean = np.asarray(means).T.tolist()  # [horizon, V]
+                qmap = {
+                    q: np.asarray(cols).T.tolist() for q, cols in qmaps.items()
+                } or None
+            else:
+                mean, qmap = self._one(series, horizon, quantiles)
+            start = ts.start_timestamp or "1970-01-01T00:00:00"
+            content.append(TimeSeriesForecast(
+                type=ts.type,
+                name=ts.name,
+                mean_forecast=mean,
+                frequency=ts.frequency,
+                start_timestamp=advance_timestamp(
+                    start, ts.frequency, len(ts.series)),
+                quantiles=qmap,
+            ))
+        output = ForecastOutput(status=Status.COMPLETED, content=content)
+        return make_forecast_response(self.name, [output])
+
+
+def main(argv=None):
+    parent = build_arg_parser()
+    parser = argparse.ArgumentParser(
+        "kserve-tpu-timeseries", parents=[parent],
+        conflict_handler="resolve")
+    parser.add_argument("--model_name", default="forecaster")
+    args = parser.parse_args(argv)
+    model = SeasonalNaiveForecaster(args.model_name)
+    model.load()
+    logger.info("timeseries forecaster ready: %s", args.model_name)
+    ModelServer(
+        http_port=args.http_port,
+        grpc_port=args.grpc_port,
+        enable_grpc=args.enable_grpc,
+        workers=args.workers,
+    ).start([model])
+
+
+if __name__ == "__main__":
+    main()

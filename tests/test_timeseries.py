@@ -1,5 +1,10 @@
-"""Time-series protocol head tests."""
+"""Time-series protocol tests (reference surface:
+python/kserve/kserve/protocol/rest/timeseries/ — typed univariate/
+multivariate inputs, frequency step math, quantiles, per-output status)
+plus the jitted seasonal-naive runtime."""
 
+import numpy as np
+import pytest
 from aiohttp.test_utils import TestClient, TestServer
 
 from kserve_tpu import ModelRepository
@@ -7,16 +12,21 @@ from kserve_tpu.protocol.model_repository_extension import ModelRepositoryExtens
 from kserve_tpu.protocol.openai.dataplane import OpenAIDataPlane
 from kserve_tpu.protocol.rest.server import RESTServer
 from kserve_tpu.protocol.timeseries import (
-    Forecast,
+    ForecastOutput,
     ForecastRequest,
-    ForecastResponse,
+    Status,
+    TimeSeriesForecast,
     TimeSeriesModel,
+    TimeSeriesType,
+    advance_timestamp,
+    make_forecast_response,
 )
+from kserve_tpu.runtimes.timeseries_server import SeasonalNaiveForecaster
 
 from conftest import async_test
 
 
-class NaiveForecaster(TimeSeriesModel):
+class LastValueForecaster(TimeSeriesModel):
     """Repeats the last observed value over the horizon."""
 
     def __init__(self):
@@ -24,46 +34,196 @@ class NaiveForecaster(TimeSeriesModel):
         self.ready = True
 
     async def create_forecast(self, request: ForecastRequest, context=None):
-        forecasts = [
-            Forecast(id=series.id, values=[series.values[-1]] * request.horizon)
-            for series in request.inputs
-        ]
-        return ForecastResponse(model=self.name, forecasts=forecasts)
+        content = []
+        for ts in request.inputs:
+            last = ts.series[-1]
+            content.append(TimeSeriesForecast(
+                type=ts.type,
+                name=ts.name,
+                mean_forecast=[last] * request.options.horizon,
+                frequency=ts.frequency,
+                start_timestamp=advance_timestamp(
+                    ts.start_timestamp or "2026-01-01T00:00:00",
+                    ts.frequency, len(ts.series)),
+            ))
+        return make_forecast_response(
+            self.name,
+            [ForecastOutput(status=Status.COMPLETED, content=content)],
+        )
 
 
-def make_client():
+def make_client(models=None):
     repo = ModelRepository()
-    repo.update(NaiveForecaster())
+    for m in models or [LastValueForecaster()]:
+        repo.update(m)
     server = RESTServer(OpenAIDataPlane(repo), ModelRepositoryExtension(repo))
     return TestClient(TestServer(server.create_application()))
 
 
-@async_test
-async def test_forecast():
-    async with make_client() as client:
-        res = await client.post(
-            "/timeseries/v1/forecast",
-            json={
-                "model": "naive",
-                "horizon": 3,
-                "inputs": [
-                    {"id": "s1", "timestamps": ["t1", "t2"], "values": [1.0, 2.0]},
-                    {"id": "s2", "timestamps": ["t1"], "values": [5.0]},
-                ],
-            },
-        )
-        assert res.status == 200
-        body = await res.json()
-        assert body["forecasts"][0]["values"] == [2.0, 2.0, 2.0]
-        assert body["forecasts"][1]["values"] == [5.0, 5.0, 5.0]
+def req(**over):
+    body = {
+        "model": "naive",
+        "inputs": [{
+            "type": "univariate_time_series",
+            "name": "s1",
+            "series": [1.0, 2.0, 3.0],
+            "frequency": "D",
+            "start_timestamp": "2026-01-01T00:00:00",
+        }],
+        "options": {"horizon": 3},
+    }
+    body.update(over)
+    return body
 
 
-@async_test
-async def test_forecast_errors():
-    async with make_client() as client:
-        missing = await client.post(
-            "/timeseries/v1/forecast", json={"model": "ghost", "inputs": []}
-        )
-        assert missing.status == 404
-        bad = await client.post("/timeseries/v1/forecast", json={"horizon": 1})
-        assert bad.status == 400
+class TestProtocol:
+    @async_test
+    async def test_forecast_envelope_and_step_math(self):
+        async with make_client() as client:
+            res = await client.post("/v1/timeseries/forecast", json=req())
+            assert res.status == 200
+            body = await res.json()
+            assert body["status"] == "completed"
+            assert body["model"] == "naive"
+            assert body["id"].startswith("forecast-")
+            out = body["outputs"][0]
+            assert out["status"] == "completed"
+            fc = out["content"][0]
+            assert fc["mean_forecast"] == [3.0, 3.0, 3.0]
+            # 3 daily observations from Jan 1 -> forecast starts Jan 4
+            assert fc["start_timestamp"] == "2026-01-04T00:00:00"
+
+    @async_test
+    async def test_models_endpoint_lists_forecasters_only(self):
+        async with make_client() as client:
+            res = await client.get("/v1/timeseries/models")
+            assert await res.json() == ["naive"]
+
+    @async_test
+    async def test_errors(self):
+        async with make_client() as client:
+            missing = await client.post(
+                "/v1/timeseries/forecast", json=req(model="ghost"))
+            assert missing.status == 404
+            bad = await client.post("/v1/timeseries/forecast", json={"x": 1})
+            assert bad.status == 400
+            neg = await client.post(
+                "/v1/timeseries/forecast",
+                json=req(options={"horizon": 0}))
+            assert neg.status == 400
+            badq = await client.post(
+                "/v1/timeseries/forecast",
+                json=req(options={"horizon": 2, "quantiles": [1.5]}))
+            assert badq.status == 400
+
+    @async_test
+    async def test_multivariate_shape_validation(self):
+        async with make_client() as client:
+            ragged = req()
+            ragged["inputs"][0].update(
+                type="multivariate_time_series",
+                series=[[1.0, 2.0], [3.0]],
+            )
+            res = await client.post("/v1/timeseries/forecast", json=ragged)
+            assert res.status == 400
+            mismatch = req()
+            mismatch["inputs"][0]["series"] = [[1.0, 2.0]]  # univariate+rows
+            res = await client.post("/v1/timeseries/forecast", json=mismatch)
+            assert res.status == 400
+
+    def test_advance_timestamp_calendar_frequencies(self):
+        from kserve_tpu.protocol.timeseries import Frequency
+
+        assert advance_timestamp(
+            "2026-01-31T00:00:00", Frequency.MONTH_SHORT, 1
+        ).startswith("2026-02-28")
+        assert advance_timestamp(
+            "2026-01-01T00:00:00", Frequency.QUARTER, 2
+        ).startswith("2026-07-01")
+        assert advance_timestamp(
+            "2026-03-01T10:00:00", Frequency.HOUR_SHORT, 5
+        ) == "2026-03-01T15:00:00"
+        assert advance_timestamp(
+            "2024-02-29T00:00:00", Frequency.YEAR, 1
+        ).startswith("2025-02-28")
+
+
+class TestSeasonalNaiveRuntime:
+    def _model(self):
+        m = SeasonalNaiveForecaster("fc")
+        m.load()
+        return m
+
+    @async_test
+    async def test_seasonal_pattern_extends(self):
+        """A pure period-4 signal forecasts its next period exactly."""
+        model = self._model()
+        pattern = [1.0, 5.0, 2.0, 8.0] * 4
+        request = ForecastRequest.model_validate(req(
+            model="fc",
+            inputs=[{
+                "type": "univariate_time_series", "name": "s",
+                "series": pattern, "frequency": "H",
+                "start_timestamp": "2026-01-01T00:00:00",
+            }],
+            options={"horizon": 4},
+        ))
+        out = await model.create_forecast(request)
+        fc = out.outputs[0].content[0]
+        np.testing.assert_allclose(fc.mean_forecast, [1.0, 5.0, 2.0, 8.0])
+        # 16 hourly points from midnight -> forecast starts at 16:00
+        assert fc.start_timestamp == "2026-01-01T16:00:00"
+
+    @async_test
+    async def test_quantiles_bracket_mean(self):
+        model = self._model()
+        rng = np.random.RandomState(0)
+        series = (np.sin(np.arange(48) * 2 * np.pi / 12) * 5
+                  + rng.randn(48)).tolist()
+        request = ForecastRequest.model_validate(req(
+            model="fc",
+            inputs=[{
+                "type": "univariate_time_series", "name": "s",
+                "series": series, "frequency": "H",
+            }],
+            options={"horizon": 6, "quantiles": [0.1, 0.9]},
+        ))
+        out = await model.create_forecast(request)
+        fc = out.outputs[0].content[0]
+        lo, hi = fc.quantiles["0.1"], fc.quantiles["0.9"]
+        for step in range(6):
+            assert lo[step] <= fc.mean_forecast[step] <= hi[step]
+        # uncertainty widens with the step (random-walk scaling)
+        assert (hi[5] - lo[5]) > (hi[0] - lo[0])
+
+    @async_test
+    async def test_multivariate_per_column(self):
+        model = self._model()
+        series = [[float(i), float(100 - i)] for i in range(8)]
+        request = ForecastRequest.model_validate(req(
+            model="fc",
+            inputs=[{
+                "type": "multivariate_time_series", "name": "mv",
+                "series": series, "frequency": "D",
+            }],
+            options={"horizon": 2},
+        ))
+        out = await model.create_forecast(request)
+        fc = out.outputs[0].content[0]
+        assert len(fc.mean_forecast) == 2
+        assert len(fc.mean_forecast[0]) == 2  # [horizon][vars]
+        # column 0 rises, column 1 falls
+        assert fc.mean_forecast[1][0] > fc.mean_forecast[0][0] - 1e-9
+        assert fc.mean_forecast[1][1] < fc.mean_forecast[0][1] + 1e-9
+
+    @async_test
+    async def test_served_end_to_end(self):
+        async with make_client([self._model()]) as client:
+            res = await client.post("/v1/timeseries/forecast", json=req(
+                model="fc",
+                options={"horizon": 2, "quantiles": [0.5]},
+            ))
+            assert res.status == 200
+            body = await res.json()
+            assert body["status"] == "completed"
+            assert "0.5" in body["outputs"][0]["content"][0]["quantiles"]
