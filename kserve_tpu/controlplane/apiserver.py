@@ -559,7 +559,7 @@ class ThreadServer:
     (one copy of the loop/runner/shutdown handling, not two)."""
 
     def __init__(self, make_app, host: str = "127.0.0.1", port: int = 0,
-                 name: str = "aiohttp-thread"):
+                 name: str = "aiohttp-thread", ssl_context=None):
         self._loop = asyncio.new_event_loop()
         started = threading.Event()
         holder: dict = {}
@@ -570,7 +570,7 @@ class ThreadServer:
             async def boot():
                 runner = web.AppRunner(make_app())
                 await runner.setup()
-                site = web.TCPSite(runner, host, port)
+                site = web.TCPSite(runner, host, port, ssl_context=ssl_context)
                 await site.start()
                 holder["runner"] = runner
                 holder["port"] = runner.addresses[0][1]

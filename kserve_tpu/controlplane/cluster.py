@@ -124,6 +124,8 @@ class ControllerManager:
             mutator=mutator, ingress_domain=ingress_domain,
             ingress_class=ingress_class, domain_template=domain_template,
             kube_ingress_class_name=kube_ingress_class_name,
+            existing_secret_getter=lambda name, ns: self.cluster.get(
+                "Secret", name, ns),
         )
         # node-group membership comes from Node labels in a live cluster;
         # tests/operators set it directly

@@ -64,13 +64,18 @@ class LLMISVCReconciler:
                  ingress_domain: str = "example.com",
                  ingress_class: str = "gateway-api",
                  domain_template: str = "{name}.{namespace}.{domain}",
-                 kube_ingress_class_name: str = "nginx"):
+                 kube_ingress_class_name: str = "nginx",
+                 existing_secret_getter=None):
         self.presets = presets or {}
         self.mutator = mutator or PodMutator()
         self.ingress_domain = ingress_domain
         self.ingress_class = ingress_class
         self.domain_template = domain_template
         self.kube_ingress_class_name = kube_ingress_class_name
+        # (name, namespace) -> Secret dict | None; lets the self-signed
+        # cert reconcile keep a still-valid existing cert instead of
+        # rotating every pass (ref getExistingSelfSignedCertificate :205)
+        self.existing_secret_getter = existing_secret_getter
 
     def reconcile(self, llm: LLMInferenceService) -> Tuple[List[dict], dict]:
         spec = self._merge_presets(llm)
@@ -93,6 +98,7 @@ class LLMISVCReconciler:
         set_condition(status, "WorkloadReady", True, reason="Reconciled")
 
         if spec.router is not None:
+            objects.append(self._self_signed_certs(llm))
             objects.extend(self._scheduler(llm, spec))
             objects.extend(self._route(llm, spec))
             set_condition(status, "RouterReady", True, reason="Reconciled")
@@ -448,6 +454,49 @@ class LLMISVCReconciler:
             },
         )
         return [epp, pool]
+
+    def _self_signed_certs(self, llm) -> dict:
+        """The router's TLS cert Secret (ref
+        reconcileSelfSignedCertsSecret workload_tls_self_signed.go:60):
+        SANs cover the workload + scheduler service names; a still-valid
+        existing cert with covering SANs is kept, rotation happens inside
+        the renew window or on SAN drift."""
+        import base64
+
+        from . import tls as tls_mod
+
+        name = llm.metadata.name
+        namespace = llm.metadata.namespace
+        secret_name = f"{name}-kserve-self-signed-certs"
+        dns = []
+        for svc in (f"{name}-kserve", f"{name}-kserve-epp",
+                    f"{name}-kserve-prefill"):
+            dns.extend([
+                svc,
+                f"{svc}.{namespace}",
+                f"{svc}.{namespace}.svc",
+                f"{svc}.{namespace}.svc.cluster.local",
+            ])
+        ips = ["127.0.0.1"]
+        existing = None
+        if self.existing_secret_getter is not None:
+            existing = self.existing_secret_getter(secret_name, namespace)
+        if existing is not None:
+            data = existing.get("data") or {}
+            try:
+                cert_pem = base64.b64decode(data.get(
+                    tls_mod.CERT_SECRET_KEY, ""))
+                key_pem = base64.b64decode(data.get(
+                    tls_mod.KEY_SECRET_KEY, ""))
+            except Exception:  # noqa: BLE001 — corrupt data: regenerate
+                cert_pem = key_pem = b""
+            # the key must be present too: a Secret with a valid cert but
+            # a lost/corrupt key would crash-loop every server mounting it
+            # with no self-heal until the cert expired
+            if key_pem.startswith(b"-----BEGIN") and (
+                    not tls_mod.should_recreate_certificate(cert_pem, dns, ips)):
+                return existing
+        return tls_mod.make_cert_secret(secret_name, namespace, dns, ips)
 
     def _route(self, llm, spec) -> List[dict]:
         """Routing for the configured ingress backend (controlplane/

@@ -378,7 +378,8 @@ class AdmissionServer:
     """
 
     def __init__(self, mutator=None, port: int = 9443,
-                 host: str = "0.0.0.0"):
+                 host: str = "0.0.0.0", certfile: Optional[str] = None,
+                 keyfile: Optional[str] = None, self_signed: bool = False):
         from .registry import RuntimeRegistry
         from .webhook import PodMutator
 
@@ -390,6 +391,43 @@ class AdmissionServer:
         self._registry_cls = RuntimeRegistry
         self._server = None
         self.url: Optional[str] = None
+        # TLS: real apiservers only call https webhooks.  Either hand in a
+        # cert pair (the manager Deployment mounts the cert Secret) or ask
+        # for an ephemeral self-signed one (parity: the reference manager's
+        # self-signed webhook cert path, cmd/manager/main.go:123)
+        self._ssl_context = None
+        self.ca_cert_pem: Optional[bytes] = None
+        if self_signed and not (certfile and keyfile):
+            import tempfile
+
+            from .tls import create_self_signed_cert
+
+            key_pem, cert_pem = create_self_signed_cert(
+                ["localhost", "kserve-webhook-server-service",
+                 "kserve-webhook-server-service.kserve-system.svc"],
+                ["127.0.0.1"],
+            )
+            self.ca_cert_pem = cert_pem  # self-signed: cert IS the CA
+            import shutil as _shutil
+
+            from .tls import server_ssl_context
+
+            tmp = tempfile.mkdtemp(prefix="kserve-webhook-tls-")
+            try:
+                with open(f"{tmp}/tls.crt", "wb") as f:
+                    f.write(cert_pem)
+                with open(f"{tmp}/tls.key", "wb") as f:
+                    f.write(key_pem)
+                # the context holds the loaded pair; don't leave the
+                # private key on disk
+                self._ssl_context = server_ssl_context(
+                    f"{tmp}/tls.crt", f"{tmp}/tls.key")
+            finally:
+                _shutil.rmtree(tmp, ignore_errors=True)
+        elif certfile and keyfile:
+            from .tls import server_ssl_context
+
+            self._ssl_context = server_ssl_context(certfile, keyfile)
 
     # -- handlers --
 
@@ -502,10 +540,12 @@ class AdmissionServer:
         from .apiserver import ThreadServer
 
         self._server = ThreadServer(self.make_app, host=self.host,
-                                    port=self.port, name="admission-server")
+                                    port=self.port, name="admission-server",
+                                    ssl_context=self._ssl_context)
         advertise = ("127.0.0.1" if self.host in ("0.0.0.0", "::")
                      else self.host)
-        self.url = f"http://{advertise}:{self._server.port}"
+        scheme = "https" if self._ssl_context is not None else "http"
+        self.url = f"{scheme}://{advertise}:{self._server.port}"
         return self.url
 
     def stop(self) -> None:
@@ -513,10 +553,24 @@ class AdmissionServer:
             self._server.stop()
 
 
-def webhook_configurations(webhook_url: str) -> list:
+def webhook_configurations(webhook_url: str,
+                           ca_bundle_pem: Optional[bytes] = None) -> list:
     """The Mutating/ValidatingWebhookConfiguration objects pointing at an
     AdmissionServer (url-form for tests/standalone; the deploy manifest
-    uses the service-form equivalents in config/manager)."""
+    uses the service-form equivalents in config/manager).  ca_bundle_pem:
+    the self-signed webhook cert, so the apiserver trusts the https
+    endpoint."""
+    import base64
+
+    ca_b64 = (base64.b64encode(ca_bundle_pem).decode()
+              if ca_bundle_pem else None)
+
+    def client_config(path: str) -> dict:
+        cfg = {"url": f"{webhook_url}{path}"}
+        if ca_b64:
+            cfg["caBundle"] = ca_b64
+        return cfg
+
     return [
         {
             "apiVersion": "admissionregistration.k8s.io/v1",
@@ -524,7 +578,7 @@ def webhook_configurations(webhook_url: str) -> list:
             "metadata": {"name": "inferenceservice.serving.kserve.io"},
             "webhooks": [{
                 "name": "inferenceservice.kserve-webhook-server.pod-mutator",
-                "clientConfig": {"url": f"{webhook_url}/mutate-pods"},
+                "clientConfig": client_config("/mutate-pods"),
                 "rules": [{"apiGroups": [""], "apiVersions": ["v1"],
                            "operations": ["CREATE"],
                            "resources": ["pods"]}],
@@ -539,8 +593,7 @@ def webhook_configurations(webhook_url: str) -> list:
             "metadata": {"name": "servingruntime.serving.kserve.io"},
             "webhooks": [{
                 "name": "servingruntime.kserve-webhook-server.validator",
-                "clientConfig": {
-                    "url": f"{webhook_url}/validate-servingruntimes"},
+                "clientConfig": client_config("/validate-servingruntimes"),
                 "rules": [{"apiGroups": ["serving.kserve.io"],
                            "apiVersions": ["v1alpha1"],
                            "operations": ["CREATE", "UPDATE"],
@@ -566,6 +619,13 @@ def main(argv=None) -> int:
     parser.add_argument("--leader-elect", action="store_true")
     parser.add_argument("--webhook-port", type=int, default=9443)
     parser.add_argument("--no-webhook", action="store_true")
+    parser.add_argument("--webhook-certfile", default=None,
+                        help="serve the webhook over TLS with this cert "
+                             "(real apiservers require https webhooks)")
+    parser.add_argument("--webhook-keyfile", default=None)
+    parser.add_argument("--webhook-self-signed", action="store_true",
+                        help="generate an ephemeral self-signed webhook "
+                             "cert at startup (standalone/dev)")
     parser.add_argument("--register-webhooks", action="store_true",
                         help="self-register url-form webhook configurations "
                              "(standalone/stub mode; in-cluster installs use "
@@ -578,11 +638,16 @@ def main(argv=None) -> int:
     cluster.wait_ready()
     admission = None
     if not args.no_webhook:
-        admission = AdmissionServer(port=args.webhook_port)
+        admission = AdmissionServer(
+            port=args.webhook_port,
+            certfile=args.webhook_certfile,
+            keyfile=args.webhook_keyfile,
+            self_signed=args.webhook_self_signed,
+        )
         url = admission.start()
         logger.info("admission webhook server on %s", url)
         if args.register_webhooks:
-            for cfg in webhook_configurations(url):
+            for cfg in webhook_configurations(url, admission.ca_cert_pem):
                 cluster.apply(cfg)
     manager = Manager(cluster, namespace=args.namespace,
                       leader_elect=args.leader_elect,

@@ -1,0 +1,189 @@
+"""Self-signed TLS: certificate generation, rotation checks, and server
+ssl-context helpers.
+
+Parity: pkg/controller/v1alpha2/llmisvc/workload_tls_self_signed.go
+(createSelfSignedTLSCertificate :156, ShouldRecreateCertificate :228,
+SAN collection :275) and pkg/tls/tls.go (min-version / cipher-suite
+parsing for the serving side, cmd/manager/main.go:123 wiring).
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import ssl
+from typing import List, Optional, Tuple
+
+CERT_SECRET_KEY = "tls.crt"
+KEY_SECRET_KEY = "tls.key"
+EXPIRATION_ANNOTATION = "serving.kserve.io/certificate-expiration"
+
+# reference: certificateDuration (~1 year) + renew buffer; rotation
+# triggers once inside the renew window
+CERT_DURATION_DAYS = 365
+RENEW_BUFFER_DAYS = 30
+
+_TLS_VERSIONS = {
+    "1.2": ssl.TLSVersion.TLSv1_2,
+    "1.3": ssl.TLSVersion.TLSv1_3,
+    "TLSv1.2": ssl.TLSVersion.TLSv1_2,
+    "TLSv1.3": ssl.TLSVersion.TLSv1_3,
+}
+
+
+def create_self_signed_cert(
+    dns_names: List[str],
+    ip_addresses: Optional[List[str]] = None,
+    duration_days: int = CERT_DURATION_DAYS + RENEW_BUFFER_DAYS,
+) -> Tuple[bytes, bytes]:
+    """(key_pem, cert_pem) — RSA-2048, serverAuth, SANs from args
+    (ref createSelfSignedTLSCertificate; 2048 instead of the reference's
+    4096: this cert is regenerated yearly and 2048 halves the handshake
+    cost on the serving path)."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import ExtendedKeyUsageOID, NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    sans: List[x509.GeneralName] = [x509.DNSName(d) for d in dns_names]
+    for ip in ip_addresses or []:
+        try:
+            sans.append(x509.IPAddress(ipaddress.ip_address(ip)))
+        except ValueError:
+            continue  # reference skips unparseable IPs
+    name = x509.Name([
+        x509.NameAttribute(NameOID.ORGANIZATION_NAME, "KServe-TPU Self Signed"),
+    ])
+    builder = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now)
+        .not_valid_after(now + datetime.timedelta(days=duration_days))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                       critical=True)
+        .add_extension(
+            x509.KeyUsage(
+                digital_signature=True, key_encipherment=True,
+                content_commitment=False, data_encipherment=False,
+                key_agreement=False, key_cert_sign=True, crl_sign=False,
+                encipher_only=False, decipher_only=False,
+            ),
+            critical=True,
+        )
+        .add_extension(
+            x509.ExtendedKeyUsage([ExtendedKeyUsageOID.SERVER_AUTH]),
+            critical=False,
+        )
+    )
+    if sans:
+        builder = builder.add_extension(
+            x509.SubjectAlternativeName(sans), critical=False)
+    cert = builder.sign(key, hashes.SHA256())
+    key_pem = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+    cert_pem = cert.public_bytes(serialization.Encoding.PEM)
+    return key_pem, cert_pem
+
+
+def cert_sans(cert_pem: bytes) -> Tuple[List[str], List[str]]:
+    """(dns_names, ips) from a PEM certificate."""
+    from cryptography import x509
+
+    cert = x509.load_pem_x509_certificate(cert_pem)
+    try:
+        ext = cert.extensions.get_extension_for_class(
+            x509.SubjectAlternativeName)
+    except x509.ExtensionNotFound:
+        return [], []
+    dns = ext.value.get_values_for_type(x509.DNSName)
+    ips = [str(ip) for ip in ext.value.get_values_for_type(x509.IPAddress)]
+    return list(dns), ips
+
+
+def cert_not_after(cert_pem: bytes) -> datetime.datetime:
+    from cryptography import x509
+
+    cert = x509.load_pem_x509_certificate(cert_pem)
+    return cert.not_valid_after_utc
+
+
+def should_recreate_certificate(
+    cert_pem: Optional[bytes],
+    expected_dns: List[str],
+    expected_ips: List[str],
+    now: Optional[datetime.datetime] = None,
+) -> bool:
+    """True when the cert is absent, unparseable, inside the renew window,
+    or its SANs no longer cover the expected names (ref
+    ShouldRecreateCertificate :228 — SAN drift happens when services gain
+    IPs or the deployment is renamed)."""
+    if not cert_pem:
+        return True
+    try:
+        not_after = cert_not_after(cert_pem)
+        dns, ips = cert_sans(cert_pem)
+    except Exception:  # noqa: BLE001 — any undecodable cert gets replaced
+        return True
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    if now + datetime.timedelta(days=RENEW_BUFFER_DAYS) >= not_after:
+        return True
+    if not set(expected_dns) <= set(dns):
+        return True
+    if not set(expected_ips) <= set(ips):
+        return True
+    return False
+
+
+def make_cert_secret(name: str, namespace: str, dns_names: List[str],
+                     ip_addresses: Optional[List[str]] = None) -> dict:
+    """A kubernetes.io/tls Secret carrying a fresh self-signed pair
+    (ref expectedSelfSignedCertsSecret :114)."""
+    import base64
+
+    from .objects import make_object
+
+    key_pem, cert_pem = create_self_signed_cert(dns_names, ip_addresses)
+    secret = make_object("v1", "Secret", name, namespace, spec=None)
+    secret.pop("spec", None)
+    secret["type"] = "kubernetes.io/tls"
+    secret["data"] = {
+        CERT_SECRET_KEY: base64.b64encode(cert_pem).decode(),
+        KEY_SECRET_KEY: base64.b64encode(key_pem).decode(),
+    }
+    secret.setdefault("metadata", {}).setdefault("annotations", {})[
+        EXPIRATION_ANNOTATION
+    ] = cert_not_after(cert_pem).isoformat()
+    return secret
+
+
+# ---------------- serving-side ssl contexts (pkg/tls/tls.go) ----------------
+
+
+def server_ssl_context(
+    certfile: str,
+    keyfile: str,
+    min_version: str = "1.2",
+    cipher_suites: Optional[str] = None,
+) -> ssl.SSLContext:
+    """SSLContext for the data plane / webhook listeners.  min_version and
+    cipher_suites mirror the reference's --tls-min-version /
+    --tls-cipher-suites flags (cipher names apply to TLS<=1.2; 1.3 suites
+    are fixed by the runtime, as in Go)."""
+    if min_version not in _TLS_VERSIONS:
+        raise ValueError(
+            f"unknown TLS min version {min_version!r}; expected one of "
+            f"{sorted(set(_TLS_VERSIONS))}")
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.minimum_version = _TLS_VERSIONS[min_version]
+    ctx.load_cert_chain(certfile, keyfile)
+    if cipher_suites:
+        ctx.set_ciphers(cipher_suites.replace(",", ":"))
+    return ctx

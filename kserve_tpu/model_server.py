@@ -51,6 +51,13 @@ def build_arg_parser(parents: Optional[list] = None) -> argparse.ArgumentParser:
     parser.add_argument("--access_log_format", default=None, type=str)
     parser.add_argument("--model_name", default="model", type=str)
     parser.add_argument("--model_dir", default="/mnt/models", type=str)
+    # secure serving (parity: the reference manager/agent TLS flags,
+    # pkg/tls/tls.go; certs typically ride the self-signed Secret the
+    # LLMISVC reconciler provisions)
+    parser.add_argument("--ssl_certfile", default=None, type=str)
+    parser.add_argument("--ssl_keyfile", default=None, type=str)
+    parser.add_argument("--tls_min_version", default="1.2", type=str)
+    parser.add_argument("--tls_cipher_suites", default=None, type=str)
     return parser
 
 
@@ -71,8 +78,21 @@ class ModelServer:
         enable_latency_logging: bool = args.enable_latency_logging,
         access_log_format: Optional[str] = args.access_log_format,
         grace_period: int = 30,
+        ssl_certfile: Optional[str] = args.ssl_certfile,
+        ssl_keyfile: Optional[str] = args.ssl_keyfile,
+        tls_min_version: str = args.tls_min_version,
+        tls_cipher_suites: Optional[str] = args.tls_cipher_suites,
     ):
         self.http_port = http_port
+        self._ssl_context = None
+        if ssl_certfile and ssl_keyfile:
+            from .controlplane.tls import server_ssl_context
+
+            self._ssl_context = server_ssl_context(
+                ssl_certfile, ssl_keyfile,
+                min_version=tls_min_version,
+                cipher_suites=tls_cipher_suites,
+            )
         self.grpc_port = grpc_port
         self.workers = workers
         self.max_threads = max_threads
@@ -136,6 +156,7 @@ class ModelServer:
             enable_docs_url=self.enable_docs_url,
             enable_latency_logging=self.enable_latency_logging,
             reuse_port=getattr(self, "_reuse_port", False),
+            ssl_context=self._ssl_context,
         )
         await self._rest_server.start()
         if self.enable_grpc:

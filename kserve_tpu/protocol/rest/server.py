@@ -96,6 +96,7 @@ class RESTServer:
         openai_models: Optional[List] = None,
         enable_latency_logging: bool = True,
         reuse_port: bool = False,
+        ssl_context=None,  # ssl.SSLContext (controlplane/tls.py helpers)
     ):
         self.dataplane = dataplane
         self.model_repository_extension = model_repository_extension
@@ -105,6 +106,7 @@ class RESTServer:
         # SO_REUSEPORT is for the multiprocess worker mode only — with it on
         # by default, stale processes silently share (and steal from) the port
         self.reuse_port = reuse_port
+        self.ssl_context = ssl_context
         self._runner: Optional[web.AppRunner] = None
 
     def create_application(self) -> web.Application:
@@ -159,10 +161,14 @@ class RESTServer:
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(
-            self._runner, host="0.0.0.0", port=self.http_port, reuse_port=self.reuse_port
+            self._runner, host="0.0.0.0", port=self.http_port,
+            reuse_port=self.reuse_port, ssl_context=self.ssl_context,
         )
         await site.start()
-        logger.info("REST server listening on port %s", self.http_port)
+        logger.info(
+            "REST server listening on port %s%s", self.http_port,
+            " (TLS)" if self.ssl_context is not None else "",
+        )
 
     async def stop(self) -> None:
         if self._runner is not None:

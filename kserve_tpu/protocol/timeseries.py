@@ -100,6 +100,11 @@ def advance_timestamp(start: str, frequency: Frequency, steps: int) -> str:
     return (dt + FREQUENCY_MAP[freq](steps)).isoformat()
 
 
+# one request may not demand more than this many forecast steps (a cap on
+# the allocation/compile cost a single unauthenticated call can trigger)
+MAX_HORIZON = 10_000
+
+
 class Status(str, Enum):
     COMPLETED = "completed"
     ERROR = "error"
@@ -248,6 +253,10 @@ class TimeSeriesDataPlane:
                 f"model {request.model} does not support forecasting")
         if request.options.horizon < 1:
             raise InvalidInput("options.horizon must be >= 1")
+        if request.options.horizon > MAX_HORIZON:
+            # unbounded horizons are an allocation/compile DoS vector
+            raise InvalidInput(
+                f"options.horizon must be <= {MAX_HORIZON}")
         for q in request.options.quantiles or []:
             if not 0.0 < q < 1.0:
                 raise InvalidInput(f"quantile {q} outside (0, 1)")

@@ -115,6 +115,11 @@ class TestProtocol:
                 "/v1/timeseries/forecast",
                 json=req(options={"horizon": 2, "quantiles": [1.5]}))
             assert badq.status == 400
+            # unbounded horizons are an allocation DoS vector
+            huge = await client.post(
+                "/v1/timeseries/forecast",
+                json=req(options={"horizon": 10_000_000}))
+            assert huge.status == 400
 
     @async_test
     async def test_multivariate_shape_validation(self):
