@@ -370,10 +370,21 @@ class WorkloadSpec(K8sModel):
 
 
 class SchedulerSpec(K8sModel):
-    """EPP-style endpoint-picker scheduler."""
+    """EPP-style endpoint-picker scheduler.  `config` mirrors the
+    reference's inline scheduler config: declaring the
+    `predicted-latency-producer` plugin enables the latency predictor
+    (ref scheduler_latency_predictor.go:36 hasLatencyProducerInSpec)."""
 
     enabled: bool = True
     template: Optional[Dict[str, Any]] = None
+    config: Optional[Dict[str, Any]] = None
+
+    def wants_latency_predictor(self) -> bool:
+        plugins = (self.config or {}).get("plugins") or []
+        return any(
+            isinstance(p, dict) and p.get("type") == "predicted-latency-producer"
+            for p in plugins
+        )
 
 
 class RouterSpec(K8sModel):

@@ -402,6 +402,12 @@ class LLMISVCReconciler:
             return []
         name = f"{llm.metadata.name}-epp"
         namespace = llm.metadata.namespace
+        strategy = "prefix-cache,queue-depth"
+        if spec.router.scheduler.wants_latency_predictor():
+            # ref scheduler_latency_predictor.go: the
+            # predicted-latency-producer plugin turns on the latency
+            # companion — here the in-process slo-aware strategy
+            strategy += ",slo-aware"
         pool_selector = {
             "serving.kserve.io/llminferenceservice": llm.metadata.name,
             "kserve.io/component": "decode",
@@ -425,7 +431,7 @@ class LLMISVCReconciler:
                                 "command": ["python", "-m", "kserve_tpu.scheduler.epp"],
                                 "args": [
                                     f"--pool-selector=serving.kserve.io/llminferenceservice={llm.metadata.name},kserve.io/component=decode",
-                                    "--strategy=prefix-cache,queue-depth",
+                                    f"--strategy={strategy}",
                                     "--port=9002",
                                     "--target-port=8080",
                                 ],

@@ -428,6 +428,15 @@ class AdmissionServer:
             from .tls import server_ssl_context
 
             self._ssl_context = server_ssl_context(certfile, keyfile)
+            # --register-webhooks needs a caBundle or the apiserver cannot
+            # verify the https endpoint and (failurePolicy: Fail) rejects
+            # every in-scope admission.  For a self-signed/private-CA file
+            # pair the cert itself is the trust anchor.
+            try:
+                with open(certfile, "rb") as f:
+                    self.ca_cert_pem = f.read()
+            except OSError:
+                pass
 
     # -- handlers --
 

@@ -91,7 +91,10 @@ class EPPServer:
         return web.json_response({"ok": True})
 
     async def state(self, request: web.Request) -> web.Response:
-        return web.json_response({"replicas": self.picker.snapshot()})
+        out = {"replicas": self.picker.snapshot()}
+        if self.picker.latency_predictor is not None:
+            out["latency"] = self.picker.latency_predictor.snapshot()
+        return web.json_response(out)
 
     async def _read_affinity(self, request: web.Request) -> tuple:
         body = await request.read()  # every method: the proxy must forward
@@ -139,6 +142,17 @@ class EPPServer:
         }
         url = replica.url + request.rel_url.path_qs
         out = None
+        # latency observation inputs, captured at PICK time (the depth the
+        # decision was made against, not the depth after serving)
+        import time as _time
+
+        from .latency import estimate_prompt_len
+
+        picked_depth = replica.queue_depth
+        prompt_len = estimate_prompt_len(ids, text)
+        t0 = _time.monotonic()
+        ttft: Optional[float] = None
+        chunks = 0
         try:
             async with self._client.request(
                 request.method, url, headers=headers, data=body or None
@@ -152,8 +166,33 @@ class EPPServer:
                 )
                 await out.prepare(request)
                 async for chunk in upstream.content.iter_any():
+                    if ttft is None:
+                        ttft = _time.monotonic() - t0
+                    chunks += 1
                     await out.write(chunk)
                 await out.write_eof()
+                if upstream.status >= 400:
+                    # the replica answered but refused/failed: penalize it
+                    # in picking (it never trains the latency model, so
+                    # without this a 429-shedder stays "cold" and WINS)
+                    self.picker.observe_http_error(replica.url)
+                # train only on SUCCESSFUL generation requests: fast 4xx
+                # rejections (429 load shedding) would teach the model a
+                # broken replica is "fast" and route MORE traffic at it,
+                # and body-less GETs would drag the intercept to zero
+                if (self.picker.latency_predictor is not None
+                        and 200 <= upstream.status < 300
+                        and ttft is not None
+                        and request.method == "POST"
+                        and (ids or text)):
+                    # streamed chunk count proxies generated tokens (SSE
+                    # emits per-token events; non-streaming bodies arrive
+                    # as ~1 chunk and contribute TTFT only)
+                    self.picker.latency_predictor.observe(
+                        replica.url, prompt_len, picked_depth, ttft,
+                        n_tokens=chunks,
+                        total_s=_time.monotonic() - t0,
+                    )
                 return out
         except (aiohttp.ClientError, OSError, asyncio.TimeoutError) as exc:
             logger.warning("epp proxy to %s failed: %s", replica.url, exc)
@@ -188,11 +227,26 @@ def discover_endpoints(cluster, selector: str, namespace: str,
 
 def build_picker(args) -> EndpointPicker:
     strategies = {s.strip() for s in args.strategy.split(",") if s.strip()}
+    predictor = None
+    latency_weight = 0.0
+    if "slo-aware" in strategies:
+        # the optional latency-predictor companion (ref
+        # scheduler_latency_predictor.go gates it on the
+        # predicted-latency-producer plugin) — here an in-process online
+        # TTFT/TPOT model fed by the proxy path (scheduler/latency.py)
+        from .latency import LatencyPredictor
+
+        predictor = LatencyPredictor()
+        # 1s of predicted TTFT outweighs one prefix page at the default
+        # prefix weight — latency dominates only when it is material
+        latency_weight = 4.0
     return EndpointPicker(
         replica_urls=[u for u in args.replicas.split(",") if u],
         poll_interval_s=args.poll_interval,
         queue_weight=1.0 if "queue-depth" in strategies else 0.0,
         prefix_weight=4.0 if "prefix-cache" in strategies else 0.0,
+        latency_predictor=predictor,
+        latency_weight=latency_weight,
     )
 
 

@@ -50,6 +50,11 @@ class Replica:
     models: Dict[str, tuple] = field(default_factory=dict)
     last_poll: float = 0.0
     consecutive_failures: int = 0
+    # decaying count of recent HTTP-error responses (4xx/5xx through the
+    # proxy): a load-shedding replica never trains the latency model, so
+    # without this it would stay "cold", un-penalized, and WIN every pick
+    error_ewma: float = 0.0
+    last_error_t: float = 0.0
 
     @property
     def digests(self) -> frozenset:
@@ -70,7 +75,13 @@ class EndpointPicker:
         prefix_weight: float = 4.0,
         unhealthy_after: int = 2,
         state_path: str = "/v1/internal/scheduler/state",
+        latency_predictor=None,  # scheduler/latency.LatencyPredictor
+        latency_weight: float = 0.0,  # score penalty per predicted TTFT sec
+        error_weight: float = 2.0,  # score penalty per recent HTTP error
     ):
+        self.latency_predictor = latency_predictor
+        self.latency_weight = latency_weight
+        self.error_weight = error_weight
         self.replicas: Dict[str, Replica] = {
             u.rstrip("/"): Replica(url=u.rstrip("/")) for u in replica_urls
         }
@@ -93,6 +104,10 @@ class EndpointPicker:
         for u in list(self.replicas):
             if u not in urls:
                 del self.replicas[u]
+                if self.latency_predictor is not None:
+                    # unbounded growth under pod churn, and a recycled
+                    # ip:port must not inherit the old pod's fitted model
+                    self.latency_predictor.forget(u)
         for u in urls:
             self.replicas.setdefault(u, Replica(url=u))
 
@@ -124,6 +139,28 @@ class EndpointPicker:
         r.healthy = not wedged
         r.consecutive_failures = 0
         r.last_poll = time.monotonic()
+
+    # recent-error half-life: a shedding replica is retried within ~30s of
+    # its last error, not banished forever
+    ERROR_DECAY_S = 30.0
+
+    def decayed_errors(self, r: Replica) -> float:
+        import math
+
+        if r.error_ewma <= 0.0:
+            return 0.0
+        dt = max(time.monotonic() - r.last_error_t, 0.0)
+        return r.error_ewma * math.exp(-dt / self.ERROR_DECAY_S)
+
+    def observe_http_error(self, url: str) -> None:
+        """A 4xx/5xx RESPONSE through the proxy (the replica is up but
+        refusing/failing work — distinct from observe_failure's transport
+        errors)."""
+        r = self.replicas.get(url.rstrip("/"))
+        if r is None:
+            return
+        r.error_ewma = self.decayed_errors(r) + 1.0
+        r.last_error_t = time.monotonic()
 
     def observe_failure(self, url: str) -> None:
         r = self.replicas.get(url.rstrip("/"))
@@ -227,6 +264,9 @@ class EndpointPicker:
         healthy = [r for r in self.replicas.values() if r.healthy]
         if not healthy:
             return None
+        from .latency import estimate_prompt_len
+
+        prompt_len = estimate_prompt_len(prompt_ids, prompt_text)
         scored = []
         chains: Dict[int, List[bytes]] = {}
         for i, r in enumerate(healthy):
@@ -235,6 +275,15 @@ class EndpointPicker:
                 self._text_hits(r, prompt_text),
             )
             score = hits * self.prefix_weight - r.queue_depth * self.queue_weight
+            score -= self.error_weight * self.decayed_errors(r)
+            if self.latency_predictor is not None and self.latency_weight > 0:
+                # SLO-aware term: penalize replicas the online model expects
+                # to be slow for THIS prompt at THEIR current depth; cold
+                # replicas (predict -> None) stay un-penalized
+                ttft = self.latency_predictor.predict_ttft(
+                    r.url, prompt_len, r.queue_depth)
+                if ttft is not None:
+                    score -= self.latency_weight * ttft
             # free pages as a mild tiebreak, round-robin as the final one
             scored.append((score, r.free_pages, -((i - self._rr) % len(healthy)), r))
         scored.sort(key=lambda t: t[:3], reverse=True)

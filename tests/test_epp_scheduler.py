@@ -309,3 +309,114 @@ class TestEngineIntegration:
             assert body["models"]["gen"]["prefix_digests"] == ["ab" * 16]
         finally:
             await client.close()
+
+
+class TestLatencyPredictor:
+    """Online TTFT/TPOT model (scheduler/latency.py — the role of the
+    reference's EPP latency-predictor companion,
+    scheduler_latency_predictor.go)."""
+
+    def test_learns_queue_depth_slope(self):
+        from kserve_tpu.scheduler.latency import LatencyPredictor
+
+        p = LatencyPredictor()
+        # synthetic truth: ttft = 0.05 + 0.02*depth + 0.0001*plen
+        for depth in range(12):
+            for plen in (64, 256, 1024):
+                p.observe("http://r1", plen, depth,
+                          0.05 + 0.02 * depth + 0.0001 * plen)
+        est_idle = p.predict_ttft("http://r1", 256, 0)
+        est_busy = p.predict_ttft("http://r1", 256, 10)
+        assert abs(est_idle - (0.05 + 0.0256)) < 0.02
+        assert abs(est_busy - est_idle - 0.2) < 0.03
+
+    def test_cold_replica_predicts_none(self):
+        from kserve_tpu.scheduler.latency import LatencyPredictor
+
+        p = LatencyPredictor()
+        assert p.predict_ttft("http://new", 100, 0) is None
+        for _ in range(3):  # below MIN_OBSERVATIONS
+            p.observe("http://new", 100, 0, 0.1)
+        assert p.predict_ttft("http://new", 100, 0) is None
+
+    def test_tpot_ewma(self):
+        from kserve_tpu.scheduler.latency import LatencyPredictor
+
+        p = LatencyPredictor()
+        for _ in range(6):
+            # 0.1 ttft + 9 decode steps at 20ms
+            p.observe("http://r", 100, 0, 0.1, n_tokens=10, total_s=0.28)
+        assert abs(p.predict_tpot("http://r") - 0.02) < 1e-6
+        total = p.predict_total("http://r", 100, 0, max_tokens=10)
+        assert abs(total - 0.28) < 0.02
+
+    def test_picker_prefers_predicted_faster_replica(self):
+        """Equal queue depth and no cache affinity: the slo-aware term
+        routes to the replica the model expects to answer sooner."""
+        from kserve_tpu.scheduler.latency import LatencyPredictor
+        from kserve_tpu.scheduler.picker import EndpointPicker
+
+        p = LatencyPredictor()
+        for _ in range(8):
+            p.observe("http://slow", 100, 0, 1.0)
+            p.observe("http://fast", 100, 0, 0.05)
+        picker = EndpointPicker(
+            ["http://slow", "http://fast"],
+            prefix_weight=0.0, queue_weight=1.0,
+            latency_predictor=p, latency_weight=4.0,
+        )
+        for _ in range(4):  # beats the round-robin tiebreak every time
+            assert picker.pick(prompt_ids=[1] * 100).url == "http://fast"
+
+    def test_llmisvc_plugin_gates_slo_strategy(self):
+        """CRD parity: the predicted-latency-producer plugin in the inline
+        scheduler config flips the EPP strategy (ref
+        hasLatencyProducerInSpec)."""
+        from kserve_tpu.controlplane.crds import LLMInferenceService
+        from kserve_tpu.controlplane.llmisvc import LLMISVCReconciler
+
+        def epp_args(config):
+            llm = LLMInferenceService.model_validate({
+                "apiVersion": "serving.kserve.io/v1alpha2",
+                "kind": "LLMInferenceService",
+                "metadata": {"name": "lat", "namespace": "default"},
+                "spec": {"model": {"uri": "hf://org/m", "name": "m"},
+                         "router": {"scheduler": config}},
+            })
+            objects, _ = LLMISVCReconciler().reconcile(llm)
+            epp = next(o for o in objects
+                       if o["kind"] == "Deployment"
+                       and o["metadata"]["name"] == "lat-epp")
+            return epp["spec"]["template"]["spec"]["containers"][0]["args"]
+
+        plain = epp_args({"enabled": True})
+        assert any(a == "--strategy=prefix-cache,queue-depth" for a in plain)
+        slo = epp_args({"enabled": True, "config": {"plugins": [
+            {"type": "predicted-latency-producer"}]}})
+        assert any(a == "--strategy=prefix-cache,queue-depth,slo-aware"
+                   for a in slo)
+
+    def test_http_error_penalty_beats_cold_replica_bias(self):
+        """A load-shedding replica never trains the latency model, so it
+        would stay 'cold' (no TTFT penalty) and win every pick; the
+        decaying HTTP-error penalty must push it below trained replicas."""
+        from kserve_tpu.scheduler.latency import LatencyPredictor
+        from kserve_tpu.scheduler.picker import EndpointPicker
+
+        p = LatencyPredictor()
+        for _ in range(8):
+            p.observe("http://good", 100, 0, 0.05)
+        picker = EndpointPicker(
+            ["http://good", "http://shedder"],
+            prefix_weight=0.0, queue_weight=1.0,
+            latency_predictor=p, latency_weight=4.0,
+        )
+        for _ in range(3):
+            picker.observe_http_error("http://shedder")
+        for _ in range(4):
+            assert picker.pick(prompt_ids=[1] * 64).url == "http://good"
+        # the penalty decays: after the half-life window the shedder gets
+        # retried instead of being banished forever
+        r = picker.replicas["http://shedder"]
+        r.last_error_t -= 300  # simulate 5 minutes passing
+        assert picker.decayed_errors(r) < 0.01
