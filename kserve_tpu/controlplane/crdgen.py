@@ -157,8 +157,17 @@ def generate(out_dir: str) -> List[str]:
 
 
 if __name__ == "__main__":
+    repo_root = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", ".."))
     target = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
-        os.path.dirname(__file__), "..", "..", "config", "crd"
+        repo_root, "config", "crd"
     )
     for path in generate(os.path.abspath(target)):
         print(path)
+    if len(sys.argv) <= 1:
+        # the Helm CRD chart installs the same manifests (charts/*/crds is
+        # helm's non-templated CRD location); regenerate both so the chart
+        # can never drift from the pydantic source of truth
+        for path in generate(
+                os.path.join(repo_root, "charts", "kserve-tpu-crd", "crds")):
+            print(path)

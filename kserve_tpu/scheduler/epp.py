@@ -26,11 +26,13 @@ import argparse
 import asyncio
 import json
 import os
+import time
 from typing import Optional
 
 from aiohttp import web
 
 from ..logging import logger
+from .latency import estimate_prompt_len
 from .picker import EndpointPicker
 
 HOP_HEADERS = {
@@ -144,13 +146,9 @@ class EPPServer:
         out = None
         # latency observation inputs, captured at PICK time (the depth the
         # decision was made against, not the depth after serving)
-        import time as _time
-
-        from .latency import estimate_prompt_len
-
         picked_depth = replica.queue_depth
         prompt_len = estimate_prompt_len(ids, text)
-        t0 = _time.monotonic()
+        t0 = time.monotonic()
         ttft: Optional[float] = None
         chunks = 0
         try:
@@ -167,14 +165,17 @@ class EPPServer:
                 await out.prepare(request)
                 async for chunk in upstream.content.iter_any():
                     if ttft is None:
-                        ttft = _time.monotonic() - t0
+                        ttft = time.monotonic() - t0
                     chunks += 1
                     await out.write(chunk)
                 await out.write_eof()
-                if upstream.status >= 400:
-                    # the replica answered but refused/failed: penalize it
-                    # in picking (it never trains the latency model, so
-                    # without this a 429-shedder stays "cold" and WINS)
+                if upstream.status == 429 or upstream.status >= 500:
+                    # REPLICA-health statuses only: 429 shedding / 5xx
+                    # failures penalize picking (a shedder never trains the
+                    # latency model, so without this it stays "cold" and
+                    # WINS).  Client-fault 4xx (400/404/422) would land on
+                    # ANY replica — penalizing the picked one would rotate
+                    # valid traffic away from its cache-affine home
                     self.picker.observe_http_error(replica.url)
                 # train only on SUCCESSFUL generation requests: fast 4xx
                 # rejections (429 load shedding) would teach the model a
@@ -191,7 +192,7 @@ class EPPServer:
                     self.picker.latency_predictor.observe(
                         replica.url, prompt_len, picked_depth, ttft,
                         n_tokens=chunks,
-                        total_s=_time.monotonic() - t0,
+                        total_s=time.monotonic() - t0,
                     )
                 return out
         except (aiohttp.ClientError, OSError, asyncio.TimeoutError) as exc:

@@ -27,6 +27,11 @@ import numpy as np
 
 MIN_OBSERVATIONS = 5
 FORGETTING = 0.98  # RLS forgetting factor: ~50-observation memory
+# covariance trace bound: forgetting divides P by 0.98 per observation, so
+# directions a uniform workload never excites wind up geometrically and
+# overflow to NaN after ~35k requests; rescaling past the cap keeps the
+# filter adaptive without the blow-up
+P_TRACE_CAP = 1e6
 
 
 def estimate_prompt_len(prompt_ids, prompt_text) -> int:
@@ -71,6 +76,13 @@ class LatencyPredictor:
         k = Px / (FORGETTING + x @ Px)
         m.w = m.w + k * (ttft_s - x @ m.w)
         m.P = (m.P - np.outer(k, Px)) / FORGETTING
+        trace = float(np.trace(m.P))
+        if not np.isfinite(trace) or trace > P_TRACE_CAP:
+            m.P = np.eye(3) * (P_TRACE_CAP / 3)
+        if not np.all(np.isfinite(m.w)):
+            m.w = np.zeros(3)
+            m.n = 0  # relearn; never serve NaN predictions
+            return
         m.n += 1
         if total_s is not None and n_tokens > 1:
             tpot = max(total_s - ttft_s, 0.0) / (n_tokens - 1)

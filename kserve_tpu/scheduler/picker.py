@@ -30,12 +30,14 @@ poll, engine wedged) are filtered; all-unhealthy yields 503 upstream.
 from __future__ import annotations
 
 import asyncio
+import math
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
 from ..logging import logger
+from .latency import estimate_prompt_len
 from .prefix import text_prefix_digests, token_prefix_digests
 
 
@@ -145,8 +147,6 @@ class EndpointPicker:
     ERROR_DECAY_S = 30.0
 
     def decayed_errors(self, r: Replica) -> float:
-        import math
-
         if r.error_ewma <= 0.0:
             return 0.0
         dt = max(time.monotonic() - r.last_error_t, 0.0)
@@ -264,8 +264,6 @@ class EndpointPicker:
         healthy = [r for r in self.replicas.values() if r.healthy]
         if not healthy:
             return None
-        from .latency import estimate_prompt_len
-
         prompt_len = estimate_prompt_len(prompt_ids, prompt_text)
         scored = []
         chains: Dict[int, List[bytes]] = {}

@@ -420,3 +420,22 @@ class TestLatencyPredictor:
         r = picker.replicas["http://shedder"]
         r.last_error_t -= 300  # simulate 5 minutes passing
         assert picker.decayed_errors(r) < 0.01
+
+    def test_rls_stays_finite_under_uniform_workload(self):
+        """Forgetting winds the covariance up geometrically in directions
+        a uniform workload never excites; the trace cap must keep weights
+        finite past the old ~35k-observation overflow point."""
+        import numpy as np
+
+        from kserve_tpu.scheduler.latency import LatencyPredictor
+
+        p = LatencyPredictor()
+        for _ in range(40_000):
+            p.observe("http://r", 128, 2, 0.1)
+        est = p.predict_ttft("http://r", 128, 2)
+        assert est is not None and np.isfinite(est)
+        assert abs(est - 0.1) < 0.01
+        # snapshot must stay JSON-serializable (no NaN weights)
+        import json
+
+        json.dumps(p.snapshot())
