@@ -1030,7 +1030,11 @@ void Batcher::execute(const std::vector<std::shared_ptr<BatchEntry>>& batch,
     }
 }
 
-Batcher g_batcher;
+// immortal singleton (intentionally leaked) for the same reason as
+// g_logger: detached flush-timer and connection threads can still touch
+// queues_/mu_ after main returns — TSAN caught ~Batcher racing a
+// sleeping timer thread's queues_.find() (heap-use-after-free)
+Batcher& g_batcher = *new Batcher;
 
 // ----------------------------------------------------------- metrics merge
 

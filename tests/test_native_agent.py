@@ -3,6 +3,7 @@
 
 import asyncio
 import json
+import os
 import shutil
 import socket
 import subprocess
@@ -27,18 +28,27 @@ def free_port():
     return port
 
 
-@pytest.fixture(scope="module")
-def agent_binary():
+def _build_agent(bin_path, make_target=None):
+    """Build (or reuse) an agent binary; one staleness/skip/make path for
+    every fixture."""
     src = AGENT_DIR / "agent.cpp"
     stale = (
-        not AGENT_BIN.exists()
-        or src.stat().st_mtime > AGENT_BIN.stat().st_mtime
+        not bin_path.exists()
+        or src.stat().st_mtime > bin_path.stat().st_mtime
     )
     if stale:
         if shutil.which("g++") is None:
             pytest.skip("no g++ toolchain")
-        subprocess.run(["make", "-C", str(AGENT_DIR)], check=True)
-    return str(AGENT_BIN)
+        cmd = ["make", "-C", str(AGENT_DIR)]
+        if make_target:
+            cmd.append(make_target)
+        subprocess.run(cmd, check=True)
+    return str(bin_path)
+
+
+@pytest.fixture(scope="module")
+def agent_binary():
+    return _build_agent(AGENT_BIN)
 
 
 class _Backend:
@@ -455,3 +465,63 @@ async def test_batch_strategies(agent_binary, tmp_path):
     files = await drive("timed", 100, 2)
     assert len(files) >= 1
     await runner.cleanup()
+
+
+@pytest.fixture
+def agent_binary_tsan():
+    """ThreadSanitizer build (SURVEY §5 race-detection row)."""
+    return _build_agent(AGENT_DIR / "kserve-tpu-agent-tsan",
+                        "kserve-tpu-agent-tsan")
+
+
+@pytest.mark.slow
+@async_test
+async def test_tsan_concurrent_load_and_shutdown(agent_binary_tsan, tmp_path):
+    """Drive the TSAN build with concurrent batched traffic while the
+    logger buffers, then SIGTERM mid-flight: any data race between the
+    connection threads, batcher, logger worker, and the shutdown path
+    makes ThreadSanitizer report and exit non-zero (TSAN_OPTIONS
+    exitcode)."""
+    backend = _Backend()
+    backend_port = free_port()
+    agent_port = free_port()
+    runner = web.AppRunner(backend.app())
+    await runner.setup()
+    await web.TCPSite(runner, "127.0.0.1", backend_port).start()
+    log_dir = tmp_path / "payloads"
+    out_path = tmp_path / "tsan-out.txt"
+    out_file = open(out_path, "wb")
+    proc = subprocess.Popen(
+        [agent_binary_tsan, "--port", str(agent_port),
+         "--component_port", str(backend_port),
+         "--enable-batcher", "--max-batchsize", "4", "--max-latency", "20",
+         "--enable-logger", "--log-url", f"file://{log_dir}",
+         "--log-batch-size", "8", "--log-flush-interval", "50"],
+        # a file, not a PIPE: a sanitizer report storm past the pipe
+        # buffer would block agent threads mid-write and mask the race
+        # behind a wait() timeout
+        stdout=out_file, stderr=subprocess.STDOUT,
+        env={**os.environ, "TSAN_OPTIONS": "exitcode=66 halt_on_error=0"},
+    )
+    try:
+        await asyncio.sleep(0.6)  # tsan startup is slower
+        async with httpx.AsyncClient() as client:
+            async def one(i):
+                r = await client.post(
+                    f"http://127.0.0.1:{agent_port}/v1/models/stub:predict",
+                    json={"instances": [[i]]}, timeout=30,
+                )
+                assert r.status_code == 200
+            # concurrent fan-in exercises batcher cv + logger queue
+            for _ in range(4):
+                await asyncio.gather(*[one(i) for i in range(16)])
+        proc.terminate()  # drain+join under tsan
+        rc = proc.wait(timeout=20)
+        out = out_path.read_text(errors="replace")
+        assert "WARNING: ThreadSanitizer" not in out, out[-4000:]
+        assert rc == 0, f"rc={rc}\n{out[-4000:]}"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        out_file.close()
+        await runner.cleanup()
