@@ -199,8 +199,10 @@ class ControllerManager:
         "LocalModelCache": LocalModelCache,
         "ClusterStorageContainer": ClusterStorageContainer,
     }
-    # untyped cluster objects the controllers only read
-    _RAW_KINDS = {"Secret", "ServiceAccount", "ConfigMap", "Node", "Pod"}
+    # untyped cluster objects the controllers only read (LocalModelNode is
+    # controller-WRITTEN, agent-reconciled — the manager never parses it)
+    _RAW_KINDS = {"Secret", "ServiceAccount", "ConfigMap", "Node", "Pod",
+                  "LocalModelNode"}
 
     def _parse(self, obj: dict):
         kind = obj.get("kind")
@@ -312,6 +314,10 @@ class ControllerManager:
         deleted = self.cluster.delete(kind, name, namespace)
         if not deleted:
             return False
+        if kind == "LocalModelCache":
+            # the per-node CRs are unowned aggregates: rebuild them so the
+            # node agents see the model leave and reclaim disk
+            self._sync_localmodelnodes()
         if kind == "ConfigMap" and namespace == self.CONTROLLER_NAMESPACE:
             # deleting controller config REVERTS it (no ratchet)
             if name == "inferenceservice-config":
@@ -378,9 +384,13 @@ class ControllerManager:
         elif isinstance(obj, LLMInferenceService):
             desired, status = self.llm_reconciler.reconcile(obj)
         elif isinstance(obj, LocalModelCache):
-            # only THIS cache's jobs (named f"{cache}-{node}") feed status —
-            # other caches' jobs on the same nodes must not bleed in
-            prefix = f"{obj.metadata.name}-"
+            # only THIS cache's jobs feed status — jobs are named by the
+            # STORAGE key (dl-{key12}-{node}), so other caches' jobs on the
+            # same nodes must not bleed in, while a same-URI cache's shared
+            # job legitimately does
+            from .localmodel import storage_key
+
+            prefix = f"dl-{storage_key(obj.spec.sourceModelUri)[:12]}-"
             job_status = {}
             for job in self.cluster.list("Job"):
                 if not job["metadata"]["name"].startswith(prefix):
@@ -398,6 +408,11 @@ class ControllerManager:
             }
             for d in desired:
                 set_owner(d, owner)
+            # per-node desired state for the node agents: LocalModelNode
+            # aggregates EVERY cache wanting a node, so it is synced
+            # cluster-wide (unowned — one cache's GC must not delete a CR
+            # other caches still populate)
+            self._sync_localmodelnodes()
         elif isinstance(obj, TrainedModel):
             desired, status = self._reconcile_trained_model(obj)
         elif isinstance(obj, InferenceGraph):
@@ -411,6 +426,47 @@ class ControllerManager:
         self.cluster.update_status(
             obj.kind, obj.metadata.name, obj.metadata.namespace, status
         )
+
+    def _sync_localmodelnodes(self) -> None:
+        """Rebuild every LocalModelNode from the full LocalModelCache set
+        (parity: the cluster controller writing the per-node CRs the
+        localmodelnode agent consumes).  Nodes no cache wants — including
+        nodes drained out of every node group — keep an EMPTY spec so
+        their agent deletes stale copies.  No-op specs are not re-applied
+        (an apply bumps resourceVersion and churns the agents' watches)."""
+        node_models: dict = {}
+        for node_list in self.localmodel_reconciler.node_groups.values():
+            for node in node_list:
+                node_models.setdefault(node, [])
+        # nodes with an existing CR but no longer in any group must be
+        # emptied, not forgotten
+        for cr in self.cluster.list("LocalModelNode"):
+            node_models.setdefault(cr["metadata"]["name"], [])
+        for cache in self.cluster.list("LocalModelCache"):
+            spec = cache.get("spec", {})
+            meta = cache["metadata"]
+            for group in spec.get("nodeGroups", []):
+                for node in self.localmodel_reconciler.node_groups.get(group, []):
+                    node_models.setdefault(node, []).append({
+                        "sourceModelUri": spec.get("sourceModelUri", ""),
+                        "modelName": meta["name"],
+                        # namespace disambiguates same-named caches; the
+                        # agent keys status by "ns/name"
+                        "namespace": meta.get("namespace", "") or None,
+                        "nodeGroup": group,
+                    })
+        for node, models in sorted(node_models.items()):
+            existing = self.cluster.get("LocalModelNode", node, "")
+            if existing is not None and (
+                    (existing.get("spec", {}) or {}).get("localModels", [])
+                    == models):
+                continue
+            self.cluster.apply({
+                "apiVersion": "serving.kserve.io/v1alpha1",
+                "kind": "LocalModelNode",
+                "metadata": {"name": node, "namespace": ""},
+                "spec": {"localModels": models},
+            })
 
     # every kind any reconciler synthesizes — the prune sweep only needs to
     # look at these (an all-objects sweep over an HTTP store would be one

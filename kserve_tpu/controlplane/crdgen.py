@@ -27,6 +27,7 @@ CRD_KINDS: Dict[str, Tuple[type, str, str, str]] = {
     "TrainedModel": (crds.TrainedModel, "serving.kserve.io", "v1alpha1", "Namespaced"),
     "InferenceGraph": (crds.InferenceGraph, "serving.kserve.io", "v1alpha1", "Namespaced"),
     "LocalModelCache": (crds.LocalModelCache, "serving.kserve.io", "v1alpha1", "Namespaced"),
+    "LocalModelNode": (crds.LocalModelNode, "serving.kserve.io", "v1alpha1", "Cluster"),
     "ClusterStorageContainer": (crds.ClusterStorageContainer, "serving.kserve.io", "v1alpha1", "Cluster"),
     "LLMInferenceService": (crds.LLMInferenceService, "serving.kserve.io", "v1alpha2", "Namespaced"),
     "LLMInferenceServiceConfig": (crds.LLMInferenceServiceConfig, "serving.kserve.io", "v1alpha2", "Namespaced"),
@@ -39,6 +40,7 @@ _PLURALS = {
     "TrainedModel": "trainedmodels",
     "InferenceGraph": "inferencegraphs",
     "LocalModelCache": "localmodelcaches",
+    "LocalModelNode": "localmodelnodes",
     "ClusterStorageContainer": "clusterstoragecontainers",
     "LLMInferenceService": "llminferenceservices",
     "LLMInferenceServiceConfig": "llminferenceserviceconfigs",

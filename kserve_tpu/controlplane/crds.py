@@ -273,6 +273,32 @@ class LocalModelCache(K8sModel):
     status: Dict[str, Any] = Field(default_factory=dict)
 
 
+class LocalModelInfo(K8sModel):
+    """One model a node must hold (parity: LocalModelInfo,
+    local_model_node_types.go:21)."""
+
+    sourceModelUri: str
+    modelName: str
+    namespace: Optional[str] = None
+    nodeGroup: Optional[str] = None
+
+
+class LocalModelNodeSpec(K8sModel):
+    localModels: List[LocalModelInfo] = Field(default_factory=list)
+
+
+class LocalModelNode(K8sModel):
+    """Per-node desired cache state, written by the cluster controller and
+    reconciled by the node agent (parity: LocalModelNode,
+    local_model_node_types.go:62; cluster-scoped, named after the node)."""
+
+    apiVersion: str = V1ALPHA1
+    kind: Literal["LocalModelNode"] = "LocalModelNode"
+    metadata: ObjectMeta
+    spec: LocalModelNodeSpec
+    status: Dict[str, Any] = Field(default_factory=dict)
+
+
 class ClusterStorageContainerSpec(K8sModel):
     container: Dict[str, Any] = Field(default_factory=dict)
     supportedUriFormats: List[Dict[str, str]] = Field(default_factory=list)

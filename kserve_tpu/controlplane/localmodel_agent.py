@@ -1,0 +1,196 @@
+"""The deployable LocalModelNode agent process (DaemonSet role).
+
+`python -m kserve_tpu.controlplane.localmodel_agent --node $NODE_NAME
+--master http://apiserver` polls this node's LocalModelNode CR, verifies
+every cached copy against its download manifest (LocalModelNodeAgent),
+launches download Jobs pinned to the node for missing/corrupt copies,
+deletes stale ones, and writes per-model status back to the CR.
+
+Parity: cmd/localmodelnode (the per-node agent the reference deploys as
+a DaemonSet); Jobs hostPath-mount the cache base exactly as that agent's
+downloads write the node's disk.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from typing import Optional
+
+from ..logging import logger
+from .localmodel import (
+    CACHE_BASE_PATH,
+    STORAGE_INITIALIZER_IMAGE,
+    LocalModelNodeAgent,
+    storage_key,
+)
+
+JOBS_NAMESPACE = "kserve-localmodel-jobs"
+
+
+def node_download_job(uri: str, node: str, cache_base: str = CACHE_BASE_PATH,
+                      image: str = STORAGE_INITIALIZER_IMAGE) -> dict:
+    """A node-pinned download Job writing the hash-keyed copy (plus its
+    verification manifest) through a hostPath mount — the agent-side
+    analogue of the cluster controller's PVC-backed jobs."""
+    key = storage_key(uri)
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        # dln- prefix: distinct from the cluster controller's PVC-backed
+        # dl- jobs — Job templates are immutable on a real apiserver, so
+        # the two writers must never claim one name
+        "metadata": {"name": f"dln-{key[:12]}-{node}",
+                     "namespace": JOBS_NAMESPACE},
+        "spec": {
+            "template": {
+                "spec": {
+                    "nodeName": node,
+                    "restartPolicy": "Never",
+                    "containers": [{
+                        "name": "download",
+                        "image": image,
+                        "command": ["python", "-m",
+                                    "kserve_tpu.storage.initializer"],
+                        "args": ["--manifest", uri, f"{cache_base}/{key}"],
+                        "volumeMounts": [
+                            {"name": "cache", "mountPath": cache_base}],
+                    }],
+                    "volumes": [{
+                        "name": "cache",
+                        "hostPath": {"path": cache_base,
+                                     "type": "DirectoryOrCreate"},
+                    }],
+                }
+            },
+            "backoffLimit": 3,
+        },
+    }
+
+
+class LocalModelNodeDaemon:
+    """One node's reconcile driver over a cluster transport (HTTPCluster
+    in production, FakeCluster in tests)."""
+
+    def __init__(self, cluster, node: str,
+                 cache_base: str = CACHE_BASE_PATH,
+                 image: str = STORAGE_INITIALIZER_IMAGE):
+        self.cluster = cluster
+        self.node = node
+        self.cache_base = cache_base
+        self.image = image
+        self.agent = LocalModelNodeAgent(cache_base=cache_base)
+
+    def _job_status(self, known_keys) -> dict:
+        """storage key -> JobStatus-ish dict for THIS node's jobs.
+        Attribution is by spec.template.spec.nodeName (a name-suffix match
+        would confuse nodes whose names suffix each other); the key comes
+        from the job's dest-dir arg, with the dln-{key12}- name prefix as
+        the fallback matched against the keys this node wants."""
+        out = {}
+        for job in self.cluster.list("Job", namespace=JOBS_NAMESPACE):
+            name = job["metadata"]["name"]
+            if not name.startswith(("dln-", "dl-")):
+                continue
+            pod = (job.get("spec", {}).get("template", {}) or {}).get(
+                "spec", {}) or {}
+            if pod.get("nodeName") != self.node:
+                continue
+            status = job.get("status", {}) or {}
+            # map either the stub apiserver's phase string or real
+            # batch/v1 counts onto the agent's JobStatus-ish shape
+            phase = status.get("phase")
+            js = {
+                "succeeded": status.get("succeeded", 0),
+                "failed": status.get("failed", 0),
+                "active": status.get("active", 0),
+            }
+            if phase == "Succeeded":
+                js["succeeded"] = js["succeeded"] or 1
+            elif phase == "Failed":
+                js["failed"] = js["failed"] or 1
+            elif phase == "Running":
+                js["active"] = js["active"] or 1
+            key = None
+            for a in pod.get("containers", [{}])[0].get("args", []):
+                if "/" in a and not a.startswith("--"):
+                    candidate = a.rsplit("/", 1)[-1]
+                    if candidate in known_keys:
+                        key = candidate
+            if key is None:
+                key12 = name.split("-", 1)[-1].rsplit(
+                    f"-{self.node}", 1)[0]
+                matches = [k for k in known_keys if k.startswith(key12)]
+                if len(matches) == 1:
+                    key = matches[0]
+            if key:
+                out[key] = js
+        return out
+
+    def sync_once(self) -> Optional[dict]:
+        """One reconcile pass; returns the agent result (None when the
+        node has no LocalModelNode CR yet)."""
+        cr = self.cluster.get("LocalModelNode", self.node, "")
+        if cr is None:
+            return None
+        local_models = []
+        for m in (cr.get("spec", {}) or {}).get("localModels", []):
+            if not m.get("sourceModelUri"):
+                continue
+            # "ns/name" keys keep same-named caches from different
+            # namespaces apart in the status map
+            name = m.get("modelName", "")
+            if m.get("namespace"):
+                name = f"{m['namespace']}/{name}"
+            local_models.append(
+                {"name": name, "uri": m["sourceModelUri"]})
+        uri_by_key = {storage_key(m["uri"]): m["uri"] for m in local_models}
+        result = self.agent.reconcile(
+            local_models, self._job_status(set(uri_by_key)))
+        for key in result["jobs"]:
+            self.cluster.apply(node_download_job(
+                uri_by_key[key], self.node, self.cache_base, self.image))
+        self.cluster.update_status(
+            "LocalModelNode", self.node, "",
+            {"modelStatus": result["status"]},
+        )
+        if result["removed"] or result["redownloads"]:
+            logger.info(
+                "localmodelnode %s: removed=%s redownloads=%s",
+                self.node, result["removed"], result["redownloads"],
+            )
+        return result
+
+
+def main(argv=None) -> int:
+    from ..api.http_transport import HTTPCluster
+    from ..logging import configure_logging
+
+    configure_logging()
+    parser = argparse.ArgumentParser("kserve-tpu-localmodelnode-agent")
+    parser.add_argument("--node", required=True,
+                        help="this node's name (Downward API)")
+    parser.add_argument("--master", default=None,
+                        help="apiserver base URL (omit for in-cluster)")
+    parser.add_argument("--token", default=None)
+    parser.add_argument("--cache-base", default=CACHE_BASE_PATH)
+    parser.add_argument("--image", default=STORAGE_INITIALIZER_IMAGE)
+    parser.add_argument("--poll-interval", default=10.0, type=float)
+    args = parser.parse_args(argv)
+    cluster = (HTTPCluster(args.master, token=args.token)
+               if args.master else HTTPCluster("", in_cluster=True))
+    cluster.wait_ready()
+    daemon = LocalModelNodeDaemon(
+        cluster, args.node, cache_base=args.cache_base, image=args.image)
+    logger.info("localmodelnode agent for %s (cache %s)",
+                args.node, args.cache_base)
+    while True:
+        try:
+            daemon.sync_once()
+        except Exception:  # noqa: BLE001 — the daemon must outlive blips
+            logger.warning("localmodelnode sync failed", exc_info=True)
+        time.sleep(args.poll_interval)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -185,3 +185,131 @@ class TestNodeAgent:
         agent = LocalModelNodeAgent(cache_base=str(tmp_path / "cache"))
         out = agent.reconcile([{"name": "m", "uri": f"file://{src}"}])
         assert out["status"] == {"m": "Downloaded"}
+
+
+class TestNodeDaemon:
+    """The deployable per-node agent (controlplane/localmodel_agent.py)
+    driving LocalModelNode CRs end-to-end against the cluster store."""
+
+    def _stack(self, tmp_path):
+        from kserve_tpu.controlplane.cluster import ControllerManager
+        from kserve_tpu.controlplane.localmodel_agent import LocalModelNodeDaemon
+
+        mgr = ControllerManager()
+        mgr.localmodel_reconciler.node_groups = {"tpu-v5e": ["node-a", "node-b"]}
+        daemon = LocalModelNodeDaemon(
+            mgr.cluster, "node-a", cache_base=str(tmp_path))
+        return mgr, daemon
+
+    def test_cache_apply_creates_localmodelnode_crs(self, tmp_path):
+        mgr, _ = self._stack(tmp_path)
+        mgr.apply(make_cache().model_dump())
+        cr = mgr.cluster.get("LocalModelNode", "node-a", "")
+        assert cr is not None
+        models = cr["spec"]["localModels"]
+        assert models[0]["sourceModelUri"] == "hf://meta-llama/Llama-3.2-1B"
+        assert models[0]["modelName"] == "llama-cache"
+        assert mgr.cluster.get("LocalModelNode", "node-b", "") is not None
+
+    def test_daemon_launches_job_then_reports_downloaded(self, tmp_path):
+        from kserve_tpu.controlplane.localmodel import storage_key
+
+        mgr, daemon = self._stack(tmp_path)
+        mgr.apply(make_cache().model_dump())
+        uri = "hf://meta-llama/Llama-3.2-1B"
+        key = storage_key(uri)
+        # pass 1: nothing cached -> a node-pinned hostPath job
+        result = daemon.sync_once()
+        assert result["jobs"] == [key]
+        job = mgr.cluster.get(
+            "Job", f"dln-{key[:12]}-node-a", "kserve-localmodel-jobs")
+        assert job["spec"]["template"]["spec"]["nodeName"] == "node-a"
+        vol = job["spec"]["template"]["spec"]["volumes"][0]
+        assert vol["hostPath"]["path"] == str(tmp_path)
+        assert job["spec"]["template"]["spec"]["containers"][0]["args"][0] == (
+            "--manifest")
+        cr = mgr.cluster.get("LocalModelNode", "node-a", "")
+        assert cr["status"]["modelStatus"] == {
+            "llama-cache": "DownloadPending"}
+        # pass 2: the job "completed" and wrote a verified copy
+        job["status"] = {"phase": "Succeeded"}
+        mgr.cluster.apply(job)
+        _write_copy(tmp_path, uri)
+        result = daemon.sync_once()
+        assert result["status"] == {"llama-cache": "Downloaded"}
+        cr = mgr.cluster.get("LocalModelNode", "node-a", "")
+        assert cr["status"]["modelStatus"] == {"llama-cache": "Downloaded"}
+
+    def test_cache_deletion_empties_node_spec_and_agent_cleans(self, tmp_path):
+        mgr, daemon = self._stack(tmp_path)
+        cache = make_cache()
+        mgr.apply(cache.model_dump())
+        uri = "hf://meta-llama/Llama-3.2-1B"
+        key = _write_copy(tmp_path, uri)
+        assert daemon.sync_once()["status"] == {"llama-cache": "Downloaded"}
+        # delete the cache, re-sync the node CRs (any cache reconcile does)
+        mgr.cluster.delete("LocalModelCache", "llama-cache", "")
+        mgr._sync_localmodelnodes()
+        cr = mgr.cluster.get("LocalModelNode", "node-a", "")
+        assert cr["spec"]["localModels"] == []
+        result = daemon.sync_once()
+        assert result["removed"] == [key]
+        assert not (tmp_path / key).exists()
+
+    def test_cache_delete_resyncs_without_manual_call(self, tmp_path):
+        """Production path: ControllerManager.delete on the cache itself
+        must empty the node CRs (no private resync call needed)."""
+        mgr, daemon = self._stack(tmp_path)
+        mgr.apply(make_cache().model_dump())
+        key = _write_copy(tmp_path, "hf://meta-llama/Llama-3.2-1B")
+        assert daemon.sync_once()["status"]  # populated
+        mgr.delete("LocalModelCache", "llama-cache", "")
+        cr = mgr.cluster.get("LocalModelNode", "node-a", "")
+        assert cr["spec"]["localModels"] == []
+        result = daemon.sync_once()
+        assert result["removed"] == [key]
+
+    def test_node_drained_from_groups_gets_emptied(self, tmp_path):
+        mgr, daemon = self._stack(tmp_path)
+        mgr.apply(make_cache().model_dump())
+        assert mgr.cluster.get(
+            "LocalModelNode", "node-a", "")["spec"]["localModels"]
+        # node-a leaves every group; next cache reconcile must empty it
+        mgr.localmodel_reconciler.node_groups = {"tpu-v5e": ["node-b"]}
+        mgr.apply(make_cache().model_dump())
+        assert mgr.cluster.get(
+            "LocalModelNode", "node-a", "")["spec"]["localModels"] == []
+
+    def test_same_named_caches_in_different_namespaces_distinct(self, tmp_path):
+        from kserve_tpu.controlplane.crds import (
+            LocalModelCache as LMC,
+            LocalModelCacheSpec,
+            ObjectMeta,
+        )
+
+        mgr, daemon = self._stack(tmp_path)
+        for ns, uri in (("team-a", "hf://org/x"), ("team-b", "hf://org/y")):
+            mgr.apply(LMC(
+                metadata=ObjectMeta(name="llama", namespace=ns),
+                spec=LocalModelCacheSpec(
+                    sourceModelUri=uri, nodeGroups=["tpu-v5e"]),
+            ).model_dump())
+        result = daemon.sync_once()
+        assert set(result["status"]) == {"team-a/llama", "team-b/llama"}
+
+    def test_nodename_attribution_not_suffix_match(self, tmp_path):
+        """A job pinned to 'tpu-node-a' must not feed 'node-a''s status
+        even though the name suffix matches."""
+        from kserve_tpu.controlplane.localmodel_agent import node_download_job
+
+        mgr, daemon = self._stack(tmp_path)
+        mgr.apply(make_cache().model_dump())
+        uri = "hf://meta-llama/Llama-3.2-1B"
+        other = node_download_job(uri, "tpu-node-a", str(tmp_path))
+        other["status"] = {"phase": "Failed", "failed": 3}
+        mgr.cluster.apply(other)
+        result = daemon.sync_once()
+        # node-a must still schedule ITS OWN download, not inherit the
+        # other node's failure
+        assert result["status"] == {"llama-cache": "DownloadPending"}
+        assert len(result["jobs"]) == 1
