@@ -122,9 +122,13 @@ class Storage:
             return Storage._download_local(uri, out_dir)
         if uri.startswith(_PVC_PREFIX):
             return Storage._download_pvc(uri, out_dir)
-        if re.match(r"https?://(.+?)\.blob\.core\.windows\.net/(.+)", uri):
+        if re.match(r"https?://[^/]+?\.blob\.core\.windows\.net/(.+)", uri):
             # must precede the generic http(s) branch or it is unreachable
+            # ([^/] keeps a generic URL whose PATH merely contains the
+            # azure suffix on the http branch)
             return Storage._download_azure_blob(uri, out_dir)
+        if re.match(r"https?://[^/]+?\.file\.core\.windows\.net/(.+)", uri):
+            return Storage._download_azure_file(uri, out_dir)
         if uri.startswith(("http://", "https://")):
             return Storage._download_http(uri, out_dir)
         if uri.startswith("gs://"):
@@ -549,6 +553,104 @@ class Storage:
             client.close()
         if count == 0:
             raise StorageError(f"no blobs under {uri}")
+        return out_dir
+
+
+    @staticmethod
+    def _download_azure_file(uri: str, out_dir: str) -> str:
+        """Azure File share via the File service REST API (httpx — no
+        SDK).  Parity: reference _download_azure_file_share (the
+        *.file.core.windows.net scheme the blob path cannot serve).
+        Directories are walked recursively ('restype=directory&comp=list'
+        per level); $AZURE_STORAGE_SAS_TOKEN authenticates private shares
+        and $KSERVE_AZURE_FILE_ENDPOINT overrides for emulators."""
+        import xml.etree.ElementTree as ET
+
+        import httpx
+
+        m = re.match(
+            r"https?://([^/]+?)\.file\.core\.windows\.net/([^/]+)/?(.*)", uri)
+        if not m:
+            raise StorageError(f"unrecognized azure file uri {uri!r}")
+        account, share = m.group(1), m.group(2)
+        # the URI may carry percent-encoding; decode once so quote() on
+        # the wire does not double-encode (%20 -> %2520)
+        prefix = unquote(m.group(3).rstrip("/"))
+        endpoint = os.getenv(
+            "KSERVE_AZURE_FILE_ENDPOINT",
+            f"https://{account}.file.core.windows.net",
+        ).rstrip("/")
+        sas = os.getenv("AZURE_STORAGE_SAS_TOKEN", "").lstrip("?")
+        client = httpx.Client(follow_redirects=True, timeout=600)
+
+        def fetch_file(full: str, rel: str) -> None:
+            dest = os.path.join(out_dir, rel)
+            os.makedirs(os.path.dirname(dest) or out_dir, exist_ok=True)
+            quoted = quote(full, safe="/")
+            url = (f"{endpoint}/{share}/{quoted}"
+                   + (f"?{sas}" if sas else ""))
+            with client.stream("GET", url) as r:
+                if r.status_code != 200:
+                    raise StorageError(
+                        f"azure file GET {full} -> HTTP {r.status_code}")
+                with open(dest, "wb") as f:
+                    for chunk in r.iter_bytes():
+                        f.write(chunk)
+            _maybe_unpack(dest, out_dir)
+
+        def list_dir(path: str):
+            """-> (files, subdirs) one level down, following NextMarker
+            pagination (the service caps one response at 5000 entries —
+            dropping the marker would silently truncate big shard dirs)."""
+            files: List[str] = []
+            dirs: List[str] = []
+            marker = ""
+            quoted = quote(path, safe="/")
+            url = f"{endpoint}/{share}/{quoted}" + (f"?{sas}" if sas else "")
+            while True:
+                params = {"restype": "directory", "comp": "list"}
+                if marker:
+                    params["marker"] = marker
+                r = client.get(url, params=params)
+                if r.status_code != 200:
+                    raise StorageError(
+                        f"azure file list {path!r} -> HTTP {r.status_code}",
+                        )
+                tree = ET.fromstring(r.text)
+                files.extend(
+                    f.findtext("Name") for f in tree.iter("File")
+                    if f.findtext("Name"))
+                dirs.extend(
+                    d.findtext("Name") for d in tree.iter("Directory")
+                    if d.findtext("Name"))
+                marker = tree.findtext("NextMarker") or ""
+                if not marker:
+                    return files, dirs
+
+        count = 0
+        try:
+            try:
+                root_files, root_dirs = list_dir(prefix)
+            except StorageError:
+                # the URI may point at a single FILE (archive layout): the
+                # directory list fails there; fall back to a plain GET
+                fetch_file(prefix, os.path.basename(prefix) or "model")
+                return out_dir
+            stack = [(prefix, root_files, root_dirs)]
+            while stack:
+                cur, files, dirs = stack.pop()
+                for d in dirs:
+                    sub = f"{cur}/{d}" if cur else d
+                    sub_files, sub_dirs = list_dir(sub)
+                    stack.append((sub, sub_files, sub_dirs))
+                for name in files:
+                    full = f"{cur}/{name}" if cur else name
+                    fetch_file(full, _safe_rel(full, prefix))
+                    count += 1
+        finally:
+            client.close()
+        if count == 0:
+            raise StorageError(f"no files under {uri}")
         return out_dir
 
 

@@ -95,7 +95,8 @@ class _FakeCloudHandler:
     """One handler serving both an azure-blob container listing/download and
     a WebHDFS namenode, for provider tests without SDKs or real clusters."""
 
-    files = {"weights.bin": b"W" * 64, "sub/config.json": b"{}"}
+    files = {"weights.bin": b"W" * 64, "sub/config.json": b"{}",
+             "single.bin": b"S" * 16}
 
     @classmethod
     def app(cls):
@@ -142,7 +143,31 @@ class _FakeCloudHandler:
                 return web.Response(status=404)
             return web.Response(status=400)
 
+        async def azure_file(request):
+            # file-share surface: ?restype=directory&comp=list walks one
+            # level; plain GET downloads
+            path = request.match_info.get("name", "")
+            if request.query.get("restype") == "directory":
+                if path in ("", "models"):
+                    xml = ("<?xml version='1.0'?><EnumerationResults>"
+                           "<Entries><File><Name>weights.bin</Name></File>"
+                           "<Directory><Name>sub</Name></Directory>"
+                           "</Entries></EnumerationResults>")
+                elif path.endswith("sub"):
+                    xml = ("<?xml version='1.0'?><EnumerationResults>"
+                           "<Entries><File><Name>config.json</Name></File>"
+                           "</Entries></EnumerationResults>")
+                else:
+                    return web.Response(status=404)
+                return web.Response(text=xml, content_type="application/xml")
+            key = path.split("/", 1)[-1] if "/" in path else path
+            if key in cls.files:
+                return web.Response(body=cls.files[key])
+            return web.Response(status=404)
+
         app = web.Application()
+        app.router.add_get("/fileshare", azure_file)
+        app.router.add_get("/fileshare/{name:.*}", azure_file)
         app.router.add_get("/{container:[a-z]+}", azure_container)
         app.router.add_get("/{container:[a-z]+}/{name:.+}", azure_blob)
         app.router.add_get("/webhdfs/v1/{path:.*}", webhdfs)
@@ -195,6 +220,33 @@ class TestAzureBlob:
         assert (tmp_path / "weights.bin").read_bytes() == b"W" * 64
         assert (tmp_path / "sub" / "config.json").exists()
         assert out == str(tmp_path)
+
+
+class TestAzureFileShare:
+    def test_download_recursive(self, tmp_path, fake_cloud_port, monkeypatch):
+        monkeypatch.setenv(
+            "KSERVE_AZURE_FILE_ENDPOINT", f"http://127.0.0.1:{fake_cloud_port}"
+        )
+        out = Storage.download(
+            "https://acct.file.core.windows.net/fileshare/models",
+            str(tmp_path),
+        )
+        assert (tmp_path / "weights.bin").read_bytes() == b"W" * 64
+        assert (tmp_path / "sub" / "config.json").read_bytes() == b"{}"
+        assert out == str(tmp_path)
+
+    def test_single_file_uri_falls_back_to_get(self, tmp_path,
+                                               fake_cloud_port, monkeypatch):
+        """A URI pointing at a FILE (archive layout): the directory list
+        404s and the downloader falls back to a plain GET."""
+        monkeypatch.setenv(
+            "KSERVE_AZURE_FILE_ENDPOINT", f"http://127.0.0.1:{fake_cloud_port}"
+        )
+        Storage.download(
+            "https://acct.file.core.windows.net/fileshare/single.bin",
+            str(tmp_path),
+        )
+        assert (tmp_path / "single.bin").read_bytes() == b"S" * 16
 
 
 class TestWebHdfs:
