@@ -118,8 +118,6 @@ class LLMEngine:
                 bad.append("kv_quant")
             if engine_config.kv_offload != "none":
                 bad.append("kv_offload")
-            if engine_config.weight_quant != "none":
-                bad.append("weight_quant")
             if lora_adapters or lora_stacked:
                 bad.append("lora")
             if bad:
@@ -169,12 +167,16 @@ class LLMEngine:
             # embed/final_norm/lm_head stay pipe-replicated with their
             # usual TP shardings
             params = llama.stack_layer_params(params)
-            flat_specs = shd.param_pspecs(model_config)
-            stacked = shd.stacked_layer_pspecs(model_config)
-            specs = {
-                k: (stacked if k == "layers" else flat_specs[k])
-                for k in params
-            }
+            all_flat = shd.param_pspecs(model_config)
+            flat_specs = shd.expand_quant_specs(
+                {k: v for k, v in params.items() if k != "layers"},
+                {k: v for k, v in all_flat.items() if k != "layers"},
+            )
+            specs = dict(
+                flat_specs,
+                layers=shd.stacked_layer_pspecs(
+                    model_config, params["layers"]),
+            )
             self.params = jax.tree.map(
                 lambda arr, spec: jax.device_put(
                     arr, shd.named(self.mesh, spec)),

@@ -112,41 +112,68 @@ def stacked_kv_pages_pspec() -> P:
     return P(PIPE_AXIS, None, None, MODEL_AXIS, None, None)
 
 
-def stacked_layer_pspecs(config: LlamaConfig) -> dict:
+def stacked_layer_pspecs(config: LlamaConfig, stacked_layers=None) -> dict:
     """Spec pytree for PP-stacked layer params: each leaf takes its
     megatron TP spec from param_pspecs with the pipe axis prepended on the
     new leading layer dim — so pp>1 composes with tp>1 (the pipeline
     shard_map is manual over `pipe` only; XLA inserts the TP collectives
-    inside each stage as it does for pp==1)."""
+    inside each stage as it does for pp==1).
+
+    With `stacked_layers` (the actual stacked pytree), int8-quantized
+    {"q","s"} leaves get matched specs: q keeps the weight's spec, s
+    follows the output channel — both with pipe prepended (pp x
+    weight_quant)."""
+    from ..models.quant import is_quantized
+
     layer_specs = param_pspecs(config)["layers"][0]
-    return {k: P(PIPE_AXIS, *spec) for k, spec in layer_specs.items()}
+    out = {}
+    for k, spec in layer_specs.items():
+        leaf = None if stacked_layers is None else stacked_layers.get(k)
+        if leaf is not None and is_quantized(leaf):
+            # same rule as the flat path, with pipe prepended to each part
+            flat = quant_leaf_specs(spec, k)
+            out[k] = {name: P(PIPE_AXIS, *sub)
+                      for name, sub in flat.items()}
+        else:
+            out[k] = P(PIPE_AXIS, *spec)
+    return out
 
 
-def _expand_quant_specs(p, s, key=None):
-    """Match the spec pytree to int8-quantized weight leaves: a quantized
-    weight {"q", "s"} takes the plain weight's spec for q and the spec of
-    its channel axis for s (per-output-channel scales shard with the
-    output; per-row embed scales shard with the vocab)."""
+def quant_leaf_specs(weight_spec: P, key=None) -> dict:
+    """THE rule for int8-quantized {"q","s"} leaves: q takes the plain
+    weight's spec; s follows the output channel (per-output-channel
+    scales shard with the output; per-row embed scales shard with the
+    vocab).  Every spec builder — flat, stacked/pp — derives from here."""
+    if key == "embed":
+        s_spec = P(weight_spec[0]) if len(weight_spec) > 0 else P()
+    else:
+        s_spec = P(weight_spec[1]) if len(weight_spec) > 1 else P()
+    return {"q": weight_spec, "s": s_spec}
+
+
+def expand_quant_specs(p, s, key=None):
+    """Match a spec pytree to a param pytree that may hold int8-quantized
+    {"q","s"} leaves (quant_leaf_specs is the per-leaf rule)."""
     from ..models.quant import is_quantized
 
     if isinstance(s, P):
         if is_quantized(p):
-            if key == "embed":
-                s_spec = P(s[0]) if len(s) > 0 else P()
-            else:
-                s_spec = P(s[1]) if len(s) > 1 else P()
-            return {"q": s, "s": s_spec}
+            return quant_leaf_specs(s, key)
         return s
     if isinstance(p, dict):
-        return {k: _expand_quant_specs(p[k], s[k], k) for k in p}
+        return {k: expand_quant_specs(p[k], s[k], k) for k in p}
     if isinstance(p, list):
-        return [_expand_quant_specs(pi, si) for pi, si in zip(p, s)]
+        return [expand_quant_specs(pi, si) for pi, si in zip(p, s)]
     return s
+
+
+# backwards-compat alias (pre-r5 internal name)
+_expand_quant_specs = expand_quant_specs
 
 
 def shard_params(params, config: LlamaConfig, mesh: Mesh):
     """Place a param pytree onto the mesh according to param_pspecs."""
-    specs = _expand_quant_specs(params, param_pspecs(config))
+    specs = expand_quant_specs(params, param_pspecs(config))
     return jax.tree.map(
         lambda arr, spec: jax.device_put(arr, NamedSharding(mesh, spec)),
         params,

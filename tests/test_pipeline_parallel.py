@@ -184,12 +184,32 @@ class TestEnginePipelineParallel:
         outs = await self._generate(LLMEngine(mc, cfg, tok), [1, 2, 3], max_tokens=4)
         assert len(outs) == 4
 
+    @async_test
+    async def test_pp2_weight_quant_serves(self):
+        """pp x int8 weights: stacked {"q","s"} leaves shard over
+        pipe(+model); generation runs (int8 output differs from the bf16
+        reference by design, so the assertion is liveness + shapes)."""
+        import jax
+
+        mc = LlamaConfig.tiny(dtype="float32")
+        tok = ByteTokenizer(mc.vocab_size)
+        engine = LLMEngine(
+            mc, self._cfg(pp=2, tp=2, weight_quant="int8"), tok)
+        wq = engine.params["layers"]["wq"]
+        assert wq["q"].dtype.name == "int8"
+        # q: [L/pp, h, h/tp] per shard; s follows the output column
+        q_shapes = {s.data.shape for s in wq["q"].addressable_shards}
+        assert q_shapes == {(1, 64, 32)}, q_shapes
+        s_shapes = {s.data.shape for s in wq["s"].addressable_shards}
+        assert s_shapes == {(1, 32)}, s_shapes
+        outs = await self._generate(engine, [21, 22, 23], max_tokens=4)
+        assert len(outs) == 4
+
     def test_incompatible_combos_raise(self):
         mc = LlamaConfig.tiny(dtype="float32")
         tok = ByteTokenizer(mc.vocab_size)
         for bad in (dict(sp=2), dict(kv_quant="int8"),
-                    dict(kv_offload="host", kv_offload_gib=1.0),
-                    dict(weight_quant="int8")):
+                    dict(kv_offload="host", kv_offload_gib=1.0)):
             with pytest.raises(NotImplementedError):
                 LLMEngine(mc, self._cfg(pp=2, **bad), tok)
 
