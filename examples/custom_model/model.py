@@ -1,0 +1,58 @@
+"""Custom predictor example (the reference's python/custom_model role):
+subclass kserve_tpu.Model, implement predict, serve with ModelServer.
+
+    PYTHONPATH=/path/to/repo python examples/custom_model/model.py \
+        --model_name my-model --http_port 8080
+
+The V1/V2/OpenAI protocol heads, gRPC, health, and metrics all come from
+the framework; the example only supplies the math — here a jitted
+softmax-regression forward so the custom path still runs under XLA.
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kserve_tpu import Model, ModelServer
+from kserve_tpu.model_server import build_arg_parser
+
+
+class MyModel(Model):
+    def __init__(self, name: str):
+        super().__init__(name)
+        self.ready = False
+        self._predict = None
+        self._w = None
+        self._b = None
+
+    def load(self) -> bool:
+        # a real model would read /mnt/models; the example initializes a
+        # tiny softmax regression and jits its forward once
+        rng = np.random.RandomState(0)
+        self._w = jnp.asarray(rng.randn(4, 3), jnp.float32)
+        self._b = jnp.asarray(rng.randn(3), jnp.float32)
+        self._predict = jax.jit(
+            lambda x: jax.nn.softmax(x @ self._w + self._b, axis=-1))
+        self.ready = True
+        return True
+
+    async def predict(self, payload, headers=None, context=None):
+        instances = jnp.asarray(payload["instances"], jnp.float32)
+        probs = self._predict(instances)
+        return {"predictions": np.asarray(probs).tolist()}
+
+
+def main():
+    parser = argparse.ArgumentParser(parents=[build_arg_parser()],
+                                     conflict_handler="resolve")
+    args = parser.parse_args()
+    model = MyModel(args.model_name)
+    model.load()
+    ModelServer(http_port=args.http_port, grpc_port=args.grpc_port,
+                enable_grpc=args.enable_grpc).start([model])
+
+
+if __name__ == "__main__":
+    main()

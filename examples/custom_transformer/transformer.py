@@ -1,0 +1,55 @@
+"""Custom transformer example (the reference's python/custom_transformer
+role): pre/postprocess around a remote predictor.
+
+    python examples/custom_transformer/transformer.py \
+        --model_name my-model --predictor_host predictor:80
+
+preprocess runs before the call to the predictor, postprocess after; the
+framework forwards predict to --predictor_host (transformer mode,
+kserve_tpu/model.py)."""
+
+import argparse
+
+from kserve_tpu import Model, ModelServer
+from kserve_tpu.model import PredictorConfig
+from kserve_tpu.model_server import build_arg_parser
+
+
+class ImageTransformer(Model):
+    def __init__(self, name: str, predictor_host: str):
+        super().__init__(name, predictor_config=PredictorConfig(
+            predictor_host=predictor_host))
+        self.ready = True
+
+    async def preprocess(self, payload, headers=None):
+        # example: min-max scale each instance before prediction
+        scaled = []
+        for row in payload.get("instances", []):
+            lo, hi = min(row), max(row)
+            rng = (hi - lo) or 1.0
+            scaled.append([(v - lo) / rng for v in row])
+        return {"instances": scaled}
+
+    async def postprocess(self, response, headers=None):
+        # example: attach the argmax class to each prediction
+        preds = response.get("predictions", [])
+        response["classes"] = [
+            int(max(range(len(p)), key=p.__getitem__)) if isinstance(p, list)
+            else None
+            for p in preds
+        ]
+        return response
+
+
+def main():
+    parser = argparse.ArgumentParser(parents=[build_arg_parser()],
+                                     conflict_handler="resolve")
+    parser.add_argument("--predictor_host", required=True)
+    args = parser.parse_args()
+    model = ImageTransformer(args.model_name, args.predictor_host)
+    ModelServer(http_port=args.http_port, grpc_port=args.grpc_port,
+                enable_grpc=args.enable_grpc).start([model])
+
+
+if __name__ == "__main__":
+    main()

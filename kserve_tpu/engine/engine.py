@@ -175,7 +175,8 @@ class LLMEngine:
             specs = dict(
                 flat_specs,
                 layers=shd.stacked_layer_pspecs(
-                    model_config, params["layers"]),
+                    model_config, params["layers"],
+                    layer_specs=all_flat["layers"][0]),
             )
             self.params = jax.tree.map(
                 lambda arr, spec: jax.device_put(

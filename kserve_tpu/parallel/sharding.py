@@ -112,7 +112,8 @@ def stacked_kv_pages_pspec() -> P:
     return P(PIPE_AXIS, None, None, MODEL_AXIS, None, None)
 
 
-def stacked_layer_pspecs(config: LlamaConfig, stacked_layers=None) -> dict:
+def stacked_layer_pspecs(config: LlamaConfig, stacked_layers=None,
+                         layer_specs=None) -> dict:
     """Spec pytree for PP-stacked layer params: each leaf takes its
     megatron TP spec from param_pspecs with the pipe axis prepended on the
     new leading layer dim — so pp>1 composes with tp>1 (the pipeline
@@ -125,7 +126,8 @@ def stacked_layer_pspecs(config: LlamaConfig, stacked_layers=None) -> dict:
     weight_quant)."""
     from ..models.quant import is_quantized
 
-    layer_specs = param_pspecs(config)["layers"][0]
+    if layer_specs is None:
+        layer_specs = param_pspecs(config)["layers"][0]
     out = {}
     for k, spec in layer_specs.items():
         leaf = None if stacked_layers is None else stacked_layers.get(k)
@@ -166,9 +168,6 @@ def expand_quant_specs(p, s, key=None):
         return [expand_quant_specs(pi, si) for pi, si in zip(p, s)]
     return s
 
-
-# backwards-compat alias (pre-r5 internal name)
-_expand_quant_specs = expand_quant_specs
 
 
 def shard_params(params, config: LlamaConfig, mesh: Mesh):
