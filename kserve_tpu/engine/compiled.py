@@ -238,6 +238,14 @@ def build_compiled(model_config, engine_config, mesh) -> CompiledPrograms:
 
     def _prefill_chunk(params, tokens, chunk_start, valid_len, kv_pages,
                        page_ids, adapter_ids):
+        if cfg.pp > 1:
+            # staged chunked prefill: unlocks long prompts AND prefix-
+            # cache hits under pipeline parallelism
+            return llama.prefill_chunk_pp(
+                params, mc, tokens, chunk_start, valid_len, kv_pages,
+                page_ids, cfg.page_size, mesh,
+                _pp_microbatches(tokens.shape[0]),
+            )
         return llama.prefill_chunk(
             params, mc, tokens, chunk_start, valid_len, kv_pages,
             page_ids, cfg.page_size, adapter_ids=adapter_ids,

@@ -127,15 +127,6 @@ class LLMEngine:
                 raise ValueError(
                     f"n_layers={model_config.n_layers} not divisible by "
                     f"pp={engine_config.pp}")
-            if engine_config.prefix_cache:
-                # VERDICT r4 weak #3: an explicit ask is a config error,
-                # never a silent downgrade
-                raise ValueError(
-                    "prefix_cache=True does not compose with pp>1 (cache "
-                    "hits admit via chunked prefill, which has no staged "
-                    "variant); leave prefix_cache unset or pass False"
-                )
-            engine_config.prefix_cache = False
         if engine_config.prefix_cache is None:
             engine_config.prefix_cache = True
         self.mesh = shd.create_mesh(
@@ -933,11 +924,6 @@ class LLMEngine:
         attending to the cached history (ops/attention.py
         chunked_prefill_attention).  Unblocks prompts up to max_model_len
         without sequence parallelism."""
-        if self.config.pp > 1:
-            raise NotImplementedError(
-                "chunked prefill has no pipeline-parallel variant yet; "
-                "raise max_prefill_len to cover the prompt or use tp"
-            )
         idx = self._free_slot_index()
         if idx is None:
             return False

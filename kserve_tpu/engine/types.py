@@ -58,9 +58,10 @@ class EngineConfig:
     # slice's HBM — within a slice prefer tp.  pp>1 composes with tp>1
     # (each stage's layers keep their megatron shardings; the staged
     # shard_map is manual over `pipe` only, so XLA still inserts the TP
-    # collectives inside stages) and with dp (disjoint replica meshes);
-    # it excludes sp, kv offload/quant, weight quant, prefix cache, LoRA
-    # and the P/D wire (each raises at init or call time).
+    # collectives inside stages), with dp (disjoint replica meshes), with
+    # int8 weights, with chunked prefill (staged: long prompts + prefix
+    # cache work under pp); it excludes sp, kv offload/quant, LoRA and
+    # the P/D wire (each raises at init or call time).
     pp: int = 1
     pp_microbatches: int = 0  # 0 = auto (pp when it divides the batch)
     # None = auto (ops/attention.py): the fused Pallas kernel for
@@ -78,11 +79,8 @@ class EngineConfig:
     prefill_batch: int = 8
     # prefix caching: full prompt pages are kept (refcounted, LRU-evicted on
     # pressure) and shared by later requests with the same page-aligned
-    # prefix, which then prefill only their uncached tail.  None = auto:
-    # enabled, except under pp>1 (prefix-cache hits admit via chunked
-    # prefill, which has no staged variant) where it resolves to False —
-    # asking for it explicitly with pp>1 is a config error, not a silent
-    # downgrade.
+    # prefix, which then prefill only their uncached tail (under pp the
+    # hit path admits via the STAGED chunked prefill).  None = auto (on).
     prefix_cache: Optional[bool] = None
     # static top-k width for the logprob-emitting program variants (OpenAI
     # caps top_logprobs at 20); requests asking for fewer slice host-side

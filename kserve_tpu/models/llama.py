@@ -383,6 +383,48 @@ def prefill(
     return _logits(params, x_last[:, None], config)[:, 0], new_pages
 
 
+def chunk_transformer_block(
+    layer: Params,
+    pages,  # this layer's KV pages
+    x: jnp.ndarray,  # [B, C, h]
+    chunk_start: jnp.ndarray,  # [B]
+    valid_len: jnp.ndarray,  # [B]
+    page_ids: jnp.ndarray,  # [B, W]
+    page_size: int,
+    config: LlamaConfig,
+    onehot=None,
+) -> Tuple[jnp.ndarray, Any]:
+    """One chunked-prefill transformer block: attend to the cached
+    history + the chunk's causal prefix, then write the chunk's KV.  The
+    SINGLE source of the chunk math — the sequential path
+    (prefill_chunk) and the pipeline-parallel path (_pp_chunk_block)
+    both call this, so their numerics cannot drift."""
+    B, C = x.shape[0], x.shape[1]
+    positions = chunk_start[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
+    residual = x
+    h = rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
+    q, k, v = _qkv(layer, h, config, onehot)
+    q = apply_rope(q, positions, config.rope_theta, config.rope_scaling)
+    k = apply_rope(k, positions, config.rope_theta, config.rope_scaling)
+    attn = chunked_prefill_attention(
+        q, k, v, pages, page_ids, chunk_start, valid_len,
+        config.logit_softcap,
+    )
+    attn_flat = attn.reshape(B, C, -1)
+    attn = _maybe_add(
+        dense(attn_flat, layer["wo"]),
+        lora_delta(layer.get("lora"), "wo", attn_flat, onehot),
+    )
+    x = residual + attn
+    residual = x
+    h = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
+    x = residual + _mlp(layer, h, config, onehot)
+    pages = write_chunk_kv_batch(
+        pages, k, v, page_ids, chunk_start, valid_len, page_size
+    )
+    return x, pages
+
+
 def prefill_chunk(
     params: Params,
     config: LlamaConfig,
@@ -401,30 +443,12 @@ def prefill_chunk(
     starts with chunk_start > 0 and the cached pages in page_ids."""
     B, C = tokens.shape
     onehot = _adapter_onehot(params, adapter_ids, B)
-    positions = chunk_start[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
     x = embed_lookup(params["embed"], tokens, jnp.dtype(config.dtype))
     new_pages = []
     for layer, pages in zip(params["layers"], kv_pages):
-        residual = x
-        h = rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
-        q, k, v = _qkv(layer, h, config, onehot)
-        q = apply_rope(q, positions, config.rope_theta, config.rope_scaling)
-        k = apply_rope(k, positions, config.rope_theta, config.rope_scaling)
-        attn = chunked_prefill_attention(
-            q, k, v, pages, page_ids, chunk_start, valid_len,
-            config.logit_softcap,
-        )
-        attn_flat = attn.reshape(B, C, -1)
-        attn = _maybe_add(
-            dense(attn_flat, layer["wo"]),
-            lora_delta(layer.get("lora"), "wo", attn_flat, onehot),
-        )
-        x = residual + attn
-        residual = x
-        h = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
-        x = residual + _mlp(layer, h, config, onehot)
-        pages = write_chunk_kv_batch(
-            pages, k, v, page_ids, chunk_start, valid_len, page_size
+        x, pages = chunk_transformer_block(
+            layer, pages, x, chunk_start, valid_len, page_ids, page_size,
+            config, onehot=onehot,
         )
         new_pages.append(pages)
     last = jnp.maximum(valid_len - 1, 0)
@@ -569,6 +593,52 @@ def prefill_pp(
     x, new_pages = pipeline_blocks(
         params["layers"], kv_pages, x, aux,
         _pp_prefill_block(config, page_size), mesh, n_microbatches,
+    )
+    last = jnp.maximum(valid_len - 1, 0)
+    x_last = x[jnp.arange(B), last]
+    return _logits(params, x_last[:, None], config)[:, 0], new_pages
+
+
+def _pp_chunk_block(config: LlamaConfig, page_size: int):
+    """One chunked-prefill transformer block as a pipeline block_fn: the
+    chunk attends to this stage's cached history plus its own causal
+    prefix, then writes its KV.  Warm-up/drain microbatches write to the
+    null page and read zero history."""
+
+    def block_fn(layer, pages_l, x, aux, valid):
+        chunk_start = jnp.where(valid, aux["chunk_start"], 0)
+        page_ids = jnp.where(valid, aux["page_ids"], 0)
+        return chunk_transformer_block(
+            layer, pages_l, x, chunk_start, aux["valid_len"], page_ids,
+            page_size, config,
+        )
+
+    return block_fn
+
+
+def prefill_chunk_pp(
+    params: Params,
+    config: LlamaConfig,
+    tokens: jnp.ndarray,  # [B, C]
+    chunk_start: jnp.ndarray,  # [B]
+    valid_len: jnp.ndarray,  # [B]
+    kv_pages: jnp.ndarray,  # stacked [L, ...]
+    page_ids: jnp.ndarray,  # [B, W]
+    page_size: int,
+    mesh,
+    n_microbatches: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Pipeline-parallel chunked prefill (engine pp>1): unlocks prompts
+    beyond max_prefill_len AND prefix-cache hits under pp."""
+    from ..parallel.pipeline import pipeline_blocks
+
+    B = tokens.shape[0]
+    x = embed_lookup(params["embed"], tokens, jnp.dtype(config.dtype))
+    aux = {"chunk_start": chunk_start, "valid_len": valid_len,
+           "page_ids": page_ids}
+    x, new_pages = pipeline_blocks(
+        params["layers"], kv_pages, x, aux,
+        _pp_chunk_block(config, page_size), mesh, n_microbatches,
     )
     last = jnp.maximum(valid_len - 1, 0)
     x_last = x[jnp.arange(B), last]

@@ -213,16 +213,42 @@ class TestEnginePipelineParallel:
             with pytest.raises(NotImplementedError):
                 LLMEngine(mc, self._cfg(pp=2, **bad), tok)
 
-    def test_prefix_cache_explicit_with_pp_raises(self):
-        """Asking for the prefix cache with pp>1 is a config error, not a
-        silent downgrade (VERDICT r4 weak #3)."""
+    @async_test
+    async def test_pp_chunked_long_prompt_matches_pp1(self):
+        """A prompt longer than max_prefill_len admits via the STAGED
+        chunked prefill (prefill_chunk_pp) and must greedy-match pp=1."""
+        mc = LlamaConfig.tiny(dtype="float32", n_layers=4)
+        tok = ByteTokenizer(mc.vocab_size)
+        prompt = [(7 * i) % 200 + 3 for i in range(50)]  # > max_prefill_len=32
+        want = await self._generate(
+            LLMEngine(mc, self._cfg(), tok), prompt, max_tokens=5)
+        got = await self._generate(
+            LLMEngine(mc, self._cfg(pp=2, tp=2), tok), prompt, max_tokens=5)
+        assert got == want
+
+    @async_test
+    async def test_pp_prefix_cache_hits(self):
+        """Prefix cache now composes with pp: the second request with a
+        shared page-aligned prefix reuses cached pages (admitting via the
+        staged chunked prefill) and still greedy-matches."""
         mc = LlamaConfig.tiny(dtype="float32")
         tok = ByteTokenizer(mc.vocab_size)
-        with pytest.raises(ValueError, match="prefix_cache"):
-            LLMEngine(mc, self._cfg(pp=2, prefix_cache=True), tok)
-        # unset resolves to off under pp, on otherwise
-        assert LLMEngine(mc, self._cfg(pp=2), tok).config.prefix_cache is False
-        assert LLMEngine(mc, self._cfg(), tok).config.prefix_cache is True
+        engine = LLMEngine(mc, self._cfg(pp=2), tok)
+        assert engine.config.prefix_cache is True  # auto-on, pp included
+        shared = [(3 * i) % 200 + 3 for i in range(16)]  # 2 full pages
+        await engine.start()
+        try:
+            params = SamplingParams(max_tokens=4, temperature=0.0,
+                                    ignore_eos=True)
+            first = [o.token_id async for o in engine.generate(
+                shared + [5, 6], params)]
+            assert engine.prefix_cache_hits == 0
+            second = [o.token_id async for o in engine.generate(
+                shared + [5, 6], params)]
+            assert engine.prefix_cache_hits > 0
+            assert second == first  # cached pages serve the same logits
+        finally:
+            await engine.stop()
 
     def test_layer_divisibility_enforced(self):
         mc = LlamaConfig.tiny(dtype="float32", n_layers=2)
