@@ -219,9 +219,13 @@ def build_compiled(model_config, engine_config, mesh) -> CompiledPrograms:
         return fn
 
     def _inject(kv_pages, kv_data, ids):
-        """Scatter transferred KV pages (P/D disaggregation) into the
-        cache.  Padded ids point at the null page (page 0), whose
-        contents are never read unmasked."""
+        """Scatter transferred KV pages (P/D transfer or tier-store
+        resume) into the cache.  Padded ids point at the null page (page
+        0), whose contents are never read unmasked.  pp>1: the cache is
+        one stacked [L, ...] array (layer axis on pipe) and the payload
+        arrives in the same layout, so one scatter covers every stage."""
+        if cfg.pp > 1:
+            return kv_pages.at[:, ids].set(kv_data.astype(kv_pages.dtype))
         return [
             layer.at[ids].set(kv_data[i].astype(layer.dtype))
             for i, layer in enumerate(kv_pages)

@@ -116,8 +116,6 @@ class LLMEngine:
                 bad.append("sp")
             if engine_config.kv_quant != "none":
                 bad.append("kv_quant")
-            if engine_config.kv_offload != "none":
-                bad.append("kv_offload")
             if lora_adapters or lora_stacked:
                 bad.append("lora")
             if bad:
@@ -1265,6 +1263,9 @@ class LLMEngine:
                     "kv_s": self._fetch(
                         jnp.stack([layer[1][ids] for layer in self.kv_pages])),
                 }
+            elif self.config.pp > 1:
+                # stacked cache: one gather covers every stage's layers
+                payload = {"kv": self._fetch(self.kv_pages[:, ids])}
             else:
                 payload = {"kv": self._fetch(
                     jnp.stack([layer[ids] for layer in self.kv_pages]))}

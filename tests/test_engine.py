@@ -300,6 +300,31 @@ class TestPreemption:
         assert engine._offload_bytes == 0
         assert engine.allocator.free_pages == engine.config.num_pages - 1
 
+    @async_test
+    async def test_host_offload_under_pp(self):
+        """pp x kv_offload: preempted slots spill the STACKED cache's
+        pages to the host tier and re-inject on resume with one scatter
+        across every stage; outputs match the roomy pp=1 reference."""
+        params = SamplingParams(max_tokens=44, temperature=0.0, ignore_eos=True)
+        prompts = [[1, 2, 3, 4], [9, 10, 11, 12]]
+        want = await self._roomy_reference(prompts, params)
+        engine = self._squeezed_engine(
+            pp=2, kv_offload="host", kv_offload_gib=1.0)
+        await engine.start()
+        try:
+            results = await asyncio.gather(
+                *[collect(engine, p, params) for p in prompts]
+            )
+        finally:
+            await engine.stop()
+        for outs, want_tokens in zip(results, want):
+            assert outs[-1].num_generated == 44
+            assert [o.token_id for o in outs] == want_tokens
+        assert engine.preemption_count > 0
+        assert engine._offload_bytes == 0
+        # same allocator-leak bar as the pp=1 variant: every page returned
+        assert engine.allocator.free_pages == engine.config.num_pages - 1
+
 
 class TestChunkedPrefill:
     """Prompts beyond max_prefill_len prefill in history-attending chunks."""

@@ -208,8 +208,7 @@ class TestEnginePipelineParallel:
     def test_incompatible_combos_raise(self):
         mc = LlamaConfig.tiny(dtype="float32")
         tok = ByteTokenizer(mc.vocab_size)
-        for bad in (dict(sp=2), dict(kv_quant="int8"),
-                    dict(kv_offload="host", kv_offload_gib=1.0)):
+        for bad in (dict(sp=2), dict(kv_quant="int8")):
             with pytest.raises(NotImplementedError):
                 LLMEngine(mc, self._cfg(pp=2, **bad), tok)
 
