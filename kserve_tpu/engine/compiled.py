@@ -234,6 +234,10 @@ def build_compiled(model_config, engine_config, mesh) -> CompiledPrograms:
     def _inject_q(kv_pages, q, s, ids):
         """Quantized-cache variant: scatter int8 pages AND their
         scales (tier-store resume over kv_quant=int8)."""
+        if cfg.pp > 1:
+            pages, scales = kv_pages
+            return (pages.at[:, ids].set(q.astype(pages.dtype)),
+                    scales.at[:, ids].set(s.astype(scales.dtype)))
         return [
             (pages.at[ids].set(q[i].astype(pages.dtype)),
              scales.at[ids].set(s[i].astype(scales.dtype)))

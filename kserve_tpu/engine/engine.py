@@ -114,8 +114,6 @@ class LLMEngine:
             bad = []
             if engine_config.sp > 1:
                 bad.append("sp")
-            if engine_config.kv_quant != "none":
-                bad.append("kv_quant")
             if lora_adapters or lora_stacked:
                 bad.append("lora")
             if bad:
@@ -218,6 +216,10 @@ class LLMEngine:
             raise ValueError(
                 f"unknown kv_quant {engine_config.kv_quant!r}; supported: none, int8"
             )
+        stacked_shape = (
+            model_config.n_layers, cache_cfg.num_pages, 2,
+            cache_cfg.n_kv_heads, cache_cfg.page_size, cache_cfg.head_dim,
+        )
         if engine_config.kv_quant == "int8":
             if engine_config.use_pallas:
                 # fail at init, not inside the jitted decode trace where the
@@ -226,24 +228,35 @@ class LLMEngine:
                     "the pallas kernel does not read int8 KV pages yet; "
                     "use kv_quant=int8 with use_pallas None/False"
                 )
-            pages = shd.shard_kv_pages(
-                init_kv_pages(dataclasses.replace(cache_cfg, dtype="int8")),
-                self.mesh
-            )
-            scale_sharding = shd.named(
-                self.mesh,
-                jax.sharding.PartitionSpec(None, None, shd.MODEL_AXIS, None),
-            )
-            scales = init_kv_scales(cache_cfg, scale_sharding)
-            self.kv_pages = list(zip(pages, scales))
+            if engine_config.pp > 1:
+                # stacked quantized cache: an (int8 pages, scales) tuple,
+                # layer axis on pipe, KV heads on model
+                self.kv_pages = (
+                    jax.device_put(
+                        jnp.zeros(stacked_shape, jnp.int8),
+                        shd.named(self.mesh, shd.stacked_kv_pages_pspec())),
+                    jax.device_put(
+                        jnp.ones(stacked_shape[:-1], jnp.float32),
+                        shd.named(self.mesh, jax.sharding.PartitionSpec(
+                            shd.PIPE_AXIS, None, None, shd.MODEL_AXIS,
+                            None))),
+                )
+            else:
+                pages = shd.shard_kv_pages(
+                    init_kv_pages(
+                        dataclasses.replace(cache_cfg, dtype="int8")),
+                    self.mesh
+                )
+                scale_sharding = shd.named(
+                    self.mesh,
+                    jax.sharding.PartitionSpec(None, None, shd.MODEL_AXIS, None),
+                )
+                scales = init_kv_scales(cache_cfg, scale_sharding)
+                self.kv_pages = list(zip(pages, scales))
         elif engine_config.pp > 1:
             # pipeline mode: one stacked [L, ...] array, layer axis on pipe
-            shape = (
-                model_config.n_layers, cache_cfg.num_pages, 2,
-                cache_cfg.n_kv_heads, cache_cfg.page_size, cache_cfg.head_dim,
-            )
             self.kv_pages = jax.device_put(
-                jnp.zeros(shape, jnp.dtype(cache_cfg.dtype)),
+                jnp.zeros(stacked_shape, jnp.dtype(cache_cfg.dtype)),
                 shd.named(self.mesh, shd.stacked_kv_pages_pspec()),
             )
         else:
@@ -1256,7 +1269,13 @@ class LLMEngine:
         # both tensors (int8 pages + scales) as one payload.
         if self._kv_store is not None and self._kv_store.would_fit(nbytes):
             ids = jnp.asarray(np.asarray(slot.pages[:P], np.int32))
-            if self.config.kv_quant == "int8":
+            if self.config.kv_quant == "int8" and self.config.pp > 1:
+                pages, scales = self.kv_pages
+                payload = {
+                    "kv_q": self._fetch(pages[:, ids]),
+                    "kv_s": self._fetch(scales[:, ids]),
+                }
+            elif self.config.kv_quant == "int8":
                 payload = {
                     "kv_q": self._fetch(
                         jnp.stack([layer[0][ids] for layer in self.kv_pages])),

@@ -60,10 +60,11 @@ class EngineConfig:
     # shard_map is manual over `pipe` only, so XLA still inserts the TP
     # collectives inside stages), with dp (disjoint replica meshes), with
     # int8 weights, with chunked prefill (staged: long prompts + prefix
-    # cache work under pp) and with the host/disk KV offload tiers (the
-    # stacked cache spills and re-injects across stages in one op); it
-    # excludes sp, kv_quant, LoRA and the P/D wire (each raises at init
-    # or call time).
+    # cache work under pp), with the host/disk KV offload tiers (the
+    # stacked cache spills and re-injects across stages in one op) and
+    # with int8 KV quantization (stacked (pages, scales) tuple); it
+    # excludes sp, LoRA and the P/D wire (each raises at init or call
+    # time).
     pp: int = 1
     pp_microbatches: int = 0  # 0 = auto (pp when it divides the batch)
     # None = auto (ops/attention.py): the fused Pallas kernel for

@@ -174,7 +174,8 @@ def _pipeline_local_stateful(
 
 def pipeline_blocks(
     stacked_layers,  # pytree with leading axis L, sharded P(pipe)
-    stacked_pages: jnp.ndarray,  # [L, num_pages, 2, nkv, ps, d], P(pipe)
+    stacked_pages,  # [L, num_pages, 2, nkv, ps, d] P(pipe), or the
+    # (int8 pages, scales) tuple for a quantized cache
     x: jnp.ndarray,  # [B, ...] activations after embedding (pipe-replicated)
     aux,  # pytree of [B, ...] tensors each microbatch carries
     block_fn,
@@ -198,13 +199,16 @@ def pipeline_blocks(
         lambda a: a.reshape((n_microbatches, mb) + a.shape[1:]), aux
     )
     layer_spec = jax.tree.map(lambda _: P(axis_name), stacked_layers)
+    # pages may be one stacked array OR an (int8 pages, scales) tuple
+    # (kv_quant): spec the pytree leaf-wise
+    pages_spec = jax.tree.map(lambda _: P(axis_name), stacked_pages)
     fn = shard_map(
         partial(_pipeline_local_stateful, block_fn=block_fn,
                 axis_name=axis_name, S=S),
         mesh=mesh,
-        in_specs=(layer_spec, P(axis_name), P(), jax.tree.map(
+        in_specs=(layer_spec, pages_spec, P(), jax.tree.map(
             lambda _: P(), mbs_aux)),
-        out_specs=(P(), P(axis_name)),
+        out_specs=(P(), pages_spec),
         axis_names={axis_name},
         check_vma=False,
     )

@@ -325,6 +325,28 @@ class TestPreemption:
         # same allocator-leak bar as the pp=1 variant: every page returned
         assert engine.allocator.free_pages == engine.config.num_pages - 1
 
+    @async_test
+    async def test_host_offload_under_pp_with_kv_quant(self):
+        """pp x int8 KV x host tier: the quantized stacked cache spills
+        (pages AND scales) and re-injects; int8 rounding means the bar is
+        full-length completion, not bit parity."""
+        params = SamplingParams(max_tokens=44, temperature=0.0, ignore_eos=True)
+        prompts = [[1, 2, 3, 4], [9, 10, 11, 12]]
+        engine = self._squeezed_engine(
+            pp=2, kv_quant="int8", kv_offload="host", kv_offload_gib=1.0)
+        await engine.start()
+        try:
+            results = await asyncio.gather(
+                *[collect(engine, p, params) for p in prompts]
+            )
+        finally:
+            await engine.stop()
+        for outs in results:
+            assert outs[-1].num_generated == 44
+        assert engine.preemption_count > 0
+        assert engine._offload_bytes == 0
+        assert engine.allocator.free_pages == engine.config.num_pages - 1
+
 
 class TestChunkedPrefill:
     """Prompts beyond max_prefill_len prefill in history-attending chunks."""

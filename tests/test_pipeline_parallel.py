@@ -205,12 +205,25 @@ class TestEnginePipelineParallel:
         outs = await self._generate(engine, [21, 22, 23], max_tokens=4)
         assert len(outs) == 4
 
+    @async_test
+    async def test_pp_kv_quant_serves(self):
+        """pp x int8 KV: the stacked quantized cache ((pages, scales)
+        tuple, layer axis on pipe) decodes through the staged schedule.
+        int8 KV rounds logits, so the bar is liveness + sane output."""
+        mc = LlamaConfig.tiny(dtype="float32")
+        tok = ByteTokenizer(mc.vocab_size)
+        engine = LLMEngine(mc, self._cfg(pp=2, tp=2, kv_quant="int8"), tok)
+        pages, scales = engine.kv_pages
+        assert pages.dtype.name == "int8"
+        assert pages.shape[0] == mc.n_layers and scales.shape[0] == mc.n_layers
+        outs = await self._generate(engine, [31, 32, 33], max_tokens=4)
+        assert len(outs) == 4
+
     def test_incompatible_combos_raise(self):
         mc = LlamaConfig.tiny(dtype="float32")
         tok = ByteTokenizer(mc.vocab_size)
-        for bad in (dict(sp=2), dict(kv_quant="int8")):
-            with pytest.raises(NotImplementedError):
-                LLMEngine(mc, self._cfg(pp=2, **bad), tok)
+        with pytest.raises(NotImplementedError):
+            LLMEngine(mc, self._cfg(pp=2, sp=2), tok)
 
     @async_test
     async def test_pp_chunked_long_prompt_matches_pp1(self):
