@@ -483,11 +483,6 @@ class LLMEngine:
             raise NotImplementedError(
                 "KV injection over a quantized cache is not supported yet"
             )
-        if self.config.pp > 1:
-            raise NotImplementedError(
-                "KV injection into a stage-sharded (pp>1) cache is not "
-                "supported yet"
-            )
         # validation runs HERE (sync), not at first __anext__: a shape
         # mismatch inside _run_loop would kill the engine for all traffic,
         # not just this request (version-skewed prefill peer)
@@ -548,11 +543,6 @@ class LLMEngine:
             raise NotImplementedError(
                 "detached prefill (P/D transfer) over a quantized KV cache "
                 "is not supported yet"
-            )
-        if self.config.pp > 1:
-            raise NotImplementedError(
-                "detached prefill (P/D transfer) from a stage-sharded "
-                "(pp>1) cache is not supported yet"
             )
         if params.logprobs is not None:
             # the P/D wire format carries (kv, first_token) only; the decode
@@ -650,9 +640,16 @@ class LLMEngine:
                 # deadline-guarded: this is the engine's LARGEST device->
                 # host copy — a tunnel wedge mid-DMA must trip liveness,
                 # not hang the prefill-role handlers forever
-                kv = self._fetch(
-                    jnp.stack([layer[ids] for layer in self.kv_pages])
-                )
+                if self.config.pp > 1:
+                    # stacked cache: one cross-stage gather; the wire
+                    # payload layout ([L, P, 2, nkv, ps, d]) is identical,
+                    # so prefill and decode tiers may run DIFFERENT
+                    # pp/tp topologies
+                    kv = self._fetch(self.kv_pages[:, ids])
+                else:
+                    kv = self._fetch(
+                        jnp.stack([layer[ids] for layer in self.kv_pages])
+                    )
                 if not fut.done():
                     fut.set_result((int(first_np[j]), kv))
         finally:

@@ -60,11 +60,12 @@ class EngineConfig:
     # shard_map is manual over `pipe` only, so XLA still inserts the TP
     # collectives inside stages), with dp (disjoint replica meshes), with
     # int8 weights, with chunked prefill (staged: long prompts + prefix
-    # cache work under pp), with the host/disk KV offload tiers (the
-    # stacked cache spills and re-injects across stages in one op) and
-    # with int8 KV quantization (stacked (pages, scales) tuple); it
-    # excludes sp, LoRA and the P/D wire (each raises at init or call
-    # time).
+    # cache work under pp), with the host/disk KV offload tiers and int8
+    # KV (the stacked cache spills/injects across stages in one op), and
+    # with the bf16 P/D wire (the transfer layout is topology-agnostic,
+    # so prefill and decode tiers may run different pp/tp meshes; the
+    # wire stays bf16 — kv_quant on either P/D tier still raises at call
+    # time).  pp excludes sp and LoRA (each raises at init).
     pp: int = 1
     pp_microbatches: int = 0  # 0 = auto (pp when it divides the batch)
     # None = auto (ops/attention.py): the fused Pallas kernel for

@@ -83,6 +83,41 @@ class TestKVTransfer:
         assert got == want
 
     @async_test
+    async def test_pd_across_pp_topologies(self):
+        """The wire format is topology-agnostic: a pp=2 prefill tier feeds
+        a pp=1 decoder AND a pp=1 prefiller feeds a pp=2 x tp=2 decoder,
+        both bit-matching the monolithic reference.  (Prefill/decode
+        tiers sizing their meshes independently is the point of P/D.)"""
+        prompt = [5, 6, 7, 8, 9]
+        params = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+
+        mono = make_engine()
+        await mono.start()
+        try:
+            want = [o.token_id for o in await collect(mono.generate(prompt, params))]
+        finally:
+            await mono.stop()
+
+        for pre_cfg, dec_cfg in ((dict(pp=2), dict()),
+                                 (dict(), dict(pp=2, tp=2))):
+            prefiller = make_engine(**pre_cfg)
+            decoder = make_engine(**dec_cfg)
+            await decoder.start()
+            try:
+                first, kv = await prefiller.prefill_detached(prompt, params)
+                meta, payload = serialize_kv(kv, first)
+                kv2, first2 = deserialize_kv(meta, payload)
+                got = [
+                    o.token_id
+                    for o in await collect(
+                        decoder.generate_injected(prompt, params, kv2, first2)
+                    )
+                ]
+            finally:
+                await decoder.stop()
+            assert got == want, (pre_cfg, dec_cfg)
+
+    @async_test
     async def test_injected_wrong_kv_changes_output(self):
         """Sanity inverse: zeroed KV must NOT reproduce the monolithic
         output (otherwise the equivalence test above proves nothing)."""

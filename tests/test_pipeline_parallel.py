@@ -268,11 +268,5 @@ class TestEnginePipelineParallel:
         with pytest.raises(ValueError, match="divisible"):
             LLMEngine(mc, self._cfg(pp=3), tok)
 
-    @async_test
-    async def test_pd_paths_rejected_under_pp(self):
-        mc = LlamaConfig.tiny(dtype="float32")
-        tok = ByteTokenizer(mc.vocab_size)
-        engine = LLMEngine(mc, self._cfg(pp=2), tok)
-        with pytest.raises(NotImplementedError):
-            await engine.prefill_detached(
-                [1, 2, 3], SamplingParams(max_tokens=2))
+    # P/D under pp is now supported end-to-end: see
+    # test_pd_disagg.TestKVTransfer.test_pd_across_pp_topologies
