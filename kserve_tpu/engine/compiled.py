@@ -107,6 +107,7 @@ def build_compiled(model_config, engine_config, mesh) -> CompiledPrograms:
                     params, mc, tokens, valid_len, kv_pages, page_ids,
                     cfg.page_size, mesh,
                     _pp_microbatches(tokens.shape[0]),
+                    adapter_ids=adapter_ids,
                 )
             else:
                 logits, kv_pages = llama.prefill(
@@ -171,6 +172,7 @@ def build_compiled(model_config, engine_config, mesh) -> CompiledPrograms:
                     logits, kv_pages = llama.decode_step_pp(
                         params, mc, tokens, pos, kv_pages, page_table,
                         live, cfg.page_size, mesh, _pp_microbatches(B),
+                        adapter_ids=adapter_ids,
                     )
                 else:
                     logits, kv_pages = llama.decode_step(
@@ -253,6 +255,7 @@ def build_compiled(model_config, engine_config, mesh) -> CompiledPrograms:
                 params, mc, tokens, chunk_start, valid_len, kv_pages,
                 page_ids, cfg.page_size, mesh,
                 _pp_microbatches(tokens.shape[0]),
+                adapter_ids=adapter_ids,
             )
         return llama.prefill_chunk(
             params, mc, tokens, chunk_start, valid_len, kv_pages,

@@ -114,8 +114,6 @@ class LLMEngine:
             bad = []
             if engine_config.sp > 1:
                 bad.append("sp")
-            if lora_adapters or lora_stacked:
-                bad.append("lora")
             if bad:
                 raise NotImplementedError(
                     f"pp>1 does not compose with {bad} yet")
@@ -147,7 +145,45 @@ class LLMEngine:
                 if isinstance(v, dict)
             ):
                 params = quantize_params(params, model_config)
+        # multi-adapter LoRA stacks load BEFORE any pp stacking so the
+        # adapter tensors ride the same stage-sharded layer pytree
+        self.adapter_ids: Dict[str, int] = {}
+        lora_layer_stacks = None
+        if lora_adapters or lora_stacked:
+            if model_config.n_experts > 0:
+                raise NotImplementedError("LoRA over MoE layers is not supported yet")
+            from ..models import lora as lora_mod
+
+            if lora_stacked is not None:
+                self.adapter_ids, lora_layer_stacks = lora_stacked
+            else:
+                self.adapter_ids, lora_layer_stacks = lora_mod.stack_adapters(
+                    lora_adapters, model_config.n_layers, dtype=model_config.dtype
+                )
+            logger.info("LoRA adapters loaded: %s", sorted(self.adapter_ids))
         if engine_config.pp > 1:
+            if lora_layer_stacks is not None:
+                # the stage-sharded stack needs UNIFORM adapter coverage:
+                # every layer must carry the same projection set or the
+                # layer pytrees cannot stack
+                shape_sets = {
+                    tuple(sorted(
+                        (proj, tuple(t["A"].shape), tuple(t["B"].shape))
+                        for proj, t in stack.items()
+                    ))
+                    for stack in lora_layer_stacks
+                }
+                if len(shape_sets) != 1:
+                    # covers both ragged projection sets AND layer-varying
+                    # ranks (PEFT rank_pattern) — jnp.stack would otherwise
+                    # die with an opaque shape error
+                    raise NotImplementedError(
+                        "pp>1 requires every layer to share one LoRA "
+                        "projection set and rank; got differing per-layer "
+                        f"shapes: {sorted(shape_sets)[:2]}"
+                    )
+                for layer, stack in zip(params["layers"], lora_layer_stacks):
+                    layer["lora"] = stack
             # stage-sharded layers: the per-layer list stacks into one
             # pytree with a leading L axis placed on the pipe mesh axis,
             # each leaf keeping its megatron TP spec on the trailing dims;
@@ -159,12 +195,17 @@ class LLMEngine:
                 {k: v for k, v in params.items() if k != "layers"},
                 {k: v for k, v in all_flat.items() if k != "layers"},
             )
-            specs = dict(
-                flat_specs,
-                layers=shd.stacked_layer_pspecs(
-                    model_config, params["layers"],
-                    layer_specs=all_flat["layers"][0]),
-            )
+            layer_specs = shd.stacked_layer_pspecs(
+                model_config, params["layers"],
+                layer_specs=all_flat["layers"][0])
+            if lora_layer_stacks is not None:
+                layer_specs["lora"] = jax.tree.map(
+                    lambda s: jax.sharding.PartitionSpec(shd.PIPE_AXIS, *s),
+                    lora_mod.lora_pspecs(lora_layer_stacks[0]),
+                    is_leaf=lambda x: isinstance(
+                        x, jax.sharding.PartitionSpec),
+                )
+            specs = dict(flat_specs, layers=layer_specs)
             self.params = jax.tree.map(
                 lambda arr, spec: jax.device_put(
                     arr, shd.named(self.mesh, spec)),
@@ -174,33 +215,23 @@ class LLMEngine:
         else:
             self.params = shd.shard_params(params, model_config, self.mesh)
 
-        # multi-adapter LoRA: stacked [n_adapters, ...] tensors attached per
-        # layer; a per-slot id selects at runtime (models/lora.py)
-        self.adapter_ids: Dict[str, int] = {}
-        if lora_adapters or lora_stacked:
-            if model_config.n_experts > 0:
-                raise NotImplementedError("LoRA over MoE layers is not supported yet")
-            from ..models import lora as lora_mod
-
-            if lora_stacked is not None:
-                self.adapter_ids, stacks = lora_stacked
-            else:
-                self.adapter_ids, stacks = lora_mod.stack_adapters(
-                    lora_adapters, model_config.n_layers, dtype=model_config.dtype
-                )
-            for i, stack in enumerate(stacks):
+        # multi-adapter LoRA (pp==1 path): stacked [n_adapters, ...]
+        # tensors attached per layer; a per-slot id selects at runtime
+        # (models/lora.py).  Under pp the stacks were folded into the
+        # stage-sharded pytree above.
+        if lora_layer_stacks is not None and engine_config.pp == 1:
+            for i, stack in enumerate(lora_layer_stacks):
                 if not stack:
                     continue
-                specs = lora_mod.lora_pspecs(stack)
+                lspecs = lora_mod.lora_pspecs(stack)
                 self.params["layers"][i]["lora"] = jax.tree.map(
                     lambda arr, spec: jax.device_put(
                         arr, jax.sharding.NamedSharding(self.mesh, spec)
                     ),
                     stack,
-                    specs,
+                    lspecs,
                     is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
                 )
-            logger.info("LoRA adapters loaded: %s", sorted(self.adapter_ids))
 
         cache_cfg = KVCacheConfig(
             n_layers=model_config.n_layers,

@@ -65,7 +65,9 @@ class EngineConfig:
     # with the bf16 P/D wire (the transfer layout is topology-agnostic,
     # so prefill and decode tiers may run different pp/tp meshes; the
     # wire stays bf16 — kv_quant on either P/D tier still raises at call
-    # time).  pp excludes sp and LoRA (each raises at init).
+    # time) and with LoRA (adapter stacks ride the stage-sharded pytree;
+    # requires uniform per-layer projection coverage).  pp excludes only
+    # sp (raises at init).
     pp: int = 1
     pp_microbatches: int = 0  # 0 = auto (pp when it divides the batch)
     # None = auto (ops/attention.py): the fused Pallas kernel for

@@ -304,8 +304,19 @@ def _logits(params: Params, x: jnp.ndarray, config: LlamaConfig) -> jnp.ndarray:
 
 def _adapter_onehot(params: Params, adapter_ids, batch: int):
     """[B, n_adapters] one-hot from per-slot adapter ids (-1 -> all-zero row
-    -> exact-zero delta -> base model); None when no adapters are loaded."""
-    for layer in params["layers"]:
+    -> exact-zero delta -> base model); None when no adapters are loaded.
+    Handles both layer layouts: the per-layer list and the pp-stacked dict
+    (whose lora leaves carry a leading layer axis)."""
+    layers = params["layers"]
+    if isinstance(layers, dict):  # pp-stacked
+        lora = layers.get("lora")
+        if lora:
+            n_a = next(iter(lora.values()))["A"].shape[1]  # [L, n, in, r]
+            if adapter_ids is None:
+                adapter_ids = jnp.full((batch,), -1, jnp.int32)
+            return jax.nn.one_hot(adapter_ids, n_a, dtype=jnp.float32)
+        return None
+    for layer in layers:
         lora = layer.get("lora")
         if lora:
             n_a = next(iter(lora.values()))["A"].shape[0]
@@ -531,7 +542,8 @@ def _pp_prefill_block(config: LlamaConfig, page_size: int):
             jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
         valid_len = aux["valid_len"]
         x_out, k, v = transformer_block(
-            layer, x, positions, valid_len, config)
+            layer, x, positions, valid_len, config,
+            onehot=aux.get("onehot"))
         page_ids = jnp.where(valid, aux["page_ids"], 0)
         pages_l = write_prompt_kv_batch(
             pages_l, k, v, page_ids, valid_len, page_size)
@@ -548,11 +560,12 @@ def _pp_decode_block(config: LlamaConfig, page_size: int):
     def block_fn(layer, pages_l, x, aux, valid):
         B = x.shape[0]
         pos, page_table = aux["pos"], aux["page_table"]
+        onehot = aux.get("onehot")
         live = aux["live"] & valid
         positions = pos[:, None]
         residual = x
         h = rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
-        q, k, v = _qkv(layer, h, config)
+        q, k, v = _qkv(layer, h, config, onehot)
         q = apply_rope(q, positions, config.rope_theta, config.rope_scaling)
         k = apply_rope(k, positions, config.rope_theta, config.rope_scaling)
         pages_l = append_token_kv(
@@ -563,10 +576,14 @@ def _pp_decode_block(config: LlamaConfig, page_size: int):
             logit_softcap=config.logit_softcap, use_pallas=False,
         )
         attn_flat = attn.reshape(B, 1, -1)
-        x = residual + dense(attn_flat, layer["wo"])
+        attn_out = _maybe_add(
+            dense(attn_flat, layer["wo"]),
+            lora_delta(layer.get("lora"), "wo", attn_flat, onehot),
+        )
+        x = residual + attn_out
         residual = x
         h = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
-        return residual + _mlp(layer, h, config), pages_l
+        return residual + _mlp(layer, h, config, onehot), pages_l
 
     return block_fn
 
@@ -581,6 +598,7 @@ def prefill_pp(
     page_size: int,
     mesh,
     n_microbatches: int,
+    adapter_ids: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Pipeline-parallel prefill: params["layers"] is the stacked pytree,
     stages stream microbatches GPipe-style (parallel/pipeline.py).
@@ -590,6 +608,9 @@ def prefill_pp(
     B = tokens.shape[0]
     x = embed_lookup(params["embed"], tokens, jnp.dtype(config.dtype))
     aux = {"valid_len": valid_len, "page_ids": page_ids}
+    onehot = _adapter_onehot(params, adapter_ids, B)
+    if onehot is not None:
+        aux["onehot"] = onehot
     x, new_pages = pipeline_blocks(
         params["layers"], kv_pages, x, aux,
         _pp_prefill_block(config, page_size), mesh, n_microbatches,
@@ -610,7 +631,7 @@ def _pp_chunk_block(config: LlamaConfig, page_size: int):
         page_ids = jnp.where(valid, aux["page_ids"], 0)
         return chunk_transformer_block(
             layer, pages_l, x, chunk_start, aux["valid_len"], page_ids,
-            page_size, config,
+            page_size, config, onehot=aux.get("onehot"),
         )
 
     return block_fn
@@ -627,6 +648,7 @@ def prefill_chunk_pp(
     page_size: int,
     mesh,
     n_microbatches: int,
+    adapter_ids: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Pipeline-parallel chunked prefill (engine pp>1): unlocks prompts
     beyond max_prefill_len AND prefix-cache hits under pp."""
@@ -636,6 +658,9 @@ def prefill_chunk_pp(
     x = embed_lookup(params["embed"], tokens, jnp.dtype(config.dtype))
     aux = {"chunk_start": chunk_start, "valid_len": valid_len,
            "page_ids": page_ids}
+    onehot = _adapter_onehot(params, adapter_ids, B)
+    if onehot is not None:
+        aux["onehot"] = onehot
     x, new_pages = pipeline_blocks(
         params["layers"], kv_pages, x, aux,
         _pp_chunk_block(config, page_size), mesh, n_microbatches,
@@ -656,12 +681,16 @@ def decode_step_pp(
     page_size: int,
     mesh,
     n_microbatches: int,
+    adapter_ids: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Pipeline-parallel decode step (engine pp>1)."""
     from ..parallel.pipeline import pipeline_blocks
 
     x = embed_lookup(params["embed"], tokens, jnp.dtype(config.dtype))[:, None, :]
     aux = {"pos": pos, "page_table": page_table, "live": active}
+    onehot = _adapter_onehot(params, adapter_ids, tokens.shape[0])
+    if onehot is not None:
+        aux["onehot"] = onehot
     x, new_pages = pipeline_blocks(
         params["layers"], kv_pages, x, aux,
         _pp_decode_block(config, page_size), mesh, n_microbatches,
