@@ -1,6 +1,7 @@
-"""Llama-family decoder in functional JAX (covers Llama 2/3, Mistral, Qwen2
-and TinyLlama-style variants via config knobs: GQA, RoPE theta, qkv bias,
-tied embeddings, optional logit softcap).
+"""Llama-family decoder in functional JAX (covers Llama 2/3, Mistral,
+Qwen2, Qwen3 and TinyLlama-style variants via config knobs: GQA, RoPE
+theta, qkv bias, per-head qk-norm, tied embeddings, optional logit
+softcap).
 
 Params are a plain pytree (nested dict of jnp arrays) so sharding is a
 matching pytree of NamedShardings (parallel/sharding.py) and jit donation
@@ -61,6 +62,8 @@ class LlamaConfig:
     max_position_embeddings: int = 4096
     tie_word_embeddings: bool = False
     attention_bias: bool = False
+    # per-head RMSNorm on q/k before rope (Qwen3-family)
+    qk_norm: bool = False
     logit_softcap: float = 0.0
     # Mixture-of-Experts (Mixtral-style): n_experts == 0 => dense MLP.
     # Experts shard over the `model` mesh axis (expert parallelism).
@@ -135,6 +138,24 @@ class LlamaConfig:
         )
 
     @staticmethod
+    def qwen3_0_6b() -> "LlamaConfig":
+        """Qwen3-0.6B shape (qk-norm family; MXU-native head_dim=128)."""
+        return LlamaConfig(
+            vocab_size=151936,
+            hidden_size=1024,
+            intermediate_size=3072,
+            n_layers=28,
+            n_heads=16,
+            n_kv_heads=8,
+            head_dim=128,
+            rope_theta=1000000.0,
+            max_position_embeddings=32768,
+            tie_word_embeddings=True,
+            qk_norm=True,
+            rms_norm_eps=1e-6,
+        )
+
+    @staticmethod
     def from_hf_config(path_or_dict) -> "LlamaConfig":
         """Map a HuggingFace config.json (LlamaForCausalLM/MistralForCausalLM/
         Qwen2ForCausalLM) onto LlamaConfig."""
@@ -172,6 +193,12 @@ class LlamaConfig:
             max_position_embeddings=cfg.get("max_position_embeddings", 4096),
             tie_word_embeddings=cfg.get("tie_word_embeddings", False),
             attention_bias=cfg.get("attention_bias", False),
+            # Qwen3 carries q_norm/k_norm weights per layer; model_type is
+            # always present in real config.json, architectures often not
+            qk_norm=(
+                cfg.get("model_type") == "qwen3"
+                or any("Qwen3" in a
+                       for a in (cfg.get("architectures") or []))),
             # MixtralForCausalLM fields
             n_experts=cfg.get("num_local_experts", 0),
             n_experts_per_tok=cfg.get("num_experts_per_tok", 2),
@@ -230,6 +257,9 @@ def init_params(config: LlamaConfig, rng: jax.Array, scale: float = 0.02,
             layer["bq"] = jnp.zeros((nq * hd,), dtype)
             layer["bk"] = jnp.zeros((nkv * hd,), dtype)
             layer["bv"] = jnp.zeros((nkv * hd,), dtype)
+        if config.qk_norm:
+            layer["q_norm"] = jnp.ones((hd,), dtype)
+            layer["k_norm"] = jnp.ones((hd,), dtype)
         layers.append(layer)
     params: Params = {
         # tied quantized embeddings carry per-ROW scales (they serve as the
@@ -265,6 +295,10 @@ def _qkv(layer: Params, x: jnp.ndarray, config: LlamaConfig, onehot=None):
     q = q.reshape(B, T, config.n_heads, config.head_dim)
     k = k.reshape(B, T, config.n_kv_heads, config.head_dim)
     v = v.reshape(B, T, config.n_kv_heads, config.head_dim)
+    if config.qk_norm:
+        # Qwen3: per-head RMSNorm over head_dim before rope
+        q = rms_norm(q, layer["q_norm"], config.rms_norm_eps)
+        k = rms_norm(k, layer["k_norm"], config.rms_norm_eps)
     return q, k, v
 
 
@@ -709,6 +743,8 @@ _HF_LAYER_MAP = {
     "self_attn.q_proj.bias": "bq",
     "self_attn.k_proj.bias": "bk",
     "self_attn.v_proj.bias": "bv",
+    "self_attn.q_norm.weight": "q_norm",
+    "self_attn.k_norm.weight": "k_norm",
     "post_attention_layernorm.weight": "mlp_norm",
     "mlp.gate_proj.weight": "w_gate",
     "mlp.up_proj.weight": "w_up",

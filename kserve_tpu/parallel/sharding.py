@@ -90,6 +90,9 @@ def param_pspecs(config: LlamaConfig) -> Dict[str, Any]:
         layer.update(moe_param_pspecs())
     if config.attention_bias:
         layer.update({"bq": P(MODEL_AXIS), "bk": P(MODEL_AXIS), "bv": P(MODEL_AXIS)})
+    if config.qk_norm:
+        # per-head norm weights are [head_dim] — tiny, replicated
+        layer.update({"q_norm": P(), "k_norm": P()})
     specs: Dict[str, Any] = {
         "embed": P(MODEL_AXIS, None),  # vocab-sharded
         "final_norm": P(),

@@ -52,6 +52,7 @@ _NAMED_CONFIGS = {
     "tiny": llama.LlamaConfig.tiny,
     "llama3-1b": llama.LlamaConfig.llama3_1b,
     "llama3-8b": llama.LlamaConfig.llama3_8b,
+    "qwen3-0.6b": llama.LlamaConfig.qwen3_0_6b,
 }
 
 

@@ -162,6 +162,9 @@ def _params_from_hf(hf_model, config):
         params["lm_head"] = jnp.asarray(sd["lm_head.weight"].T, jnp.float32)
     mapping = {
         "attn_norm": ("input_layernorm.weight", False),
+        # qk-norm family (absent keys are skipped below)
+        "q_norm": ("self_attn.q_norm.weight", False),
+        "k_norm": ("self_attn.k_norm.weight", False),
         "wq": ("self_attn.q_proj.weight", True),
         "wk": ("self_attn.k_proj.weight", True),
         "wv": ("self_attn.v_proj.weight", True),
@@ -174,7 +177,10 @@ def _params_from_hf(hf_model, config):
     for i in range(config.n_layers):
         layer = {}
         for ours, (suffix, transpose) in mapping.items():
-            w = sd[f"model.layers.{i}.{suffix}"]
+            key = f"model.layers.{i}.{suffix}"
+            if key not in sd:
+                continue  # e.g. q_norm on non-qk-norm models
+            w = sd[key]
             layer[ours] = jnp.asarray(w.T if transpose else w, jnp.float32)
         params["layers"].append(layer)
     return params
@@ -239,3 +245,117 @@ class TestRopeScaling:
         }
         with pytest.raises(ValueError, match="rope_scaling"):
             LlamaConfig.from_hf_config(cfg)
+
+
+class TestQwen3Parity:
+    def test_logits_match_transformers_qwen3(self):
+        """Qwen3 = Llama family + per-head q/k RMSNorm before rope; gold
+        parity against the torch reference at f32."""
+        torch = pytest.importorskip("torch")
+        from transformers import Qwen3Config as HFQwen3Config
+        from transformers import Qwen3ForCausalLM
+
+        hf_config = HFQwen3Config(
+            vocab_size=128,
+            hidden_size=32,
+            intermediate_size=64,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            head_dim=8,
+            max_position_embeddings=64,
+            rope_theta=10000.0,
+            tie_word_embeddings=False,
+            attention_bias=False,
+        )
+        torch.manual_seed(0)
+        hf_model = Qwen3ForCausalLM(hf_config).eval()
+
+        config = LlamaConfig.from_hf_config(hf_config.to_dict())
+        assert config.qk_norm is True  # detected via model_type
+        config.dtype = "float32"
+        params = _params_from_hf(hf_model, config)
+        assert "q_norm" in params["layers"][0]
+
+        prompt = np.array([[1, 5, 9, 33, 77, 100, 2, 64]], dtype=np.int64)
+        with torch.no_grad():
+            ref = hf_model(torch.from_numpy(prompt)).logits.numpy()
+
+        cache_cfg, pages = make_cache(config)
+        page_ids = jnp.asarray([[1, 2, 0, 0, 0, 0, 0, 0]], jnp.int32)
+        got_last, pages = prefill(
+            params, config, jnp.asarray(prompt, jnp.int32), jnp.asarray([8]),
+            pages, page_ids, cache_cfg.page_size,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_last)[0], ref[0, -1], rtol=2e-3, atol=2e-3
+        )
+        # decode continues the HF sequence: next-token logits at pos 8
+        with torch.no_grad():
+            ref9 = hf_model(torch.from_numpy(
+                np.concatenate([prompt, [[42]]], axis=1))).logits.numpy()
+        got9, _ = decode_step(
+            params, config, jnp.asarray([42], jnp.int32),
+            jnp.asarray([8], jnp.int32), pages, page_ids,
+            jnp.asarray([True]), cache_cfg.page_size, use_pallas=False,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got9)[0], ref9[0, -1], rtol=2e-3, atol=2e-3
+        )
+
+    @pytest.mark.parametrize("axes", [dict(), dict(tp=2), dict(pp=2, tp=2)])
+    def test_qwen3_engine_greedy_consistent(self, axes):
+        """qk-norm serves through the engine on every parallelism layout
+        (the per-head [head_dim] norms are replicated; parity across
+        layouts proves the sharding composes)."""
+        import asyncio
+
+        from kserve_tpu.engine.engine import EngineConfig, LLMEngine
+        from kserve_tpu.engine.sampling import SamplingParams
+        from kserve_tpu.engine.tokenizer import ByteTokenizer
+
+        mc = LlamaConfig.tiny(dtype="float32", qk_norm=True)
+        cfg = EngineConfig(
+            max_batch_size=2, page_size=8, num_pages=32, max_pages_per_seq=4,
+            max_prefill_len=16, prefill_buckets=(16,), dtype="float32",
+            use_pallas=False, **axes,
+        )
+
+        async def run():
+            engine = LLMEngine(mc, cfg, ByteTokenizer(mc.vocab_size))
+            await engine.start()
+            try:
+                return [
+                    o.token_id async for o in engine.generate(
+                        [7, 8, 9],
+                        SamplingParams(max_tokens=5, temperature=0.0,
+                                       ignore_eos=True))
+                ]
+            finally:
+                await engine.stop()
+
+        outs = asyncio.run(run())
+        assert len(outs) == 5
+        if axes:
+            # explicit single-layout reference per case (execution-order
+            # independent: works under -k filters and xdist splits)
+            base_cfg = EngineConfig(
+                max_batch_size=2, page_size=8, num_pages=32,
+                max_pages_per_seq=4, max_prefill_len=16,
+                prefill_buckets=(16,), dtype="float32", use_pallas=False,
+            )
+
+            async def run_base():
+                engine = LLMEngine(mc, base_cfg, ByteTokenizer(mc.vocab_size))
+                await engine.start()
+                try:
+                    return [
+                        o.token_id async for o in engine.generate(
+                            [7, 8, 9],
+                            SamplingParams(max_tokens=5, temperature=0.0,
+                                           ignore_eos=True))
+                    ]
+                finally:
+                    await engine.stop()
+
+            assert outs == asyncio.run(run_base())
