@@ -53,9 +53,13 @@ def build_compiled(model_config, engine_config, mesh) -> CompiledPrograms:
 
         decode_attention_fn = make_sharded_paged_attention(
             mesh,
-            logit_softcap=mc.logit_softcap,
+            logit_softcap=mc.attn_logit_softcap,
             use_pallas=cfg.use_pallas,
             quantized=(getattr(cfg, "kv_quant", None) == "int8"),
+            scale=mc.attn_scale,
+            # static: only windowed models thread the per-layer scalar
+            # through (a traced window forces the gather path)
+            windowed=mc.sliding_window > 0,
         )
 
     attention_fn = None
@@ -78,7 +82,7 @@ def build_compiled(model_config, engine_config, mesh) -> CompiledPrograms:
             _partial(
                 ring_attention,
                 axis_name=shd.SEQ_AXIS,
-                logit_softcap=mc.logit_softcap,
+                logit_softcap=mc.attn_logit_softcap,
             ),
             mesh=mesh,
             in_specs=(qkv_spec, qkv_spec, qkv_spec, _P(None)),

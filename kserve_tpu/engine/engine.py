@@ -98,8 +98,21 @@ class LLMEngine:
         engine_config = dataclasses.replace(engine_config)
         self.config = engine_config
         self.tokenizer = tokenizer
+        if tokenizer is not None and tokenizer.vocab_size > model_config.vocab_size:
+            # loud, not silent: ids past the embedding table clamp inside
+            # jit (garbage lookups) and crash the host-side prompt mask
+            raise ValueError(
+                f"tokenizer vocab ({tokenizer.vocab_size}) exceeds model "
+                f"vocab ({model_config.vocab_size}); ids past the embedding "
+                "table would silently clamp under jit")
         self._mlabel = metrics_label
         shd.validate_tp(model_config, engine_config.tp)
+        if engine_config.sp > 1 and (
+                model_config.sliding_window > 0
+                or model_config.query_pre_attn_scalar is not None):
+            raise NotImplementedError(
+                "sp>1 (ring-attention prefill) does not support sliding "
+                "windows or attention-scale overrides yet")
         if engine_config.sp > 1:
             bad = [b for b in engine_config.prefill_buckets if b % engine_config.sp]
             if bad:

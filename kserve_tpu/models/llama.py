@@ -33,7 +33,7 @@ from ..ops.attention import (
     chunked_prefill_attention,
     paged_attention,
 )
-from ..ops.norms import rms_norm
+from ..ops.norms import rms_norm, rms_norm_plus_one
 from ..ops.rotary import apply_rope
 from .lora import lora_delta
 from .quant import (
@@ -45,6 +45,17 @@ from .quant import (
 )
 
 Params = Dict[str, Any]
+
+
+def _map_hidden_act(act) -> str:
+    """HF activation name -> ours.  Loud on anything unimplemented: a
+    silent silu substitution (e.g. for exact 'gelu') would produce wrong
+    logits with no signal."""
+    if act in (None, "silu", "swish"):
+        return "silu"
+    if act in ("gelu_pytorch_tanh", "gelu_tanh"):
+        return "gelu_tanh"
+    raise ValueError(f"unsupported hidden_act {act!r}")
 
 
 @dataclass
@@ -64,6 +75,19 @@ class LlamaConfig:
     attention_bias: bool = False
     # per-head RMSNorm on q/k before rope (Qwen3-family)
     qk_norm: bool = False
+    # ---- Gemma-2 family knobs (all default to Llama behavior) ----
+    hidden_act: str = "silu"  # or "gelu_tanh" (GeGLU)
+    norm_plus_one: bool = False  # RMSNorm multiplies by (1 + w)
+    embed_scale: bool = False  # inputs scaled by sqrt(hidden_size)
+    sandwich_norms: bool = False  # post-attn + post-ffn norms per layer
+    attn_logit_softcap: float = 0.0  # tanh cap on ATTENTION scores
+    query_pre_attn_scalar: Optional[float] = None  # attn scale = qpas**-0.5
+    sliding_window: int = 0  # >0: window on layers marked sliding
+    # per-layer attention kind; None = all full attention.  Tuple of
+    # "sliding_attention"|"full_attention" (hashable: configs close over
+    # jitted programs)
+    layer_types: Optional[Tuple[str, ...]] = None
+    # final-logit tanh cap (pre-existing knob)
     logit_softcap: float = 0.0
     # Mixture-of-Experts (Mixtral-style): n_experts == 0 => dense MLP.
     # Experts shard over the `model` mesh axis (expert parallelism).
@@ -74,6 +98,24 @@ class LlamaConfig:
     def __post_init__(self):
         if self.head_dim is None:
             self.head_dim = self.hidden_size // self.n_heads
+        if self.layer_types is not None:
+            self.layer_types = tuple(self.layer_types)
+
+    def layer_window(self, i: int) -> int:
+        """Sliding-window width for layer i (0 = full attention)."""
+        if self.sliding_window <= 0:
+            return 0
+        if self.layer_types is None:
+            return self.sliding_window
+        return (self.sliding_window
+                if self.layer_types[i] == "sliding_attention" else 0)
+
+    @property
+    def attn_scale(self) -> Optional[float]:
+        """Attention score scale override (None = 1/sqrt(head_dim))."""
+        if self.query_pre_attn_scalar is None:
+            return None
+        return float(self.query_pre_attn_scalar) ** -0.5
 
     @staticmethod
     def tiny(**overrides) -> "LlamaConfig":
@@ -199,6 +241,30 @@ class LlamaConfig:
                 cfg.get("model_type") == "qwen3"
                 or any("Qwen3" in a
                        for a in (cfg.get("architectures") or []))),
+            # Gemma-2 family (model_type "gemma2")
+            hidden_act=_map_hidden_act(
+                cfg.get("hidden_act", cfg.get("hidden_activation"))),
+            norm_plus_one=cfg.get("model_type") == "gemma2",
+            embed_scale=cfg.get("model_type") == "gemma2",
+            sandwich_norms=cfg.get("model_type") == "gemma2",
+            attn_logit_softcap=cfg.get("attn_logit_softcapping") or 0.0,
+            logit_softcap=cfg.get("final_logit_softcapping") or 0.0,
+            query_pre_attn_scalar=cfg.get("query_pre_attn_scalar"),
+            sliding_window=(
+                cfg.get("sliding_window") or 0
+                if cfg.get("model_type") == "gemma2" else 0),
+            # raw hub config.json for Gemma-2 predates the layer_types
+            # key (the alternation lived in modeling code: even layers
+            # sliding); synthesize it so full-attention layers are never
+            # silently windowed
+            layer_types=(
+                tuple(cfg["layer_types"]) if cfg.get("layer_types")
+                else tuple(
+                    "sliding_attention" if i % 2 == 0 else "full_attention"
+                    for i in range(cfg["num_hidden_layers"]))
+                if cfg.get("model_type") == "gemma2"
+                and (cfg.get("sliding_window") or 0) > 0
+                else None),
             # MixtralForCausalLM fields
             n_experts=cfg.get("num_local_experts", 0),
             n_experts_per_tok=cfg.get("num_experts_per_tok", 2),
@@ -235,13 +301,14 @@ def init_params(config: LlamaConfig, rng: jax.Array, scale: float = 0.02,
     layers = []
     for i in range(config.n_layers):
         k = jax.random.split(keys[i], 8)
+        norm_init = jnp.zeros if config.norm_plus_one else jnp.ones
         layer = {
-            "attn_norm": jnp.ones((h,), dtype),
+            "attn_norm": norm_init((h,), dtype),
             "wq": dense(k[0], (h, nq * hd)),
             "wk": dense(k[1], (h, nkv * hd)),
             "wv": dense(k[2], (h, nkv * hd)),
             "wo": dense(k[3], (nq * hd, h)),
-            "mlp_norm": jnp.ones((h,), dtype),
+            "mlp_norm": norm_init((h,), dtype),
         }
         if config.n_experts > 0:
             E, f = config.n_experts, config.intermediate_size
@@ -260,6 +327,13 @@ def init_params(config: LlamaConfig, rng: jax.Array, scale: float = 0.02,
         if config.qk_norm:
             layer["q_norm"] = jnp.ones((hd,), dtype)
             layer["k_norm"] = jnp.ones((hd,), dtype)
+        if config.sandwich_norms:
+            # Gemma norm weights init to ZERO ((1+w) multiplies by 1)
+            layer["post_attn_norm"] = jnp.zeros((h,), dtype)
+            layer["post_mlp_norm"] = jnp.zeros((h,), dtype)
+        if config.sliding_window > 0:
+            layer["attn_window"] = jnp.asarray(
+                config.layer_window(i), jnp.int32)
         layers.append(layer)
     params: Params = {
         # tied quantized embeddings carry per-ROW scales (they serve as the
@@ -269,7 +343,8 @@ def init_params(config: LlamaConfig, rng: jax.Array, scale: float = 0.02,
             if quant and config.tie_word_embeddings
             else dense_f32(keys[-2], (config.vocab_size, h))
         ),
-        "final_norm": jnp.ones((h,), dtype),
+        "final_norm": (jnp.zeros if config.norm_plus_one else jnp.ones)(
+            (h,), dtype),
         "layers": layers,
     }
     if not config.tie_word_embeddings:
@@ -314,8 +389,9 @@ def _mlp(layer: Params, x: jnp.ndarray, config: LlamaConfig, onehot=None) -> jnp
         )
         return moe_mlp(layer, x, moe_cfg)
     lora = layer.get("lora")
-    gate = jax.nn.silu(
-        _maybe_add(dense(x, layer["w_gate"]), lora_delta(lora, "w_gate", x, onehot))
+    gate = _act(
+        _maybe_add(dense(x, layer["w_gate"]), lora_delta(lora, "w_gate", x, onehot)),
+        config,
     )
     up = _maybe_add(dense(x, layer["w_up"]), lora_delta(lora, "w_up", x, onehot))
     h = gate * up
@@ -325,7 +401,7 @@ def _mlp(layer: Params, x: jnp.ndarray, config: LlamaConfig, onehot=None) -> jnp
 
 
 def _logits(params: Params, x: jnp.ndarray, config: LlamaConfig) -> jnp.ndarray:
-    x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
+    x = _norm(x, params["final_norm"], config)
     head = params.get("lm_head")
     if head is None:
         logits = tied_head_matmul(x, params["embed"]).astype(jnp.float32)
@@ -334,6 +410,28 @@ def _logits(params: Params, x: jnp.ndarray, config: LlamaConfig) -> jnp.ndarray:
     if config.logit_softcap > 0.0:
         logits = jnp.tanh(logits / config.logit_softcap) * config.logit_softcap
     return logits
+
+
+def _norm(x: jnp.ndarray, weight: jnp.ndarray, config: LlamaConfig) -> jnp.ndarray:
+    """Config-dispatched RMSNorm: Gemma's (1+w) variant or the default."""
+    if config.norm_plus_one:
+        return rms_norm_plus_one(x, weight, config.rms_norm_eps)
+    return rms_norm(x, weight, config.rms_norm_eps)
+
+
+def _embed(params: Params, tokens: jnp.ndarray, config: LlamaConfig) -> jnp.ndarray:
+    x = embed_lookup(params["embed"], tokens, jnp.dtype(config.dtype))
+    if config.embed_scale:
+        # Gemma scales embeddings by sqrt(hidden); the normalizer is cast
+        # to the activation dtype first (HF parity)
+        x = x * jnp.asarray(config.hidden_size ** 0.5, x.dtype)
+    return x
+
+
+def _act(x: jnp.ndarray, config: LlamaConfig) -> jnp.ndarray:
+    if config.hidden_act == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
 
 
 def _adapter_onehot(params: Params, adapter_ids, batch: int):
@@ -374,24 +472,35 @@ def transformer_block(
     discards them (the pipeline-parallel layer_fn).  The single source of
     the block math: prefill and parallel/pipeline.py both call this, so
     rope/softcap/LoRA changes cannot drift between them."""
-    if attention_fn is None:
-        attention_fn = causal_prefill_attention
     B, T = x.shape[0], x.shape[1]
     residual = x
-    h = rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
+    h = _norm(x, layer["attn_norm"], config)
     q, k, v = _qkv(layer, h, config, onehot)
     q = apply_rope(q, positions, config.rope_theta, config.rope_scaling)
     k = apply_rope(k, positions, config.rope_theta, config.rope_scaling)
-    attn = attention_fn(q, k, v, valid_len, config.logit_softcap)
+    if attention_fn is None:
+        attn = causal_prefill_attention(
+            q, k, v, valid_len, config.attn_logit_softcap,
+            scale=config.attn_scale, window=layer.get("attn_window"),
+        )
+    else:
+        # pluggable path (SP ring attention); engines exclude it for
+        # windowed/scaled configs at init
+        attn = attention_fn(q, k, v, valid_len, config.attn_logit_softcap)
     attn_flat = attn.reshape(B, T, -1)
     attn = _maybe_add(
         dense(attn_flat, layer["wo"]),
         lora_delta(layer.get("lora"), "wo", attn_flat, onehot),
     )
+    if config.sandwich_norms:
+        attn = _norm(attn, layer["post_attn_norm"], config)
     x = residual + attn
     residual = x
-    h = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
-    return residual + _mlp(layer, h, config, onehot), k, v
+    h = _norm(x, layer["mlp_norm"], config)
+    out = _mlp(layer, h, config, onehot)
+    if config.sandwich_norms:
+        out = _norm(out, layer["post_mlp_norm"], config)
+    return residual + out, k, v
 
 
 def prefill(
@@ -408,12 +517,13 @@ def prefill(
 ) -> Tuple[jnp.ndarray, List[jnp.ndarray]]:
     """Process prompts, write their KV into the cache, return logits at the
     last valid token of each row: [B, vocab]."""
-    if attention_fn is None:
-        attention_fn = causal_prefill_attention
+    # attention_fn=None flows through to transformer_block, whose default
+    # branch passes scale= and window= — substituting the bare default here
+    # would silently drop both (sliding-window layers would attend globally)
     B, T = tokens.shape
     onehot = _adapter_onehot(params, adapter_ids, B)
     positions = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, axis=0)
-    x = embed_lookup(params["embed"], tokens, jnp.dtype(config.dtype))
+    x = _embed(params, tokens, config)
     new_pages = []
     for layer, pages in zip(params["layers"], kv_pages):
         x, k, v = transformer_block(
@@ -447,23 +557,29 @@ def chunk_transformer_block(
     B, C = x.shape[0], x.shape[1]
     positions = chunk_start[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
     residual = x
-    h = rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
+    h = _norm(x, layer["attn_norm"], config)
     q, k, v = _qkv(layer, h, config, onehot)
     q = apply_rope(q, positions, config.rope_theta, config.rope_scaling)
     k = apply_rope(k, positions, config.rope_theta, config.rope_scaling)
     attn = chunked_prefill_attention(
         q, k, v, pages, page_ids, chunk_start, valid_len,
-        config.logit_softcap,
+        config.attn_logit_softcap,
+        scale=config.attn_scale, window=layer.get("attn_window"),
     )
     attn_flat = attn.reshape(B, C, -1)
     attn = _maybe_add(
         dense(attn_flat, layer["wo"]),
         lora_delta(layer.get("lora"), "wo", attn_flat, onehot),
     )
+    if config.sandwich_norms:
+        attn = _norm(attn, layer["post_attn_norm"], config)
     x = residual + attn
     residual = x
-    h = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
-    x = residual + _mlp(layer, h, config, onehot)
+    h = _norm(x, layer["mlp_norm"], config)
+    out = _mlp(layer, h, config, onehot)
+    if config.sandwich_norms:
+        out = _norm(out, layer["post_mlp_norm"], config)
+    x = residual + out
     pages = write_chunk_kv_batch(
         pages, k, v, page_ids, chunk_start, valid_len, page_size
     )
@@ -488,7 +604,7 @@ def prefill_chunk(
     starts with chunk_start > 0 and the cached pages in page_ids."""
     B, C = tokens.shape
     onehot = _adapter_onehot(params, adapter_ids, B)
-    x = embed_lookup(params["embed"], tokens, jnp.dtype(config.dtype))
+    x = _embed(params, tokens, config)
     new_pages = []
     for layer, pages in zip(params["layers"], kv_pages):
         x, pages = chunk_transformer_block(
@@ -518,39 +634,49 @@ def decode_step(
     """One decode token per sequence; returns ([B, vocab] logits, new pages)."""
     B = tokens.shape[0]
     onehot = _adapter_onehot(params, adapter_ids, B)
-    x = embed_lookup(params["embed"], tokens, jnp.dtype(config.dtype))[:, None, :]  # [B,1,h]
+    x = _embed(params, tokens, config)[:, None, :]  # [B,1,h]
     positions = pos[:, None]
     seq_lens = jnp.where(active, pos + 1, 0)
     new_pages = []
     for layer, pages in zip(params["layers"], kv_pages):
         residual = x
-        h = rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
+        h = _norm(x, layer["attn_norm"], config)
         q, k, v = _qkv(layer, h, config, onehot)
         q = apply_rope(q, positions, config.rope_theta, config.rope_scaling)
         k = apply_rope(k, positions, config.rope_theta, config.rope_scaling)
         pages = append_token_kv(
             pages, k[:, 0], v[:, 0], page_table, pos, active, page_size
         )
+        window = layer.get("attn_window")
         if attention_fn is not None:
-            attn = attention_fn(q[:, 0], pages, page_table, seq_lens)
+            attn = attention_fn(q[:, 0], pages, page_table, seq_lens,
+                                window if window is not None
+                                else jnp.asarray(0, jnp.int32))
         else:
             attn = paged_attention(
                 q[:, 0],
                 pages,
                 page_table,
                 seq_lens,
-                logit_softcap=config.logit_softcap,
+                logit_softcap=config.attn_logit_softcap,
                 use_pallas=use_pallas,
+                scale=config.attn_scale,
+                window=window,
             )
         attn_flat = attn.reshape(B, 1, -1)
         attn = _maybe_add(
             dense(attn_flat, layer["wo"]),
             lora_delta(layer.get("lora"), "wo", attn_flat, onehot),
         )
+        if config.sandwich_norms:
+            attn = _norm(attn, layer["post_attn_norm"], config)
         x = residual + attn
         residual = x
-        h = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
-        x = residual + _mlp(layer, h, config, onehot)
+        h = _norm(x, layer["mlp_norm"], config)
+        out = _mlp(layer, h, config, onehot)
+        if config.sandwich_norms:
+            out = _norm(out, layer["post_mlp_norm"], config)
+        x = residual + out
         new_pages.append(pages)
     return _logits(params, x, config)[:, 0], new_pages
 
@@ -598,7 +724,7 @@ def _pp_decode_block(config: LlamaConfig, page_size: int):
         live = aux["live"] & valid
         positions = pos[:, None]
         residual = x
-        h = rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
+        h = _norm(x, layer["attn_norm"], config)
         q, k, v = _qkv(layer, h, config, onehot)
         q = apply_rope(q, positions, config.rope_theta, config.rope_scaling)
         k = apply_rope(k, positions, config.rope_theta, config.rope_scaling)
@@ -607,17 +733,23 @@ def _pp_decode_block(config: LlamaConfig, page_size: int):
         seq_lens = jnp.where(live, pos + 1, 0)
         attn = paged_attention(
             q[:, 0], pages_l, page_table, seq_lens,
-            logit_softcap=config.logit_softcap, use_pallas=False,
+            logit_softcap=config.attn_logit_softcap, use_pallas=False,
+            scale=config.attn_scale, window=layer.get("attn_window"),
         )
         attn_flat = attn.reshape(B, 1, -1)
         attn_out = _maybe_add(
             dense(attn_flat, layer["wo"]),
             lora_delta(layer.get("lora"), "wo", attn_flat, onehot),
         )
+        if config.sandwich_norms:
+            attn_out = _norm(attn_out, layer["post_attn_norm"], config)
         x = residual + attn_out
         residual = x
-        h = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
-        return residual + _mlp(layer, h, config, onehot), pages_l
+        h = _norm(x, layer["mlp_norm"], config)
+        out = _mlp(layer, h, config, onehot)
+        if config.sandwich_norms:
+            out = _norm(out, layer["post_mlp_norm"], config)
+        return residual + out, pages_l
 
     return block_fn
 
@@ -640,7 +772,7 @@ def prefill_pp(
     from ..parallel.pipeline import pipeline_blocks
 
     B = tokens.shape[0]
-    x = embed_lookup(params["embed"], tokens, jnp.dtype(config.dtype))
+    x = _embed(params, tokens, config)
     aux = {"valid_len": valid_len, "page_ids": page_ids}
     onehot = _adapter_onehot(params, adapter_ids, B)
     if onehot is not None:
@@ -689,7 +821,7 @@ def prefill_chunk_pp(
     from ..parallel.pipeline import pipeline_blocks
 
     B = tokens.shape[0]
-    x = embed_lookup(params["embed"], tokens, jnp.dtype(config.dtype))
+    x = _embed(params, tokens, config)
     aux = {"chunk_start": chunk_start, "valid_len": valid_len,
            "page_ids": page_ids}
     onehot = _adapter_onehot(params, adapter_ids, B)
@@ -720,7 +852,7 @@ def decode_step_pp(
     """Pipeline-parallel decode step (engine pp>1)."""
     from ..parallel.pipeline import pipeline_blocks
 
-    x = embed_lookup(params["embed"], tokens, jnp.dtype(config.dtype))[:, None, :]
+    x = _embed(params, tokens, config)[:, None, :]
     aux = {"pos": pos, "page_table": page_table, "live": active}
     onehot = _adapter_onehot(params, adapter_ids, tokens.shape[0])
     if onehot is not None:
@@ -746,6 +878,11 @@ _HF_LAYER_MAP = {
     "self_attn.q_norm.weight": "q_norm",
     "self_attn.k_norm.weight": "k_norm",
     "post_attention_layernorm.weight": "mlp_norm",
+    # Gemma-2 sandwich norms: HF's post_attention_layernorm is the
+    # POST-attn norm and pre_feedforward_layernorm the pre-ffn norm; the
+    # loader remaps below when the config is sandwich
+    "pre_feedforward_layernorm.weight": "pre_ffn_norm_hf",
+    "post_feedforward_layernorm.weight": "post_mlp_norm",
     "mlp.gate_proj.weight": "w_gate",
     "mlp.up_proj.weight": "w_up",
     "mlp.down_proj.weight": "w_down",
@@ -819,6 +956,18 @@ def load_hf_weights(model_dir: str, config: LlamaConfig,
                     layer[ours] = to_jnp_q(tensors[key], True)
                 else:
                     layer[ours] = to_jnp(tensors[key], ours in _TRANSPOSED)
+        if config.sandwich_norms:
+            # Gemma-2 norm remap: HF post_attention_layernorm is the
+            # POST-attn norm (our "post_attn_norm"); pre_feedforward is
+            # the pre-ffn norm (our "mlp_norm" slot)
+            layer["post_attn_norm"] = layer.pop("mlp_norm")
+            layer["mlp_norm"] = layer.pop("pre_ffn_norm_hf")
+        else:
+            layer.pop("pre_ffn_norm_hf", None)
+            layer.pop("post_mlp_norm", None)
+        if config.sliding_window > 0:
+            layer["attn_window"] = jnp.asarray(
+                config.layer_window(i), jnp.int32)
         if config.n_experts > 0:
             # MixtralForCausalLM: block_sparse_moe.gate + per-expert w1/w3/w2
             # (HF w1=gate, w3=up, w2=down; Linear stores [out, in] -> stack

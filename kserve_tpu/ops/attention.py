@@ -46,10 +46,13 @@ def causal_prefill_attention(
     v: jnp.ndarray,  # [B, T, nkv, d]
     valid_len: jnp.ndarray,  # [B] int32
     logit_softcap: float = 0.0,
+    scale: Optional[float] = None,  # default 1/sqrt(d); Gemma overrides
+    window=None,  # traced int32 scalar; >0 = sliding-window width
 ) -> jnp.ndarray:
     """Causal self-attention over the prompt (no cache read)."""
     B, T, nq, d = q.shape
-    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    if scale is None:
+        scale = 1.0 / float(d) ** 0.5
     scores = _gqa_scores(q, k) * scale  # [B,nq,T,T]
     if logit_softcap > 0.0:
         scores = jnp.tanh(scores / logit_softcap) * logit_softcap
@@ -57,6 +60,10 @@ def causal_prefill_attention(
     causal = t[None, :] <= t[:, None]  # [Tq, Tk]
     valid = t[None, :] < valid_len[:, None]  # [B, Tk]
     mask = causal[None, None, :, :] & valid[:, None, None, :]
+    if window is not None:
+        dist = t[:, None] - t[None, :]  # q - k
+        wmask = (dist < window) | (window <= 0)
+        mask = mask & wmask[None, None, :, :]
     scores = jnp.where(mask, scores, -1e30)
     weights = jax.nn.softmax(scores, axis=-1)
     out = _gqa_out(weights, v)
@@ -101,6 +108,8 @@ def chunked_prefill_attention(
     history_len: jnp.ndarray,  # [B] tokens already in the cache
     valid_len: jnp.ndarray,  # [B] valid tokens within THIS chunk
     logit_softcap: float = 0.0,
+    scale: Optional[float] = None,
+    window=None,  # traced int32 scalar; >0 = sliding-window width
 ) -> jnp.ndarray:
     """Causal attention for a prefill CHUNK: queries attend to the cached
     history (gathered from pages) plus the causal prefix of the chunk
@@ -112,7 +121,8 @@ def chunked_prefill_attention(
     H = k_hist.shape[1]
     k_all = jnp.concatenate([k_hist, k_chunk.astype(k_hist.dtype)], axis=1)
     v_all = jnp.concatenate([v_hist, v_chunk.astype(v_hist.dtype)], axis=1)
-    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    if scale is None:
+        scale = 1.0 / float(d) ** 0.5
     scores = _gqa_scores(q, k_all) * scale  # [B, nq, C, H+C]
     if logit_softcap > 0.0:
         scores = jnp.tanh(scores / logit_softcap) * logit_softcap
@@ -128,6 +138,16 @@ def chunked_prefill_attention(
         ],
         axis=-1,
     )  # [B, C, H+C]
+    if window is not None:
+        # absolute positions: history keys 0..H-1; chunk token c sits at
+        # chunk_start + c
+        q_pos = history_len[:, None] + c[None, :]  # [B, C]
+        k_pos = jnp.concatenate([
+            jnp.broadcast_to(hist_pos[None, :], (B, H)),
+            history_len[:, None] + c[None, :],
+        ], axis=1)  # [B, H+C]
+        dist = q_pos[:, :, None] - k_pos[:, None, :]
+        mask = mask & ((dist < window) | (window <= 0))
     scores = jnp.where(mask[:, None, :, :], scores, -1e30)
     weights = jax.nn.softmax(scores, axis=-1)
     out = _gqa_out(weights, v_all)  # [B, C, nq, d]
@@ -140,18 +160,25 @@ def paged_attention_xla(
     page_table: jnp.ndarray,  # [B, max_pages]
     seq_lens: jnp.ndarray,  # [B] int32 (length INCLUDING current token)
     logit_softcap: float = 0.0,
+    scale: Optional[float] = None,
+    window=None,  # traced int32 scalar; >0 = sliding-window width
 ) -> jnp.ndarray:
     """Decode attention: gather this batch's pages and do masked softmax.
     Materializes [B, L, nkv, d]; the Pallas kernel avoids that copy."""
     B, nq, d = q.shape
     k, v = _gather_history(kv_pages, page_table)
     L = k.shape[1]
-    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    if scale is None:
+        scale = 1.0 / float(d) ** 0.5
     scores = _gqa_scores(q[:, None], k) * scale  # [B,nq,1,L]
     if logit_softcap > 0.0:
         scores = jnp.tanh(scores / logit_softcap) * logit_softcap
     pos = jnp.arange(L, dtype=jnp.int32)
     mask = pos[None, :] < seq_lens[:, None]  # [B, L]
+    if window is not None:
+        # the query sits at pos seq_len-1: keep keys within the window
+        dist = (seq_lens[:, None] - 1) - pos[None, :]
+        mask = mask & ((dist < window) | (window <= 0))
     scores = jnp.where(mask[:, None, None, :], scores, -1e30)
     weights = jax.nn.softmax(scores, axis=-1)
     out = _gqa_out(weights, v)  # [B,1,nq,d]
@@ -201,6 +228,8 @@ def make_sharded_paged_attention(
     use_pallas: Optional[bool] = None,
     quantized: bool = False,
     interpret: bool = False,
+    scale: Optional[float] = None,
+    windowed: bool = False,
 ):
     """Decode attention under `shard_map` over the model (head) axis.
 
@@ -213,20 +242,34 @@ def make_sharded_paged_attention(
     (no collectives).  This is what un-boxes the kernel for the multi-chip
     path (round-2 VERDICT weak #3).
 
-    Returns fn(q [B,nq,d], kv_pages, page_table [B,W], seq_lens [B]) ->
-    [B,nq,d].  `quantized` selects the (int8 pages, scales) cache layout.
+    Returns fn(q [B,nq,d], kv_pages, page_table [B,W], seq_lens [B],
+    window [] int32) -> [B,nq,d].  `windowed` is STATIC: when False the
+    traced window arg is ignored (0 at every call site) and the Pallas
+    auto-dispatch stays available; when True the scalar rides through to
+    the gather path (per-layer sliding windows are data, and a traced
+    window always forces the gather — threading it unconditionally would
+    silently disable the kernel for every non-windowed tp>1 model).
+    `quantized` selects the (int8 pages, scales) cache layout.
     """
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.sharding import MODEL_AXIS
 
+    if interpret and (windowed or scale is not None):
+        # the interpret path exists to test the KERNEL's math on CPU, and
+        # the kernel takes neither a window nor a scale override — dropping
+        # them here would make a parity test compare the wrong math
+        raise ValueError(
+            "interpret mode tests the Pallas kernel, which supports "
+            "neither `windowed` nor a scale override")
+
     q_spec = P(None, MODEL_AXIS, None)
     kv_spec = P(None, None, MODEL_AXIS, None, None)
     if quantized:
         kv_spec = (kv_spec, P(None, None, MODEL_AXIS, None))
 
-    def inner(q, kv_pages, page_table, seq_lens):
+    def inner(q, kv_pages, page_table, seq_lens, window):
         if interpret:
             from .pallas_paged_attention import paged_attention_pallas
 
@@ -235,12 +278,13 @@ def make_sharded_paged_attention(
                 logit_softcap=logit_softcap, interpret=True)
         return paged_attention(
             q, kv_pages, page_table, seq_lens,
-            logit_softcap=logit_softcap, use_pallas=use_pallas)
+            logit_softcap=logit_softcap, use_pallas=use_pallas,
+            scale=scale, window=window if windowed else None)
 
     return shard_map(
         inner,
         mesh=mesh,
-        in_specs=(q_spec, kv_spec, P(None, None), P(None)),
+        in_specs=(q_spec, kv_spec, P(None, None), P(None), P()),
         out_specs=q_spec,
         check_vma=False,
     )
@@ -253,6 +297,8 @@ def paged_attention(
     seq_lens: jnp.ndarray,
     logit_softcap: float = 0.0,
     use_pallas: Optional[bool] = None,
+    scale: Optional[float] = None,
+    window=None,  # sliding window (forces the gather path)
 ) -> jnp.ndarray:
     """Dispatch between the fused Pallas kernel and the XLA gather path.
 
@@ -263,6 +309,20 @@ def paged_attention(
     silently benchmarking the gather); False forces the gather."""
     d = q.shape[-1]
     quantized = isinstance(kv_pages, tuple)
+    if window is not None:
+        # the kernel has no sliding-window mask yet; windowed layers take
+        # the gather (scale/softcap still apply).  An explicit opt-in
+        # stays loud — silently measuring the gather would corrupt a
+        # benchmark that forced the kernel
+        if use_pallas:
+            raise ValueError(
+                "pallas paged attention has no sliding-window mask; "
+                "windowed layers cannot run with use_pallas=True")
+        use_pallas = False
+    if scale is not None and use_pallas is None:
+        # same for a non-default scale (query_pre_attn_scalar without a
+        # sliding window): auto-dispatch falls back rather than raising
+        use_pallas = False
     if use_pallas is None:
         page_size = None if quantized else int(kv_pages.shape[3])
         use_pallas = _should_use_pallas(
@@ -278,7 +338,13 @@ def paged_attention(
         # must not quietly benchmark the XLA path
         from .pallas_paged_attention import paged_attention_pallas
 
+        if scale is not None:
+            raise ValueError(
+                "pallas paged attention does not take a scale override")
         return paged_attention_pallas(
             q, kv_pages, page_table, seq_lens, logit_softcap=logit_softcap
         )
-    return paged_attention_xla(q, kv_pages, page_table, seq_lens, logit_softcap)
+    return paged_attention_xla(
+        q, kv_pages, page_table, seq_lens, logit_softcap,
+        scale=scale, window=window,
+    )

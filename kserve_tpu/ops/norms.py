@@ -13,6 +13,17 @@ def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndar
     return (normed * weight.astype(jnp.float32)).astype(dtype)
 
 
+def rms_norm_plus_one(x: jnp.ndarray, weight: jnp.ndarray,
+                      eps: float = 1e-6) -> jnp.ndarray:
+    """Gemma-style RMSNorm: multiplies by (1 + weight), with the product
+    taken in f32 BEFORE the cast (HF PR #29402 semantics)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    normed = x32 * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (normed * (1.0 + weight.astype(jnp.float32))).astype(dtype)
+
+
 def layer_norm(x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray, eps: float = 1e-12) -> jnp.ndarray:
     dtype = x.dtype
     x32 = x.astype(jnp.float32)

@@ -93,6 +93,10 @@ def param_pspecs(config: LlamaConfig) -> Dict[str, Any]:
     if config.qk_norm:
         # per-head norm weights are [head_dim] — tiny, replicated
         layer.update({"q_norm": P(), "k_norm": P()})
+    if config.sandwich_norms:
+        layer.update({"post_attn_norm": P(), "post_mlp_norm": P()})
+    if config.sliding_window > 0:
+        layer.update({"attn_window": P()})
     specs: Dict[str, Any] = {
         "embed": P(MODEL_AXIS, None),  # vocab-sharded
         "final_norm": P(),

@@ -40,6 +40,22 @@ async def collect(engine, prompt, params):
 
 
 class TestEngine:
+    def test_tokenizer_vocab_overflow_rejected(self):
+        """A tokenizer whose ids can exceed the embedding table must be
+        rejected at init — under jit the lookups silently clamp, and the
+        host-side penalty prompt mask IndexErrors (found by a live drive
+        with ByteTokenizer(259) against a vocab-256 model)."""
+        import pytest
+
+        mc = LlamaConfig.tiny(dtype="float32", vocab_size=256)
+        with pytest.raises(ValueError, match="tokenizer vocab"):
+            LLMEngine(mc, EngineConfig(max_batch_size=2, page_size=8,
+                                       num_pages=16, max_pages_per_seq=4,
+                                       max_prefill_len=16,
+                                       prefill_buckets=(16,),
+                                       dtype="float32"),
+                      ByteTokenizer(256))  # clamps itself to >= 259
+
     @async_test
     async def test_generate_streams_tokens(self):
         engine = make_engine()

@@ -359,3 +359,165 @@ class TestQwen3Parity:
                     await engine.stop()
 
             assert outs == asyncio.run(run_base())
+
+
+class TestGemma2Parity:
+    def _build(self, sliding_window):
+        torch = pytest.importorskip("torch")
+        from transformers import Gemma2Config as HFGemma2Config
+        from transformers import Gemma2ForCausalLM
+
+        hf_config = HFGemma2Config(
+            vocab_size=128,
+            hidden_size=32,
+            intermediate_size=64,
+            num_hidden_layers=4,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            head_dim=8,
+            max_position_embeddings=64,
+            rope_theta=10000.0,
+            query_pre_attn_scalar=8,
+            attn_logit_softcapping=50.0,
+            final_logit_softcapping=30.0,
+            sliding_window=sliding_window,
+            tie_word_embeddings=True,
+        )
+        torch.manual_seed(0)
+        hf_model = Gemma2ForCausalLM(hf_config).eval()
+        config = LlamaConfig.from_hf_config(hf_config.to_dict())
+        config.dtype = "float32"
+        assert config.sandwich_norms and config.norm_plus_one
+        assert config.embed_scale and config.hidden_act == "gelu_tanh"
+        assert config.attn_logit_softcap == 50.0
+        assert config.logit_softcap == 30.0
+        assert config.attn_scale == 8 ** -0.5
+        params = _params_from_hf_gemma2(hf_model, config)
+        return torch, hf_model, config, params
+
+    @pytest.mark.parametrize("sliding_window", [64, 4])
+    def test_logits_match_transformers_gemma2(self, sliding_window):
+        """Gold parity incl. the sandwich norms, (1+w) RMSNorm, GeGLU,
+        embed scaling, split softcaps, query scale — and with
+        sliding_window=4 the per-layer window masking actually binds
+        (prompt length 8 > window)."""
+        torch, hf_model, config, params = self._build(sliding_window)
+        prompt = np.array([[1, 5, 9, 33, 77, 100, 2, 64]], dtype=np.int64)
+        with torch.no_grad():
+            ref = hf_model(torch.from_numpy(prompt)).logits.numpy()
+
+        cache_cfg, pages = make_cache(config)
+        page_ids = jnp.asarray([[1, 2, 0, 0, 0, 0, 0, 0]], jnp.int32)
+        got_last, pages = prefill(
+            params, config, jnp.asarray(prompt, jnp.int32), jnp.asarray([8]),
+            pages, page_ids, cache_cfg.page_size,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_last)[0], ref[0, -1], rtol=2e-3, atol=2e-3
+        )
+        # decode continuation must honor the window against the cache
+        with torch.no_grad():
+            ref9 = hf_model(torch.from_numpy(np.concatenate(
+                [prompt, [[42]]], axis=1))).logits.numpy()
+        got9, _ = decode_step(
+            params, config, jnp.asarray([42], jnp.int32),
+            jnp.asarray([8], jnp.int32), pages, page_ids,
+            jnp.asarray([True]), cache_cfg.page_size, use_pallas=False,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got9)[0], ref9[0, -1], rtol=2e-3, atol=2e-3
+        )
+
+    def test_layer_types_fallback_alternates(self):
+        """Raw hub config.json for Gemma-2 predates the layer_types key
+        (the even-sliding/odd-full alternation lived in HF modeling code);
+        from_hf_config must synthesize it, never window every layer."""
+        cfg = LlamaConfig.from_hf_config({
+            "model_type": "gemma2", "vocab_size": 64, "hidden_size": 16,
+            "intermediate_size": 32, "num_hidden_layers": 4,
+            "num_attention_heads": 2, "num_key_value_heads": 1,
+            "head_dim": 8, "sliding_window": 4,
+        })
+        assert cfg.layer_types == (
+            "sliding_attention", "full_attention",
+            "sliding_attention", "full_attention")
+        assert [cfg.layer_window(i) for i in range(4)] == [4, 0, 4, 0]
+        # no sliding_window -> no synthesized list at all
+        cfg2 = LlamaConfig.from_hf_config({
+            "model_type": "gemma2", "vocab_size": 64, "hidden_size": 16,
+            "intermediate_size": 32, "num_hidden_layers": 4,
+            "num_attention_heads": 2, "num_key_value_heads": 1,
+            "head_dim": 8, "sliding_window": None,
+        })
+        assert cfg2.layer_types is None and cfg2.sliding_window == 0
+
+    def test_gemma2_engine_serves(self):
+        """The windowed config serves end-to-end through the engine
+        (chunked prefill + decode against the paged cache)."""
+        import asyncio
+
+        from kserve_tpu.engine.engine import EngineConfig, LLMEngine
+        from kserve_tpu.engine.sampling import SamplingParams
+        from kserve_tpu.engine.tokenizer import ByteTokenizer
+
+        mc = LlamaConfig.tiny(
+            dtype="float32", norm_plus_one=True, sandwich_norms=True,
+            embed_scale=True, hidden_act="gelu_tanh",
+            attn_logit_softcap=50.0, logit_softcap=30.0,
+            query_pre_attn_scalar=16, sliding_window=8,
+            layer_types=("sliding_attention", "full_attention"),
+        )
+        cfg = EngineConfig(
+            max_batch_size=2, page_size=8, num_pages=32, max_pages_per_seq=4,
+            max_prefill_len=16, prefill_buckets=(16,), dtype="float32",
+            use_pallas=False,
+        )
+
+        async def run():
+            engine = LLMEngine(mc, cfg, ByteTokenizer(mc.vocab_size))
+            await engine.start()
+            try:
+                # 20-token prompt: chunked prefill + window binding
+                prompt = [(5 * i) % 200 + 3 for i in range(20)]
+                return [
+                    o.token_id async for o in engine.generate(
+                        prompt,
+                        SamplingParams(max_tokens=5, temperature=0.0,
+                                       ignore_eos=True))
+                ]
+            finally:
+                await engine.stop()
+
+        outs = asyncio.run(run())
+        assert len(outs) == 5
+
+
+def _params_from_hf_gemma2(hf_model, config):
+    """Gemma2 state_dict -> param pytree (4 norms + window leaves)."""
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    params = {
+        "embed": jnp.asarray(sd["model.embed_tokens.weight"], jnp.float32),
+        "final_norm": jnp.asarray(sd["model.norm.weight"], jnp.float32),
+        "layers": [],
+    }
+    mapping = {
+        "attn_norm": ("input_layernorm.weight", False),
+        "wq": ("self_attn.q_proj.weight", True),
+        "wk": ("self_attn.k_proj.weight", True),
+        "wv": ("self_attn.v_proj.weight", True),
+        "wo": ("self_attn.o_proj.weight", True),
+        "post_attn_norm": ("post_attention_layernorm.weight", False),
+        "mlp_norm": ("pre_feedforward_layernorm.weight", False),
+        "post_mlp_norm": ("post_feedforward_layernorm.weight", False),
+        "w_gate": ("mlp.gate_proj.weight", True),
+        "w_up": ("mlp.up_proj.weight", True),
+        "w_down": ("mlp.down_proj.weight", True),
+    }
+    for i in range(config.n_layers):
+        layer = {}
+        for ours, (suffix, transpose) in mapping.items():
+            w = sd[f"model.layers.{i}.{suffix}"]
+            layer[ours] = jnp.asarray(w.T if transpose else w, jnp.float32)
+        layer["attn_window"] = jnp.asarray(config.layer_window(i), jnp.int32)
+        params["layers"].append(layer)
+    return params

@@ -108,6 +108,21 @@ class TestPallasPagedAttention:
         assert not _should_use_pallas(**{**ok, "batch": 13})  # prime > MAX_SB
         assert not _should_use_pallas(**{**ok, "backend": "cpu"})
 
+    def test_scale_override_auto_falls_back(self):
+        """A non-default scale (query_pre_attn_scalar without a sliding
+        window) must auto-dispatch to the gather, not raise at trace time;
+        an explicit use_pallas=True stays loud."""
+        from kserve_tpu.ops.attention import PALLAS_MIN_PAGES, paged_attention
+
+        q, kv, pt, lens = make_case(B=8, d=64, max_pages=PALLAS_MIN_PAGES,
+                                    num_pages=PALLAS_MIN_PAGES * 8 + 1)
+        ref = paged_attention_xla(q, kv, pt, lens, scale=0.5)
+        got = paged_attention(q, kv, pt, lens, scale=0.5)  # auto
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        with pytest.raises(ValueError, match="scale override"):
+            paged_attention(q, kv, pt, lens, scale=0.5, use_pallas=True)
+
     def test_pick_sb_covers_odd_batches(self):
         assert _pick_sb(48) == 8
         assert _pick_sb(49) == 7
@@ -134,7 +149,7 @@ class TestShardedPagedAttention:
         mesh = self._mesh(tp)
         fn = make_sharded_paged_attention(mesh, interpret=True)
         ref = paged_attention_xla(q, kv, pt, lens)
-        got = jax.jit(fn)(q, kv, pt, lens)
+        got = jax.jit(fn)(q, kv, pt, lens, jnp.asarray(0, jnp.int32))
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
         assert float(jnp.max(jnp.abs(ref))) > 1e-3
@@ -148,9 +163,36 @@ class TestShardedPagedAttention:
         mesh = self._mesh(2)
         fn = make_sharded_paged_attention(mesh, use_pallas=False)
         ref = paged_attention_xla(q, kv, pt, lens)
-        got = jax.jit(fn)(q, kv, pt, lens)
+        got = jax.jit(fn)(q, kv, pt, lens, jnp.asarray(0, jnp.int32))
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
+
+    def test_windowed_under_tp(self):
+        """windowed=True (Gemma-2-class configs): the traced per-layer
+        scalar rides through to the gather path; numerics must match the
+        unsharded windowed reference."""
+        from kserve_tpu.ops.attention import make_sharded_paged_attention
+
+        q, kv, pt, lens = make_case(B=8, nq=8, nkv=4, d=64)
+        mesh = self._mesh(2)
+        fn = make_sharded_paged_attention(mesh, windowed=True)
+        w = jnp.asarray(4, jnp.int32)
+        ref = paged_attention_xla(q, kv, pt, lens, window=w)
+        got = jax.jit(fn)(q, kv, pt, lens, w)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        # the windowed result must actually differ from full attention
+        full = paged_attention_xla(q, kv, pt, lens)
+        assert float(jnp.max(jnp.abs(ref - full))) > 1e-3
+
+    def test_interpret_rejects_window_and_scale(self):
+        from kserve_tpu.ops.attention import make_sharded_paged_attention
+
+        mesh = self._mesh(2)
+        with pytest.raises(ValueError, match="neither"):
+            make_sharded_paged_attention(mesh, interpret=True, windowed=True)
+        with pytest.raises(ValueError, match="neither"):
+            make_sharded_paged_attention(mesh, interpret=True, scale=0.5)
 
     def test_engine_tp2_builds_sharded_decode(self):
         """The engine no longer forces use_pallas off under tp>1: the
