@@ -198,6 +198,35 @@ class LlamaConfig:
         )
 
     @staticmethod
+    def gemma2_2b() -> "LlamaConfig":
+        """Gemma-2-2B shape (sandwich norms, GeGLU, softcaps, alternating
+        4096-token sliding windows on even layers)."""
+        return LlamaConfig(
+            vocab_size=256000,
+            hidden_size=2304,
+            intermediate_size=9216,
+            n_layers=26,
+            n_heads=8,
+            n_kv_heads=4,
+            head_dim=256,
+            rope_theta=10000.0,
+            max_position_embeddings=8192,
+            tie_word_embeddings=True,
+            hidden_act="gelu_tanh",
+            norm_plus_one=True,
+            embed_scale=True,
+            sandwich_norms=True,
+            attn_logit_softcap=50.0,
+            logit_softcap=30.0,
+            query_pre_attn_scalar=256,
+            sliding_window=4096,
+            layer_types=tuple(
+                "sliding_attention" if i % 2 == 0 else "full_attention"
+                for i in range(26)),
+            rms_norm_eps=1e-6,
+        )
+
+    @staticmethod
     def from_hf_config(path_or_dict) -> "LlamaConfig":
         """Map a HuggingFace config.json (LlamaForCausalLM/MistralForCausalLM/
         Qwen2ForCausalLM) onto LlamaConfig."""

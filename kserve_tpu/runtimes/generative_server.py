@@ -53,6 +53,7 @@ _NAMED_CONFIGS = {
     "llama3-1b": llama.LlamaConfig.llama3_1b,
     "llama3-8b": llama.LlamaConfig.llama3_8b,
     "qwen3-0.6b": llama.LlamaConfig.qwen3_0_6b,
+    "gemma2-2b": llama.LlamaConfig.gemma2_2b,
 }
 
 

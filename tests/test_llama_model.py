@@ -428,6 +428,13 @@ class TestGemma2Parity:
             np.asarray(got9)[0], ref9[0, -1], rtol=2e-3, atol=2e-3
         )
 
+    def test_gemma2_2b_named_config(self):
+        cfg = LlamaConfig.gemma2_2b()
+        assert cfg.sandwich_norms and cfg.norm_plus_one and cfg.embed_scale
+        assert cfg.attn_scale == 256 ** -0.5
+        assert cfg.layer_window(0) == 4096 and cfg.layer_window(1) == 0
+        assert len(cfg.layer_types) == cfg.n_layers
+
     def test_layer_types_fallback_alternates(self):
         """Raw hub config.json for Gemma-2 predates the layer_types key
         (the even-sliding/odd-full alternation lived in HF modeling code);
