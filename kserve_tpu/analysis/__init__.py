@@ -1,0 +1,43 @@
+"""jaxlint: AST-based static analysis for JAX-serving correctness hazards.
+
+Usage::
+
+    python -m kserve_tpu.analysis kserve_tpu/ tests/
+
+Programmatic::
+
+    from kserve_tpu.analysis import lint_source, lint_paths
+    findings = lint_paths(["kserve_tpu"])
+
+Rules (see docs/static_analysis.md):
+
+- ``donated-buffer-reuse``  — read of a buffer after donate_argnums
+- ``recompile-hazard``      — bool()/int()/float()/.item() on traced values
+- ``blocking-async``        — time.sleep / sync HTTP / blocking IO in async
+- ``pspec-axis``            — PartitionSpec axis not in the mesh vocabulary
+- ``swallowed-exception``   — broad except that neither logs nor re-raises
+- ``host-sync``             — np.asarray/.tolist() in jit-traced step code
+
+Suppress per line with ``# jaxlint: disable=<rule>`` (justify it in the
+same comment) or per file with ``# jaxlint: disable-file=<rule>``.
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    Rule,
+    all_rules,
+    lint_file,
+    lint_paths,
+    lint_source,
+    register,
+)
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "all_rules",
+    "lint_file",
+    "lint_paths",
+    "lint_source",
+    "register",
+]

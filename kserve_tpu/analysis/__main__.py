@@ -1,0 +1,61 @@
+"""jaxlint CLI: ``python -m kserve_tpu.analysis [paths...]``.
+
+Exits non-zero when any finding survives suppression — wire it into CI
+next to the tier-1 pytest run (scripts/lint.sh does both).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .core import all_rules, lint_paths
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kserve_tpu.analysis",
+        description="AST-based lint for JAX-serving correctness hazards",
+    )
+    parser.add_argument("paths", nargs="*", default=["kserve_tpu"],
+                        help="files or directories to lint (default: kserve_tpu)")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule ids to run (default: all)")
+    parser.add_argument("--ignore", default=None,
+                        help="comma-separated rule ids to skip")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule registry and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rid, cls in sorted(all_rules().items()):
+            print(f"{rid:24s} {cls.description}")
+        return 0
+
+    # a typo'd path must not produce a vacuous "clean" exit 0 in CI
+    import os
+
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(f"jaxlint: no such path: {', '.join(missing)}", file=sys.stderr)
+        return 2
+    from .core import iter_python_files
+
+    if not any(True for _ in iter_python_files(args.paths)):
+        print("jaxlint: no Python files under the given paths", file=sys.stderr)
+        return 2
+
+    select = args.select.split(",") if args.select else None
+    ignore = args.ignore.split(",") if args.ignore else None
+    findings = lint_paths(args.paths, select=select, ignore=ignore)
+    for finding in findings:
+        print(finding.render())
+    if findings:
+        print(f"jaxlint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print("jaxlint: clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,210 @@
+"""jaxlint core: rule registry, suppression handling, and the file runner.
+
+The linter is pure-AST and imports nothing heavy (no jax, no numpy), so it
+can run in CI images that lack the accelerator stack.  Each rule is a
+subclass of :class:`Rule` registered via :func:`register`; a rule receives a
+:class:`FileContext` (source + parsed tree + shared per-file analyses) and
+yields :class:`Finding`s.
+
+Suppression syntax (checked by tests/test_jaxlint.py):
+
+- ``# jaxlint: disable=<rule>[,<rule>...]`` trailing on the flagged line
+  suppresses those rules for that line only.
+- ``# jaxlint: disable-file=<rule>[,<rule>...]`` anywhere in the file
+  suppresses those rules for the whole file.
+- The rule name ``all`` suppresses every rule.
+
+Every suppression in the real tree must carry a justification in the same
+comment (enforced by convention + review, counted in tests).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Type
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*disable(?P<scope>-file)?\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint hit: ``path:line:col  rule  message``."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class Suppressions:
+    file_rules: Set[str] = field(default_factory=set)
+    line_rules: Dict[int, Set[str]] = field(default_factory=dict)
+
+    def hides(self, finding: Finding) -> bool:
+        if "all" in self.file_rules or finding.rule in self.file_rules:
+            return True
+        rules = self.line_rules.get(finding.line, ())
+        return "all" in rules or finding.rule in rules
+
+
+def parse_suppressions(source: str) -> Suppressions:
+    """Directives are read from real COMMENT tokens only — a directive
+    inside a string literal (e.g. a lint-test fixture) must not suppress
+    anything in the file that contains it."""
+    import io
+    import tokenize
+
+    sup = Suppressions()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return sup  # unparseable source is reported as syntax-error anyway
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+        if m.group("scope"):
+            sup.file_rules |= rules
+        else:
+            sup.line_rules.setdefault(tok.start[0], set()).update(rules)
+    return sup
+
+
+class FileContext:
+    """Everything a rule needs about one file, computed once."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.suppressions = parse_suppressions(source)
+        self._traced: Optional[set] = None  # filled lazily by jaxutil
+
+    def traced_functions(self) -> set:
+        """Set of FunctionDef/AsyncFunctionDef/Lambda nodes whose bodies
+        run under jax tracing (see jaxutil.traced_function_nodes)."""
+        if self._traced is None:
+            from .jaxutil import traced_function_nodes
+
+            self._traced = traced_function_nodes(self.tree)
+        return self._traced
+
+
+class Rule:
+    """Base class for jaxlint rules.  Subclasses set ``id`` (the name used
+    in suppression comments) and ``description``, and implement
+    :meth:`check`."""
+
+    id: str = ""
+    description: str = ""
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, ctx: FileContext, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=self.id,
+            path=ctx.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+        )
+
+
+_REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    if not cls.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if cls.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {cls.id!r}")
+    _REGISTRY[cls.id] = cls
+    return cls
+
+
+def all_rules() -> Dict[str, Type[Rule]]:
+    # importing the rules package populates the registry
+    from . import rules  # noqa: F401
+
+    return dict(_REGISTRY)
+
+
+def lint_source(
+    source: str,
+    path: str = "<string>",
+    select: Optional[Iterable[str]] = None,
+    ignore: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Lint one source string; returns unsuppressed findings sorted by
+    position.  ``select``/``ignore`` filter by rule id."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return [
+            Finding(
+                rule="syntax-error",
+                path=path,
+                line=exc.lineno or 1,
+                col=exc.offset or 0,
+                message=f"file does not parse: {exc.msg}",
+            )
+        ]
+    ctx = FileContext(path, source, tree)
+    wanted = all_rules()
+    if select:
+        keep = set(select)
+        wanted = {rid: r for rid, r in wanted.items() if rid in keep}
+    if ignore:
+        drop = set(ignore)
+        wanted = {rid: r for rid, r in wanted.items() if rid not in drop}
+    findings: List[Finding] = []
+    for rule_cls in wanted.values():
+        for finding in rule_cls().check(ctx):
+            if not ctx.suppressions.hides(finding):
+                findings.append(finding)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_file(path, select=None, ignore=None) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    return lint_source(source, path=str(path), select=select, ignore=ignore)
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    import os
+
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git", ".venv", "node_modules")
+                )
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+        elif p.endswith(".py"):
+            yield p
+
+
+def lint_paths(paths: Iterable[str], select=None, ignore=None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        findings.extend(lint_file(path, select=select, ignore=ignore))
+    return findings

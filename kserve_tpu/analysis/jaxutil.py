@@ -1,0 +1,119 @@
+"""Shared AST helpers: dotted call names and jit-traced-function detection.
+
+"Traced" means the function body executes under ``jax.jit`` tracing, where
+host-side effects (``.item()``, ``np.asarray``, ``bool(tracer)``) are either
+trace-time errors or silent performance hazards.  Detection is per-file and
+deliberately conservative — a function is traced when we can *prove* it from
+this file alone:
+
+1. decorated with ``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)``;
+2. passed by name as the first argument of a ``jax.jit(...)`` call;
+3. defined inside — and returned by — a factory whose *call result* is
+   passed to ``jax.jit`` (the ``jax.jit(_make_decode(...))`` idiom used by
+   engine/compiled.py), including inner defs the factory returns via a
+   local helper name.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional, Set
+
+JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+PARTIAL_NAMES = {"partial", "functools.partial"}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None (calls, subscripts
+    and anything dynamic break the chain)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)
+
+
+def _is_jit_callable(node: ast.AST) -> bool:
+    """True for an expression that IS the jit transform: ``jax.jit`` or a
+    ``partial(jax.jit, ...)`` wrapping it."""
+    if dotted_name(node) in JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call) and dotted_name(node.func) in PARTIAL_NAMES:
+        return bool(node.args) and _is_jit_callable(node.args[0])
+    return False
+
+
+def _returned_local_functions(fn: ast.FunctionDef) -> Set[ast.AST]:
+    """Inner FunctionDefs that ``fn`` returns (directly by name)."""
+    local: Dict[str, ast.AST] = {}
+    for stmt in ast.walk(fn):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) and stmt is not fn:
+            local[stmt.name] = stmt
+    out: Set[ast.AST] = set()
+    for stmt in ast.walk(fn):
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            if isinstance(stmt.value, ast.Name) and stmt.value.id in local:
+                out.add(local[stmt.value.id])
+            elif isinstance(stmt.value, ast.Lambda):
+                out.add(stmt.value)
+    return out
+
+
+def traced_function_nodes(tree: ast.Module) -> Set[ast.AST]:
+    by_name: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, node)
+
+    traced: Set[ast.AST] = set()
+
+    # 1. decorator form
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if _is_jit_callable(deco):
+                    traced.add(node)
+
+    # 2./3. call-site forms
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_jit_callable(node.func)):
+            continue
+        if not node.args:
+            continue
+        target = node.args[0]
+        if isinstance(target, ast.Name) and target.id in by_name:
+            traced.add(by_name[target.id])
+        elif isinstance(target, ast.Lambda):
+            traced.add(target)
+        elif isinstance(target, ast.Call):
+            factory = dotted_name(target.func)
+            if factory and factory in by_name:
+                fnode = by_name[factory]
+                if isinstance(fnode, ast.FunctionDef):
+                    traced.update(_returned_local_functions(fnode))
+    return traced
+
+
+def walk_function_body(fn: ast.AST, *, skip_nested_defs: bool = False):
+    """Yield nodes in a function body.  With ``skip_nested_defs`` the
+    subtrees of nested (non-lambda) function definitions are not entered —
+    used by the async-blocking rule, where a sync helper defined inside an
+    ``async def`` (e.g. a thunk handed to ``run_in_executor``) legitimately
+    blocks."""
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if skip_nested_defs and isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))

@@ -1,0 +1,15 @@
+"""jaxlint rule modules — importing this package registers every rule.
+
+Add a new rule by dropping a module here that subclasses
+:class:`kserve_tpu.analysis.core.Rule` and decorating it with
+:func:`kserve_tpu.analysis.core.register`, then importing it below.
+"""
+
+from . import (  # noqa: F401
+    blocking,
+    donation,
+    excepts,
+    hostsync,
+    pspec,
+    recompile,
+)

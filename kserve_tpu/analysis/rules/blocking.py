@@ -1,0 +1,105 @@
+"""Rule ``blocking-async``: blocking calls on the event loop.
+
+The data plane (REST/gRPC/OpenAI protocol servers, the engine submit path,
+the scheduler) is async; one ``time.sleep`` or sync HTTP call inside an
+``async def`` stalls *every* in-flight request on that loop — the serving
+papers' "hidden host sync" applied to the request path.  Flagged inside
+``async def`` bodies:
+
+- ``time.sleep``
+- sync HTTP: module-level ``requests.*`` / ``httpx.*`` verbs,
+  ``urllib.request.urlopen``
+- ``subprocess.run/call/check_call/check_output``, ``os.system``
+- blocking file IO via bare ``open(...)``
+- ``<x>.block_until_ready()`` (host-device sync)
+
+``time.sleep`` is additionally flagged *anywhere*: in this codebase a
+sleep should be ``asyncio.sleep`` (async), a stop-responsive
+``Event.wait`` (thread loops), or carry a justified suppression
+(dedicated daemon poll loops).
+
+Sync helpers *defined inside* an async def (e.g. thunks handed to
+``run_in_executor``) are exempt — nested non-async defs are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import FileContext, Finding, Rule, register
+from ..jaxutil import dotted_name, walk_function_body
+
+_HTTP_VERBS = {"get", "post", "put", "delete", "head", "options", "patch",
+               "request", "stream", "send"}
+_SUBPROCESS = {"subprocess.run", "subprocess.call", "subprocess.check_call",
+               "subprocess.check_output", "os.system"}
+
+
+def _blocking_reason(node: ast.Call) -> Optional[str]:
+    name = dotted_name(node.func)
+    if name == "time.sleep":
+        return "time.sleep blocks the event loop; use asyncio.sleep"
+    if name == "urllib.request.urlopen" or name == "urlopen":
+        return "urllib.request.urlopen is synchronous; use aiohttp/httpx.AsyncClient"
+    if name in _SUBPROCESS:
+        return f"{name} blocks; use asyncio.create_subprocess_* or a thread"
+    if name == "open":
+        return "blocking file IO on the event loop; use a thread executor"
+    if name is not None and "." in name:
+        base, attr = name.split(".", 1)
+        if base in ("requests", "httpx") and attr in _HTTP_VERBS:
+            return (f"{name} is a synchronous HTTP call; use aiohttp or "
+                    "httpx.AsyncClient")
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "block_until_ready":
+        return ("block_until_ready is a host-device sync; await an executor "
+                "or restructure the step")
+    return None
+
+
+@register
+class BlockingInAsync(Rule):
+    id = "blocking-async"
+    description = (
+        "blocking call (time.sleep, sync HTTP, blocking IO, "
+        "block_until_ready) inside an async def — stalls the event loop"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        handled = set()
+        executor_thunks = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            for sub in walk_function_body(node, skip_nested_defs=True):
+                if isinstance(sub, ast.FunctionDef):
+                    # a sync helper defined inside an async def is an
+                    # executor-destined thunk: exempt from both passes
+                    executor_thunks.add(sub)
+                if not isinstance(sub, ast.Call):
+                    continue
+                reason = _blocking_reason(sub)
+                if reason:
+                    handled.add(sub)
+                    yield self.finding(
+                        ctx, sub, f"in 'async def {node.name}': {reason}"
+                    )
+        for thunk in executor_thunks:
+            handled.update(
+                n for n in ast.walk(thunk) if isinstance(n, ast.Call)
+            )
+        # time.sleep is a hazard even in sync code here: thread loops
+        # should use a stop-responsive Event.wait, clients asyncio.sleep
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Call)
+                and node not in handled
+                and dotted_name(node.func) == "time.sleep"
+            ):
+                yield self.finding(
+                    ctx,
+                    node,
+                    "time.sleep in server code: use asyncio.sleep (async), "
+                    "a stop-responsive Event.wait (thread loops), or "
+                    "suppress with justification (dedicated daemons)",
+                )

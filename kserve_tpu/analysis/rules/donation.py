@@ -1,0 +1,207 @@
+"""Rule ``donated-buffer-reuse``: a buffer passed through a
+``donate_argnums``/``donate_argnames`` position is dead after the call —
+XLA may have aliased its memory to the output.  Reading it afterwards
+returns garbage (or raises on TPU), and it does so *silently* on CPU test
+runs, which is exactly why a static pass has to catch it.
+
+Ground truth for the donation-site shapes this rule understands: the six
+``jax.jit(..., donate_argnums=...)`` sites in engine/compiled.py — name
+bindings, keyword-constructor bindings, and immediately-invoked jits.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import FileContext, Finding, Rule, register
+from ..jaxutil import JIT_NAMES, dotted_name
+
+
+def _donation_spec(call: ast.Call) -> Optional[Tuple[Set[int], Set[str]]]:
+    """(argnums, argnames) if ``call`` is a jit call that donates, else
+    None.  Handles ``jax.jit(f, donate_argnums=(3,))`` and single-int
+    forms."""
+    if dotted_name(call.func) not in JIT_NAMES:
+        return None
+    argnums: Set[int] = set()
+    argnames: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            argnums |= _int_literals(kw.value)
+        elif kw.arg == "donate_argnames":
+            argnames |= _str_literals(kw.value)
+    if argnums or argnames:
+        return argnums, argnames
+    return None
+
+
+def _int_literals(node: ast.AST) -> Set[int]:
+    out: Set[int] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.add(elt.value)
+    return out
+
+
+def _str_literals(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.add(elt.value)
+    return out
+
+
+def _collect_donating_callables(tree: ast.Module) -> Dict[str, Tuple[Set[int], Set[str]]]:
+    """Names bound (anywhere in the file) to a donating jit: covers
+    ``f = jax.jit(g, donate_argnums=...)`` and attribute bindings like
+    ``self.decode = jax.jit(...)`` (keyed by the full dotted target)."""
+    out: Dict[str, Tuple[Set[int], Set[str]]] = {}
+    for node in ast.walk(tree):
+        value = None
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            value, targets = node.value, node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            value, targets = node.value, [node.target]
+        if not isinstance(value, ast.Call):
+            continue
+        spec = _donation_spec(value)
+        if spec is None:
+            continue
+        for target in targets:
+            name = dotted_name(target)
+            if name:
+                out[name] = spec
+    return out
+
+
+@register
+class DonatedBufferReuse(Rule):
+    id = "donated-buffer-reuse"
+    description = (
+        "an array passed at a donate_argnums/donate_argnames position is "
+        "invalidated by the call; any later read sees aliased memory"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        donating = _collect_donating_callables(ctx.tree)
+        scopes: List[List[ast.stmt]] = [ctx.tree.body]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node.body)
+        for body in scopes:
+            yield from self._scan_block(ctx, body, donating, {})
+
+    # ---- linear dataflow over one statement block ----
+
+    def _scan_block(self, ctx, stmts, donating, dead: Dict[str, int]):
+        """``dead`` maps variable name -> line where it was donated.
+        Branches recurse with a copy of ``dead``: a donation inside one
+        branch does not poison code after the branch (conservative — no
+        false positives from paths that may not execute)."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.If, ast.While)):
+                yield from self._scan_exprs(ctx, [stmt.test], donating, dead)
+                for branch in (stmt.body, stmt.orelse):
+                    yield from self._scan_block(ctx, branch, donating, dict(dead))
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                yield from self._scan_exprs(ctx, [stmt.iter], donating, dead)
+                dead.pop(getattr(stmt.target, "id", None), None)
+                for branch in (stmt.body, stmt.orelse):
+                    yield from self._scan_block(ctx, branch, donating, dict(dead))
+                continue
+            if isinstance(stmt, ast.Try):
+                for branch in (stmt.body, stmt.orelse, stmt.finalbody):
+                    yield from self._scan_block(ctx, branch, donating, dict(dead))
+                for handler in stmt.handlers:
+                    yield from self._scan_block(ctx, handler.body, donating, dict(dead))
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                yield from self._scan_exprs(
+                    ctx, [i.context_expr for i in stmt.items], donating, dead
+                )
+                # with-bodies execute unconditionally: propagate, don't copy
+                yield from self._scan_block(ctx, stmt.body, donating, dead)
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue  # separate scope — check() scans each on its own
+
+            # linear statement: reads of dead names, then rebinds, then
+            # new donations
+            yield from self._scan_exprs(ctx, [stmt], donating, dead, collect=False)
+            rebound = self._bound_names(stmt)
+            for name in rebound:
+                dead.pop(name, None)
+            for call in (n for n in ast.walk(stmt) if isinstance(n, ast.Call)):
+                for name, line in self._donated_args(call, donating):
+                    # `kv = f(kv)` rebinds to the result — the correct idiom
+                    if name not in rebound:
+                        dead[name] = line
+
+    def _scan_exprs(self, ctx, nodes, donating, dead, collect: bool = True):
+        """Flag reads of dead names inside ``nodes``; with ``collect``,
+        also record donations made by calls there."""
+        for root in nodes:
+            if root is None:
+                continue
+            for node in ast.walk(root):
+                if (
+                    isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in dead
+                ):
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"'{node.id}' was donated to a jit-compiled call on "
+                        f"line {dead[node.id]} and must not be read afterwards "
+                        "(its buffer may be aliased to the output)",
+                    )
+                    dead.pop(node.id, None)  # report once per name
+                if collect and isinstance(node, ast.Call):
+                    for name, line in self._donated_args(node, donating):
+                        dead[name] = line
+
+    @staticmethod
+    def _bound_names(stmt: ast.stmt) -> Set[str]:
+        out: Set[str] = set()
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                for node in ast.walk(t):
+                    if isinstance(node, ast.Name):
+                        out.add(node.id)
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            if isinstance(stmt.target, ast.Name):
+                out.add(stmt.target.id)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        return out
+
+    @staticmethod
+    def _donated_args(call: ast.Call, donating) -> Iterator[Tuple[str, int]]:
+        spec = None
+        name = dotted_name(call.func)
+        if name is not None and name in donating:
+            spec = donating[name]
+        elif isinstance(call.func, ast.Call):
+            # immediately-invoked: jax.jit(f, donate_argnums=(0,))(x)
+            spec = _donation_spec(call.func)
+        if spec is None:
+            return
+        argnums, argnames = spec
+        for i, arg in enumerate(call.args):
+            if i in argnums and isinstance(arg, ast.Name):
+                yield arg.id, call.lineno
+        for kw in call.keywords:
+            if kw.arg in argnames and isinstance(kw.value, ast.Name):
+                yield kw.value.id, call.lineno

@@ -1,0 +1,79 @@
+"""Rule ``swallowed-exception``: a bare/broad ``except`` that neither
+logs, re-raises, nor converts to a typed ``kserve_tpu.errors`` error.
+
+In a serving stack a swallowed exception is a wrong answer served with a
+200: the reconciler that silently skips an object, the storage download
+whose failure surfaces three layers later as "model not ready".  Broad
+catches are legitimate at daemon/loop boundaries — but only when they
+*log with context* or translate to a typed error; anything else must
+narrow the exception type or carry a justified suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import FileContext, Finding, Rule, register
+from ..jaxutil import dotted_name
+
+_BROAD = {"Exception", "BaseException"}
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "log"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    name = dotted_name(handler.type)
+    return name is not None and name.split(".")[-1] in _BROAD
+
+
+def _handler_disposes(handler: ast.ExceptHandler) -> bool:
+    """True when the handler raises, logs, warns, or relays the exception
+    to a waiter via ``fut.set_exception(exc)`` somewhere in its body
+    (nested defs excluded — a callback defined in the handler does not
+    handle this exception)."""
+    stack = list(handler.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name in ("warnings.warn", "traceback.print_exc"):
+                return True
+            if isinstance(node.func, ast.Attribute) and (
+                node.func.attr in _LOG_METHODS
+                or node.func.attr == "set_exception"
+            ):
+                return True
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+@register
+class SwallowedException(Rule):
+    id = "swallowed-exception"
+    description = (
+        "broad 'except Exception' that neither logs, re-raises, nor "
+        "converts to a typed kserve_tpu.errors error"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _is_broad(node) and not _handler_disposes(node):
+                what = (
+                    "bare except" if node.type is None
+                    else f"except {dotted_name(node.type)}"
+                )
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"{what} swallows the error: narrow the type, log with "
+                    "context, or re-raise as a typed kserve_tpu.errors error",
+                )

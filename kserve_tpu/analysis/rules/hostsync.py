@@ -1,0 +1,59 @@
+"""Rule ``host-sync``: implicit device-to-host transfers inside
+jit-traced code.  ``np.asarray(x)`` / ``np.array(x)`` / ``x.tolist()`` /
+``jax.device_get(x)`` on a traced value pulls the array to the host —
+inside the decode/prefill step functions that is a per-token sync that
+serializes the TPU against the Python thread and destroys decode
+throughput.  Keep the math in jnp; convert on the host *after* the step
+returns.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import FileContext, Finding, Rule, register
+from ..jaxutil import dotted_name
+
+_TRANSFER_CALLS = {
+    "np.asarray", "np.array", "np.copy", "numpy.asarray", "numpy.array",
+    "onp.asarray", "onp.array", "jax.device_get", "device_get",
+}
+_TRANSFER_METHODS = {"tolist", "to_py"}
+
+
+@register
+class HostSyncInTracedCode(Rule):
+    id = "host-sync"
+    description = (
+        "np.asarray/.tolist()/device_get inside a jit-traced function: a "
+        "device-to-host transfer on the hot path"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for fn in ctx.traced_functions():
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for root in body:
+                for node in ast.walk(root):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = dotted_name(node.func)
+                    if name in _TRANSFER_CALLS:
+                        yield self.finding(
+                            ctx,
+                            node,
+                            f"{name}() inside a jit-traced function is a "
+                            "device-to-host transfer; use jnp and convert "
+                            "after the step returns",
+                        )
+                    elif (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _TRANSFER_METHODS
+                        and not node.args
+                    ):
+                        yield self.finding(
+                            ctx,
+                            node,
+                            f".{node.func.attr}() inside a jit-traced "
+                            "function syncs device to host on the hot path",
+                        )

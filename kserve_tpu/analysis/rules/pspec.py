@@ -1,0 +1,106 @@
+"""Rule ``pspec-axis``: every string axis name in a ``PartitionSpec``
+literal must come from the mesh axis vocabulary declared in
+``kserve_tpu/parallel/sharding.py`` (``DATA_AXIS``/``SEQ_AXIS``/
+``PIPE_AXIS``/``MODEL_AXIS``).  A typo'd or stale axis name does not
+error — ``PartitionSpec("modle")`` simply fails to shard (or shards over
+a mesh axis that no longer exists after a mesh refactor), silently
+replicating a tensor that was meant to be distributed.
+
+References through the named constants (``shd.MODEL_AXIS``) are always
+fine — they cannot drift from the vocabulary.  The vocabulary is read
+from sharding.py's AST at lint time, so adding an axis there teaches the
+rule automatically.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator, Optional, Set
+
+from ..core import FileContext, Finding, Rule, register
+from ..jaxutil import dotted_name
+
+_FALLBACK_VOCAB = {"data", "seq", "pipe", "model"}
+_vocab_cache: Optional[Set[str]] = None
+
+
+def mesh_axis_vocabulary() -> Set[str]:
+    """``*_AXIS = "<name>"`` module-level constants from
+    parallel/sharding.py; falls back to the known axes if the file moved."""
+    global _vocab_cache
+    if _vocab_cache is not None:
+        return _vocab_cache
+    sharding_py = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        os.pardir, "parallel", "sharding.py",
+    )
+    vocab: Set[str] = set()
+    try:
+        with open(os.path.normpath(sharding_py), encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+        for node in tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id.endswith("_AXIS")
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+            ):
+                vocab.add(node.value.value)
+    except (OSError, SyntaxError):
+        pass
+    _vocab_cache = vocab or set(_FALLBACK_VOCAB)
+    return _vocab_cache
+
+
+def _pspec_call_names(tree: ast.Module) -> Set[str]:
+    """Local names that refer to jax.sharding.PartitionSpec ('P' only
+    counts when the import says so — plenty of code uses P for other
+    things)."""
+    names = {"PartitionSpec", "jax.sharding.PartitionSpec", "sharding.PartitionSpec"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and (
+            node.module == "jax.sharding" or node.module.endswith(".sharding")
+        ):
+            for alias in node.names:
+                if alias.name == "PartitionSpec":
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+@register
+class PartitionSpecAxis(Rule):
+    id = "pspec-axis"
+    description = (
+        "string axis in a PartitionSpec literal not in the mesh axis "
+        "vocabulary declared by parallel/sharding.py — silently fails "
+        "to shard"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        vocab = mesh_axis_vocabulary()
+        pspec_names = _pspec_call_names(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name not in pspec_names:
+                continue
+            for arg in node.args:
+                yield from self._check_axis(ctx, arg, vocab)
+
+    def _check_axis(self, ctx, node: ast.AST, vocab) -> Iterator[Finding]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if node.value not in vocab:
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"axis {node.value!r} is not a declared mesh axis "
+                    f"({', '.join(sorted(vocab))}); use the *_AXIS constants "
+                    "from parallel/sharding.py",
+                )
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for elt in node.elts:
+                yield from self._check_axis(ctx, elt, vocab)

@@ -1,0 +1,84 @@
+"""Rule ``recompile-hazard``: host-side concretization inside jit-traced
+code.  ``bool(x)`` / ``float(x)`` / ``int(x)`` on a traced value either
+raises a ConcretizationTypeError at trace time or — when the value happens
+to be a weakly-typed Python scalar that changed — silently retraces and
+recompiles the whole program, which is the classic cause of multi-second
+tail-latency spikes in a serving step loop.  ``.item()`` is the same
+hazard spelled as a method.
+
+Static shapes are fine: casts whose argument goes through ``.shape``,
+``.ndim``, ``.size`` or ``len(...)`` are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import FileContext, Finding, Rule, register
+from ..jaxutil import dotted_name
+
+_CASTS = {"bool", "float", "int"}
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype"}
+
+
+def _is_static_expr(node: ast.AST) -> bool:
+    """True when the cast argument is trace-time static: literals, shape /
+    ndim / dtype attribute chains, len() calls, or arithmetic over those."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute):
+        return node.attr in _STATIC_ATTRS or _is_static_expr(node.value)
+    if isinstance(node, ast.Subscript):
+        return _is_static_expr(node.value)
+    if isinstance(node, ast.Call):
+        return dotted_name(node.func) == "len"
+    if isinstance(node, ast.BinOp):
+        return _is_static_expr(node.left) and _is_static_expr(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _is_static_expr(node.operand)
+    return False
+
+
+@register
+class RecompileHazard(Rule):
+    id = "recompile-hazard"
+    description = (
+        "bool()/int()/float()/.item() on a traced value inside jit forces "
+        "concretization: a trace-time error or a silent recompile"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for fn in ctx.traced_functions():
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for root in body:
+                for node in ast.walk(root):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = dotted_name(node.func)
+                    if (
+                        name in _CASTS
+                        and len(node.args) == 1
+                        and not node.keywords
+                        and not _is_static_expr(node.args[0])
+                    ):
+                        yield self.finding(
+                            ctx,
+                            node,
+                            f"{name}() on a traced value inside a jit-compiled "
+                            "function concretizes it (trace error or silent "
+                            "recompile); use jnp ops or mark the argument "
+                            "static",
+                        )
+                    elif (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item"
+                        and not node.args
+                    ):
+                        yield self.finding(
+                            ctx,
+                            node,
+                            ".item() inside a jit-compiled function forces a "
+                            "device-to-host transfer per step; keep the value "
+                            "on device",
+                        )

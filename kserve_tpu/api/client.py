@@ -77,7 +77,9 @@ class KServeClient:
                 self.transport.reconcile_all()
             if self.is_isvc_ready(name, namespace):
                 return self.get("InferenceService", name, namespace)
-            time.sleep(polling_interval)
+            # sync SDK surface: callers are operator CLIs/tests off the
+            # event loop, so a real sleep is the contract here
+            time.sleep(polling_interval)  # jaxlint: disable=blocking-async
         raise TimeoutError(
             f"InferenceService {namespace}/{name} not Ready after "
             f"{timeout_seconds}s"

@@ -291,5 +291,7 @@ class HTTPCluster:
                 self._request("GET", "/readyz")
                 return
             except (APIError, OSError):
-                time.sleep(0.2)
+                # sync bootstrap client: runs before any event loop exists
+                # (manager/agent main() readiness gate)
+                time.sleep(0.2)  # jaxlint: disable=blocking-async
         raise TimeoutError(f"apiserver at {self.base_url} not ready")

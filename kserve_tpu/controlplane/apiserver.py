@@ -368,7 +368,9 @@ class APIServerStub:
         if method in ("POST", "PUT", "PATCH"):
             try:
                 body = await request.json(loads=json.loads)
-            except Exception:  # noqa: BLE001
+            except ValueError:
+                # kubectl applies YAML bodies; anything that is neither
+                # valid JSON nor YAML fails below in yaml.safe_load
                 import yaml
 
                 body = yaml.safe_load(await request.text())

@@ -13,6 +13,7 @@ Parity role: cmd/manager/main.go wiring + envtest bootstrap
 
 from __future__ import annotations
 
+import http.client
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from .crds import (
@@ -508,8 +509,12 @@ class ControllerManager:
             try:
                 children = self.cluster.list(
                     kind, None if cluster_scoped else owner_ns)
-            except Exception:  # noqa: BLE001 — a type the store doesn't
-                continue  # serve (stripped-down apiserver) prunes nothing
+            except (KeyError, OSError, RuntimeError,
+                    http.client.HTTPException):
+                continue  # a type the store doesn't serve (stripped-down
+                # apiserver, KeyError from discovery; APIError is a
+                # RuntimeError; IncompleteRead on a dropped body) prunes
+                # nothing
             for obj in children:
                 meta = obj.get("metadata", {})
                 if not cluster_scoped and meta.get("namespace") != owner_ns:

@@ -494,7 +494,7 @@ class LLMISVCReconciler:
                     tls_mod.CERT_SECRET_KEY, ""))
                 key_pem = base64.b64decode(data.get(
                     tls_mod.KEY_SECRET_KEY, ""))
-            except Exception:  # noqa: BLE001 — corrupt data: regenerate
+            except (ValueError, TypeError):  # corrupt base64: regenerate
                 cert_pem = key_pem = b""
             # the key must be present too: a Secret with a valid cert but
             # a lost/corrupt key would crash-loop every server mounting it

@@ -189,7 +189,9 @@ def main(argv=None) -> int:
             daemon.sync_once()
         except Exception:  # noqa: BLE001 — the daemon must outlive blips
             logger.warning("localmodelnode sync failed", exc_info=True)
-        time.sleep(args.poll_interval)
+        # dedicated daemon poll loop in the agent's main thread — there is
+        # no event loop to starve and no stop signal beyond SIGTERM
+        time.sleep(args.poll_interval)  # jaxlint: disable=blocking-async
 
 
 if __name__ == "__main__":

@@ -30,6 +30,7 @@ from typing import Optional
 from ..api.http_transport import APIError, HTTPCluster
 from ..logging import logger
 from .cluster import ControllerManager
+from .registry import RuntimeSelectionError
 
 # the pod webhook keys off this annotation — a pod created by anything
 # (our controller, a user Deployment) is injected at admission time
@@ -249,7 +250,7 @@ class Manager:
         resource_version: Optional[str] = None
         while not self._stop.is_set():
             if self.elector and not self.elector.is_leader.is_set():
-                time.sleep(0.2)
+                self._stop.wait(0.2)
                 continue
             if resource_version is None:
                 # list-then-watch: resume from the COLLECTION rv, never
@@ -257,7 +258,7 @@ class Manager:
                 # objects deleted while we were away
                 resource_version = self._initial_sync_kind(kind)
                 if resource_version is None:
-                    time.sleep(0.5)
+                    self._stop.wait(0.5)
                     continue
             try:
                 for event_type, obj in self.cluster.watch(
@@ -288,7 +289,7 @@ class Manager:
                 if self._stop.is_set():
                     return
                 logger.debug("watch on %s broke; re-listing", kind)
-                time.sleep(0.5)
+                self._stop.wait(0.5)
                 resource_version = None
 
     def _initial_sync_kind(self, kind: str) -> Optional[str]:
@@ -344,7 +345,7 @@ class Manager:
                     "serving.kserve.io CRDs not served after 60s — "
                     "apply config/crd first")
             logger.info("waiting for serving.kserve.io CRDs to be served")
-            time.sleep(1.0)
+            self._stop.wait(1.0)
         if self._stop.is_set():
             return
         self.cm = self._build_cm()
@@ -482,7 +483,9 @@ class AdmissionServer:
         try:
             obj = cls.model_validate(runtime)
             self._registry_cls().add(obj)  # validation rules live in add()
-        except Exception as exc:  # noqa: BLE001 — message goes on the wire
+        except (ValueError, RuntimeSelectionError) as exc:
+            # pydantic ValidationError is a ValueError; the message goes
+            # on the wire as the admission rejection
             return str(exc)
         return None
 
@@ -664,9 +667,10 @@ def main(argv=None) -> int:
     manager.start()
     logger.info("controller manager started (watching %d kinds)",
                 len(WATCHED_KINDS))
+    park = threading.Event()  # never set — Ctrl-C is the only exit
     try:
-        while True:
-            time.sleep(3600)
+        while not park.is_set():
+            park.wait(3600)
     except KeyboardInterrupt:
         pass
     finally:

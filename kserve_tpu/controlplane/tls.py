@@ -14,6 +14,8 @@ import ipaddress
 import ssl
 from typing import List, Optional, Tuple
 
+from ..logging import logger
+
 CERT_SECRET_KEY = "tls.crt"
 KEY_SECRET_KEY = "tls.key"
 EXPIRATION_ANNOTATION = "serving.kserve.io/certificate-expiration"
@@ -130,7 +132,11 @@ def should_recreate_certificate(
     try:
         not_after = cert_not_after(cert_pem)
         dns, ips = cert_sans(cert_pem)
-    except Exception:  # noqa: BLE001 — any undecodable cert gets replaced
+    except Exception:  # noqa: BLE001 — ANY undecodable cert gets replaced
+        # (malformed PEM raises ValueError, but extension parsing can
+        # raise direct Exception subclasses like x509.DuplicateExtension;
+        # regeneration must cover all of them, not crash-loop the reconciler)
+        logger.warning("undecodable certificate; regenerating", exc_info=True)
         return True
     now = now or datetime.datetime.now(datetime.timezone.utc)
     if now + datetime.timedelta(days=RENEW_BUFFER_DAYS) >= not_after:

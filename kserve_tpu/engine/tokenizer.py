@@ -10,6 +10,8 @@ from __future__ import annotations
 import os
 from typing import Dict, List, Optional, Sequence
 
+from ..logging import logger
+
 
 class BaseTokenizer:
     eos_token_id: int = -1
@@ -73,6 +75,7 @@ class HFTokenizer(BaseTokenizer):
         self.eos_token_id = -1
         self.bos_token_id = -1
         self._chat_template = None
+        self._template_warned = False
         # read special tokens + chat template from tokenizer_config.json
         cfg_path = os.path.join(model_dir, "tokenizer_config.json")
         if os.path.exists(cfg_path):
@@ -119,8 +122,15 @@ class HFTokenizer(BaseTokenizer):
                     eos_token="",
                     **kwargs,
                 )
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — template syntax varies by model
+                # a broken template fails identically on every request:
+                # warn once with the traceback, then fall back silently
+                # (this runs per chat request — no hot-path log spam)
+                if not self._template_warned:
+                    self._template_warned = True
+                    logger.warning(
+                        "chat template render failed; falling back to the "
+                        "default template", exc_info=True)
         return super().apply_chat_template(messages, add_generation_prompt, **kwargs)
 
 

@@ -143,7 +143,9 @@ class _DeadlineFetcher:
             fn, box, done = item
             try:
                 box.append(("ok", fn()))
-            except BaseException as exc:  # noqa: BLE001 — relayed to caller
+            # the exception object itself is relayed to the waiting caller
+            # through box and re-raised there — nothing is swallowed
+            except BaseException as exc:  # jaxlint: disable=swallowed-exception
                 box.append(("err", exc))
             done.set()
 

@@ -124,7 +124,8 @@ class InferenceRESTClient:
         if response.status_code != 200:
             try:
                 message = response.json().get("error", response.text)
-            except Exception:
+            except (ValueError, AttributeError):
+                # non-JSON or non-object error body: fall back to raw text
                 message = response.text
             raise InferenceError(
                 f"HTTP {response.status_code}: {message}", status=str(response.status_code)

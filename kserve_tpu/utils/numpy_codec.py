@@ -18,7 +18,7 @@ try:  # bfloat16 rides along with jax/ml_dtypes; optional for pure-CPU installs
     import ml_dtypes
 
     _BF16 = np.dtype(ml_dtypes.bfloat16)
-except Exception:  # pragma: no cover
+except ImportError:  # pragma: no cover
     _BF16 = None
 
 # OIP datatype name -> numpy dtype

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Repo lint gate: ruff for cheap generic checks (skipped when not
+# installed — the CI image does not bake it in), then jaxlint, the
+# domain-specific AST pass for JAX-serving hazards (docs/static_analysis.md).
+# Run from the repo root:  scripts/lint.sh [extra paths...]
+set -u
+
+cd "$(dirname "$0")/.."
+if [ "$#" -gt 0 ]; then paths=("$@"); else paths=(kserve_tpu/ tests/); fi
+rc=0
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "== ruff check ${paths[*]}"
+    ruff check "${paths[@]}" || rc=1
+else
+    echo "== ruff not installed; skipping generic checks"
+fi
+
+echo "== jaxlint ${paths[*]}"
+python -m kserve_tpu.analysis "${paths[@]}" || rc=1
+
+exit $rc

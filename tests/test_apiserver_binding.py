@@ -77,7 +77,8 @@ def wait_for(fn, timeout=15, interval=0.1):
         last = fn()
         if last:
             return last
-        time.sleep(interval)
+        # sync poll helper on the pytest main thread; no event loop here
+        time.sleep(interval)  # jaxlint: disable=blocking-async
     raise AssertionError(f"condition not met within {timeout}s (last={last!r})")
 
 
@@ -119,7 +120,8 @@ class TestWireProtocol:
 
         t = threading.Thread(target=consume, daemon=True)
         t.start()
-        time.sleep(0.3)
+        # give the watch thread time to connect; sync test main thread
+        time.sleep(0.3)  # jaxlint: disable=blocking-async
         cluster.apply({"apiVersion": "v1", "kind": "ConfigMap",
                        "metadata": {"name": "w1", "namespace": "watch-ns"},
                        "data": {}})
@@ -302,7 +304,8 @@ class TestLeaderElection:
             e1.start()
             assert wait_for(lambda: e1.is_leader.is_set(), timeout=10)
             e2.start()
-            time.sleep(1.0)
+            # hold long enough to prove the standby does NOT acquire
+            time.sleep(1.0)  # jaxlint: disable=blocking-async
             assert not e2.is_leader.is_set()
             # leader releases on stop -> standby takes over
             e1.stop()

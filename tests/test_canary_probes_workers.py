@@ -139,10 +139,13 @@ class TestMultiprocessWorkers:
                         pids.add(r.json()["predictions"][0])
                         if len(pids) >= 2:
                             break
-                except Exception:
-                    time.sleep(0.5)
+                # connection errors while the subprocess boots are the
+                # retry condition; the sleep is the backoff (sync test)
+                except Exception:  # jaxlint: disable=swallowed-exception
+                    time.sleep(0.5)  # jaxlint: disable=blocking-async
                     continue
-                time.sleep(0.05)
+                # brief gap between fresh connections (sync test thread)
+                time.sleep(0.05)  # jaxlint: disable=blocking-async
             assert pids, "server never came up"
             # kernel load-balances connections across SO_REUSEPORT sockets;
             # with enough fresh connections both workers must appear

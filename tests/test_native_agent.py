@@ -490,7 +490,8 @@ async def test_tsan_concurrent_load_and_shutdown(agent_binary_tsan, tmp_path):
     await web.TCPSite(runner, "127.0.0.1", backend_port).start()
     log_dir = tmp_path / "payloads"
     out_path = tmp_path / "tsan-out.txt"
-    out_file = open(out_path, "wb")
+    # opening the subprocess's output sink before spawn; one-shot test setup
+    out_file = open(out_path, "wb")  # jaxlint: disable=blocking-async
     proc = subprocess.Popen(
         [agent_binary_tsan, "--port", str(agent_port),
          "--component_port", str(backend_port),

@@ -281,9 +281,11 @@ def _wait_ready(port, proc, timeout=120):
             r = httpx.get(f"http://127.0.0.1:{port}/v1/models/llm", timeout=2)
             if r.status_code == 200 and r.json().get("ready"):
                 return
-        except Exception:
+        # refusal while the subprocess server boots is the retry
+        # condition; the sleep is the backoff (sync test helper)
+        except Exception:  # jaxlint: disable=swallowed-exception
             pass
-        time.sleep(1)
+        time.sleep(1)  # jaxlint: disable=blocking-async
     raise AssertionError("server did not become ready")
 
 

@@ -285,12 +285,17 @@ ModelServer(http_port={port}, enable_grpc=False).start([m])
             import httpx
             deadline = time.time() + 30
             while time.time() < deadline:
+                # deliberately drives a live subprocess server with sync
+                # httpx from the async test body; refusal during boot is
+                # the retry condition
                 try:
-                    if httpx.get(f"http://127.0.0.1:{port}/", timeout=1).status_code == 200:
+                    if httpx.get(f"http://127.0.0.1:{port}/", timeout=1).status_code == 200:  # jaxlint: disable=blocking-async
                         break
-                except Exception:
+                except Exception:  # jaxlint: disable=swallowed-exception
                     await asyncio.sleep(0.2)
-            out = subprocess.run(
+            # the loadbench CLI is the thing under test; blocking the
+            # test's loop while it runs is the point
+            out = subprocess.run(  # jaxlint: disable=blocking-async
                 [sys.executable, os.path.join(repo, "scripts", "loadbench.py"),
                  "--url", f"http://127.0.0.1:{port}/v1/models/echo:predict",
                  "--body", '{"instances": [[1, 2]]}',

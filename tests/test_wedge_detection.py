@@ -22,7 +22,9 @@ class _BlockingChunk:
     wedged device tunnel looks like from np.asarray)."""
 
     def __array__(self, dtype=None, copy=None):
-        time.sleep(3600)
+        # this sleep IS the simulated wedge (a host fetch that never
+        # returns); the engine's watchdog must fire around it
+        time.sleep(3600)  # jaxlint: disable=blocking-async
 
     def __getitem__(self, item):
         return self
