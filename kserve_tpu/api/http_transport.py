@@ -35,6 +35,7 @@ from ..controlplane.gvk import (
     resource_from_crd,
 )
 from ..logging import logger
+from ..resilience import RetryPolicy, parse_retry_after
 
 
 class APIError(RuntimeError):
@@ -73,6 +74,11 @@ class HTTPCluster:
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.timeout = timeout
+        # apiserver flow control (429) is retried under the shared policy:
+        # the request was rejected before execution, so any verb is safe
+        self.retry_policy = RetryPolicy(
+            max_attempts=3, base_backoff_s=0.2, max_backoff_s=2.0
+        )
         self._ssl_ctx = None
         if self.base_url.startswith("https"):
             self._ssl_ctx = ssl.create_default_context(cafile=ca_file)
@@ -96,16 +102,30 @@ class HTTPCluster:
             headers["Authorization"] = f"Bearer {self.token}"
         req = urllib.request.Request(url, data=data, headers=headers,
                                      method=method)
-        try:
-            resp = urllib.request.urlopen(
-                req, timeout=timeout or self.timeout, context=self._ssl_ctx)
-        except urllib.error.HTTPError as exc:
-            detail = exc.read().decode(errors="replace")
+        attempt = 0
+        started = time.monotonic()
+        while True:
+            attempt += 1
             try:
-                detail = json.loads(detail).get("message", detail)
-            except ValueError:
-                pass
-            raise APIError(exc.code, detail) from None
+                resp = urllib.request.urlopen(
+                    req, timeout=timeout or self.timeout, context=self._ssl_ctx)
+                break
+            except urllib.error.HTTPError as exc:
+                detail = exc.read().decode(errors="replace")
+                retry_after = parse_retry_after(exc.headers.get("Retry-After"))
+                try:
+                    detail = json.loads(detail).get("message", detail)
+                except ValueError:
+                    pass  # non-JSON error body: keep the raw text
+                if exc.code == 429 and not stream:
+                    delay = self.retry_policy.next_delay(
+                        attempt, retry_after=retry_after,
+                        elapsed=time.monotonic() - started)
+                    if delay is not None:
+                        # sync bootstrap/controller client — no event loop
+                        time.sleep(delay)  # jaxlint: disable=blocking-async
+                        continue
+                raise APIError(exc.code, detail) from None
         if stream:
             return resp
         with resp:
@@ -285,13 +305,25 @@ class HTTPCluster:
         return applied
 
     def wait_ready(self, timeout: float = 15.0) -> None:
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        # readiness probing rides the shared backoff policy (capped by the
+        # caller's timeout) instead of a fixed-interval poll
+        policy = RetryPolicy(
+            max_attempts=10_000, base_backoff_s=0.2, max_backoff_s=1.0,
+            retry_budget_s=timeout,
+        )
+        started = time.monotonic()
+        attempt = 0
+        while True:
+            attempt += 1
             try:
                 self._request("GET", "/readyz")
                 return
             except (APIError, OSError):
+                delay = policy.next_delay(
+                    attempt, elapsed=time.monotonic() - started)
+                if delay is None:
+                    break
                 # sync bootstrap client: runs before any event loop exists
                 # (manager/agent main() readiness gate)
-                time.sleep(0.2)  # jaxlint: disable=blocking-async
+                time.sleep(delay)  # jaxlint: disable=blocking-async
         raise TimeoutError(f"apiserver at {self.base_url} not ready")

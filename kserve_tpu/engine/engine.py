@@ -47,8 +47,10 @@ from ..metrics import (
     GENERATED_TOKENS,
     PROMPT_TOKENS,
 )
+from ..metrics import DEADLINE_REJECTED
 from ..models import llama
 from ..parallel import sharding as shd
+from ..resilience import DeadlineExceededError, current_deadline
 from .kvcache import (
     KVCacheConfig,
     PageAllocator,
@@ -338,6 +340,9 @@ class LLMEngine:
         # the exact failure mode this exists to escape.
         self._fetcher = _DeadlineFetcher()
         self._wedged = False
+        # chaos seam (resilience/faults.py): a FaultPlan whose "wedge"
+        # specs targeting "engine.fetch" the device-fetch path honors
+        self.fault_plan = None
         # prefix cache (engine/prefix_cache.py): chained page key -> page
         # id, LRU-evicted on pressure; holds one allocator ref per page
         from .prefix_cache import PrefixCache
@@ -460,6 +465,12 @@ class LLMEngine:
 
     def _fetch(self, x) -> np.ndarray:
         """Device->host fetch with the wedge deadline (see step_deadline_s)."""
+        if self.fault_plan is not None:
+            spec = self.fault_plan.decide("engine.fetch")
+            if spec is not None and spec.kind == "wedge":
+                self._wedged = True
+                ENGINE_WEDGED.labels(model_name=self._mlabel).set(1)
+                raise EngineWedgedError("injected wedge (fault plan)")
         try:
             return self._fetcher.fetch(
                 lambda: np.asarray(x), self.config.step_deadline_s)
@@ -488,13 +499,27 @@ class LLMEngine:
             raise ValueError(
                 f"prompt+max_tokens exceeds max_model_len {self.config.max_model_len}"
             )
+        deadline = self._admission_deadline()
         queue: asyncio.Queue = asyncio.Queue()
         rid = request_id or f"req-{time.monotonic_ns()}"
         req = _QueuedRequest(
             rid, list(prompt_ids), params, queue,
             adapter_id=self._resolve_adapter(adapter),
+            deadline=deadline,
         )
         return self._submit_and_stream(req)
+
+    def _admission_deadline(self):
+        """The propagated request deadline (resilience contextvar), checked
+        HERE so an already-dead budget is rejected synchronously — before
+        any stream machinery, queue slot, or prefill work is committed."""
+        deadline = current_deadline()
+        if deadline is not None and deadline.expired:
+            DEADLINE_REJECTED.labels(component="engine").inc()
+            raise DeadlineExceededError(
+                "request deadline expired before engine admission"
+            )
+        return deadline
 
     def _resolve_adapter(self, adapter: Optional[str]) -> int:
         if adapter is None:
@@ -542,12 +567,14 @@ class LLMEngine:
                 f"this engine's cache (expected {expect}); prefill peer and "
                 "decode server must share model + page_size configuration"
             )
+        deadline = self._admission_deadline()
         queue: asyncio.Queue = asyncio.Queue()
         rid = request_id or f"req-{time.monotonic_ns()}"
         req = _QueuedRequest(
             rid, list(prompt_ids), params, queue,
             kv_data=kv_data, first_token=int(first_token),
             adapter_id=self._resolve_adapter(adapter),
+            deadline=deadline,
         )
         return self._submit_and_stream(req)
 
@@ -724,6 +751,10 @@ class LLMEngine:
         try:
             while not self._stopped:
                 did_work = False
+                # deadline enforcement: a queued request whose budget died
+                # is failed upfront — seating it would burn prefill+decode
+                # on an answer nobody is waiting for
+                self._drop_expired_waiting()
                 # admission: prefill waiting requests into free slots,
                 # batched so one compiled call covers many prompts
                 while self._waiting and self._free_slot_index() is not None:
@@ -759,6 +790,26 @@ class LLMEngine:
             for req in self._waiting:
                 req.queue.put_nowait(e)
             self._waiting.clear()
+
+    def _drop_expired_waiting(self) -> None:
+        """Fail queued requests whose propagated deadline expired before a
+        slot freed up (504 at the protocol layer); spilled resume KV is
+        released back to the tier store."""
+        kept: List[_QueuedRequest] = []
+        for req in self._waiting:
+            if req.deadline is None or not req.deadline.expired:
+                kept.append(req)
+                continue
+            if (req.resume is not None and req.resume["kv"] is not None
+                    and self._kv_store is not None):
+                self._kv_store.discard(req.resume["kv"])
+                self._set_offload_gauges()
+            DEADLINE_REJECTED.labels(component="engine").inc()
+            req.queue.put_nowait(DeadlineExceededError(
+                f"request {req.request_id} deadline expired while queued"
+            ))
+        if len(kept) != len(self._waiting):
+            self._waiting = kept
 
     def _free_slot_index(self) -> Optional[int]:
         for i, slot in enumerate(self._slots):

@@ -208,12 +208,16 @@ class _Slot:
 
 class _QueuedRequest:
     def __init__(self, request_id, prompt_ids, params, queue,
-                 kv_data=None, first_token=None, adapter_id=-1):
+                 kv_data=None, first_token=None, adapter_id=-1,
+                 deadline=None):
         self.request_id = request_id
         self.prompt_ids = prompt_ids
         self.params = params
         self.queue = queue
         self.adapter_id = adapter_id  # LoRA stack row; -1 = base model
+        # resilience.Deadline captured at submit: admission drops the
+        # request with DeadlineExceededError once it expires while queued
+        self.deadline = deadline
         # P/D disaggregation: KV computed by a prefill-role server
         # ([L, P, 2, n_kv, ps, d] host array) plus its sampled first token —
         # admission scatters the pages instead of prefilling

@@ -22,11 +22,22 @@ import asyncio
 import json
 import random
 from typing import Any, Dict, Optional
+from urllib.parse import urlsplit
 
 import httpx
 from aiohttp import web
 
 from ..logging import configure_logging, logger
+from ..metrics import record_breaker_transition
+from ..resilience import (
+    DEADLINE_HEADER,
+    MONOTONIC,
+    BreakerRegistry,
+    Clock,
+    Deadline,
+    RetryPolicy,
+    parse_retry_after,
+)
 
 DEFAULT_TIMEOUT = 60.0
 
@@ -71,14 +82,29 @@ def eval_condition(condition: str, payload: Any) -> bool:
 
 class GraphRouter:
     def __init__(self, graph_spec: dict, timeout: float = DEFAULT_TIMEOUT,
-                 retries: int = 1, client: Optional[httpx.AsyncClient] = None):
+                 retries: int = 1, client: Optional[httpx.AsyncClient] = None,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 breakers: Optional[BreakerRegistry] = None,
+                 clock: Clock = MONOTONIC):
         self.nodes: Dict[str, dict] = graph_spec["nodes"]
         self.timeout = graph_spec.get("timeout") or timeout
         self.retries = retries
+        self.clock = clock
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=retries + 1, base_backoff_s=0.05, max_backoff_s=1.0,
+        )
+        self.breakers = breakers if breakers is not None else BreakerRegistry(
+            clock=clock, on_transition=record_breaker_transition,
+        )
         self._client = client or httpx.AsyncClient(timeout=self.timeout)
 
     async def close(self):
         await self._client.aclose()
+
+    @staticmethod
+    def _backend_key(url: str) -> str:
+        parts = urlsplit(url)
+        return parts.netloc or url
 
     def _step_url(self, step: dict) -> str:
         if step.get("serviceUrl"):
@@ -89,34 +115,126 @@ class GraphRouter:
             return f"http://{step['serviceName']}/v1/models/{model}:predict"
         raise GraphExecutionError(f"step has neither serviceUrl nor serviceName: {step}")
 
-    async def _call_step(self, step: dict, payload: Any, headers: Dict[str, str]) -> Any:
+    async def _call_step(self, step: dict, payload: Any, headers: Dict[str, str],
+                         deadline: Optional[Deadline] = None) -> Any:
+        """One step call under the resilience policy: per-backend circuit
+        breaker, RetryPolicy backoff (Retry-After aware, deadline-capped),
+        transport errors mapped to gateway statuses (timeout -> 504,
+        connect -> 502) naming the step that failed."""
         if step.get("nodeName"):
-            return await self.execute_node(step["nodeName"], payload, headers)
+            return await self.execute_node(
+                step["nodeName"], payload, headers, deadline=deadline
+            )
         url = self._step_url(step)
-        last_exc: Optional[Exception] = None
-        for _ in range(self.retries + 1):
-            try:
-                response = await self._client.post(url, json=payload, headers=headers)
-                if response.status_code == 200:
-                    return response.json()
+        name = step.get("name") or url
+        backend = self._backend_key(url)
+        soft = step.get("dependency") == "Soft"
+        started = self.clock.now()
+        attempt = 0
+        last_exc: Optional[GraphExecutionError] = None
+        while True:
+            if deadline is not None and deadline.expired:
                 last_exc = GraphExecutionError(
-                    f"step {step.get('name') or url} returned {response.status_code}: "
+                    f"step {name}: request deadline exceeded", status=504
+                )
+                break
+            if not self.breakers.allow(backend):
+                last_exc = GraphExecutionError(
+                    f"step {name}: circuit open for backend {backend}", status=503
+                )
+                break
+            attempt += 1
+            retry_after = None
+            retryable = True
+            try:
+                send_headers = dict(headers)
+                if deadline is not None:
+                    send_headers[DEADLINE_HEADER] = deadline.to_header()
+                response = await self._client.post(
+                    url, json=payload, headers=send_headers
+                )
+                if response.status_code == 200:
+                    self.breakers.record_success(backend)
+                    return response.json()
+                # 429 (shedding) and 5xx mark backend health; client-fault
+                # 4xx would fail identically anywhere and must not trip it
+                if response.status_code == 429 or response.status_code >= 500:
+                    self.breakers.record_failure(backend)
+                retry_after = parse_retry_after(response.headers.get("Retry-After"))
+                retryable = self.retry_policy.retryable(response.status_code)
+                last_exc = GraphExecutionError(
+                    f"step {name} returned {response.status_code}: "
                     f"{response.text[:200]}",
                     status=response.status_code,
                 )
-                if step.get("dependency") == "Soft":
-                    break
+            except (httpx.ConnectTimeout, httpx.PoolTimeout) as e:
+                # pre-send timeouts: the request never reached the backend,
+                # so replaying it cannot duplicate work
+                self.breakers.record_failure(backend)
+                last_exc = GraphExecutionError(
+                    f"step {name} timed out: {e}", status=504
+                )
+            except httpx.TimeoutException as e:
+                # read/write timeout: the backend may be EXECUTING the
+                # request — replaying would duplicate (expensive) inference
+                self.breakers.record_failure(backend)
+                retryable = False
+                last_exc = GraphExecutionError(
+                    f"step {name} timed out: {e}", status=504
+                )
+            except httpx.ConnectError as e:
+                self.breakers.record_failure(backend)
+                last_exc = GraphExecutionError(
+                    f"step {name} connect failed: {e}", status=502
+                )
             except httpx.HTTPError as e:
-                last_exc = GraphExecutionError(f"step call failed: {e}", status=503)
-        if step.get("dependency") == "Soft":
+                self.breakers.record_failure(backend)
+                last_exc = GraphExecutionError(
+                    f"step {name} call failed: {e}", status=503
+                )
+            if soft or not retryable:
+                break
+            delay = self.retry_policy.next_delay(
+                attempt,
+                retry_after=retry_after,
+                elapsed=self.clock.now() - started,
+                deadline=deadline,
+            )
+            if delay is None:
+                break
+            await self.clock.sleep(delay)
+        if soft:
             logger.warning("soft-dependency step failed, continuing: %s", last_exc)
             return None
         raise last_exc
 
-    async def execute_node(self, node_name: str, payload: Any, headers: Dict[str, str]) -> Any:
+    def _splitter_candidates(self, steps: list) -> list:
+        """Weighted-pick candidates with open-breaker backends excluded —
+        the router routes around a tripped backend instead of burning a
+        pick on it.  When nothing pickable remains (all open, or only
+        zero-weight steps survive the filter), fall back to the full set:
+        every choice then fails fast in _call_step with an accurate,
+        retryable 503 'circuit open' instead of a misleading 422."""
+        viable = [
+            s for s in steps
+            if s.get("weight", 0) > 0
+            and (s.get("nodeName")
+                 # available(), not allow(): filtering must not consume the
+                 # half-open probe of a step that may not even be picked
+                 or self.breakers.available(self._backend_key(self._step_url(s))))
+        ]
+        return viable if viable else steps
+
+    async def execute_node(self, node_name: str, payload: Any,
+                           headers: Dict[str, str],
+                           deadline: Optional[Deadline] = None) -> Any:
         node = self.nodes.get(node_name)
         if node is None:
             raise GraphExecutionError(f"graph node {node_name!r} not found", status=404)
+        if deadline is not None and deadline.expired:
+            raise GraphExecutionError(
+                f"node {node_name}: request deadline exceeded", status=504
+            )
         router_type = node["routerType"]
         steps = node.get("steps", [])
         if router_type == "Sequence":
@@ -125,39 +243,53 @@ class GraphRouter:
             for step in steps:
                 data = step.get("data", "$request" if step is steps[0] else "$response")
                 step_input = request_payload if data == "$request" else current
-                result = await self._call_step(step, step_input, headers)
+                result = await self._call_step(step, step_input, headers, deadline)
                 if result is not None:
                     current = result
             return current
         if router_type == "Splitter":
-            total = sum(s.get("weight", 0) for s in steps)
+            candidates = self._splitter_candidates(steps)
+            total = sum(s.get("weight", 0) for s in candidates)
             if total <= 0:
                 raise GraphExecutionError("splitter steps need positive weights", 422)
             pick = random.uniform(0, total)
             acc = 0.0
-            chosen = steps[-1]
-            for s in steps:
+            chosen = candidates[-1]
+            for s in candidates:
                 acc += s.get("weight", 0)
                 if pick <= acc:
                     chosen = s
                     break
-            return await self._call_step(chosen, payload, headers)
+            return await self._call_step(chosen, payload, headers, deadline)
         if router_type == "Ensemble":
             results = await asyncio.gather(
-                *[self._call_step(s, payload, headers) for s in steps],
+                *[self._call_step(s, payload, headers, deadline) for s in steps],
                 return_exceptions=True,
             )
             merged: Dict[str, Any] = {}
+            failed: list = []  # (member_key, GraphExecutionError)
             for i, (step, result) in enumerate(zip(steps, results)):
                 key = step.get("name") or step.get("serviceName") or str(i)
-                if isinstance(result, Exception):
+                if isinstance(result, GraphExecutionError):
+                    failed.append((key, result))
+                    continue
+                if isinstance(result, BaseException):
                     raise result
                 merged[key] = result
+            if failed:
+                # hard-dependency member death fails the ensemble naming
+                # WHICH member died (soft members already degraded to None)
+                members = ", ".join(k for k, _ in failed)
+                first = failed[0][1]
+                raise GraphExecutionError(
+                    f"ensemble member(s) [{members}] failed: {first}",
+                    status=first.status,
+                )
             return merged
         if router_type == "Switch":
             for step in steps:
                 if eval_condition(step.get("condition", ""), payload):
-                    return await self._call_step(step, payload, headers)
+                    return await self._call_step(step, payload, headers, deadline)
             raise GraphExecutionError("no switch branch matched the request", status=404)
         raise GraphExecutionError(f"unknown routerType {router_type!r}", status=422)
 
@@ -172,8 +304,13 @@ class GraphRouter:
             k: v for k, v in request.headers.items()
             if k.lower() in ("x-request-id", "authorization", "content-type")
         }
+        # the deadline budget is re-anchored here and decremented per hop:
+        # every outgoing step call carries the REMAINING budget
+        deadline = Deadline.from_header(
+            request.headers.get(DEADLINE_HEADER), clock=self.clock
+        )
         try:
-            result = await self.execute_node("root", payload, headers)
+            result = await self.execute_node("root", payload, headers, deadline)
         except GraphExecutionError as e:
             return web.json_response({"error": str(e)}, status=e.status)
         return web.json_response(result)

@@ -17,6 +17,14 @@ import httpx
 from .errors import InferenceError, InvalidInput, UnsupportedProtocol
 from .infer_type import InferRequest, InferResponse
 from .model import PredictorProtocol
+from .resilience import (
+    DEADLINE_HEADER,
+    MONOTONIC,
+    Clock,
+    RetryPolicy,
+    current_deadline,
+    parse_retry_after,
+)
 
 
 @dataclass
@@ -30,6 +38,11 @@ class RESTConfig:
     verify: Union[bool, str] = True
     auth: Optional[object] = None
     verbose: bool = False
+    # resilience/retry.py policy governing connect-error and 429/503
+    # retries (None = built from `retries`); `clock` is the test seam for
+    # deterministic backoff without real sleeps
+    retry_policy: Optional[RetryPolicy] = None
+    clock: Optional[Clock] = None
 
     def __post_init__(self):
         if isinstance(self.protocol, PredictorProtocol):
@@ -39,16 +52,90 @@ class RESTConfig:
 class InferenceRESTClient:
     def __init__(self, config: Optional[RESTConfig] = None):
         self._config = config or RESTConfig()
-        transport = self._config.transport
-        retry_transport = None
-        if transport is None:
-            retry_transport = httpx.AsyncHTTPTransport(retries=self._config.retries)
+        # retries moved off the httpx transport (which only replayed
+        # connects, silently and un-budgeted) onto one explicit RetryPolicy.
+        # Default statuses are 429/503 only: those are rejected-before-work
+        # responses, while 502/504 may mean the backend is mid-execution
+        # (replay would duplicate inference).  A caller-supplied policy's
+        # retryable_statuses are honored as given.
+        self._retry_policy = self._config.retry_policy or RetryPolicy(
+            max_attempts=self._config.retries + 1,
+            retryable_statuses=frozenset({429, 503}),
+        )
+        self._clock = self._config.clock or MONOTONIC
         self._client = httpx.AsyncClient(
-            transport=transport or retry_transport,
+            transport=self._config.transport,
             http2=self._config.http2,
             timeout=self._config.timeout,
             verify=self._config.verify,
         )
+
+    async def _post_with_retries(self, url, *, content=None, json_body=None,
+                                 headers=None, timeout=None) -> httpx.Response:
+        """POST with the resilience retry loop: connect-phase failures and
+        429/503 responses retry under the policy, honoring Retry-After and
+        never past the propagated deadline (resilience/deadline.py).  The
+        outgoing request carries the remaining deadline budget, refreshed
+        per attempt."""
+        started = self._clock.now()
+        attempt = 0
+        while True:
+            deadline = current_deadline()
+            if deadline is not None and deadline.expired:
+                raise InferenceError(
+                    "request deadline exceeded before send", status="504"
+                )
+            attempt += 1
+            retry_after = None
+            failure: Optional[Exception] = None
+            response: Optional[httpx.Response] = None
+            try:
+                send_headers = dict(headers or {})
+                if deadline is not None:
+                    send_headers.setdefault(DEADLINE_HEADER, deadline.to_header())
+                response = await self._client.post(
+                    url, content=content, json=json_body,
+                    headers=send_headers, timeout=timeout,
+                )
+                if not self._retry_policy.retryable(response.status_code):
+                    return response
+                retry_after = parse_retry_after(response.headers.get("Retry-After"))
+            except (httpx.ConnectError, httpx.ConnectTimeout) as e:
+                # connect-phase only: the request never reached the server,
+                # so replaying it cannot duplicate inference work
+                failure = e
+            delay = self._retry_policy.next_delay(
+                attempt,
+                retry_after=retry_after,
+                elapsed=self._clock.now() - started,
+                deadline=current_deadline(),
+            )
+            if delay is None:
+                if failure is not None:
+                    raise failure
+                return response
+            await self._clock.sleep(delay)
+
+    async def _get_with_retries(self, url, *, headers=None,
+                                timeout=None) -> httpx.Response:
+        """GET with connect-phase retries only (the health/readiness probes
+        the old transport-level retries used to cover); response statuses
+        are returned as-is — probe callers interpret 503 etc. themselves."""
+        started = self._clock.now()
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                return await self._client.get(url, headers=headers, timeout=timeout)
+            except (httpx.ConnectError, httpx.ConnectTimeout) as e:
+                delay = self._retry_policy.next_delay(
+                    attempt,
+                    elapsed=self._clock.now() - started,
+                    deadline=current_deadline(),
+                )
+                if delay is None:
+                    raise e
+                await self._clock.sleep(delay)
 
     def _is_v2(self) -> bool:
         return self._config.protocol in (
@@ -73,15 +160,17 @@ class InferenceRESTClient:
             if json_length is not None:
                 headers["inference-header-content-length"] = str(json_length)
                 headers["content-type"] = "application/octet-stream"
-                response = await self._client.post(
+                response = await self._post_with_retries(
                     url, content=body, headers=headers, timeout=timeout
                 )
             else:
-                response = await self._client.post(
-                    url, json=body, headers=headers, timeout=timeout
+                response = await self._post_with_retries(
+                    url, json_body=body, headers=headers, timeout=timeout
                 )
         else:
-            response = await self._client.post(url, json=data, headers=headers, timeout=timeout)
+            response = await self._post_with_retries(
+                url, json_body=data, headers=headers, timeout=timeout
+            )
         if response_headers is not None:
             response_headers.update(dict(response.headers))
         return self._decode_response(response, is_graph_endpoint)
@@ -100,9 +189,13 @@ class InferenceRESTClient:
             url = self._construct_url(base_url, model_name, verb="explain")
         if isinstance(data, InferRequest):
             body, _ = data.to_rest()
-            response = await self._client.post(url, json=body, headers=headers, timeout=timeout)
+            response = await self._post_with_retries(
+                url, json_body=body, headers=headers, timeout=timeout
+            )
         else:
-            response = await self._client.post(url, json=data, headers=headers, timeout=timeout)
+            response = await self._post_with_retries(
+                url, json_body=data, headers=headers, timeout=timeout
+            )
         return self._decode_response(response, False)
 
     def _construct_url(self, base_url: str, model_name: Optional[str], verb: str) -> str:
@@ -139,7 +232,7 @@ class InferenceRESTClient:
         return body
 
     async def is_server_ready(self, base_url: str, headers=None, timeout=None) -> bool:
-        response = await self._client.get(
+        response = await self._get_with_retries(
             self._health_url(base_url, "ready"), headers=headers, timeout=timeout
         )
         response.raise_for_status()
@@ -148,11 +241,13 @@ class InferenceRESTClient:
     async def is_server_live(self, base_url: str, headers=None, timeout=None) -> bool:
         if self._is_v2():
             url = self._health_url(base_url, "live")
-            response = await self._client.get(url, headers=headers, timeout=timeout)
+            response = await self._get_with_retries(url, headers=headers, timeout=timeout)
             response.raise_for_status()
             return response.json().get("live", False)
         base = str(base_url).rstrip("/")
-        response = await self._client.get(base + "/", headers=headers, timeout=timeout)
+        response = await self._get_with_retries(
+            base + "/", headers=headers, timeout=timeout
+        )
         response.raise_for_status()
         return response.json().get("status") == "alive"
 
@@ -162,7 +257,7 @@ class InferenceRESTClient:
             url = f"{base}/v2/models/{model_name}/ready"
         else:
             url = f"{base}/v1/models/{model_name}"
-        response = await self._client.get(url, headers=headers, timeout=timeout)
+        response = await self._get_with_retries(url, headers=headers, timeout=timeout)
         if response.status_code == 503:
             return False
         response.raise_for_status()
@@ -189,22 +284,26 @@ class InferenceGRPCClient:
         channel_args: Optional[List[Tuple[str, str]]] = None,
         timeout: float = 60,
         retries: int = 3,
+        retry_policy: Optional[RetryPolicy] = None,
     ):
         import grpc
 
         from .protocol.grpc.servicer import build_stub_multicallables
 
         options = list(channel_args or [])
-        if retries > 0:
+        # the ad-hoc retryPolicy dict is now a translation of the shared
+        # RetryPolicy so REST and gRPC hops retry under one policy surface
+        policy = retry_policy or RetryPolicy(max_attempts=retries + 1)
+        if policy.max_attempts > 1:
             service_config = {
                 "methodConfig": [
                     {
                         "name": [{"service": "inference.GRPCInferenceService"}],
                         "retryPolicy": {
-                            "maxAttempts": retries + 1,
-                            "initialBackoff": "0.1s",
-                            "maxBackoff": "1s",
-                            "backoffMultiplier": 2,
+                            "maxAttempts": policy.max_attempts,
+                            "initialBackoff": f"{policy.base_backoff_s:g}s",
+                            "maxBackoff": f"{policy.max_backoff_s:g}s",
+                            "backoffMultiplier": policy.multiplier,
                             "retryableStatusCodes": ["UNAVAILABLE"],
                         },
                     }

@@ -56,6 +56,32 @@ ENGINE_KV_DISK_BYTES = Gauge(
     "KV bytes currently parked in the disk tier", ["model_name"],
 )
 
+# Resilience layer (kserve_tpu/resilience — docs/resilience.md).
+# Labeled by state only: backend identity is a pod ip:port, an unbounded
+# label cardinality under replica churn (prometheus label children are
+# never freed); per-backend state lives in the picker/router snapshots.
+BREAKER_TRANSITIONS = Counter(
+    "resilience_breaker_transitions_total",
+    "circuit breaker state transitions",
+    ["state"],
+)
+SHED_REQUESTS = Counter(
+    "resilience_shed_requests_total",
+    "requests bounced with 429 + Retry-After at admission",
+    ["component"],
+)
+DEADLINE_REJECTED = Counter(
+    "resilience_deadline_rejected_total",
+    "requests rejected because their propagated deadline had expired",
+    ["component"],
+)
+
+
+def record_breaker_transition(backend: str, state: str) -> None:
+    """The BreakerRegistry on_transition hook (resilience/breaker.py);
+    `backend` is part of the hook signature but deliberately not a label."""
+    BREAKER_TRANSITIONS.labels(state=state).inc()
+
 
 def get_labels(model_name: str) -> dict:
     return {"model_name": model_name}

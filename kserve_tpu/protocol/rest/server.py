@@ -27,6 +27,16 @@ from ...errors import (
     UnsupportedProtocol,
 )
 from ...logging import logger, trace_logger
+from ...metrics import DEADLINE_REJECTED, SHED_REQUESTS
+from ...resilience import (
+    DEADLINE_HEADER,
+    Deadline,
+    DeadlineExceededError,
+    LoadShedder,
+    ShedConfig,
+    deadline_scope,
+    shedding_middleware,
+)
 from .v1_endpoints import V1Endpoints
 from .v2_endpoints import V2Endpoints
 
@@ -53,6 +63,8 @@ async def error_middleware(request: web.Request, handler):
         return _error_response(400, e.reason)
     except NotImplementedError as e:
         return _error_response(501, str(e) or "Not implemented")
+    except DeadlineExceededError as e:
+        return _error_response(504, str(e))
     except InferenceError as e:
         return _error_response(500, str(e))
     except web.HTTPException:
@@ -60,6 +72,21 @@ async def error_middleware(request: web.Request, handler):
     except Exception as e:  # noqa: BLE001 — last-resort 500 with log
         logger.exception("Internal server error handling %s", request.path)
         return _error_response(500, f"{type(e).__name__}: {e}")
+
+
+@web.middleware
+async def deadline_middleware(request: web.Request, handler):
+    """Parse the propagated deadline budget (resilience/deadline.py) and
+    bind it as the request's contextvar scope; an already-dead budget is
+    rejected 504 here, before any handler work."""
+    deadline = Deadline.from_header(request.headers.get(DEADLINE_HEADER))
+    if deadline is None:
+        return await handler(request)
+    if deadline.expired:
+        DEADLINE_REJECTED.labels(component="rest").inc()
+        return _error_response(504, "request deadline exceeded before handling")
+    with deadline_scope(deadline):
+        return await handler(request)
 
 
 @web.middleware
@@ -97,12 +124,20 @@ class RESTServer:
         enable_latency_logging: bool = True,
         reuse_port: bool = False,
         ssl_context=None,  # ssl.SSLContext (controlplane/tls.py helpers)
+        shed_config: Optional[ShedConfig] = None,  # None = env defaults
     ):
         self.dataplane = dataplane
         self.model_repository_extension = model_repository_extension
         self.http_port = http_port
         self.access_log_format = access_log_format
         self.enable_latency_logging = enable_latency_logging
+        # admission-time load shedding (resilience/shedding.py): inference
+        # POSTs bounce 429 + Retry-After once the aggregate engine queue
+        # crosses the watermark (KSERVE_TPU_SHED_WATERMARK; <=0 disables)
+        self.shedder = LoadShedder(
+            shed_config or ShedConfig.from_env(),
+            on_shed=lambda: SHED_REQUESTS.labels(component="rest").inc(),
+        )
         # SO_REUSEPORT is for the multiprocess worker mode only — with it on
         # by default, stale processes silently share (and steal from) the port
         self.reuse_port = reuse_port
@@ -118,6 +153,13 @@ class RESTServer:
         if get_tracer() is not None:
             middlewares.append(tracing_middleware)
         middlewares.append(error_middleware)
+        # shedding sits inside error mapping but before deadline parsing:
+        # a shed request must cost nothing beyond the depth read
+        if self.shedder.enabled:
+            middlewares.append(
+                shedding_middleware(self.shedder, self._total_queue_depth)
+            )
+        middlewares.append(deadline_middleware)
         if self.enable_latency_logging:
             middlewares.append(timing_middleware)
         app = web.Application(middlewares=middlewares, client_max_size=1024**3)
@@ -139,6 +181,17 @@ class RESTServer:
             "/v1/internal/scheduler/state", self._scheduler_state_handler
         )
         return app
+
+    def _total_queue_depth(self) -> int:
+        """Aggregate engine admission queue depth — the load-shedding
+        watermark signal (mirrors what /v1/internal/scheduler/state
+        advertises to the EPP)."""
+        depth = 0
+        for model in self.dataplane.model_registry.get_models().values():
+            engine = getattr(model, "engine", None)
+            if engine is not None:
+                depth += int(getattr(engine, "queue_depth", 0) or 0)
+        return depth
 
     async def _scheduler_state_handler(self, request: web.Request) -> web.Response:
         """Per-replica load + prefix-cache snapshot consumed by the EPP

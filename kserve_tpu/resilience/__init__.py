@@ -1,0 +1,31 @@
+"""Unified resilience layer: deadline propagation, retry policy, circuit
+breakers, load shedding, and deterministic fault injection.
+
+One policy surface for the scattered defenses the serving stack needs at
+scale (docs/resilience.md): the REST server parses and enforces the
+`x-request-deadline` budget and sheds load past a queue watermark; the
+graph router and inference client retry through one `RetryPolicy`; the
+router and EPP picker consult per-backend `CircuitBreaker`s; and a
+seeded `FaultPlan` makes every one of those behaviors provable in CI
+without real sleeps (clock injection throughout).
+"""
+
+from .breaker import (  # noqa: F401
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    BreakerConfig,
+    BreakerRegistry,
+    CircuitBreaker,
+)
+from .clock import MONOTONIC, Clock, FakeClock  # noqa: F401
+from .deadline import (  # noqa: F401
+    DEADLINE_HEADER,
+    Deadline,
+    DeadlineExceededError,
+    current_deadline,
+    deadline_scope,
+)
+from .faults import FaultInjectingTransport, FaultPlan, FaultSpec  # noqa: F401
+from .retry import RETRYABLE_STATUSES, RetryPolicy, parse_retry_after  # noqa: F401
+from .shedding import LoadShedder, ShedConfig, shedding_middleware  # noqa: F401

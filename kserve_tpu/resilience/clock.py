@@ -1,0 +1,48 @@
+"""Injectable monotonic clock shared by every resilience primitive.
+
+Deadlines, breakers, retries, and fault injection all reason about time;
+threading one clock object through them is what makes the chaos suite
+deterministic — a test advances a `FakeClock` instead of sleeping, so
+backoff schedules, breaker cooldowns, and deadline expiry are provable
+in milliseconds of wall time.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import List
+
+
+class Clock:
+    """Real monotonic time + asyncio sleep (the production default)."""
+
+    def now(self) -> float:
+        return time.monotonic()
+
+    async def sleep(self, seconds: float) -> None:
+        await asyncio.sleep(seconds)
+
+
+class FakeClock(Clock):
+    """Deterministic clock for chaos tests: `sleep` advances virtual time
+    instantly (one event-loop yield), and `advance` moves time without any
+    await — breaker cooldowns and deadline expiry become pure state."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = start
+        self.sleeps: List[float] = []  # every sleep requested, in order
+
+    def now(self) -> float:
+        return self._now
+
+    def advance(self, seconds: float) -> None:
+        self._now += seconds
+
+    async def sleep(self, seconds: float) -> None:
+        self.sleeps.append(seconds)
+        self._now += max(seconds, 0.0)
+        await asyncio.sleep(0)
+
+
+MONOTONIC = Clock()

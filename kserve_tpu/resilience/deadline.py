@@ -1,0 +1,93 @@
+"""Request deadline: one budget, propagated across every hop.
+
+The wire form (`x-request-deadline` header) is the REMAINING budget in
+seconds, not an absolute timestamp — peers do not share a clock, and a
+relative budget can only shrink as it crosses hops (each hop re-anchors
+it against its own monotonic clock, so network transit time is charged
+automatically).  In-process the deadline rides a contextvar so the REST
+middleware can set it once and the engine admission path, the inference
+client, and the graph router all see it without plumbing a parameter
+through every call signature.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from contextvars import ContextVar
+from typing import Optional
+
+from .clock import MONOTONIC, Clock
+
+DEADLINE_HEADER = "x-request-deadline"
+
+
+class DeadlineExceededError(TimeoutError):
+    """The request's deadline expired before (or while) it could be served.
+    Maps to HTTP 504 at the protocol layer."""
+
+    def __init__(self, detail: str = "request deadline exceeded"):
+        super().__init__(detail)
+
+
+class Deadline:
+    """An absolute expiry point on a monotonic clock."""
+
+    __slots__ = ("expires_at", "clock")
+
+    def __init__(self, expires_at: float, clock: Clock = MONOTONIC):
+        self.expires_at = expires_at
+        self.clock = clock
+
+    @classmethod
+    def after(cls, seconds: float, clock: Clock = MONOTONIC) -> "Deadline":
+        return cls(clock.now() + seconds, clock)
+
+    def remaining(self) -> float:
+        return self.expires_at - self.clock.now()
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def to_header(self) -> str:
+        """Remaining budget for the next hop (clamped at 0: a dead budget
+        still propagates, so the receiver rejects instead of working)."""
+        return f"{max(self.remaining(), 0.0):.3f}"
+
+    @classmethod
+    def from_header(
+        cls, value: Optional[str], clock: Clock = MONOTONIC
+    ) -> Optional["Deadline"]:
+        """Parse a remaining-seconds header; malformed values are ignored
+        (None) rather than failing the request — a deadline is an
+        optimization contract, not an input schema."""
+        if not value:
+            return None
+        try:
+            seconds = float(value)
+        except (TypeError, ValueError):
+            return None
+        return cls.after(seconds, clock)
+
+    def __repr__(self) -> str:
+        return f"Deadline(remaining={self.remaining():.3f}s)"
+
+
+_current_deadline: ContextVar[Optional[Deadline]] = ContextVar(
+    "kserve_tpu_request_deadline", default=None
+)
+
+
+def current_deadline() -> Optional[Deadline]:
+    """The deadline governing the current async context (None = unbounded)."""
+    return _current_deadline.get()
+
+
+@contextlib.contextmanager
+def deadline_scope(deadline: Optional[Deadline]):
+    """Bind `deadline` as the current deadline for the enclosed block."""
+    token = _current_deadline.set(deadline)
+    try:
+        yield deadline
+    finally:
+        _current_deadline.reset(token)

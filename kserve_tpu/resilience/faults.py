@@ -1,0 +1,146 @@
+"""Deterministic fault injection: the chaos suite's source of failure.
+
+A `FaultPlan` is a seeded, ordered list of `FaultSpec`s.  Every call site
+that honors faults asks `plan.decide(target)` with a stable target string
+(the backend host for HTTP calls, `"engine.fetch"` for the engine's
+device fetch); the plan matches by substring, counts matching calls, and
+deterministically decides whether to inject.  Same plan + same call
+sequence = same faults, which is what lets CI *prove* breakers trip and
+deadlines fire instead of asserting they probably would.
+
+Fault kinds:
+
+- ``latency``        sleep `latency_s` on the plan's clock, then proceed
+- ``connect_error``  the backend is unreachable (httpx.ConnectError)
+- ``http_status``    a served error (5xx/429), optional Retry-After
+- ``wedge``          the call hangs until the caller's deadline (httpx
+                     ReadTimeout; the engine maps it to a wedged fetch)
+- ``partial_stream`` a 200 whose body dies mid-stream
+
+`FaultInjectingTransport` honors a plan in front of any httpx handler or
+inner transport; `LLMEngine` honors ``wedge`` specs targeted at
+``engine.fetch`` (see engine._fetch).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+import httpx
+
+from .clock import MONOTONIC, Clock
+
+
+@dataclass
+class FaultSpec:
+    target: str  # substring matched against the call target
+    kind: str  # latency | connect_error | http_status | wedge | partial_stream
+    status: int = 503
+    latency_s: float = 0.0
+    retry_after_s: Optional[float] = None
+    probability: float = 1.0  # <1.0 draws from the plan's seeded RNG
+    after: int = 0  # skip the first N matching calls
+    count: Optional[int] = None  # inject at most N times (None = forever)
+
+
+class FaultPlan:
+    """Seeded decision engine over an ordered spec list (first match wins)."""
+
+    def __init__(self, specs: List[FaultSpec], seed: int = 0):
+        self.specs = list(specs)
+        self._rng = random.Random(seed)
+        self._seen: Dict[int, int] = {}
+        self._injected: Dict[int, int] = {}
+        self.log: List[Tuple[str, str]] = []  # (target, kind) per injection
+
+    def decide(self, target: str) -> Optional[FaultSpec]:
+        for i, spec in enumerate(self.specs):
+            if spec.target not in target:
+                continue
+            seen = self._seen.get(i, 0)
+            self._seen[i] = seen + 1
+            if seen < spec.after:
+                continue
+            done = self._injected.get(i, 0)
+            if spec.count is not None and done >= spec.count:
+                continue
+            if spec.probability < 1.0 and self._rng.random() >= spec.probability:
+                continue
+            self._injected[i] = done + 1
+            self.log.append((target, spec.kind))
+            return spec
+        return None
+
+    def injected(self, kind: Optional[str] = None) -> int:
+        if kind is None:
+            return len(self.log)
+        return sum(1 for _, k in self.log if k == kind)
+
+
+class _TruncatedStream(httpx.AsyncByteStream):
+    """A body that emits one partial JSON chunk then dies mid-read."""
+
+    async def __aiter__(self):
+        yield b'{"partial":'
+        raise httpx.ReadError("injected partial stream")
+
+
+class FaultInjectingTransport(httpx.AsyncBaseTransport):
+    """httpx transport honoring a FaultPlan in front of a real handler.
+
+    `handler(request) -> (status, json_payload)` serves pass-through calls
+    (the in-memory stub idiom the router tests already use); alternatively
+    wrap an `inner` transport.  The target string handed to the plan is
+    the request host (or the full url when host-less).
+    """
+
+    def __init__(
+        self,
+        plan: FaultPlan,
+        handler: Optional[Callable] = None,
+        inner: Optional[httpx.AsyncBaseTransport] = None,
+        clock: Clock = MONOTONIC,
+    ):
+        self.plan = plan
+        self.handler = handler
+        self.inner = inner
+        self.clock = clock
+        self.calls: List[str] = []  # pass-through + faulted targets, in order
+
+    async def handle_async_request(self, request: httpx.Request) -> httpx.Response:
+        target = request.url.host or str(request.url)
+        self.calls.append(target)
+        spec = self.plan.decide(target)
+        if spec is not None:
+            if spec.kind == "latency":
+                await self.clock.sleep(spec.latency_s)
+            elif spec.kind == "connect_error":
+                raise httpx.ConnectError("injected connect error", request=request)
+            elif spec.kind == "wedge":
+                raise httpx.ReadTimeout("injected wedge", request=request)
+            elif spec.kind == "partial_stream":
+                return httpx.Response(
+                    200, stream=_TruncatedStream(), request=request
+                )
+            elif spec.kind == "http_status":
+                headers = {}
+                if spec.retry_after_s is not None:
+                    headers["Retry-After"] = f"{spec.retry_after_s:g}"
+                return httpx.Response(
+                    spec.status,
+                    json={"error": f"injected {spec.status}"},
+                    headers=headers,
+                    request=request,
+                )
+            else:
+                raise ValueError(f"unknown fault kind {spec.kind!r}")
+        if self.inner is not None:
+            return await self.inner.handle_async_request(request)
+        if self.handler is None:
+            return httpx.Response(
+                200, json={"ok": True, "target": target}, request=request
+            )
+        status, payload = self.handler(request)
+        return httpx.Response(status, json=payload, request=request)

@@ -1,0 +1,124 @@
+"""Admission-time load shedding for the REST protocol layer.
+
+When the engine queue crosses a watermark, new inference work is refused
+at the door with 429 + `Retry-After` — a fast, cheap rejection the
+client's RetryPolicy understands — instead of being queued into latency
+that blows every deadline behind it.  A hysteresis band (shed at the
+watermark, resume below `resume_fraction` x watermark) prevents flapping
+at the boundary.
+
+Only POSTs are shed: health probes, readiness, metrics, and model
+listings must keep answering during overload or the system can never be
+observed (or healed) while it drowns.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from aiohttp import web
+
+
+@dataclass
+class ShedConfig:
+    # depth at which new inference POSTs start bouncing; <= 0 disables
+    queue_watermark: int = 256
+    # stop shedding once depth falls to watermark * resume_fraction
+    resume_fraction: float = 0.75
+    # the Retry-After hint handed to shed clients
+    retry_after_s: float = 1.0
+
+    @classmethod
+    def from_env(cls, env=None) -> "ShedConfig":
+        env = os.environ if env is None else env
+        return cls(
+            queue_watermark=int(env.get("KSERVE_TPU_SHED_WATERMARK", "256")),
+            resume_fraction=float(
+                env.get("KSERVE_TPU_SHED_RESUME_FRACTION", "0.75")
+            ),
+            retry_after_s=float(env.get("KSERVE_TPU_SHED_RETRY_AFTER_S", "1.0")),
+        )
+
+
+class LoadShedder:
+    """Hysteresis watermark over an externally-supplied queue depth."""
+
+    def __init__(
+        self,
+        config: Optional[ShedConfig] = None,
+        on_shed: Optional[Callable[[], None]] = None,
+    ):
+        self.config = config or ShedConfig()
+        self.on_shed = on_shed
+        self._shedding = False
+        self.shed_count = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.config.queue_watermark > 0
+
+    @property
+    def shedding(self) -> bool:
+        return self._shedding
+
+    @property
+    def retry_after_s(self) -> float:
+        return self.config.retry_after_s
+
+    def should_shed(self, depth: int) -> bool:
+        """The admission decision for one request at the given depth."""
+        if not self.enabled:
+            return False
+        if self._shedding:
+            if depth <= self.config.queue_watermark * self.config.resume_fraction:
+                self._shedding = False
+        elif depth >= self.config.queue_watermark:
+            self._shedding = True
+        if self._shedding:
+            self.shed_count += 1
+            if self.on_shed is not None:
+                self.on_shed()
+        return self._shedding
+
+
+def is_inference_path(path: str) -> bool:
+    """POST paths that enqueue engine/model work (v1 predict/explain, v2
+    infer, OpenAI heads, timeseries forecast, P/D prefill).  Admin POSTs —
+    repository load/unload in particular, the very actions an operator
+    uses to HEAL an overload — must never be shed."""
+    return (
+        ":predict" in path
+        or ":explain" in path
+        or path.endswith("/infer")
+        or path.startswith("/openai/")
+        or path.startswith("/v1/timeseries/")
+        or path.startswith("/v1/prefill/")
+    )
+
+
+def shedding_middleware(
+    shedder: LoadShedder,
+    queue_depth: Callable[[], int],
+    path_filter: Callable[[str], bool] = is_inference_path,
+):
+    """aiohttp middleware bouncing inference POSTs while past the
+    watermark; everything else (probes, GETs, metrics, repository admin)
+    always passes."""
+
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        if (
+            request.method == "POST"
+            and path_filter(request.path)
+            and shedder.should_shed(queue_depth())
+        ):
+            return web.json_response(
+                {"error": "server overloaded, shedding load"},
+                status=429,
+                headers={"Retry-After": f"{shedder.retry_after_s:g}"},
+            )
+        return await handler(request)
+
+    return middleware

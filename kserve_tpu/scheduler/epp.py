@@ -169,6 +169,10 @@ class EPPServer:
                     chunks += 1
                     await out.write(chunk)
                 await out.write_eof()
+                if 200 <= upstream.status < 300:
+                    # breaker bookkeeping: a served 2xx closes a half-open
+                    # breaker and clears the failure streak
+                    self.picker.observe_success(replica.url)
                 if upstream.status == 429 or upstream.status >= 500:
                     # REPLICA-health statuses only: 429 shedding / 5xx
                     # failures penalize picking (a shedder never trains the
@@ -197,7 +201,13 @@ class EPPServer:
                 return out
         except (aiohttp.ClientError, OSError, asyncio.TimeoutError) as exc:
             logger.warning("epp proxy to %s failed: %s", replica.url, exc)
-            self.picker.observe_failure(replica.url)
+            if out is None or not out.prepared:
+                # the replica never produced a response: a replica-side
+                # fault.  Once headers are flowing, the error is just as
+                # likely a CLIENT disconnect mid-stream (out.write raising)
+                # — penalizing the replica for those would let routine
+                # cancels trip a healthy backend's breaker.
+                self.picker.observe_failure(replica.url)
             if out is not None and out.prepared:
                 # headers already sent: a second response is impossible, so
                 # abort the stream — the client sees the truncation instead
@@ -241,6 +251,9 @@ def build_picker(args) -> EndpointPicker:
         # 1s of predicted TTFT outweighs one prefix page at the default
         # prefix weight — latency dominates only when it is material
         latency_weight = 4.0
+    from ..metrics import record_breaker_transition
+    from ..resilience import BreakerRegistry
+
     return EndpointPicker(
         replica_urls=[u for u in args.replicas.split(",") if u],
         poll_interval_s=args.poll_interval,
@@ -248,6 +261,7 @@ def build_picker(args) -> EndpointPicker:
         prefix_weight=4.0 if "prefix-cache" in strategies else 0.0,
         latency_predictor=predictor,
         latency_weight=latency_weight,
+        breakers=BreakerRegistry(on_transition=record_breaker_transition),
     )
 
 

@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
 from ..logging import logger
+from ..resilience import BreakerRegistry
 from .latency import estimate_prompt_len
 from .prefix import text_prefix_digests, token_prefix_digests
 
@@ -80,10 +81,15 @@ class EndpointPicker:
         latency_predictor=None,  # scheduler/latency.LatencyPredictor
         latency_weight: float = 0.0,  # score penalty per predicted TTFT sec
         error_weight: float = 2.0,  # score penalty per recent HTTP error
+        breakers: Optional[BreakerRegistry] = None,  # resilience/breaker.py
     ):
         self.latency_predictor = latency_predictor
         self.latency_weight = latency_weight
         self.error_weight = error_weight
+        # per-replica circuit breakers: open = excluded from picks entirely
+        # (the error_ewma penalty only down-weights; a tripped breaker must
+        # hard-stop traffic so the backend gets silence to recover)
+        self.breakers = breakers
         self.replicas: Dict[str, Replica] = {
             u.rstrip("/"): Replica(url=u.rstrip("/")) for u in replica_urls
         }
@@ -110,6 +116,10 @@ class EndpointPicker:
                     # unbounded growth under pod churn, and a recycled
                     # ip:port must not inherit the old pod's fitted model
                     self.latency_predictor.forget(u)
+                if self.breakers is not None:
+                    # same churn contract for breaker state: a fresh pod on
+                    # a recycled ip:port starts closed, not open
+                    self.breakers.forget(u)
         for u in urls:
             self.replicas.setdefault(u, Replica(url=u))
 
@@ -161,6 +171,18 @@ class EndpointPicker:
             return
         r.error_ewma = self.decayed_errors(r) + 1.0
         r.last_error_t = time.monotonic()
+        if self.breakers is not None:
+            self.breakers.record_failure(r.url)
+
+    def observe_success(self, url: str) -> None:
+        """A 2xx served through the proxy: closes a half-open breaker and
+        clears the transport-failure streak."""
+        r = self.replicas.get(url.rstrip("/"))
+        if r is None:
+            return
+        r.consecutive_failures = 0
+        if self.breakers is not None:
+            self.breakers.record_success(r.url)
 
     def observe_failure(self, url: str) -> None:
         r = self.replicas.get(url.rstrip("/"))
@@ -169,6 +191,8 @@ class EndpointPicker:
         r.consecutive_failures += 1
         if r.consecutive_failures >= self.unhealthy_after:
             r.healthy = False
+        if self.breakers is not None:
+            self.breakers.record_failure(r.url)
 
     async def refresh_once(self) -> None:
         import aiohttp
@@ -260,8 +284,15 @@ class EndpointPicker:
         prompt_ids: Optional[Sequence[int]] = None,
         prompt_text: Optional[str] = None,
     ) -> Optional[Replica]:
-        """Best replica for this request, or None when none is healthy."""
-        healthy = [r for r in self.replicas.values() if r.healthy]
+        """Best replica for this request, or None when none is healthy.
+        Replicas with an open circuit breaker are excluded from the pick
+        (half-open replicas stay in as probe traffic); all-excluded falls
+        through to None -> 503 upstream."""
+        healthy = [
+            r for r in self.replicas.values()
+            if r.healthy
+            and (self.breakers is None or self.breakers.available(r.url))
+        ]
         if not healthy:
             return None
         prompt_len = estimate_prompt_len(prompt_ids, prompt_text)
@@ -306,6 +337,10 @@ class EndpointPicker:
                 "queue_depth": r.queue_depth,
                 "free_pages": r.free_pages,
                 "digests": len(r.digests),
+                "breaker": (
+                    self.breakers.state(r.url)
+                    if self.breakers is not None else None
+                ),
             }
             for r in self.replicas.values()
         ]

@@ -1,0 +1,924 @@
+"""Resilience layer chaos tests (kserve_tpu/resilience — docs/resilience.md).
+
+Every failure here is injected by a seeded FaultPlan and every clock is a
+FakeClock: backoff schedules, breaker cooldowns, deadline expiry, and shed/
+recover cycles are asserted deterministically, with zero real sleeps —
+fast enough for tier-1.
+"""
+
+import asyncio
+import json
+import random
+from types import SimpleNamespace
+
+import httpx
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from kserve_tpu.graph.router import GraphExecutionError, GraphRouter
+from kserve_tpu.inference_client import InferenceRESTClient, RESTConfig
+from kserve_tpu.errors import InferenceError
+from kserve_tpu.resilience import (
+    DEADLINE_HEADER,
+    BreakerConfig,
+    BreakerRegistry,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceededError,
+    FakeClock,
+    FaultInjectingTransport,
+    FaultPlan,
+    FaultSpec,
+    LoadShedder,
+    RetryPolicy,
+    ShedConfig,
+    current_deadline,
+    deadline_scope,
+    parse_retry_after,
+)
+from kserve_tpu.scheduler.picker import EndpointPicker
+
+from conftest import async_test
+
+pytestmark = pytest.mark.chaos
+
+
+# ---------------- primitives ----------------
+
+
+class TestDeadline:
+    def test_header_round_trip_decrements(self):
+        clock = FakeClock()
+        d = Deadline.after(10.0, clock)
+        clock.advance(4.0)
+        # the wire form carries the REMAINING budget
+        assert float(d.to_header()) == pytest.approx(6.0, abs=1e-3)
+        hop2 = Deadline.from_header(d.to_header(), clock)
+        assert hop2.remaining() == pytest.approx(6.0, abs=1e-3)
+
+    def test_expiry_and_clamp(self):
+        clock = FakeClock()
+        d = Deadline.after(1.0, clock)
+        assert not d.expired
+        clock.advance(2.0)
+        assert d.expired
+        assert d.to_header() == "0.000"  # dead budgets propagate as zero
+
+    def test_malformed_header_ignored(self):
+        assert Deadline.from_header(None) is None
+        assert Deadline.from_header("") is None
+        assert Deadline.from_header("soon") is None
+
+    def test_contextvar_scope(self):
+        clock = FakeClock()
+        assert current_deadline() is None
+        with deadline_scope(Deadline.after(5, clock)) as d:
+            assert current_deadline() is d
+        assert current_deadline() is None
+
+
+class TestRetryPolicy:
+    def test_jitter_bounded_and_deterministic(self):
+        a = RetryPolicy(max_attempts=10, base_backoff_s=0.1, max_backoff_s=2.0, seed=7)
+        b = RetryPolicy(max_attempts=10, base_backoff_s=0.1, max_backoff_s=2.0, seed=7)
+        for attempt in range(1, 10):
+            da = a.next_delay(attempt)
+            db = b.next_delay(attempt)
+            assert da == db  # same seed, same schedule
+            cap = min(2.0, 0.1 * 2 ** (attempt - 1))
+            assert 0.0 <= da <= cap
+
+    def test_attempts_exhausted(self):
+        p = RetryPolicy(max_attempts=2, seed=0)
+        assert p.next_delay(1) is not None
+        assert p.next_delay(2) is None
+
+    def test_retry_after_floors_delay(self):
+        p = RetryPolicy(max_attempts=5, base_backoff_s=0.01, seed=0)
+        assert p.next_delay(1, retry_after=3.0) >= 3.0
+
+    def test_budget_caps_wall_time(self):
+        p = RetryPolicy(max_attempts=100, retry_budget_s=5.0, seed=0)
+        assert p.next_delay(1, retry_after=2.0, elapsed=4.0) is None
+
+    def test_no_retry_past_dead_deadline(self):
+        clock = FakeClock()
+        p = RetryPolicy(max_attempts=5, seed=0)
+        d = Deadline.after(1.0, clock)
+        # server asks for 5s but the deadline only has 1s left
+        assert p.next_delay(1, retry_after=5.0, deadline=d) is None
+
+    def test_huge_attempt_counts_never_overflow(self):
+        # wait_ready-style configs run thousands of attempts; the backoff
+        # growth must clamp to max_backoff_s, not blow up float range
+        p = RetryPolicy(max_attempts=10_000, base_backoff_s=0.2,
+                        max_backoff_s=1.0, retry_budget_s=10_000.0, seed=0)
+        for attempt in (1025, 2000, 9999):
+            delay = p.next_delay(attempt)
+            assert delay is not None and 0.0 <= delay <= 1.0
+
+    def test_parse_retry_after(self):
+        assert parse_retry_after("2") == 2.0
+        assert parse_retry_after("1.5") == 1.5
+        assert parse_retry_after(None) is None
+        assert parse_retry_after("not-a-date") is None
+        # HTTP-date form parses to a non-negative delta
+        assert parse_retry_after("Wed, 21 Oct 2015 07:28:00 GMT") == 0.0
+
+
+class TestCircuitBreaker:
+    def make(self, **kw):
+        clock = FakeClock()
+        transitions = []
+        cfg = dict(window=10, failure_threshold=0.5, min_volume=4, open_for_s=30.0)
+        cfg.update(kw)
+        b = CircuitBreaker(
+            BreakerConfig(**cfg), clock,
+            on_transition=lambda name, st: transitions.append(st), name="b",
+        )
+        return b, clock, transitions
+
+    def test_low_volume_never_opens(self):
+        b, _, _ = self.make()
+        for _ in range(3):
+            b.record_failure()
+        assert b.state == "closed"  # min_volume not reached
+
+    def test_error_rate_opens(self):
+        b, _, transitions = self.make()
+        for _ in range(2):
+            b.record_success()
+        for _ in range(2):
+            b.record_failure()
+        assert b.state == "open"
+        assert not b.allow()
+        assert transitions == ["open"]
+
+    def test_cooldown_half_open_then_close(self):
+        b, clock, transitions = self.make()
+        for _ in range(4):
+            b.record_failure()
+        assert b.state == "open"
+        clock.advance(31.0)
+        assert b.allow()  # half-open admits probe traffic
+        assert b.state == "half_open"
+        b.record_success()
+        assert b.state == "closed"
+        assert transitions == ["open", "half_open", "closed"]
+
+    def test_half_open_admits_single_probe_per_cooldown(self):
+        b, clock, _ = self.make()
+        for _ in range(4):
+            b.record_failure()
+        clock.advance(31.0)
+        assert b.allow()       # the one probe
+        assert not b.allow()   # concurrent callers refused
+        assert b.available()   # ...but the non-consuming read stays eligible
+        # an unreported probe re-grants after another cooldown (no wedge)
+        clock.advance(31.0)
+        assert b.allow()
+        b.record_success()
+        assert b.state == "closed"
+        assert b.allow()  # closed: unlimited again
+
+    def test_half_open_failure_reopens(self):
+        b, clock, _ = self.make()
+        for _ in range(4):
+            b.record_failure()
+        clock.advance(31.0)
+        assert b.state == "half_open"
+        b.record_failure()
+        assert b.state == "open"
+        assert not b.allow()
+
+    def test_registry_creates_per_backend(self):
+        reg = BreakerRegistry(BreakerConfig(min_volume=1, failure_threshold=0.5),
+                              clock=FakeClock())
+        reg.record_failure("http://a")
+        assert reg.state("http://a") == "open"
+        assert reg.state("http://b") == "closed"
+        assert reg.snapshot() == {"http://a": "open", "http://b": "closed"}
+
+
+class TestLoadShedder:
+    def test_hysteresis_band(self):
+        s = LoadShedder(ShedConfig(queue_watermark=10, resume_fraction=0.5))
+        assert not s.should_shed(9)
+        assert s.should_shed(10)
+        # still shedding inside the band (flap protection)
+        assert s.should_shed(7)
+        # resumes only below watermark * resume_fraction
+        assert not s.should_shed(5)
+        assert s.shed_count == 2
+
+    def test_disabled_by_watermark(self):
+        s = LoadShedder(ShedConfig(queue_watermark=0))
+        assert not s.should_shed(10**9)
+
+    def test_env_config(self):
+        cfg = ShedConfig.from_env({
+            "KSERVE_TPU_SHED_WATERMARK": "7",
+            "KSERVE_TPU_SHED_RETRY_AFTER_S": "2.5",
+        })
+        assert cfg.queue_watermark == 7
+        assert cfg.retry_after_s == 2.5
+
+
+class TestFaultPlan:
+    def test_deterministic_across_runs(self):
+        specs = [FaultSpec("a", "connect_error", probability=0.5)]
+        log1 = []
+        log2 = []
+        for log in (log1, log2):
+            plan = FaultPlan(specs, seed=42)
+            log.extend(plan.decide("a") is not None for _ in range(20))
+        assert log1 == log2
+        assert any(log1) and not all(log1)  # probability actually applied
+
+    def test_after_and_count(self):
+        plan = FaultPlan([FaultSpec("a", "http_status", after=2, count=3)])
+        decisions = [plan.decide("a") is not None for _ in range(8)]
+        assert decisions == [False, False, True, True, True, False, False, False]
+        assert plan.injected("http_status") == 3
+
+    def test_substring_target_match(self):
+        plan = FaultPlan([FaultSpec("decode-1", "wedge")])
+        assert plan.decide("http://decode-1:8080/v1/x") is not None
+        assert plan.decide("http://decode-2:8080/v1/x") is None
+
+
+# ---------------- graph router under chaos ----------------
+
+
+def make_chaos_router(nodes, handler=None, specs=(), policy=None,
+                      breaker_cfg=None, seed=0):
+    clock = FakeClock()
+    plan = FaultPlan(list(specs), seed=seed)
+    transport = FaultInjectingTransport(plan, handler=handler, clock=clock)
+    client = httpx.AsyncClient(transport=transport)
+    router = GraphRouter(
+        {"nodes": nodes},
+        client=client,
+        clock=clock,
+        retry_policy=policy or RetryPolicy(max_attempts=1, seed=seed),
+        breakers=BreakerRegistry(
+            breaker_cfg or BreakerConfig(min_volume=2, failure_threshold=0.5,
+                                         open_for_s=30.0),
+            clock=clock,
+        ),
+    )
+    return router, transport, clock
+
+
+SEQ_A = {"root": {"routerType": "Sequence",
+                  "steps": [{"serviceName": "a", "name": "step-a"}]}}
+
+
+class TestRouterChaos:
+    @async_test
+    async def test_timeout_maps_to_504_with_step_name(self):
+        router, _, _ = make_chaos_router(SEQ_A, specs=[FaultSpec("a", "wedge")])
+        with pytest.raises(GraphExecutionError) as err:
+            await router.execute_node("root", {}, {})
+        assert err.value.status == 504
+        assert "step-a" in str(err.value)
+
+    @async_test
+    async def test_connect_error_maps_to_502_with_step_name(self):
+        router, _, _ = make_chaos_router(
+            SEQ_A, specs=[FaultSpec("a", "connect_error")])
+        with pytest.raises(GraphExecutionError) as err:
+            await router.execute_node("root", {}, {})
+        assert err.value.status == 502
+        assert "step-a" in str(err.value)
+
+    @async_test
+    async def test_retries_with_backoff_then_succeeds(self):
+        router, transport, clock = make_chaos_router(
+            SEQ_A,
+            handler=lambda req: (200, {"ok": True}),
+            specs=[FaultSpec("a", "connect_error", count=2)],
+            policy=RetryPolicy(max_attempts=3, base_backoff_s=0.1, seed=3),
+            # loose breaker: this test isolates the retry loop
+            breaker_cfg=BreakerConfig(min_volume=10),
+        )
+        out = await router.execute_node("root", {}, {})
+        assert out == {"ok": True}
+        assert transport.calls == ["a", "a", "a"]
+        assert len(clock.sleeps) == 2  # two backoffs, on the fake clock
+
+    @async_test
+    async def test_retry_after_floors_backoff(self):
+        router, _, clock = make_chaos_router(
+            SEQ_A,
+            handler=lambda req: (200, {"ok": True}),
+            specs=[FaultSpec("a", "http_status", status=503,
+                             retry_after_s=4.0, count=1)],
+            policy=RetryPolicy(max_attempts=2, base_backoff_s=0.01, seed=0),
+        )
+        out = await router.execute_node("root", {}, {})
+        assert out == {"ok": True}
+        assert clock.sleeps and clock.sleeps[0] >= 4.0
+
+    @async_test
+    async def test_non_retryable_status_fails_fast(self):
+        router, transport, _ = make_chaos_router(
+            SEQ_A,
+            specs=[FaultSpec("a", "http_status", status=422)],
+            policy=RetryPolicy(max_attempts=5, seed=0),
+        )
+        with pytest.raises(GraphExecutionError) as err:
+            await router.execute_node("root", {}, {})
+        assert err.value.status == 422
+        assert transport.calls == ["a"]  # no retry on client-fault statuses
+
+    @async_test
+    async def test_breaker_trips_and_short_circuits(self):
+        router, transport, clock = make_chaos_router(
+            SEQ_A,
+            handler=lambda req: (200, {"ok": True}),
+            specs=[FaultSpec("a", "connect_error", count=2)],
+        )
+        for _ in range(2):
+            with pytest.raises(GraphExecutionError):
+                await router.execute_node("root", {}, {})
+        assert router.breakers.state("a") == "open"
+        # open circuit: the router fails fast without touching the backend
+        with pytest.raises(GraphExecutionError) as err:
+            await router.execute_node("root", {}, {})
+        assert err.value.status == 503
+        assert "circuit open" in str(err.value)
+        assert len(transport.calls) == 2
+        # cooldown -> half-open probe; faults are exhausted so it heals
+        clock.advance(31.0)
+        out = await router.execute_node("root", {}, {})
+        assert out == {"ok": True}
+        assert router.breakers.state("a") == "closed"
+
+    @async_test
+    async def test_deadline_expiry_mid_sequence(self):
+        nodes = {"root": {"routerType": "Sequence", "steps": [
+            {"serviceName": "a", "name": "slow-a"},
+            {"serviceName": "b", "name": "late-b", "data": "$response"},
+        ]}}
+        router, transport, clock = make_chaos_router(
+            nodes,
+            handler=lambda req: (200, {"ok": True}),
+            specs=[FaultSpec("a", "latency", latency_s=5.0)],
+        )
+        deadline = Deadline.after(3.0, clock)
+        with pytest.raises(GraphExecutionError) as err:
+            await router.execute_node("root", {}, {}, deadline=deadline)
+        # step a consumed the whole budget; step b was never called
+        assert err.value.status == 504
+        assert "late-b" in str(err.value)
+        assert transport.calls == ["a"]
+
+    @async_test
+    async def test_deadline_header_decrements_across_hops(self):
+        seen = []
+
+        def handler(req):
+            seen.append(float(req.headers[DEADLINE_HEADER]))
+            return 200, {"ok": True}
+
+        nodes = {"root": {"routerType": "Sequence", "steps": [
+            {"serviceName": "a", "name": "one"},
+            {"serviceName": "b", "name": "two", "data": "$response"},
+        ]}}
+        router, _, clock = make_chaos_router(
+            nodes, handler=handler,
+            specs=[FaultSpec("a", "latency", latency_s=2.0)],
+        )
+        await router.execute_node("root", {}, {}, deadline=Deadline.after(10.0, clock))
+        assert len(seen) == 2
+        assert seen[1] <= seen[0] - 2.0  # hop two sees the decremented budget
+
+    @async_test
+    async def test_ensemble_failure_names_member(self):
+        nodes = {"root": {"routerType": "Ensemble", "steps": [
+            {"serviceName": "good", "name": "healthy"},
+            {"serviceName": "bad", "name": "dying"},
+        ]}}
+        router, _, _ = make_chaos_router(
+            nodes,
+            handler=lambda req: (200, {"p": 1}),
+            specs=[FaultSpec("bad", "connect_error")],
+        )
+        with pytest.raises(GraphExecutionError) as err:
+            await router.execute_node("root", {}, {})
+        assert "dying" in str(err.value)
+        assert err.value.status == 502
+
+    @async_test
+    async def test_ensemble_soft_member_degrades_gracefully(self):
+        nodes = {"root": {"routerType": "Ensemble", "steps": [
+            {"serviceName": "good", "name": "healthy"},
+            {"serviceName": "bad", "name": "dying", "dependency": "Soft"},
+        ]}}
+        router, _, _ = make_chaos_router(
+            nodes,
+            handler=lambda req: (200, {"p": 1}),
+            specs=[FaultSpec("bad", "connect_error")],
+        )
+        out = await router.execute_node("root", {}, {})
+        assert out == {"healthy": {"p": 1}, "dying": None}
+
+    @async_test
+    async def test_splitter_routes_around_open_breaker(self):
+        random.seed(1234)
+        nodes = {
+            "root": {"routerType": "Splitter", "steps": [
+                {"serviceName": "bad", "name": "m", "weight": 99},
+                {"serviceName": "good", "name": "m", "weight": 1},
+            ]},
+            "bad-only": {"routerType": "Sequence",
+                         "steps": [{"serviceName": "bad", "name": "m"}]},
+        }
+        router, transport, _ = make_chaos_router(
+            nodes,
+            handler=lambda req: (200, {"host": req.url.host}),
+            specs=[FaultSpec("bad", "connect_error")],
+        )
+        # trip the breaker for "bad" deterministically
+        for _ in range(2):
+            with pytest.raises(GraphExecutionError):
+                await router.execute_node("bad-only", {}, {})
+        assert router.breakers.state("bad") == "open"
+        # despite 99:1 weights, every pick now lands on the live backend
+        for _ in range(10):
+            out = await router.execute_node("root", {}, {})
+            assert out == {"host": "good"}
+
+    @async_test
+    async def test_splitter_all_viable_tripped_returns_503_not_422(self):
+        """A zero-weight canary must not turn a tripped primary into a 422
+        'invalid weights' client error: the fallback path fails fast with
+        the accurate, retryable circuit-open 503."""
+        nodes = {
+            "root": {"routerType": "Splitter", "steps": [
+                {"serviceName": "bad", "name": "m", "weight": 100},
+                {"serviceName": "canary", "name": "m", "weight": 0},
+            ]},
+            "bad-only": {"routerType": "Sequence",
+                         "steps": [{"serviceName": "bad", "name": "m"}]},
+        }
+        router, _, _ = make_chaos_router(
+            nodes,
+            handler=lambda req: (200, {"host": req.url.host}),
+            specs=[FaultSpec("bad", "connect_error")],
+        )
+        for _ in range(2):
+            with pytest.raises(GraphExecutionError):
+                await router.execute_node("bad-only", {}, {})
+        assert router.breakers.state("bad") == "open"
+        with pytest.raises(GraphExecutionError) as err:
+            await router.execute_node("root", {}, {})
+        assert err.value.status == 503
+        assert "circuit open" in str(err.value)
+
+    @async_test
+    async def test_http_surface_rejects_expired_deadline(self):
+        router, transport, _ = make_chaos_router(
+            SEQ_A, handler=lambda req: (200, {"ok": True}))
+        client = TestClient(TestServer(router.create_application()))
+        async with client:
+            res = await client.post("/", json={}, headers={DEADLINE_HEADER: "-1"})
+            assert res.status == 504
+            assert transport.calls == []  # rejected before any backend call
+            ok = await client.post("/", json={}, headers={DEADLINE_HEADER: "30"})
+            assert ok.status == 200
+
+
+# ---------------- inference client under chaos ----------------
+
+
+def make_chaos_client(specs=(), handler=None, seed=0, max_attempts=3):
+    clock = FakeClock()
+    plan = FaultPlan(list(specs), seed=seed)
+    transport = FaultInjectingTransport(
+        plan, handler=handler or (lambda req: (200, {"predictions": [[2]]})),
+        clock=clock,
+    )
+    client = InferenceRESTClient(RESTConfig(
+        transport=transport, protocol="v1", clock=clock,
+        retry_policy=RetryPolicy(max_attempts=max_attempts, base_backoff_s=0.05,
+                                 seed=seed),
+    ))
+    return client, transport, clock
+
+
+class TestInferenceClientChaos:
+    @async_test
+    async def test_retry_after_honored_on_429(self):
+        client, transport, clock = make_chaos_client(
+            specs=[FaultSpec("m", "http_status", status=429,
+                             retry_after_s=2.0, count=1)],
+        )
+        out = await client.infer("http://m:8080", {"instances": [[1]]},
+                                 model_name="m")
+        assert out == {"predictions": [[2]]}
+        assert len(transport.calls) == 2
+        assert clock.sleeps[0] >= 2.0  # Retry-After floored the backoff
+
+    @async_test
+    async def test_503_retries_then_surfaces(self):
+        client, transport, _ = make_chaos_client(
+            specs=[FaultSpec("m", "http_status", status=503)], max_attempts=3,
+        )
+        with pytest.raises(InferenceError) as err:
+            await client.infer("http://m:8080", {"instances": [[1]]},
+                               model_name="m")
+        assert "503" in str(err.value)
+        assert len(transport.calls) == 3  # exhausted the policy first
+
+    @async_test
+    async def test_no_retry_past_dead_deadline(self):
+        client, transport, clock = make_chaos_client(
+            specs=[FaultSpec("m", "http_status", status=429,
+                             retry_after_s=5.0)],
+        )
+        with deadline_scope(Deadline.after(1.0, clock)):
+            with pytest.raises(InferenceError) as err:
+                await client.infer("http://m:8080", {"instances": [[1]]},
+                                   model_name="m")
+        # the 5s Retry-After cannot fit in the 1s budget: exactly one try
+        assert "429" in str(err.value)
+        assert len(transport.calls) == 1
+
+    @async_test
+    async def test_expired_deadline_rejected_before_send(self):
+        client, transport, clock = make_chaos_client()
+        d = Deadline.after(1.0, clock)
+        clock.advance(2.0)
+        with deadline_scope(d):
+            with pytest.raises(InferenceError) as err:
+                await client.infer("http://m:8080", {"instances": [[1]]},
+                                   model_name="m")
+        assert err.value.status == "504"
+        assert transport.calls == []
+
+    @async_test
+    async def test_deadline_header_propagates(self):
+        seen = {}
+
+        def handler(req):
+            seen["deadline"] = req.headers.get(DEADLINE_HEADER)
+            return 200, {"predictions": []}
+
+        client, _, clock = make_chaos_client(handler=handler)
+        with deadline_scope(Deadline.after(7.0, clock)):
+            await client.infer("http://m:8080", {"instances": [[1]]},
+                               model_name="m")
+        assert seen["deadline"] is not None
+        assert float(seen["deadline"]) == pytest.approx(7.0, abs=0.1)
+
+    @async_test
+    async def test_connect_errors_retry_then_raise(self):
+        client, transport, _ = make_chaos_client(
+            specs=[FaultSpec("m", "connect_error")], max_attempts=2,
+        )
+        with pytest.raises(httpx.ConnectError):
+            await client.infer("http://m:8080", {"instances": [[1]]},
+                               model_name="m")
+        assert len(transport.calls) == 2
+
+    @async_test
+    async def test_health_probes_retry_connect_errors(self):
+        """GET probes keep the connect-retry behavior the old transport-
+        level retries provided (a restarting backend must not fail a
+        single readiness poll)."""
+        client, transport, _ = make_chaos_client(
+            specs=[FaultSpec("m", "connect_error", count=1)],
+            handler=lambda req: (200, {"status": "alive"}),
+        )
+        assert await client.is_server_live("http://m:8080")
+        assert len(transport.calls) == 2  # one injected failure + retry
+
+    @async_test
+    async def test_partial_stream_surfaces_as_error(self):
+        client, _, _ = make_chaos_client(
+            specs=[FaultSpec("m", "partial_stream")], max_attempts=1,
+        )
+        with pytest.raises((httpx.ReadError, ValueError, json.JSONDecodeError)):
+            await client.infer("http://m:8080", {"instances": [[1]]},
+                               model_name="m")
+
+
+# ---------------- EPP picker breaker integration ----------------
+
+
+class TestPickerBreakers:
+    def make_picker(self):
+        clock = FakeClock()
+        breakers = BreakerRegistry(
+            BreakerConfig(min_volume=3, failure_threshold=0.5, open_for_s=30.0),
+            clock=clock,
+        )
+        # error_weight=0 isolates breaker exclusion from the score penalty
+        picker = EndpointPicker(
+            ["http://a:8080", "http://b:8080"], breakers=breakers,
+            error_weight=0.0)
+        return picker, breakers, clock
+
+    def test_open_breaker_excluded_from_picks(self):
+        picker, breakers, _ = self.make_picker()
+        picker.observe_state("http://a:8080", {"queue_depth": 0, "free_pages": 50})
+        picker.observe_state("http://b:8080", {"queue_depth": 0, "free_pages": 50})
+        for _ in range(3):
+            picker.observe_http_error("http://a:8080")
+        assert breakers.state("http://a:8080") == "open"
+        for _ in range(6):
+            assert picker.pick(prompt_ids=[1, 2, 3]).url == "http://b:8080"
+
+    def test_all_open_yields_none(self):
+        picker, _, _ = self.make_picker()
+        for url in ("http://a:8080", "http://b:8080"):
+            picker.observe_state(url, {"queue_depth": 0})
+            for _ in range(3):
+                picker.observe_http_error(url)
+        assert picker.pick(prompt_ids=[1]) is None  # 503 upstream
+
+    def test_half_open_probe_and_recovery(self):
+        picker, breakers, clock = self.make_picker()
+        picker.observe_state("http://a:8080", {"queue_depth": 0})
+        picker.observe_state("http://b:8080", {"queue_depth": 0})
+        for _ in range(3):
+            picker.observe_http_error("http://a:8080")
+        assert breakers.state("http://a:8080") == "open"
+        clock.advance(31.0)
+        # half-open: back in the candidate set as probe traffic
+        urls = {picker.pick(prompt_ids=[1]).url for _ in range(8)}
+        assert "http://a:8080" in urls
+        picker.observe_success("http://a:8080")
+        assert breakers.state("http://a:8080") == "closed"
+
+    def test_replica_churn_forgets_breaker_state(self):
+        """A recycled ip:port must not inherit the dead pod's open breaker,
+        and the registry must not grow unboundedly under churn."""
+        picker, breakers, _ = self.make_picker()
+        picker.observe_state("http://a:8080", {"queue_depth": 0})
+        for _ in range(3):
+            picker.observe_http_error("http://a:8080")
+        assert breakers.state("http://a:8080") == "open"
+        picker.set_replicas(["http://b:8080"])  # pod a dies
+        picker.set_replicas(["http://a:8080", "http://b:8080"])  # recycled
+        assert breakers.state("http://a:8080") == "closed"
+        assert picker.pick(prompt_ids=[1]) is not None
+
+    def test_snapshot_reports_breaker_state(self):
+        picker, _, _ = self.make_picker()
+        states = {s["url"]: s["breaker"] for s in picker.snapshot()}
+        assert states == {"http://a:8080": "closed", "http://b:8080": "closed"}
+
+    def test_transition_metrics_hook(self):
+        from kserve_tpu.metrics import BREAKER_TRANSITIONS, record_breaker_transition
+
+        clock = FakeClock()
+        breakers = BreakerRegistry(
+            BreakerConfig(min_volume=1, failure_threshold=0.5),
+            clock=clock, on_transition=record_breaker_transition,
+        )
+        before = BREAKER_TRANSITIONS.labels(state="open")._value.get()
+        breakers.record_failure("http://x:1")
+        after = BREAKER_TRANSITIONS.labels(state="open")._value.get()
+        assert after == before + 1
+
+
+# ---------------- REST server: shedding + deadline middleware ----------------
+
+
+def make_rest_client(shed_config=None, queue_depth=0):
+    from kserve_tpu.model import Model
+    from kserve_tpu.model_repository import ModelRepository
+    from kserve_tpu.protocol.model_repository_extension import (
+        ModelRepositoryExtension,
+    )
+    from kserve_tpu.protocol.openai.dataplane import OpenAIDataPlane
+    from kserve_tpu.protocol.rest.server import RESTServer
+
+    class EngineBackedModel(Model):
+        def __init__(self):
+            super().__init__("dummy")
+            self.ready = True
+            self.engine = SimpleNamespace(queue_depth=queue_depth)
+
+        async def predict(self, payload, headers=None, response_headers=None):
+            return {"predictions": payload["instances"]}
+
+    repo = ModelRepository()
+    model = EngineBackedModel()
+    repo.update(model)
+    server = RESTServer(
+        OpenAIDataPlane(repo), ModelRepositoryExtension(repo),
+        shed_config=shed_config,
+    )
+    return TestClient(TestServer(server.create_application())), model
+
+
+class TestRESTShedding:
+    @async_test
+    async def test_sheds_429_with_retry_after_then_recovers(self):
+        client, model = make_rest_client(
+            shed_config=ShedConfig(queue_watermark=4, resume_fraction=0.5,
+                                   retry_after_s=2.5),
+            queue_depth=10,
+        )
+        async with client:
+            res = await client.post("/v1/models/dummy:predict",
+                                    json={"instances": [[1]]})
+            assert res.status == 429
+            assert res.headers["Retry-After"] == "2.5"
+            # probes keep answering during overload
+            live = await client.get("/")
+            assert live.status == 200
+            # pressure drains below the resume band -> admission recovers
+            model.engine.queue_depth = 1
+            res = await client.post("/v1/models/dummy:predict",
+                                    json={"instances": [[1]]})
+            assert res.status == 200
+            assert (await res.json()) == {"predictions": [[1]]}
+
+    @async_test
+    async def test_hysteresis_keeps_shedding_inside_band(self):
+        client, model = make_rest_client(
+            shed_config=ShedConfig(queue_watermark=4, resume_fraction=0.5),
+            queue_depth=4,
+        )
+        async with client:
+            assert (await client.post("/v1/models/dummy:predict",
+                                      json={"instances": [[1]]})).status == 429
+            model.engine.queue_depth = 3  # inside the hysteresis band
+            assert (await client.post("/v1/models/dummy:predict",
+                                      json={"instances": [[1]]})).status == 429
+
+    @async_test
+    async def test_admin_posts_never_shed(self):
+        """Repository load/unload must pass during overload — they are the
+        actions an operator uses to heal it (only inference paths shed)."""
+        client, _ = make_rest_client(
+            shed_config=ShedConfig(queue_watermark=4), queue_depth=100)
+        async with client:
+            shed = await client.post("/v1/models/dummy:predict",
+                                     json={"instances": [[1]]})
+            assert shed.status == 429
+            admin = await client.post("/v2/repository/models/dummy/unload")
+            assert admin.status != 429
+
+    @async_test
+    async def test_disabled_shedder_admits_everything(self):
+        client, _ = make_rest_client(
+            shed_config=ShedConfig(queue_watermark=0), queue_depth=10**6)
+        async with client:
+            res = await client.post("/v1/models/dummy:predict",
+                                    json={"instances": [[1]]})
+            assert res.status == 200
+
+
+class TestRESTDeadline:
+    @async_test
+    async def test_expired_deadline_rejected_504(self):
+        client, _ = make_rest_client()
+        async with client:
+            res = await client.post(
+                "/v1/models/dummy:predict", json={"instances": [[1]]},
+                headers={DEADLINE_HEADER: "-1"},
+            )
+            assert res.status == 504
+            assert "deadline" in (await res.json())["error"]
+
+    @async_test
+    async def test_live_deadline_passes_and_malformed_ignored(self):
+        client, _ = make_rest_client()
+        async with client:
+            ok = await client.post(
+                "/v1/models/dummy:predict", json={"instances": [[1]]},
+                headers={DEADLINE_HEADER: "30"},
+            )
+            assert ok.status == 200
+            junk = await client.post(
+                "/v1/models/dummy:predict", json={"instances": [[1]]},
+                headers={DEADLINE_HEADER: "whenever"},
+            )
+            assert junk.status == 200
+
+
+# ---------------- engine: deadline admission + injected wedge ----------------
+
+
+class TestEngineResilience:
+    def test_expired_deadline_rejected_before_stream_machinery(self):
+        from test_engine import make_engine
+
+        engine = make_engine()
+        clock = FakeClock()
+        d = Deadline.after(1.0, clock)
+        clock.advance(2.0)
+        from kserve_tpu.engine.sampling import SamplingParams
+
+        with deadline_scope(d):
+            with pytest.raises(DeadlineExceededError):
+                engine.generate([1, 2, 3], SamplingParams(max_tokens=4))
+
+    @async_test
+    async def test_queued_request_dropped_on_expiry(self):
+        from test_engine import make_engine
+        from kserve_tpu.engine.sampling import SamplingParams
+
+        engine = make_engine()  # not started: requests stay queued
+        clock = FakeClock()
+
+        async def consume():
+            with deadline_scope(Deadline.after(5.0, clock)):
+                stream = engine.generate([1, 2, 3], SamplingParams(max_tokens=4))
+            async for _ in stream:
+                pass
+
+        task = asyncio.create_task(consume())
+        for _ in range(5):
+            await asyncio.sleep(0)
+        assert engine.queue_depth == 1
+        clock.advance(10.0)
+        engine._drop_expired_waiting()
+        with pytest.raises(DeadlineExceededError):
+            await task
+        assert engine.queue_depth == 0
+
+    def test_fault_plan_wedge_honored_by_fetch(self):
+        from test_engine import make_engine
+        from kserve_tpu.engine.engine import EngineWedgedError
+
+        engine = make_engine()
+        engine.fault_plan = FaultPlan([FaultSpec("engine.fetch", "wedge")])
+        assert not engine.wedged
+        with pytest.raises(EngineWedgedError):
+            engine._fetch([1, 2, 3])
+        assert engine.wedged
+
+
+# ---------------- acceptance: the end-to-end chaos scenario ----------------
+
+
+class TestEndToEndChaos:
+    @async_test
+    async def test_breaker_trip_reroute_deadline_and_shed_recovery(self):
+        """ISSUE 4 acceptance: one seeded FaultPlan drives (1) a backend
+        failure that trips its breaker and the router routing around it,
+        (2) an over-deadline request rejected 504 before any backend work,
+        and (3) queue pressure shedding 429 + Retry-After, then recovering
+        — all deterministic, zero real sleeps."""
+        random.seed(99)
+        nodes = {
+            "root": {"routerType": "Splitter", "steps": [
+                {"serviceName": "dying", "name": "m", "weight": 95},
+                {"serviceName": "healthy", "name": "m", "weight": 5},
+            ]},
+            "probe": {"routerType": "Sequence",
+                      "steps": [{"serviceName": "dying", "name": "m"}]},
+        }
+        router, transport, clock = make_chaos_router(
+            nodes,
+            handler=lambda req: (200, {"host": req.url.host}),
+            specs=[FaultSpec("dying", "connect_error", count=2)],
+            seed=99,
+        )
+        # (1) injected backend failure trips the breaker...
+        for _ in range(2):
+            with pytest.raises(GraphExecutionError) as err:
+                await router.execute_node("probe", {}, {})
+            assert err.value.status == 502
+        assert router.breakers.state("dying") == "open"
+        # ...and the router routes around the dead member
+        calls_before = len(transport.calls)
+        for _ in range(8):
+            out = await router.execute_node("root", {}, {})
+            assert out == {"host": "healthy"}
+        assert transport.calls[calls_before:] == ["healthy"] * 8
+        # (2) an over-deadline request is rejected 504 before any call
+        dead = Deadline.after(1.0, clock)
+        clock.advance(2.0)
+        calls_before = len(transport.calls)
+        with pytest.raises(GraphExecutionError) as err:
+            await router.execute_node("root", {}, {}, deadline=dead)
+        assert err.value.status == 504
+        assert len(transport.calls) == calls_before
+        # (3) sustained queue pressure sheds 429 + Retry-After, then recovers
+        client, model = make_rest_client(
+            shed_config=ShedConfig(queue_watermark=4, resume_fraction=0.5,
+                                   retry_after_s=1.5),
+            queue_depth=50,
+        )
+        async with client:
+            shed = await client.post("/v1/models/dummy:predict",
+                                     json={"instances": [[1]]})
+            assert shed.status == 429
+            assert shed.headers["Retry-After"] == "1.5"
+            model.engine.queue_depth = 0
+            ok = await client.post("/v1/models/dummy:predict",
+                                   json={"instances": [[1]]})
+            assert ok.status == 200
+        # the breaker heals too: cooldown + exhausted faults -> closed
+        clock.advance(31.0)
+        out = await router.execute_node("probe", {}, {})
+        assert out == {"host": "dying"}
+        assert router.breakers.state("dying") == "closed"
