@@ -20,17 +20,32 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+from ..lifecycle.state import DEFAULT_DRAIN_GRACE_S, normalize_drain_grace
 from .crds import (
     LLMInferenceService,
     LLMInferenceServiceConfig,
     ParallelismSpec,
     WorkloadSpec,
 )
-from .objects import ensure_probes, make_object, set_condition, set_owner, strategic_merge
+from .objects import (
+    ensure_drain_lifecycle,
+    ensure_probes,
+    make_object,
+    set_condition,
+    set_owner,
+    strategic_merge,
+)
 from .topology import plan_slice
 from .webhook import PodMutator
 
 GENERATIVE_IMAGE = "kserve-tpu/generative:latest"
+
+# graceful-drain budget handed to the runtime (KSERVE_TPU_DRAIN_GRACE env)
+# and margin added on top for the post-drain shutdown (checkpoint delivery,
+# server teardown) before kubelet SIGKILLs — together they set
+# terminationGracePeriodSeconds
+DRAIN_GRACE_S = DEFAULT_DRAIN_GRACE_S
+DRAIN_SHUTDOWN_MARGIN_S = 15.0
 
 # full k8s quantity suffix set (binary Ki..Ei, decimal k..E, milli)
 _QUANTITY_BYTES = {
@@ -297,9 +312,30 @@ class LLMISVCReconciler:
             slice_plan=plan,
             service_account=pod_spec.get("serviceAccountName") or "default",
         )
+        effective_grace_s = DRAIN_GRACE_S
         for c in pod_spec.get("containers", []):
             if c.get("name") == "main":
                 ensure_probes(c)
+                # preStop drain + aligned grace: pod deletion starts the
+                # drain BEFORE SIGTERM, and kubelet waits out the budget
+                # plus shutdown margin before SIGKILL — no generation dies
+                # inside its budget (docs/lifecycle.md)
+                ensure_drain_lifecycle(c, DRAIN_GRACE_S)
+                # a user-supplied KSERVE_TPU_DRAIN_GRACE env wins inside
+                # ensure_drain_lifecycle — the grace period must track the
+                # budget the runtime will actually grant, or kubelet
+                # SIGKILLs generations still inside their budget
+                for e in c.get("env", []):
+                    if e.get("name") == "KSERVE_TPU_DRAIN_GRACE":
+                        # shares the runtime's parse/bounds (valueFrom,
+                        # garbage, inf/nan/negative all keep the default)
+                        v = normalize_drain_grace(e.get("value"))
+                        if v is not None:
+                            effective_grace_s = v
+        pod_spec.setdefault(
+            "terminationGracePeriodSeconds",
+            int(effective_grace_s + DRAIN_SHUTDOWN_MARGIN_S),
+        )
         if adapters:
             # adapter downloads get the same image override, credentials and
             # CA trust as the model's storage-initializer

@@ -215,3 +215,31 @@ def ensure_probes(container: dict, port: int = None) -> dict:
         "failureThreshold": 6,
     })
     return container
+
+
+def ensure_drain_lifecycle(container: dict, drain_grace_s: float,
+                           port: int = None) -> dict:
+    """preStop drain hook on a synthesized serving container: pod deletion
+    POSTs /admin/drain BEFORE kubelet sends SIGTERM, so the replica flips
+    DRAINING (readiness red, EPP stops picking it) and in-flight
+    generations start burning their drain budget immediately — the SIGTERM
+    that follows joins the same budget instead of starting a fresh one
+    (kserve_tpu/lifecycle, docs/lifecycle.md).  The KSERVE_TPU_DRAIN_GRACE
+    env aligns the runtime's budget with the pod's
+    terminationGracePeriodSeconds, which the caller must set to
+    drain_grace_s plus shutdown margin.  User-provided lifecycle wins."""
+    if port is None:
+        ports = container.get("ports") or [{}]
+        port = ports[0].get("containerPort", 8080)
+    container.setdefault("lifecycle", {}).setdefault("preStop", {
+        # ?source=prestop: the GET route is read-only without this marker
+        # (a scanner's stray GET must not retire a healthy replica)
+        "httpGet": {"path": "/admin/drain?source=prestop", "port": port},
+    })
+    env = container.setdefault("env", [])
+    if not any(e.get("name") == "KSERVE_TPU_DRAIN_GRACE" for e in env):
+        env.append({
+            "name": "KSERVE_TPU_DRAIN_GRACE",
+            "value": f"{drain_grace_s:g}",
+        })
+    return container

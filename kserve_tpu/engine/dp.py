@@ -37,6 +37,7 @@ class DataParallelEngine:
         params: Optional[Any] = None,
         rng_seed: int = 0,
         devices: Optional[list] = None,
+        checkpoint_label: Optional[str] = None,
         lora_adapters: Optional[dict] = None,
     ):
         dp = engine_config.dp
@@ -70,6 +71,10 @@ class DataParallelEngine:
                 rng_seed=rng_seed + g,
                 devices=devices[g * per_replica : (g + 1) * per_replica],
                 metrics_label=f"engine-dp{g}",
+                # one weights identity shared by every dp group (NOT the
+                # per-group metrics label): a checkpoint from any group
+                # resumes on any other
+                checkpoint_label=checkpoint_label or "engine",
                 lora_stacked=lora_stacked,
             )
             for g in range(dp)
@@ -101,6 +106,28 @@ class DataParallelEngine:
         """Any replica wedged wedges the pod: its slice of traffic would
         hang forever, and a restart re-homes all replicas together."""
         return any(eng.wedged for eng in self.replicas)
+
+    @property
+    def draining(self) -> bool:
+        return any(eng.draining for eng in self.replicas)
+
+    async def drain(self, deadline=None, clock=None,
+                    poll_s: float = 0.01) -> list:
+        """Drain every dp group concurrently against the shared budget;
+        the pod's checkpoints are the aggregate (lifecycle drain —
+        docs/lifecycle.md)."""
+        results = await asyncio.gather(
+            *[eng.drain(deadline, clock=clock, poll_s=poll_s)
+              for eng in self.replicas]
+        )
+        return [ckpt for per_replica in results for ckpt in per_replica]
+
+    def resume_generation(
+        self, checkpoint, request_id: Optional[str] = None,
+    ) -> AsyncIterator[GenerationOutput]:
+        """Re-seat a drained/preempted generation on the least-loaded dp
+        group (all groups share one weights identity, so any accepts it)."""
+        return self._pick().resume_generation(checkpoint, request_id=request_id)
 
     # ---------------- routing ----------------
 
@@ -167,8 +194,15 @@ def build_engine(
     params: Optional[Any] = None,
     rng_seed: int = 0,
     lora_adapters: Optional[dict] = None,
+    checkpoint_label: Optional[str] = None,
 ):
-    """LLMEngine for dp=1, DataParallelEngine for dp>1."""
+    """LLMEngine for dp=1, DataParallelEngine for dp>1.
+
+    checkpoint_label is the weights identity stamped into generation
+    checkpoints — pass the served model's name so resume_generation can
+    refuse checkpoints captured against different weights (every engine
+    defaulting to the same label would make that guard vacuous)."""
     cls = DataParallelEngine if engine_config.dp > 1 else LLMEngine
     return cls(model_config, engine_config, tokenizer, params=params,
-               rng_seed=rng_seed, lora_adapters=lora_adapters)
+               rng_seed=rng_seed, lora_adapters=lora_adapters,
+               checkpoint_label=checkpoint_label)

@@ -47,10 +47,17 @@ from ..metrics import (
     GENERATED_TOKENS,
     PROMPT_TOKENS,
 )
-from ..metrics import DEADLINE_REJECTED
+from ..metrics import (
+    DEADLINE_REJECTED,
+    GENERATION_CHECKPOINTS,
+    GENERATION_RESUMES,
+    TOKENS_SALVAGED,
+)
+from ..lifecycle.checkpoint import GenerationCheckpoint, GenerationPreempted
+from ..lifecycle.state import ReplicaDrainingError
 from ..models import llama
 from ..parallel import sharding as shd
-from ..resilience import DeadlineExceededError, current_deadline
+from ..resilience import MONOTONIC, Deadline, DeadlineExceededError, current_deadline
 from .kvcache import (
     KVCacheConfig,
     PageAllocator,
@@ -84,6 +91,7 @@ class LLMEngine:
         rng_seed: int = 0,
         devices: Optional[list] = None,
         metrics_label: str = "engine",
+        checkpoint_label: Optional[str] = None,  # weights identity for resume
         lora_adapters: Optional[Dict[str, str]] = None,
         lora_stacked=None,  # (adapter_ids, per-layer stacks) pre-loaded
     ):
@@ -108,6 +116,11 @@ class LLMEngine:
                 f"vocab ({model_config.vocab_size}); ids past the embedding "
                 "table would silently clamp under jit")
         self._mlabel = metrics_label
+        # checkpoints carry this as model_name; resume_generation rejects a
+        # mismatch.  Distinct from the metrics label so DP sub-engines
+        # (engine-dp0, engine-dp1, ...) share one weights identity and a
+        # checkpoint from any of them resumes on any other
+        self._ckpt_label = checkpoint_label or metrics_label
         shd.validate_tp(model_config, engine_config.tp)
         if engine_config.sp > 1 and (
                 model_config.sliding_window > 0
@@ -317,6 +330,16 @@ class LLMEngine:
         self._detached_queue: List[tuple] = []
         self._detached_task: Optional[asyncio.Task] = None
         self._stopped = False
+        # lifecycle (kserve_tpu/lifecycle): once draining, new admission is
+        # refused (503 upstream) and drain() checkpoints whatever the drain
+        # budget cannot finish; resume_count/checkpointed_count are the
+        # test/observability counters behind the prometheus metrics
+        self._draining = False
+        self.resume_count = 0
+        self.checkpointed_count = 0
+        # requests popped from _waiting by an in-flight _admit_batch; the
+        # crash handler fails these too (they are otherwise unreachable)
+        self._admitting: List[tuple] = []
         self._task: Optional[asyncio.Task] = None
         self._pipeline_busy = False
         self._deferred_free: List[int] = []
@@ -392,6 +415,13 @@ class LLMEngine:
     async def stop(self):
         self._stopped = True
         self._wake.set()
+        # fail queued-but-unseated requests NOW, before waiting on the loop
+        # task: their asyncio queues would otherwise never see another put
+        # and the consumer side would hang forever (a stop mid-drain leaves
+        # exactly these behind)
+        self._fail_waiting(lambda req: RuntimeError(
+            f"engine stopped before request {req.request_id} was seated"
+        ))
         # fail queued detached-prefill waiters before cancelling the worker —
         # otherwise prefill-role HTTP handlers awaiting prefill_detached()
         # hang until client timeout
@@ -408,12 +438,38 @@ class LLMEngine:
             except (asyncio.TimeoutError, asyncio.CancelledError):
                 self._task.cancel()
             self._task = None
+        # the loop is down: fail whatever is still seated (and anything the
+        # loop's final iteration re-queued) so no stream outlives the engine
+        self._fail_waiting(lambda req: RuntimeError(
+            f"engine stopped before request {req.request_id} was seated"
+        ))
+        for slot in self._slots:
+            if slot.request_id is not None:
+                self._evict_slot(slot, RuntimeError("engine stopped"))
         # close AFTER the loop task is done: an in-flight chunk draining
         # through _fetch must reach a live worker (close-first would stall
         # the drain a full step deadline, then false-flag a wedge)
         self._fetcher.close()
         if self._kv_store is not None:
             self._kv_store.close()
+
+    def _discard_resume_kv(self, req) -> None:
+        """Release a queued request's spilled resume KV to the tier store
+        (shared by every path that fails/checkpoints waiting requests)."""
+        if (req.resume is not None and req.resume["kv"] is not None
+                and self._kv_store is not None):
+            self._kv_store.discard(req.resume["kv"])
+            self._set_offload_gauges()
+
+    def _fail_waiting(self, make_exc) -> None:
+        """Fail every queued-but-unseated request with make_exc(req),
+        releasing any spilled resume KV back to the tier store."""
+        pending, self._waiting = self._waiting, []
+        for req in pending:
+            self._discard_resume_kv(req)
+            req.queue.put_nowait(make_exc(req))
+        if pending:
+            ENGINE_QUEUE_DEPTH.labels(model_name=self._mlabel).set(0)
 
     @property
     def running(self) -> bool:
@@ -463,24 +519,46 @@ class LLMEngine:
         ENGINE_KV_DISK_BYTES.labels(model_name=self._mlabel).set(
             self._kv_store.disk_used)
 
-    def _fetch(self, x) -> np.ndarray:
-        """Device->host fetch with the wedge deadline (see step_deadline_s)."""
+    def _fetch_fault_check(self) -> None:
+        """Shared fault seam for _fetch/_fetch_async — one copy, so a new
+        fault kind can't be honored in one fetch path and not the other."""
         if self.fault_plan is not None:
             spec = self.fault_plan.decide("engine.fetch")
             if spec is not None and spec.kind == "wedge":
-                self._wedged = True
-                ENGINE_WEDGED.labels(model_name=self._mlabel).set(1)
-                raise EngineWedgedError("injected wedge (fault plan)")
+                raise self._wedge("injected wedge (fault plan)")
+
+    def _wedge(self, msg: str) -> EngineWedgedError:
+        self._wedged = True
+        ENGINE_WEDGED.labels(model_name=self._mlabel).set(1)
+        return EngineWedgedError(msg)
+
+    def _fetch_timeout(self) -> EngineWedgedError:
+        return self._wedge(
+            f"device fetch exceeded step_deadline_s="
+            f"{self.config.step_deadline_s}s — device tunnel wedged?"
+        )
+
+    def _fetch(self, x) -> np.ndarray:
+        """Device->host fetch with the wedge deadline (see step_deadline_s)."""
+        self._fetch_fault_check()
         try:
             return self._fetcher.fetch(
                 lambda: np.asarray(x), self.config.step_deadline_s)
         except TimeoutError:
-            self._wedged = True
-            ENGINE_WEDGED.labels(model_name=self._mlabel).set(1)
-            raise EngineWedgedError(
-                f"device fetch exceeded step_deadline_s="
-                f"{self.config.step_deadline_s}s — device tunnel wedged?"
-            ) from None
+            raise self._fetch_timeout() from None
+
+    async def _fetch_async(self, x) -> np.ndarray:
+        """_fetch for the decode hot loop: AWAITS the device->host fetch so
+        the event loop keeps serving (probes, /admin/drain, the drain
+        budget loop, admission rejects) while the chunk computes — a
+        blocking wait here starves every other coroutine for the full step
+        duration.  Same fault seam and wedge mapping as _fetch."""
+        self._fetch_fault_check()
+        try:
+            return await self._fetcher.fetch_async(
+                lambda: np.asarray(x), self.config.step_deadline_s)
+        except TimeoutError:
+            raise self._fetch_timeout() from None
 
     def generate(
         self,
@@ -499,6 +577,7 @@ class LLMEngine:
             raise ValueError(
                 f"prompt+max_tokens exceeds max_model_len {self.config.max_model_len}"
             )
+        self._check_accepting()
         deadline = self._admission_deadline()
         queue: asyncio.Queue = asyncio.Queue()
         rid = request_id or f"req-{time.monotonic_ns()}"
@@ -508,6 +587,17 @@ class LLMEngine:
             deadline=deadline,
         )
         return self._submit_and_stream(req)
+
+    def _check_accepting(self) -> None:
+        """Admission gate for the lifecycle layer: a draining (or stopped)
+        engine refuses new work synchronously — 503 + Retry-After upstream —
+        instead of queueing it into a replica that is going away."""
+        if self._stopped or self._draining:
+            raise ReplicaDrainingError(
+                "engine is "
+                + ("stopped" if self._stopped else "draining")
+                + "; retry another replica"
+            )
 
     def _admission_deadline(self):
         """The propagated request deadline (resilience contextvar), checked
@@ -567,6 +657,7 @@ class LLMEngine:
                 f"this engine's cache (expected {expect}); prefill peer and "
                 "decode server must share model + page_size configuration"
             )
+        self._check_accepting()
         deadline = self._admission_deadline()
         queue: asyncio.Queue = asyncio.Queue()
         rid = request_id or f"req-{time.monotonic_ns()}"
@@ -579,6 +670,12 @@ class LLMEngine:
         return self._submit_and_stream(req)
 
     async def _submit_and_stream(self, req: "_QueuedRequest"):
+        # re-check admission at ENQUEUE time: _check_accepting ran in the
+        # sync part of the caller, but the first __anext__ can land after a
+        # drain that already flushed _waiting for the last time — appending
+        # now would strand this request forever (nothing re-flushes once
+        # drain() has returned)
+        self._check_accepting()
         self._waiting.append(req)
         ENGINE_QUEUE_DEPTH.labels(model_name=self._mlabel).set(len(self._waiting))
         self._wake.set()
@@ -628,8 +725,7 @@ class LLMEngine:
                 f"prompt length {n} exceeds max_prefill_len "
                 f"{self.config.max_prefill_len}"
             )
-        if self._stopped:
-            raise RuntimeError("engine stopped")
+        self._check_accepting()
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._detached_queue.append(
             (list(prompt_ids), params, fut, self._resolve_adapter(adapter))
@@ -732,11 +828,8 @@ class LLMEngine:
         for r in self._waiting:
             if r.request_id != request_id:
                 kept.append(r)
-            elif r.resume is not None and r.resume["kv"] is not None:
-                # release the spill from the tier store
-                if self._kv_store is not None:
-                    self._kv_store.discard(r.resume["kv"])
-                    self._set_offload_gauges()
+            else:
+                self._discard_resume_kv(r)
         self._waiting = kept
         for i, slot in enumerate(self._slots):
             if slot.request_id == request_id:
@@ -744,6 +837,212 @@ class LLMEngine:
                 slot.reset()
                 self._mark_penalty_dirty(i)
                 self._wake.set()
+
+    # ---------------- lifecycle: drain + resumable generation ----------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def _adapter_name(self, adapter_id: int) -> Optional[str]:
+        if adapter_id < 0:
+            return None
+        for name, i in self.adapter_ids.items():
+            if i == adapter_id:
+                return name
+        return None
+
+    def _checkpoint(self, request_id, prompt_ids, generated, params,
+                    adapter_id, deadline, reason) -> GenerationCheckpoint:
+        ckpt = GenerationCheckpoint.capture(
+            request_id=request_id,
+            prompt_ids=prompt_ids,
+            generated=generated,
+            params=params,
+            adapter=self._adapter_name(adapter_id),
+            model_name=self._ckpt_label,
+            deadline=deadline,
+            reason=reason,
+        )
+        self.checkpointed_count += 1
+        GENERATION_CHECKPOINTS.labels(
+            model_name=self._mlabel, reason=reason).inc()
+        return ckpt
+
+    def _checkpoint_slot(self, slot: _Slot, reason: str) -> GenerationCheckpoint:
+        """Snapshot a seated slot.  A slot still chunk-prefilling has
+        emitted nothing; its checkpoint carries only the prompt (plus any
+        prior resume progress), so resume costs exactly one prefill."""
+        if slot.prefilling is not None:
+            req = slot.prefilling["req"]
+            generated = req.resume["generated"] if req.resume is not None else []
+            return self._checkpoint(
+                req.request_id, req.prompt_ids, generated, req.params,
+                req.adapter_id, req.deadline, reason,
+            )
+        return self._checkpoint(
+            slot.request_id, slot.prompt_ids, slot.generated, slot.params,
+            slot.adapter_id, slot.deadline, reason,
+        )
+
+    def _evict_slot(self, slot: _Slot, exc: Exception) -> None:
+        """Deliver exc to the slot's stream and release its resources
+        (deferred-free-safe: legal while a chained chunk is in flight)."""
+        slot.queue.put_nowait(exc)
+        self._free_pages(slot.pages)
+        idx = self._slots.index(slot)
+        slot.reset()
+        self._mark_penalty_dirty(idx)
+
+    def _checkpoint_waiting(self, reason: str,
+                            out: List[GenerationCheckpoint]) -> None:
+        """Checkpoint + fail every queued-but-unseated request (fresh
+        arrivals and KV-pressure preemptions alike).  Their streams see
+        GenerationPreempted; spilled resume KV is released."""
+        pending, self._waiting = self._waiting, []
+        for req in pending:
+            self._discard_resume_kv(req)
+            generated = (
+                list(req.resume["generated"]) if req.resume is not None else []
+            )
+            ckpt = self._checkpoint(
+                req.request_id, req.prompt_ids, generated, req.params,
+                req.adapter_id, req.deadline, reason,
+            )
+            out.append(ckpt)
+            req.queue.put_nowait(GenerationPreempted(ckpt))
+        if pending:
+            ENGINE_QUEUE_DEPTH.labels(model_name=self._mlabel).set(0)
+
+    async def drain(self, deadline: Optional[Deadline] = None,
+                    clock=None, poll_s: float = 0.01) -> List[GenerationCheckpoint]:
+        """Graceful drain (SIGTERM / POST /admin/drain): stop admitting,
+        give in-flight generations until `deadline` (the replica's drain
+        budget — lifecycle.begin_drain()) to finish, then snapshot whatever
+        remains into portable GenerationCheckpoints delivered to each
+        stream as GenerationPreempted.  Queued-but-unseated requests are
+        checkpointed immediately — re-seating them here would burn budget a
+        healthy replica could spend better.  `clock` is the chaos-test seam
+        (FakeClock => the wait is virtual); escalation (second SIGTERM)
+        expires `deadline` in place, which this loop observes on its next
+        poll.  Returns the checkpoints, newest last."""
+        self._draining = True
+        clk = clock or MONOTONIC
+        checkpoints: List[GenerationCheckpoint] = []
+        while True:
+            # KV-pressure preemptions during the drain land back in
+            # _waiting; flush them each pass instead of re-seating
+            self._checkpoint_waiting("drain", checkpoints)
+            active = [s for s in self._slots if s.request_id is not None]
+            if not active:
+                break
+            if deadline is not None and deadline.expired:
+                for slot in active:
+                    ckpt = self._checkpoint_slot(slot, "drain")
+                    checkpoints.append(ckpt)
+                    self._evict_slot(slot, GenerationPreempted(ckpt))
+                self._wake.set()
+                break
+            await clk.sleep(poll_s)
+        if checkpoints:
+            logger.info(
+                "drain: %d generation(s) checkpointed (%d tokens salvaged)",
+                len(checkpoints),
+                sum(c.tokens_salvaged for c in checkpoints),
+            )
+        return checkpoints
+
+    def resume_generation(
+        self,
+        checkpoint: GenerationCheckpoint,
+        request_id: Optional[str] = None,
+    ) -> AsyncIterator[GenerationOutput]:
+        """Admit a checkpointed generation from another (drained/preempted)
+        replica.  Resume rides the existing preemption-resume machinery: a
+        prefill of prompt+generated[:-1] (cheap under the prefix cache)
+        re-creates the KV, the detokenizer is replayed to the checkpoint
+        point, and decoding continues at the NEXT token — the re-prefill
+        emits nothing, so the spliced stream has zero duplicated and zero
+        dropped tokens.  Sync validation, async stream (see generate)."""
+        if checkpoint.model_name and checkpoint.model_name != self._ckpt_label:
+            raise ValueError(
+                f"checkpoint was captured on model {checkpoint.model_name!r} "
+                f"but this engine serves {self._ckpt_label!r}; resume "
+                "requires identical weights"
+            )
+        # header-sourced checkpoints are untrusted input: normalize token
+        # ids and sampling types HERE, synchronously, so a malformed value
+        # fails this request instead of crashing the shared run loop
+        checkpoint.validate(self.model_config.vocab_size)
+        params = checkpoint.sampling_params()
+        prompt_ids = list(checkpoint.prompt_ids)
+        if len(prompt_ids) + params.max_tokens > self.config.max_model_len:
+            raise ValueError(
+                f"prompt+max_tokens exceeds max_model_len {self.config.max_model_len}"
+            )
+        # max_tokens is the TOTAL budget (pre-drain tokens count toward it),
+        # so this bound plus the one above also caps prompt+generated at
+        # max_model_len — an oversized crafted checkpoint must fail HERE
+        # with a 400, not detonate allocation inside the shared run loop
+        if len(checkpoint.generated) >= params.max_tokens:
+            raise ValueError(
+                f"checkpoint already holds {len(checkpoint.generated)} "
+                f"generated tokens with max_tokens={params.max_tokens}; "
+                "nothing left to resume"
+            )
+        self._check_accepting()
+        # the effective budget is the min of the snapshot-time remainder
+        # and the retry's own propagated deadline: the time a client spent
+        # backing off between drain and resume is SLA time spent, and the
+        # snapshot must not re-grant it (an expired propagated deadline is
+        # rejected synchronously inside _admission_deadline)
+        deadline = self._admission_deadline()
+        if checkpoint.deadline_remaining_s is not None:
+            if checkpoint.deadline_remaining_s <= 0:
+                DEADLINE_REJECTED.labels(component="engine").inc()
+                raise DeadlineExceededError(
+                    "checkpoint deadline budget exhausted before resume"
+                )
+            snapshot = Deadline.after(checkpoint.deadline_remaining_s)
+            if deadline is None or snapshot.remaining() < deadline.remaining():
+                deadline = snapshot
+        generated = [int(t) for t in checkpoint.generated]
+        queue: asyncio.Queue = asyncio.Queue()
+        # the engine-side id must be unique even when the SAME checkpoint
+        # is replayed twice (exactly the retry-storm case this feature
+        # serves): cancel() tears down every slot matching the id, so two
+        # resumes sharing checkpoint.request_id would have the first
+        # finisher silently evict its live sibling and hang that stream.
+        # The suffix keeps the original id traceable in logs/checkpoints.
+        if request_id is not None:
+            rid = request_id
+        elif checkpoint.request_id:
+            rid = f"{checkpoint.request_id}~r{time.monotonic_ns()}"
+        else:
+            rid = f"req-{time.monotonic_ns()}"
+        req = _QueuedRequest(
+            rid, prompt_ids, params, queue,
+            adapter_id=self._resolve_adapter(checkpoint.adapter),
+            deadline=deadline,
+        )
+        if generated:
+            # replay the detokenizer so continuation text deltas pick up
+            # exactly where the drained replica's stream stopped
+            detok = IncrementalDetokenizer(self.tokenizer)
+            for t in generated:
+                detok.push(t)
+            req.resume = {
+                "generated": generated,
+                "detok": detok,
+                "stop_texts": list(params.stop or []),
+                "pos": len(prompt_ids) + len(generated) - 1,
+                "admitted_at": time.perf_counter(),
+                "kv": None,  # cross-replica: always re-prefill
+            }
+        self.resume_count += 1
+        GENERATION_RESUMES.labels(model_name=self._mlabel).inc()
+        TOKENS_SALVAGED.labels(model_name=self._mlabel).inc(len(generated))
+        return self._submit_and_stream(req)
 
     # ---------------- engine loop ----------------
 
@@ -756,8 +1055,12 @@ class LLMEngine:
                 # on an answer nobody is waiting for
                 self._drop_expired_waiting()
                 # admission: prefill waiting requests into free slots,
-                # batched so one compiled call covers many prompts
-                while self._waiting and self._free_slot_index() is not None:
+                # batched so one compiled call covers many prompts.  Paused
+                # while draining — anything queued (including KV-pressure
+                # preemptions) belongs to drain()'s checkpoint flush, not a
+                # re-seat on a replica that is going away.
+                while (not self._draining and self._waiting
+                       and self._free_slot_index() is not None):
                     if not self._admit_batch():
                         break
                     did_work = True
@@ -790,6 +1093,12 @@ class LLMEngine:
             for req in self._waiting:
                 req.queue.put_nowait(e)
             self._waiting.clear()
+            # requests a crashed _admit_batch popped but never seated: fail
+            # their streams and release the pages admission allocated
+            for _, req, pages, _, _ in self._admitting:
+                self.allocator.free(pages)
+                req.queue.put_nowait(e)
+            self._admitting = []
 
     def _drop_expired_waiting(self) -> None:
         """Fail queued requests whose propagated deadline expired before a
@@ -800,10 +1109,7 @@ class LLMEngine:
             if req.deadline is None or not req.deadline.expired:
                 kept.append(req)
                 continue
-            if (req.resume is not None and req.resume["kv"] is not None
-                    and self._kv_store is not None):
-                self._kv_store.discard(req.resume["kv"])
-                self._set_offload_gauges()
+            self._discard_resume_kv(req)
             DEADLINE_REJECTED.labels(component="engine").inc()
             req.queue.put_nowait(DeadlineExceededError(
                 f"request {req.request_id} deadline expired while queued"
@@ -836,6 +1142,12 @@ class LLMEngine:
         ps = self.config.page_size
         chunk_cap = self.config.prefill_buckets[-1]
         admitted: List[tuple] = []  # (slot_index, request, pages, n_cached, seq)
+        # aliased (not assigned after the loop) so the run-loop crash
+        # handler sees every popped-but-unseated request even when a later
+        # iteration raises mid-admission: a prefill or allocation that
+        # raises must fail these requests (they are in neither _waiting nor
+        # a slot — losing them hangs their streams forever)
+        self._admitting = admitted
         free = [i for i, s in enumerate(self._slots) if s.request_id is None]
         while (
             self._waiting
@@ -872,9 +1184,11 @@ class LLMEngine:
             ):
                 self.allocator.free(hits)
                 break
+            # allocate BEFORE popping: if allocate raises, the request is
+            # still in _waiting and the crash handler fails it there
+            pages = list(hits) + self.allocator.allocate(need - len(hits))
             self._waiting.pop(0)
             self._prefix_cache.hits += len(hits)
-            pages = list(hits) + self.allocator.allocate(need - len(hits))
             admitted.append((free.pop(0), req, pages, len(hits), seq))
         if not admitted:
             return False
@@ -981,6 +1295,7 @@ class LLMEngine:
                 self._prefix_cache.register(req.prompt_ids, pages)
             self._mark_penalty_dirty(idx)
             self._emit(slot, first_token, *self._lp_for(req.params, lp_np, j))
+        self._admitting = []
         return True
 
     @staticmethod
@@ -1014,6 +1329,7 @@ class LLMEngine:
         slot.stop_texts = list(req.params.stop or [])
         slot.admitted_at = time.perf_counter()
         slot.adapter_id = req.adapter_id
+        slot.deadline = req.deadline
 
     @property
     def prefix_cache_hits(self) -> int:
@@ -1059,9 +1375,13 @@ class LLMEngine:
         ):
             self.allocator.free(cached)  # release the early reference
             return False
+        # allocate BEFORE popping: if allocate raises, the request is still
+        # in _waiting and the crash handler fails it there (everything after
+        # this is infallible python bookkeeping until the slot — whose queue
+        # the handler covers — owns the request)
+        pages = cached + self.allocator.allocate(fresh_needed)
         self._waiting.remove(req)
         self._prefix_cache.hits += len(cached)
-        pages = cached + self.allocator.allocate(fresh_needed)
         # the slot enters "prefilling" state immediately and the run loop
         # advances ONE chunk per iteration — in-flight decode streams keep
         # emitting between chunks, and the queue behind this request isn't
@@ -1190,6 +1510,7 @@ class LLMEngine:
         slot.stop_texts = r["stop_texts"]
         slot.admitted_at = r["admitted_at"]
         slot.adapter_id = req.adapter_id
+        slot.deadline = req.deadline
 
     def _admit_injected(self, req: "_QueuedRequest") -> bool:
         """Admit a request whose KV already exists on host: either P/D
@@ -1222,8 +1543,14 @@ class LLMEngine:
             payload = {"kv": req.kv_data}
         quantized = "kv_q" in payload
         kv = payload["kv_q"] if quantized else payload["kv"]
-        self._waiting.remove(req)
+        # allocate BEFORE popping (a raise leaves req in _waiting for the
+        # crash handler), then register the popped request in _admitting so
+        # a device inject that raises fails this stream instead of hanging
+        # it — same contract as the batched-prefill path
         pages = self.allocator.allocate(need)
+        self._waiting.remove(req)
+        entry = (idx, req, pages, 0, None)
+        self._admitting.append(entry)
         P = kv.shape[1]
         # pad the page dim to the standard width buckets (small compile cache)
         bucket = self.config.page_bucket(P)
@@ -1247,9 +1574,11 @@ class LLMEngine:
         slot = self._slots[idx]
         if req.resume is not None:
             self._seat_resumed(slot, req, pages)
+            self._admitting.remove(entry)
             self._mark_penalty_dirty(idx)
             return True
         self._seat_fresh(slot, req, pages, req.first_token)
+        self._admitting.remove(entry)
         PROMPT_TOKENS.labels(model_name=self._mlabel).inc(len(req.prompt_ids))
         self._mark_penalty_dirty(idx)
         self._emit(slot, req.first_token)
@@ -1277,6 +1606,19 @@ class LLMEngine:
         honestly (config smaller than one max-length sequence)."""
         steps = self.config.steps_per_sync
         ps = self.config.page_size
+        # chaos seam (resilience/faults.py): a "preempt" spec targeting
+        # "engine.preempt" forcibly requeues the newest active sequence —
+        # the deterministic stand-in for spot/KV-pressure preemption the
+        # drain/resume chaos tests fire under FakeClock
+        if self.fault_plan is not None:
+            spec = self.fault_plan.decide("engine.preempt")
+            if spec is not None and spec.kind == "preempt":
+                victims = [
+                    s for s in self._slots
+                    if s.request_id is not None and s.prefilling is None
+                ]
+                if victims:
+                    self._preempt(max(victims, key=lambda s: s.admitted_at))
         while True:
             active = [
                 s for s in self._slots
@@ -1321,7 +1663,14 @@ class LLMEngine:
                     # finish; starved lanes pause (capacity mask) and retry
                     return
                 for s in starved:
-                    self._finish(s, "length")  # no page source left anywhere
+                    if self._draining:
+                        # mid-drain, a starved lane must not be truncated
+                        # with a dishonest "length": checkpoint it so a
+                        # healthy replica finishes the generation
+                        ckpt = self._checkpoint_slot(s, "preempt")
+                        self._evict_slot(s, GenerationPreempted(ckpt))
+                    else:
+                        self._finish(s, "length")  # no page source left anywhere
                 continue
             self._preempt(max(candidates, key=lambda s: s.admitted_at))
 
@@ -1358,8 +1707,16 @@ class LLMEngine:
         )
         # spill into the tier store when it can fit; otherwise chunked
         # re-prefill recomputes the KV on resume.  Quantized caches spill
-        # both tensors (int8 pages + scales) as one payload.
-        if self._kv_store is not None and self._kv_store.would_fit(nbytes):
+        # both tensors (int8 pages + scales) as one payload.  Mid-drain the
+        # spill is skipped outright: the drain loop checkpoints the requeued
+        # request on its next pass and discards any resume KV (resume is
+        # cross-replica, always re-prefilled), so the device fetch would
+        # only burn drain budget and stall the loop for zero benefit.
+        if (
+            self._kv_store is not None
+            and not self._draining
+            and self._kv_store.would_fit(nbytes)
+        ):
             ids = jnp.asarray(np.asarray(slot.pages[:P], np.int32))
             if self.config.kv_quant == "int8" and self.config.pp > 1:
                 pages, scales = self.kv_pages
@@ -1384,7 +1741,7 @@ class LLMEngine:
                 kv_key = slot.request_id
             self._set_offload_gauges()
         req = _QueuedRequest(slot.request_id, slot.prompt_ids, slot.params, slot.queue,
-                             adapter_id=slot.adapter_id)
+                             adapter_id=slot.adapter_id, deadline=slot.deadline)
         req.resume = {
             "generated": slot.generated,
             "detok": slot.detok,
@@ -1584,15 +1941,19 @@ class LLMEngine:
                 self._mark_penalty_dirty(None)
         return chunk
 
-    def _route_chunk(self, meta: dict, chunk) -> bool:
+    async def _route_chunk(self, meta: dict, chunk) -> bool:
         """Read a finished chunk and stream its tokens.  True when any slot
-        finished (the pipeline must drain: chained lanes are stale)."""
+        finished (the pipeline must drain: chained lanes are stale).  Async
+        because the fetch awaits the device (loop stays responsive); slot
+        state is only mutated in the sync stretch after the fetches, so a
+        drain evicting a slot during the await is observed (request_id
+        None) rather than raced."""
         steps = self.config.steps_per_sync
         if isinstance(chunk, tuple):  # logprobs variant: (tokens, lp, tv, ti)
-            chunk_np = self._fetch(chunk[0])  # [steps, B]
-            lp_np = tuple(self._fetch(a) for a in chunk[1:])
+            chunk_np = await self._fetch_async(chunk[0])  # [steps, B]
+            lp_np = tuple([await self._fetch_async(a) for a in chunk[1:]])
         else:
-            chunk_np = self._fetch(chunk)  # [steps, B]
+            chunk_np = await self._fetch_async(chunk)  # [steps, B]
             lp_np = None
         active = meta["active"]
         finished_any = False
@@ -1651,7 +2012,10 @@ class LLMEngine:
                 and not predictable_finish
                 and not prefill_pending  # alternate with prefill chunks
                 and not meta.get("penalized")
-                and not self._stopped
+                # draining: no chaining — the drain loop must observe the
+                # budget (and the preempt fault seam must run) between
+                # every chunk, not once per arbitrarily long pipeline
+                and not (self._stopped or self._draining)
             ):
                 meta2 = self._prepare_chunk(prev=meta)
             if meta2 is not None:
@@ -1660,19 +2024,19 @@ class LLMEngine:
                 )
                 chunk2 = self._dispatch_chunk(meta2, tokens_dev=last_tokens)
                 self._pipeline_busy = True
-            finished_any = self._route_chunk(meta, chunk)
+            finished_any = await self._route_chunk(meta, chunk)
             # flush streams while the chained chunk runs on device
             await asyncio.sleep(0)
             if chunk2 is None:
                 break
             meta, chunk = meta2, chunk2
-            if finished_any or self._stopped or (
+            if finished_any or self._stopped or self._draining or (
                 self._waiting and self._free_slot_index() is not None
             ):
                 # in-flight chunk has stale lanes (or admission can now
                 # proceed); drain and re-plan
                 self._pipeline_busy = False
-                self._route_chunk(meta, chunk)
+                await self._route_chunk(meta, chunk)
                 break
         self._pipeline_busy = False
         self._flush_deferred_frees()

@@ -8,8 +8,29 @@ the loop.
 
 from __future__ import annotations
 
+import asyncio
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
+
+
+class _LoopNotify:
+    """threading.Event-shaped completion signal for fetch_async: the worker
+    thread's set() marshals back onto the event loop via
+    call_soon_threadsafe instead of waking a blocked loop thread.  Module
+    level (not a per-call closure) — fetch_async runs once per decode
+    chunk, the hottest path in the engine."""
+
+    __slots__ = ("_loop", "_event")
+
+    def __init__(self, loop: asyncio.AbstractEventLoop, event: asyncio.Event):
+        self._loop = loop
+        self._event = event
+
+    def set(self) -> None:
+        try:
+            self._loop.call_soon_threadsafe(self._event.set)
+        except RuntimeError:
+            pass  # loop closed mid-shutdown; nobody awaits this
 
 @dataclass
 class EngineConfig:
@@ -149,21 +170,46 @@ class _DeadlineFetcher:
                 box.append(("err", exc))
             done.set()
 
-    def fetch(self, fn, timeout_s: float):
+    def _check_open(self) -> None:
         if self._closed:
             # a drain-path fetch after close() must fail fast, not wait a
             # full deadline on a dead worker queue (that would freeze the
             # event loop through a graceful shutdown)
             raise RuntimeError("engine stopped")
+
+    @staticmethod
+    def _unbox(box: list):
+        kind, value = box[0]
+        if kind == "err":
+            raise value
+        return value
+
+    def fetch(self, fn, timeout_s: float):
+        self._check_open()
         box: list = []
         done = self._threading.Event()
         self._q.put((fn, box, done))
         if not done.wait(timeout_s):
             raise TimeoutError(f"fetch exceeded {timeout_s}s")
-        kind, value = box[0]
-        if kind == "err":
-            raise value
-        return value
+        return self._unbox(box)
+
+    async def fetch_async(self, fn, timeout_s: float):
+        """fetch() for the decode hot loop: the event-loop thread must not
+        sit in a threading wait for device compute — that starves every
+        other coroutine (readiness probes, /admin/drain, the drain budget
+        loop, admission 503s) for the full duration of the step.  The
+        worker signals completion back through call_soon_threadsafe so the
+        loop keeps serving while the chunk computes."""
+        self._check_open()
+        loop = asyncio.get_running_loop()
+        event = asyncio.Event()
+        box: list = []
+        self._q.put((fn, box, _LoopNotify(loop, event)))
+        try:
+            await asyncio.wait_for(event.wait(), timeout_s)
+        except asyncio.TimeoutError:
+            raise TimeoutError(f"fetch exceeded {timeout_s}s") from None
+        return self._unbox(box)
 
     def close(self):
         self._closed = True
@@ -191,7 +237,7 @@ class _Slot:
     __slots__ = (
         "request_id", "prompt_len", "prompt_ids", "pages", "pos", "generated",
         "params", "queue", "detok", "stop_texts", "admitted_at", "adapter_id",
-        "prefilling",
+        "prefilling", "deadline",
     )
 
     def __init__(self):
@@ -200,6 +246,9 @@ class _Slot:
         # "logits"} — the run loop advances ONE chunk per iteration so
         # in-flight decode streams keep emitting (bounded stall)
         self.prefilling: Optional[dict] = None
+        # the request's propagated resilience.Deadline (None = unbounded);
+        # rides the slot so drain checkpoints carry the remaining budget
+        self.deadline = None
 
     def reset(self):
         self.request_id = None

@@ -16,6 +16,11 @@ import httpx
 
 from .errors import InferenceError, InvalidInput, UnsupportedProtocol
 from .infer_type import InferRequest, InferResponse
+from .lifecycle import (
+    CHECKPOINT_HEADER,
+    CHECKPOINT_HEADER_MAX_BYTES,
+    GenerationCheckpoint,
+)
 from .model import PredictorProtocol
 from .resilience import (
     DEADLINE_HEADER,
@@ -100,6 +105,22 @@ class InferenceRESTClient:
                 if not self._retry_policy.retryable(response.status_code):
                     return response
                 retry_after = parse_retry_after(response.headers.get("Retry-After"))
+                checkpoint = response.headers.get(CHECKPOINT_HEADER)
+                if not checkpoint:
+                    # a large checkpoint rides the 503 body only (servers
+                    # omit the header past CHECKPOINT_HEADER_SAFE_BYTES so
+                    # stock response parsers don't choke on it)
+                    checkpoint = self._checkpoint_from_body(response)
+                if checkpoint and len(checkpoint) <= CHECKPOINT_HEADER_MAX_BYTES:
+                    # preemption-safe resume: a draining replica returned a
+                    # generation checkpoint with its 503 — carry it on the
+                    # retry so wherever the request lands next (the EPP
+                    # routes around DRAINING backends) the generation
+                    # RESUMES instead of restarting from the prompt.
+                    # Oversized checkpoints are dropped: restarting from
+                    # the prompt beats a retry the server rejects outright.
+                    headers = dict(headers or {})
+                    headers[CHECKPOINT_HEADER] = checkpoint
             except (httpx.ConnectError, httpx.ConnectTimeout) as e:
                 # connect-phase only: the request never reached the server,
                 # so replaying it cannot duplicate inference work
@@ -115,6 +136,17 @@ class InferenceRESTClient:
                     raise failure
                 return response
             await self._clock.sleep(delay)
+
+    @staticmethod
+    def _checkpoint_from_body(response) -> Optional[str]:
+        """Header form of the `checkpoint` object a 503 body may carry
+        (rest/server.py sends large checkpoints body-only); None when the
+        body isn't JSON or has no parseable checkpoint."""
+        try:
+            data = response.json()
+            return GenerationCheckpoint.from_dict(data["checkpoint"]).to_header()
+        except (ValueError, TypeError, KeyError):
+            return None
 
     async def _get_with_retries(self, url, *, headers=None,
                                 timeout=None) -> httpx.Response:

@@ -1,0 +1,35 @@
+"""Replica lifecycle layer: graceful drain + preemption-safe resumable
+generation (docs/lifecycle.md).
+
+- `ReplicaLifecycle`: the STARTING -> READY -> DRAINING -> TERMINATING
+  state machine every serving process owns (state.py).
+- `GenerationCheckpoint` / `GenerationPreempted`: the portable snapshot a
+  draining engine hands each live request so a healthy replica resumes it
+  with zero lost or duplicated tokens (checkpoint.py).
+- `lifecycle_middleware` / `register_admin_routes`: the REST-layer
+  admission gate, readiness override, and `POST /admin/drain` preStop
+  entrypoint (middleware.py).
+"""
+
+from .checkpoint import (  # noqa: F401
+    CHECKPOINT_FIELD_SIZE_LIMIT,
+    CHECKPOINT_HEADER,
+    CHECKPOINT_HEADER_MAX_BYTES,
+    CHECKPOINT_HEADER_SAFE_BYTES,
+    GenerationCheckpoint,
+    GenerationPreempted,
+)
+from .middleware import lifecycle_middleware, register_admin_routes  # noqa: F401
+from .state import (  # noqa: F401
+    DEFAULT_DRAIN_GRACE_S,
+    DRAIN_GRACE_ENV,
+    DRAINING,
+    READY,
+    STARTING,
+    STATES,
+    TERMINATING,
+    ReplicaDrainingError,
+    ReplicaLifecycle,
+    drain_grace_from_env,
+    normalize_drain_grace,
+)

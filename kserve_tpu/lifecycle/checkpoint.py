@@ -1,0 +1,229 @@
+"""Portable generation checkpoints: the unit of preemption-safe resume.
+
+When a drain budget expires (or KV-pressure preemption would otherwise
+kill a live sequence during a drain), the engine snapshots each affected
+request into a `GenerationCheckpoint` — prompt token ids, every token
+decoded so far, the sampling params (including the seed, so seeded lanes
+stay reproducible), the LoRA adapter, and the remaining request deadline.
+The checkpoint travels to the caller as a `GenerationPreempted` exception
+through the stream queue; the protocol layer serializes it into the
+`x-generation-checkpoint` response header/body, and a healthy replica
+resumes it with `engine.resume_generation(checkpoint)` — a prefill of
+prompt+generated (cheap under the prefix cache) after which decoding
+continues at the next token: zero tokens lost, zero duplicated.
+
+This module stays import-light (no jax) so the EPP/scheduler side can
+parse checkpoints without pulling the engine stack.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+import operator
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+CHECKPOINT_HEADER = "x-generation-checkpoint"
+# the header form grows with prompt+generated length (~8 b64 bytes/token);
+# servers raise their header-field limit to accept up to this much, and
+# clients drop a larger checkpoint from the retry (restarting from the
+# prompt beats a retry the server rejects with 400 before any handler)
+CHECKPOINT_HEADER_MAX_BYTES = 1 << 20
+# the aiohttp max_field_size/max_line_size every hop that carries the
+# checkpoint header must use (replica REST server, EPP proxy client and
+# server): one constant so the limits cannot drift out of lockstep —
+# a request that fits one hop must fit them all
+CHECKPOINT_FIELD_SIZE_LIMIT = CHECKPOINT_HEADER_MAX_BYTES + 8190
+# RESPONSE headers cross parsers we don't control (httpx/h11 refuses
+# header lines around ~100 KiB; stock aiohttp clients stop at 8190 bytes
+# per header FIELD — the tightest limit in the fleet): above this size the
+# 503 carries the checkpoint in its JSON body only, and clients fall back
+# to reading it from there.  Sized under aiohttp's 8190 with margin for
+# the header name + separator so a stock client never sees LineTooLong.
+CHECKPOINT_HEADER_SAFE_BYTES = 8000
+
+
+@dataclass
+class GenerationCheckpoint:
+    request_id: str
+    prompt_ids: List[int]
+    generated: List[int] = field(default_factory=list)
+    # dataclasses.asdict(SamplingParams) — plain JSON types only
+    sampling: Dict[str, Any] = field(default_factory=dict)
+    adapter: Optional[str] = None
+    model_name: Optional[str] = None
+    # remaining request-deadline budget at snapshot time (None = unbounded);
+    # relative seconds, same contract as the x-request-deadline header
+    deadline_remaining_s: Optional[float] = None
+    reason: str = "drain"  # drain | preempt
+
+    @classmethod
+    def capture(
+        cls,
+        request_id: str,
+        prompt_ids: List[int],
+        generated: List[int],
+        params,  # engine.sampling.SamplingParams
+        adapter: Optional[str] = None,
+        model_name: Optional[str] = None,
+        deadline=None,  # resilience.Deadline
+        reason: str = "drain",
+    ) -> "GenerationCheckpoint":
+        return cls(
+            request_id=request_id,
+            prompt_ids=[int(t) for t in prompt_ids],
+            generated=[int(t) for t in generated],
+            sampling=dataclasses.asdict(params),
+            adapter=adapter,
+            model_name=model_name,
+            deadline_remaining_s=(
+                max(deadline.remaining(), 0.0) if deadline is not None else None
+            ),
+            reason=reason,
+        )
+
+    # engine.sampling.SamplingParams wire schema (hardcoded: this module
+    # must not import jax via sampling.py; tests/test_lifecycle.py pins it
+    # against dataclasses.fields(SamplingParams) so drift fails loudly)
+    _SAMPLING_FLOATS = ("temperature", "top_p", "min_p", "repetition_penalty",
+                        "frequency_penalty", "presence_penalty")
+    _SAMPLING_INTS = ("top_k", "max_tokens", "min_tokens")
+    _SAMPLING_OPT_INTS = ("seed", "logprobs")
+
+    def validate(self, vocab_size: Optional[int] = None) -> "GenerationCheckpoint":
+        """Normalize and bounds-check a wire-sourced checkpoint before it
+        is admitted into an engine.  Checkpoints arrive in client-supplied
+        headers, so every field is untrusted: a non-integer or out-of-vocab
+        token id, or a non-numeric sampling value, must raise ValueError to
+        THIS caller — admitted raw, it would crash the shared run loop and
+        kill every other in-flight generation on the replica.  Mutates the
+        checkpoint in place (ids coerced to int, unknown sampling keys
+        dropped for rollout forward-compatibility) and returns self."""
+        self.prompt_ids = self._int_ids("prompt_ids", self.prompt_ids, vocab_size)
+        if not self.prompt_ids:
+            raise ValueError("invalid checkpoint: empty prompt_ids")
+        self.generated = self._int_ids("generated", self.generated, vocab_size)
+        if not isinstance(self.sampling, dict):
+            raise ValueError("invalid checkpoint: sampling must be an object")
+        sampling: Dict[str, Any] = {}
+        try:
+            for key in self._SAMPLING_FLOATS:
+                if key in self.sampling:
+                    sampling[key] = float(self.sampling[key])
+            for key in self._SAMPLING_INTS:
+                if key in self.sampling:
+                    sampling[key] = self._bounded_int(key, self.sampling[key])
+            for key in self._SAMPLING_OPT_INTS:
+                value = self.sampling.get(key)
+                if key in self.sampling:
+                    sampling[key] = (
+                        None if value is None else self._bounded_int(key, value)
+                    )
+        except (TypeError, ValueError) as exc:
+            raise ValueError(f"invalid checkpoint: bad sampling value ({exc})") from exc
+        if "ignore_eos" in self.sampling:
+            sampling["ignore_eos"] = bool(self.sampling["ignore_eos"])
+        stop = self.sampling.get("stop")
+        if stop is not None:
+            if not isinstance(stop, list) or any(not isinstance(s, str) for s in stop):
+                raise ValueError("invalid checkpoint: stop must be a list of strings")
+            sampling["stop"] = stop
+        elif "stop" in self.sampling:
+            sampling["stop"] = None
+        # anything else is silently dropped: a newer replica's checkpoint
+        # resuming here mid-rollout must not fail on fields it added
+        self.sampling = sampling
+        return self
+
+    @staticmethod
+    def _bounded_int(field_name: str, value) -> int:
+        """Coerce an untrusted sampling int and bound it to int32 — these
+        values reach jnp.asarray(..., jnp.int32) inside the shared run
+        loop, where an out-of-range Python int raises OverflowError and
+        kills every in-flight generation on the replica."""
+        out = operator.index(value)
+        if not -(2 ** 31) <= out < 2 ** 31:
+            raise ValueError(f"{field_name} {out} outside int32 range")
+        return out
+
+    @staticmethod
+    def _int_ids(field_name: str, values, vocab_size: Optional[int]) -> List[int]:
+        try:
+            ids = [operator.index(t) for t in values]
+        except TypeError as exc:
+            raise ValueError(
+                f"invalid checkpoint: {field_name} must be integer token ids"
+            ) from exc
+        if vocab_size is not None:
+            for t in ids:
+                if not 0 <= t < vocab_size:
+                    raise ValueError(
+                        f"invalid checkpoint: {field_name} id {t} outside "
+                        f"vocab [0, {vocab_size})"
+                    )
+        return ids
+
+    @property
+    def tokens_salvaged(self) -> int:
+        return len(self.generated)
+
+    def sampling_params(self):
+        """Rebuild the engine SamplingParams (lazy import: this module must
+        not pull jax into scheduler-side consumers)."""
+        from ..engine.sampling import SamplingParams
+
+        return SamplingParams(**self.sampling)
+
+    # ---------------- wire forms ----------------
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "GenerationCheckpoint":
+        if not isinstance(data, dict):
+            raise ValueError(f"checkpoint must be an object, got {type(data).__name__}")
+        known = {f.name for f in dataclasses.fields(cls)}
+        # tolerate unknown keys so a newer replica's checkpoint resumes on
+        # an older one during a rollout (forward compatibility)
+        return cls(**{k: v for k, v in data.items() if k in known})
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), separators=(",", ":"))
+
+    @classmethod
+    def from_json(cls, raw: str) -> "GenerationCheckpoint":
+        return cls.from_dict(json.loads(raw))
+
+    def to_header(self) -> str:
+        """Base64 wire form for the x-generation-checkpoint header (token
+        id lists are header-hostile as raw JSON)."""
+        return base64.b64encode(self.to_json().encode("utf-8")).decode("ascii")
+
+    @classmethod
+    def from_header(cls, value: Optional[str]) -> Optional["GenerationCheckpoint"]:
+        """Parse the header form; malformed values return None — a
+        checkpoint is a resume optimization, not an input schema."""
+        if not value:
+            return None
+        try:
+            return cls.from_json(base64.b64decode(value).decode("utf-8"))
+        except (ValueError, TypeError, KeyError):
+            return None
+
+
+class GenerationPreempted(Exception):
+    """Raised into a generation stream when this replica checkpointed it
+    (drain budget expired / escalated shutdown / KV-pressure kill).  The
+    protocol layer maps it to 503 + checkpoint header/body; clients (or
+    the EPP) re-seat the checkpoint on a healthy replica."""
+
+    def __init__(self, checkpoint: GenerationCheckpoint, reason: Optional[str] = None):
+        self.checkpoint = checkpoint
+        self.reason = reason or checkpoint.reason
+        super().__init__(
+            f"generation {checkpoint.request_id} preempted ({self.reason}); "
+            f"{checkpoint.tokens_salvaged} decoded tokens checkpointed for resume"
+        )

@@ -1,0 +1,109 @@
+"""aiohttp integration for the replica lifecycle.
+
+`lifecycle_middleware` sits directly inside error mapping on the REST
+server:
+
+- readiness (`/v2/health/ready`) answers 503 the moment the replica
+  leaves READY, so the endpoint controller/EPP stops routing here —
+  while liveness keeps answering 200 (kubelet must not kill a drain);
+- new inference POSTs are refused 503 + `Retry-After` once draining
+  begins (same path predicate as load shedding: admin/observability
+  routes always pass — an operator must be able to watch a drain).
+
+`register_admin_routes` adds `POST /admin/drain`, the preStop-hook /
+operator entrypoint that starts a drain without a signal.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Optional
+
+from aiohttp import web
+
+from ..logging import logger
+from ..resilience.shedding import is_inference_path
+from .state import READY, ReplicaLifecycle
+
+READINESS_PATHS = ("/v2/health/ready",)
+
+
+def lifecycle_middleware(lifecycle: ReplicaLifecycle):
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        if request.path in READINESS_PATHS and not lifecycle.ready:
+            return web.json_response(
+                {"ready": lifecycle.state == READY, "lifecycle": lifecycle.state},
+                status=503,
+            )
+        if (
+            request.method == "POST"
+            and is_inference_path(request.path)
+            and not lifecycle.accepting
+        ):
+            return web.json_response(
+                {
+                    "error": "replica is draining; retry another replica",
+                    "lifecycle": lifecycle.state,
+                },
+                status=503,
+                headers={"Retry-After": "1"},
+            )
+        return await handler(request)
+
+    return middleware
+
+
+def register_admin_routes(
+    app: web.Application,
+    lifecycle: ReplicaLifecycle,
+    on_drain: Optional[Callable] = None,
+) -> None:
+    """POST /admin/drain: flip to DRAINING (idempotent) and kick the async
+    drain callback; responds immediately with the state + remaining budget
+    so a preStop hook returns fast while the drain proceeds."""
+    # strong reference to the running drain task: a bare create_task result
+    # is weakly held by the loop and the drain could be GC'd unrun
+    drain_tasks: list = []
+
+    async def drain_handler(request: web.Request) -> web.Response:
+        first = lifecycle.drain_deadline is None
+        deadline = lifecycle.begin_drain()
+        if on_drain is not None and first:
+            drain_tasks.append(
+                asyncio.get_running_loop().create_task(_run_drain(on_drain))
+            )
+        return web.json_response({
+            "lifecycle": lifecycle.state,
+            "drain_remaining_s": max(deadline.remaining(), 0.0),
+        })
+
+    async def drain_get_handler(request: web.Request) -> web.Response:
+        # kubelet lifecycle httpGet handlers issue GET — a POST-only route
+        # would 405 the synthesized preStop hook (controlplane
+        # ensure_drain_lifecycle) and the drain-before-SIGTERM window
+        # would silently never exist.  But the state machine is forward-
+        # only, so a BARE GET (scanner, browser prefetch, misaimed probe)
+        # must not retire a healthy replica: only the ?source=prestop
+        # marker the control plane synthesizes mutates; anything else
+        # reads the drain status
+        if request.query.get("source") == "prestop":
+            return await drain_handler(request)
+        deadline = lifecycle.drain_deadline
+        return web.json_response({
+            "lifecycle": lifecycle.state,
+            "drain_remaining_s": (
+                max(deadline.remaining(), 0.0) if deadline is not None else None
+            ),
+            "hint": "GET is read-only; drain via POST or GET ?source=prestop",
+        })
+
+    app.router.add_post("/admin/drain", drain_handler)
+    app.router.add_get("/admin/drain", drain_get_handler)
+
+
+async def _run_drain(on_drain: Callable) -> None:
+    try:
+        await on_drain()
+    except Exception:  # noqa: BLE001 — a failed drain must be loud, not lost
+        logger.exception("graceful drain failed")

@@ -1,0 +1,166 @@
+"""Replica lifecycle state machine: STARTING -> READY -> DRAINING ->
+TERMINATING.
+
+One object per serving process, owned by the ModelServer and consulted by
+the REST admission middleware, the readiness probe, and the EPP state
+endpoint.  The contract (docs/lifecycle.md):
+
+- READY        accepting traffic; readiness green.
+- DRAINING     SIGTERM (or POST /admin/drain) arrived: readiness goes red
+               so the endpoint controller stops routing here, liveness
+               stays green so kubelet does not kill the drain, admission
+               rejects NEW inference with 503 + Retry-After, and in-flight
+               requests get the drain budget to finish.
+- TERMINATING  the budget expired (leftover generations were checkpointed)
+               or a second signal escalated; the process is exiting.
+
+Transitions are forward-only and idempotent — a second drain request
+returns the budget already running rather than restarting it, and a
+second SIGTERM escalates by EXPIRING that budget (`escalate()`), which
+every drain loop polls, so escalation cuts a drain short deterministically
+under the injected clock.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Callable, Optional
+
+from ..metrics import DRAIN_DURATION, set_lifecycle_state
+from ..resilience import MONOTONIC, Clock, Deadline
+
+STARTING = "STARTING"
+READY = "READY"
+DRAINING = "DRAINING"
+TERMINATING = "TERMINATING"
+STATES = (STARTING, READY, DRAINING, TERMINATING)
+
+# env knob for the drain budget (seconds an in-flight generation may keep
+# decoding after SIGTERM before it is checkpointed); the LLMISVC reconciler
+# sets it alongside the pod's terminationGracePeriodSeconds so kubelet never
+# SIGKILLs a drain that is still inside its budget
+DRAIN_GRACE_ENV = "KSERVE_TPU_DRAIN_GRACE"
+DEFAULT_DRAIN_GRACE_S = 30.0
+
+
+def normalize_drain_grace(value) -> Optional[float]:
+    """Parse one candidate drain-grace value (env string, k8s env entry);
+    None when it must not be used.  Shared by the runtime and the LLMISVC
+    reconciler so the synthesized terminationGracePeriodSeconds can never
+    drift from the budget the runtime actually grants.  float() accepts
+    'inf'/'nan' without raising, but a non-finite or negative budget is a
+    Deadline that never expires: in-flight generations would never be
+    checkpointed and kubelet SIGKILLs them at the grace period."""
+    try:
+        grace = float(value)
+    except (TypeError, ValueError):
+        return None
+    if not math.isfinite(grace) or grace < 0:
+        return None
+    return grace
+
+
+def drain_grace_from_env(env=None) -> float:
+    env = os.environ if env is None else env
+    grace = normalize_drain_grace(env.get(DRAIN_GRACE_ENV, DEFAULT_DRAIN_GRACE_S))
+    return DEFAULT_DRAIN_GRACE_S if grace is None else grace
+
+
+class ReplicaDrainingError(RuntimeError):
+    """New work refused because this replica is draining/terminating.
+    Maps to 503 + Retry-After at the protocol layer — the client's retry
+    (or the EPP) re-seats the request on a healthy replica."""
+
+    def __init__(self, detail: str = "replica is draining; retry another replica",
+                 retry_after_s: float = 1.0):
+        super().__init__(detail)
+        self.retry_after_s = retry_after_s
+
+
+class ReplicaLifecycle:
+    """The replica's lifecycle state + the drain budget, clock-injectable
+    so chaos tests drive drains on a FakeClock with zero real sleeps."""
+
+    def __init__(
+        self,
+        clock: Clock = MONOTONIC,
+        drain_grace_s: Optional[float] = None,
+        on_transition: Optional[Callable[[str], None]] = None,
+    ):
+        self.clock = clock
+        self.drain_grace_s = (
+            drain_grace_from_env() if drain_grace_s is None else float(drain_grace_s)
+        )
+        self.on_transition = on_transition
+        self._state = STARTING
+        self._drain_deadline: Optional[Deadline] = None
+        self._drain_started: Optional[float] = None
+        set_lifecycle_state(STARTING)
+
+    # ---------------- observation ----------------
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    @property
+    def ready(self) -> bool:
+        """Readiness-probe view: red unless fully READY (a DRAINING replica
+        must drop out of the endpoint set while its liveness stays green)."""
+        return self._state == READY
+
+    @property
+    def accepting(self) -> bool:
+        """Admission view: new inference is rejected once draining begins.
+        STARTING still admits — model readiness gates that phase already."""
+        return self._state in (STARTING, READY)
+
+    @property
+    def drain_deadline(self) -> Optional[Deadline]:
+        """The running drain budget (None before a drain starts)."""
+        return self._drain_deadline
+
+    # ---------------- transitions (forward-only) ----------------
+
+    def _to(self, state: str) -> None:
+        if STATES.index(state) <= STATES.index(self._state):
+            return  # forward-only, idempotent
+        self._state = state
+        set_lifecycle_state(state)
+        if self.on_transition is not None:
+            self.on_transition(state)
+
+    def mark_ready(self) -> None:
+        self._to(READY)
+
+    def begin_drain(self, grace_s: Optional[float] = None) -> Deadline:
+        """Flip to DRAINING and start the drain budget; idempotent (a
+        concurrent SIGTERM and /admin/drain share one budget).  Returns the
+        budget Deadline every engine drain loop should honor."""
+        if self._drain_deadline is not None:
+            self._to(DRAINING)
+            return self._drain_deadline
+        grace = self.drain_grace_s if grace_s is None else float(grace_s)
+        self._drain_started = self.clock.now()
+        self._drain_deadline = Deadline.after(grace, self.clock)
+        self._to(DRAINING)
+        return self._drain_deadline
+
+    def escalate(self) -> None:
+        """Second SIGTERM: expire the drain budget (every drain loop polls
+        it, so in-flight generations checkpoint on their next iteration)
+        and jump to TERMINATING."""
+        if self._drain_deadline is not None:
+            self._drain_deadline.expires_at = self.clock.now()
+        else:
+            self._drain_deadline = Deadline.after(0.0, self.clock)
+        self._to(TERMINATING)
+
+    def finish_drain(self) -> None:
+        """Drain complete (all in-flight finished or checkpointed): record
+        the drain duration and settle into TERMINATING."""
+        if self._drain_started is not None:
+            DRAIN_DURATION.observe(max(self.clock.now() - self._drain_started, 0.0))
+            self._drain_started = None
+        self._to(TERMINATING)

@@ -76,6 +76,44 @@ DEADLINE_REJECTED = Counter(
     ["component"],
 )
 
+# Lifecycle layer (kserve_tpu/lifecycle — docs/lifecycle.md): graceful
+# drain + preemption-safe resumable generation.
+LIFECYCLE_STATE = Gauge(
+    "replica_lifecycle_state",
+    "1 for the replica's current lifecycle state "
+    "(STARTING/READY/DRAINING/TERMINATING), 0 otherwise",
+    ["state"],
+)
+DRAIN_DURATION = Histogram(
+    "lifecycle_drain_duration_seconds",
+    "wall time from drain start (SIGTERM / POST /admin/drain) until every "
+    "in-flight generation finished or was checkpointed",
+)
+GENERATION_CHECKPOINTS = Counter(
+    "generation_checkpoints_total",
+    "live generations snapshotted into portable checkpoints",
+    ["model_name", "reason"],
+)
+GENERATION_RESUMES = Counter(
+    "generation_resumes_total",
+    "generations resumed from a checkpoint on this replica",
+    ["model_name"],
+)
+TOKENS_SALVAGED = Counter(
+    "generation_tokens_salvaged_total",
+    "decoded tokens carried across a drain/preemption via checkpoint "
+    "instead of being re-decoded from scratch",
+    ["model_name"],
+)
+
+_LIFECYCLE_STATES = ("STARTING", "READY", "DRAINING", "TERMINATING")
+
+
+def set_lifecycle_state(state: str) -> None:
+    """One-hot the lifecycle gauge (the PromQL-friendly enum idiom)."""
+    for s in _LIFECYCLE_STATES:
+        LIFECYCLE_STATE.labels(state=s).set(1.0 if s == state else 0.0)
+
 
 def record_breaker_transition(backend: str, state: str) -> None:
     """The BreakerRegistry on_transition hook (resilience/breaker.py);

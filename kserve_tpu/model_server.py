@@ -13,11 +13,13 @@ from __future__ import annotations
 import argparse
 import asyncio
 import concurrent.futures
+import inspect
 import signal
 from typing import Dict, List, Optional, Union
 
 from . import logging as ks_logging
 from .errors import NoModelReady
+from .lifecycle import GenerationCheckpoint, ReplicaLifecycle
 from .logging import logger
 from .model import BaseModel, Model
 from .model_repository import ModelRepository
@@ -109,6 +111,11 @@ class ModelServer:
         self._grpc_server: Optional[GRPCServer] = None
         self._engine_tasks: List[asyncio.Task] = []
         self._grpc_task: Optional[asyncio.Task] = None
+        # replica lifecycle (kserve_tpu/lifecycle — docs/lifecycle.md):
+        # STARTING -> READY after start_async; SIGTERM / POST /admin/drain
+        # -> DRAINING (readiness red, admission 503, in-flight gets the
+        # drain budget); second signal escalates to TERMINATING
+        self.lifecycle = ReplicaLifecycle()
         if not ks_logging.is_configured():
             ks_logging.configure_logging(args.log_config_file)
 
@@ -157,6 +164,8 @@ class ModelServer:
             enable_latency_logging=self.enable_latency_logging,
             reuse_port=getattr(self, "_reuse_port", False),
             ssl_context=self._ssl_context,
+            lifecycle=self.lifecycle,
+            on_drain=self.drain_async,
         )
         await self._rest_server.start()
         if self.enable_grpc:
@@ -164,6 +173,72 @@ class ModelServer:
                 self.grpc_port, self.dataplane, self.model_repository_extension
             )
             self._grpc_task = asyncio.create_task(self._grpc_server.start(self.max_threads))
+        self.lifecycle.mark_ready()
+
+    async def drain_async(self) -> List[GenerationCheckpoint]:
+        """Graceful drain: flip DRAINING (readiness red, liveness green,
+        new inference 503s), give every engine's in-flight generations the
+        drain budget, and checkpoint what the budget cannot finish.
+        Idempotent — the signal handler and POST /admin/drain share one
+        budget; escalation expires it in place."""
+        deadline = self.lifecycle.begin_drain()
+        logger.info(
+            "draining replica: budget %.1fs (signal again to escalate)",
+            max(deadline.remaining(), 0.0),
+        )
+        drains = []
+        for model in self.registered_models.get_models().values():
+            # model-level drain is the extension point (a wrapper can
+            # aggregate several engines); plain engine models fall back to
+            # engine.drain directly
+            drain = getattr(model, "drain", None)
+            if drain is not None:
+                drains.append(drain(deadline))
+                continue
+            engine = getattr(model, "engine", None)
+            if engine is not None and hasattr(engine, "drain"):
+                drains.append(engine.drain(deadline))
+        # CONCURRENT, not sequential: every engine must flip into drain
+        # mode immediately — an engine drained later would keep seating new
+        # work and 'length'-finishing KV-starved lanes while earlier models
+        # consume the shared budget (DataParallelEngine.drain gathers its
+        # replicas for the same reason)
+        checkpoints: List[GenerationCheckpoint] = []
+        for result in await asyncio.gather(*drains):
+            checkpoints.extend(result)
+        self.lifecycle.finish_drain()
+        if checkpoints:
+            logger.info(
+                "drain complete: %d generation(s) checkpointed for resume "
+                "elsewhere", len(checkpoints),
+            )
+        return checkpoints
+
+    def _make_signal_handler(self, stop_event: asyncio.Event):
+        """First SIGINT/SIGTERM starts the graceful drain; a SECOND signal
+        escalates — it expires the drain budget in place (every engine
+        drain loop observes that on its next poll) so shutdown proceeds
+        immediately with the leftovers checkpointed, and cancels any stop
+        task a model already has pending (a wedged engine.stop() must not
+        outlive the escalation)."""
+
+        def on_signal():
+            if not stop_event.is_set():
+                stop_event.set()
+            else:
+                logger.warning(
+                    "second shutdown signal: escalating to immediate "
+                    "shutdown (drain budget expired in place)"
+                )
+                self.lifecycle.escalate()
+                for model in self.registered_models.get_models().values():
+                    stop = getattr(model, "stop", None)
+                    if stop is not None and (
+                        "escalate" in inspect.signature(stop).parameters
+                    ):
+                        stop(escalate=True)
+
+        return on_signal
 
     async def stop_async(self) -> None:
         for model_name in list(self.registered_models.get_models().keys()):
@@ -197,12 +272,14 @@ class ModelServer:
             await self.start_async(models)
             stop_event = asyncio.Event()
             loop = asyncio.get_event_loop()
+            handler = self._make_signal_handler(stop_event)
             for sig in (signal.SIGINT, signal.SIGTERM):
                 try:
-                    loop.add_signal_handler(sig, stop_event.set)
+                    loop.add_signal_handler(sig, handler)
                 except NotImplementedError:  # pragma: no cover (non-unix)
                     pass
             await stop_event.wait()
+            await self.drain_async()
             logger.info("Stopping servers (grace period %ss)", self.grace_period)
             await self.stop_async()
 

@@ -17,6 +17,8 @@ from aiohttp import web
 from pydantic import ValidationError
 
 from ...errors import InvalidInput, ModelNotFound, ModelNotReady
+from ...lifecycle import GenerationPreempted, ReplicaDrainingError
+from ...logging import logger
 from .dataplane import OpenAIDataPlane
 from .types import (
     ChatCompletionRequest,
@@ -31,6 +33,17 @@ from .types import (
 def _openai_error(status: int, message: str, err_type: str = "invalid_request_error"):
     body = ErrorResponse(error=ErrorInfo(message=message, type=err_type))
     return web.json_response(body.model_dump(), status=status)
+
+
+async def _final_event(response: web.StreamResponse, payload: dict) -> None:
+    """Write a terminal SSE event, tolerating a client that already hung
+    up.  The stream then ends WITHOUT [DONE], keeping the truncation
+    detectable to splice-aware clients."""
+    try:
+        await response.write(
+            f"data: {json.dumps(payload)}\n\n".encode("utf-8"))
+    except ConnectionResetError:
+        pass
 
 
 async def _stream_sse(request: web.Request, iterator: AsyncIterator) -> web.StreamResponse:
@@ -53,6 +66,31 @@ async def _stream_sse(request: web.Request, iterator: AsyncIterator) -> web.Stre
         await response.write(b"data: [DONE]\n\n")
     except ConnectionResetError:
         pass
+    except GenerationPreempted as e:
+        # drained mid-stream with headers already sent: emit the portable
+        # checkpoint as the final event — the client re-seats it
+        # (x-generation-checkpoint request header) on a healthy replica
+        # and splices the continuation deltas after what it already
+        # received: zero lost, zero duplicated
+        await _final_event(response, {
+            "error": {"type": "generation_preempted", "message": str(e)},
+            "checkpoint": e.checkpoint.to_header(),
+        })
+    except ReplicaDrainingError as e:
+        # a drain landed between sync admission and the first enqueue:
+        # the client retries from scratch on a healthy replica
+        await _final_event(response, {
+            "error": {"type": "replica_draining", "message": str(e)},
+        })
+    except Exception as e:
+        # headers are already on the wire: letting this escape would have
+        # the error middleware write a SECOND response into the chunked
+        # stream, corrupting it mid-flight (the client sees a bare parse
+        # error instead of what went wrong)
+        logger.exception("mid-stream failure after SSE prepare")
+        await _final_event(response, {
+            "error": {"type": "internal_error", "message": str(e)},
+        })
     await response.write_eof()
     return response
 

@@ -26,6 +26,17 @@ from ...errors import (
     ServerNotReady,
     UnsupportedProtocol,
 )
+from ...lifecycle import (
+    CHECKPOINT_FIELD_SIZE_LIMIT,
+    CHECKPOINT_HEADER,
+    CHECKPOINT_HEADER_SAFE_BYTES,
+    READY,
+    GenerationPreempted,
+    ReplicaDrainingError,
+    ReplicaLifecycle,
+    lifecycle_middleware,
+    register_admin_routes,
+)
 from ...logging import logger, trace_logger
 from ...metrics import DEADLINE_REJECTED, SHED_REQUESTS
 from ...resilience import (
@@ -65,6 +76,30 @@ async def error_middleware(request: web.Request, handler):
         return _error_response(501, str(e) or "Not implemented")
     except DeadlineExceededError as e:
         return _error_response(504, str(e))
+    except ReplicaDrainingError as e:
+        # this replica is going away: 503 + Retry-After sends the client's
+        # RetryPolicy (or the EPP) to a healthy replica
+        return web.json_response(
+            {"error": str(e)}, status=503,
+            headers={"Retry-After": f"{e.retry_after_s:g}"},
+        )
+    except GenerationPreempted as e:
+        # the drain budget expired mid-generation: hand the caller the
+        # portable checkpoint so the retry RESUMES (zero tokens lost)
+        # instead of restarting from the prompt.  The body always carries
+        # it; the header convenience form is attached only while it fits
+        # the parsers of stock intermediaries (httpx/h11, default aiohttp)
+        # — an oversized response header would crash the very client the
+        # checkpoint is meant to save
+        headers = {"Retry-After": "1"}
+        header_form = e.checkpoint.to_header()
+        if len(header_form) <= CHECKPOINT_HEADER_SAFE_BYTES:
+            headers[CHECKPOINT_HEADER] = header_form
+        return web.json_response(
+            {"error": str(e), "checkpoint": e.checkpoint.to_dict()},
+            status=503,
+            headers=headers,
+        )
     except InferenceError as e:
         return _error_response(500, str(e))
     except web.HTTPException:
@@ -125,8 +160,14 @@ class RESTServer:
         reuse_port: bool = False,
         ssl_context=None,  # ssl.SSLContext (controlplane/tls.py helpers)
         shed_config: Optional[ShedConfig] = None,  # None = env defaults
+        lifecycle: Optional[ReplicaLifecycle] = None,
+        on_drain=None,  # async callable kicked by POST /admin/drain
     ):
         self.dataplane = dataplane
+        # replica lifecycle (kserve_tpu/lifecycle): drives the admission
+        # gate, the readiness override while draining, and /admin/drain
+        self.lifecycle = lifecycle
+        self.on_drain = on_drain
         self.model_repository_extension = model_repository_extension
         self.http_port = http_port
         self.access_log_format = access_log_format
@@ -153,6 +194,12 @@ class RESTServer:
         if get_tracer() is not None:
             middlewares.append(tracing_middleware)
         middlewares.append(error_middleware)
+        # lifecycle sits directly inside error mapping: a draining replica
+        # must reject before shedding counts the request or the deadline
+        # budget is parsed (readiness red / admission 503 — /admin routes
+        # and liveness keep answering)
+        if self.lifecycle is not None:
+            middlewares.append(lifecycle_middleware(self.lifecycle))
         # shedding sits inside error mapping but before deadline parsing:
         # a shed request must cost nothing beyond the depth read
         if self.shedder.enabled:
@@ -180,6 +227,8 @@ class RESTServer:
         app.router.add_get(
             "/v1/internal/scheduler/state", self._scheduler_state_handler
         )
+        if self.lifecycle is not None:
+            register_admin_routes(app, self.lifecycle, on_drain=self.on_drain)
         return app
 
     def _total_queue_depth(self) -> int:
@@ -206,12 +255,26 @@ class RESTServer:
             "queue_depth": sum(m["queue_depth"] for m in models.values()),
             "free_pages": sum(m["free_pages"] for m in models.values()),
             "models": models,
+            # the EPP excludes DRAINING/TERMINATING backends from picks
+            # (scheduler/picker.py), same contract as open breakers
+            "lifecycle": (
+                self.lifecycle.state if self.lifecycle is not None else READY
+            ),
         }
         return web.json_response(agg)
 
     async def start(self) -> None:
         app = self.create_application()
-        self._runner = web.AppRunner(app, access_log=None)
+        # header-field limit raised past aiohttp's 8190 default: the
+        # x-generation-checkpoint request header a resuming client carries
+        # grows with prompt+generated length (lifecycle/checkpoint.py) and
+        # a 400 'header too long' would turn every long-prompt resume into
+        # a hard failure
+        self._runner = web.AppRunner(
+            app, access_log=None,
+            max_field_size=CHECKPOINT_FIELD_SIZE_LIMIT,
+            max_line_size=CHECKPOINT_FIELD_SIZE_LIMIT,
+        )
         await self._runner.setup()
         site = web.TCPSite(
             self._runner, host="0.0.0.0", port=self.http_port,

@@ -16,10 +16,16 @@ Fault kinds:
 - ``wedge``          the call hangs until the caller's deadline (httpx
                      ReadTimeout; the engine maps it to a wedged fetch)
 - ``partial_stream`` a 200 whose body dies mid-stream
+- ``preempt``        the engine forcibly requeues its newest active
+                     sequence — the deterministic stand-in for spot/KV
+                     preemption the drain/resume chaos tests fire
 
 `FaultInjectingTransport` honors a plan in front of any httpx handler or
 inner transport; `LLMEngine` honors ``wedge`` specs targeted at
-``engine.fetch`` (see engine._fetch).
+``engine.fetch`` (see engine._fetch) and ``preempt`` specs targeted at
+``engine.preempt`` (see engine._grow_and_preempt — during a drain the
+preempted sequence is checkpointed for cross-replica resume instead of
+being re-seated).
 """
 
 from __future__ import annotations
@@ -36,7 +42,7 @@ from .clock import MONOTONIC, Clock
 @dataclass
 class FaultSpec:
     target: str  # substring matched against the call target
-    kind: str  # latency | connect_error | http_status | wedge | partial_stream
+    kind: str  # latency | connect_error | http_status | wedge | partial_stream | preempt
     status: int = 503
     latency_s: float = 0.0
     retry_after_s: Optional[float] = None

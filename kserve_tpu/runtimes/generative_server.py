@@ -25,6 +25,12 @@ from ..engine.engine import EngineConfig, LLMEngine
 from ..engine.sampling import SamplingParams
 from ..engine.tokenizer import load_tokenizer
 from ..errors import InvalidInput
+from ..lifecycle import (
+    CHECKPOINT_HEADER,
+    GenerationCheckpoint,
+    GenerationPreempted,
+    ReplicaDrainingError,
+)
 from ..logging import logger
 from ..model_server import ModelServer, build_arg_parser
 from ..models import llama
@@ -120,13 +126,16 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
             self.tokenizer,
             params=getattr(self, "_params", None),
             lora_adapters=self.lora_adapters or None,
+            # weights identity for resumable checkpoints: the served model
+            # name, so a checkpoint can only re-seat on the same model
+            checkpoint_label=self.name,
         )
         self._params = None  # free the host copy
         await self.engine.start()
         self.ready = True
         logger.info("generative model %s ready", self.name)
 
-    def stop(self):
+    def stop(self, escalate: bool = False):
         import asyncio
 
         try:
@@ -135,14 +144,42 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
             return
         if not loop.is_running():
             return
-        # keep references: create_task results are weakly held by the loop
-        # and an un-referenced shutdown task can be GC'd before it runs
+        # keep STRONG references until each task completes: create_task
+        # results are weakly held by the loop and an un-referenced shutdown
+        # task can be GC'd before it runs — the drain would silently never
+        # happen.  A done-callback prunes finished tasks so repeated stops
+        # don't accumulate them.  `escalate` (second shutdown signal, see
+        # ModelServer._make_signal_handler) only CANCELS wedged stop work
+        # and returns: the normal shutdown path issues the fresh stop, and
+        # creating tasks here could race an in-progress drain loop.
         self._stop_tasks = getattr(self, "_stop_tasks", [])
+        if escalate:
+            for task in self._stop_tasks:
+                if not task.done():
+                    task.cancel()
+            return
         if self.engine is not None and self.engine.running:
-            self._stop_tasks.append(loop.create_task(self.engine.stop()))
+            self._track_stop_task(loop.create_task(self.engine.stop()))
         if self._prefill_client is not None:
-            self._stop_tasks.append(loop.create_task(self._prefill_client.close()))
+            self._track_stop_task(loop.create_task(self._prefill_client.close()))
             self._prefill_client = None
+
+    def _track_stop_task(self, task) -> None:
+        self._stop_tasks.append(task)
+        task.add_done_callback(self._discard_stop_task)
+
+    def _discard_stop_task(self, task) -> None:
+        try:
+            self._stop_tasks.remove(task)
+        except ValueError:
+            pass  # escalation already pruned it
+
+    async def drain(self, deadline=None) -> list:
+        """Lifecycle drain passthrough: checkpoint whatever the budget
+        cannot finish (kserve_tpu/lifecycle, docs/lifecycle.md)."""
+        if self.engine is None or not self.engine.running:
+            return []
+        return await self.engine.drain(deadline)
 
     async def healthy(self) -> bool:
         return self.ready and self.engine is not None and self.engine.running
@@ -233,6 +270,43 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
     async def create_completion(
         self, request: CompletionRequest, raw_request=None, context=None
     ):
+        ckpt = self._checkpoint_from_context(context)
+        if ckpt is not None:
+            # preemption-safe resume: a drained replica handed the client
+            # (or the EPP) this checkpoint; continue decoding from it —
+            # prompt ids, sampling params and progress all come from the
+            # checkpoint, not the (re-sent) request body.  A checkpoint is
+            # ONE generation: multi-choice requests never receive one
+            # (_raise_gathered), so carrying one here is a client error.
+            if max(request.n or 1, 1) > 1 or (
+                isinstance(request.prompt, list)
+                and len(request.prompt) > 1
+                # str elements and list-of-token-id elements are both
+                # multi-prompt forms; a flat list of ints is ONE prompt
+                and isinstance(request.prompt[0], (str, list))
+            ):
+                raise InvalidInput(
+                    "checkpoint resume supports a single prompt with n=1"
+                )
+            # the checkpoint carries tokens but not the prefix's logprob
+            # entries, so a non-streaming body cannot honor a logprobs
+            # request faithfully — silently returning logprobs=null would
+            # break clients that index it.  (Streaming resumes are fine:
+            # the prefix deltas already delivered their logprobs before
+            # the preemption.)
+            if not request.stream and self._logprobs_k(request) is not None:
+                raise InvalidInput(
+                    "checkpoint resume cannot reconstruct logprobs for the "
+                    "checkpointed prefix in a non-streaming response; "
+                    "retry the request without the checkpoint"
+                )
+            source = self._resume_source(ckpt)
+            if request.stream:
+                return self._stream_completion(
+                    request, list(ckpt.prompt_ids), ckpt.sampling_params(),
+                    source=source,
+                )
+            return await self._resumed_completion(request, ckpt, source)
         prompts = self._encode_prompt(request.prompt)
         params = self._sampling_from(request, max_len_default=16)
         adapter = self._adapter_for(request)
@@ -247,8 +321,10 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
         ]
         # concurrent submission: the engine batches all of them in one pass
         results = await asyncio.gather(
-            *[self._run_one(p, params, adapter) for p in runs]
+            *[self._run_one(p, params, adapter) for p in runs],
+            return_exceptions=True,
         )
+        results = self._raise_gathered(results)
         choices = []
         usage = UsageInfo()
         for idx, (prompt_ids, (text, n_gen, finish, entries)) in enumerate(
@@ -267,6 +343,76 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
             usage.completion_tokens += n_gen
         usage.total_tokens = usage.prompt_tokens + usage.completion_tokens
         return Completion(model=request.model, choices=choices, usage=usage)
+
+    @staticmethod
+    def _raise_gathered(results: list) -> list:
+        """Surface errors from a multi-generation gather without losing
+        sibling generations silently.  A lone GenerationPreempted re-raises
+        as-is (503 + checkpoint: a single-choice resume is exact).  With
+        MULTIPLE generations the response cannot carry per-choice
+        checkpoints, so preemption degrades to a plain retryable 503 —
+        the client restarts the whole request on a healthy replica, which
+        loses salvaged tokens but never drops a choice from the response
+        shape.  Any non-preemption error wins (it would have propagated
+        first under plain gather too)."""
+        errors = [r for r in results if isinstance(r, BaseException)]
+        if not errors:
+            return results
+        for e in errors:
+            if not isinstance(e, GenerationPreempted):
+                raise e
+        if len(results) == 1:
+            raise errors[0]
+        raise ReplicaDrainingError(
+            "replica drained mid-request; multi-choice responses cannot "
+            "carry per-choice checkpoints — retry on another replica"
+        )
+
+    def _checkpoint_from_context(self, context) -> Optional[GenerationCheckpoint]:
+        """A generation checkpoint riding the request headers (the
+        x-generation-checkpoint value a draining replica returned)."""
+        if not context:
+            return None
+        return GenerationCheckpoint.from_header(context.get(CHECKPOINT_HEADER))
+
+    def _resume_source(self, ckpt):
+        """Validate + admit a wire-sourced checkpoint exactly once (the
+        engine counts a resume per call).  A malformed or model-mismatched
+        checkpoint is the CLIENT's error — surface it as 400 InvalidInput,
+        not the last-resort 500."""
+        try:
+            return self.engine.resume_generation(ckpt)
+        except ValueError as e:
+            raise InvalidInput(f"cannot resume from checkpoint: {e}") from e
+
+    @staticmethod
+    async def _splice_resume(ckpt, source):
+        """Drain a resumed generation source to completion.  Returns the
+        full spliced text (checkpointed tokens + continuation), the finish
+        reason, and usage accounted against the checkpoint's prompt — the
+        shared core of the completion and chat resume bodies."""
+        n_gen, finish, last = 0, None, None
+        async for out in source:
+            last, n_gen, finish = out, out.num_generated, out.finish_reason
+        text = last.cumulative_text if last is not None else ""
+        usage = UsageInfo(
+            prompt_tokens=len(ckpt.prompt_ids),
+            completion_tokens=n_gen,
+            total_tokens=len(ckpt.prompt_ids) + n_gen,
+        )
+        return text, finish or "stop", usage
+
+    async def _resumed_completion(self, request: CompletionRequest, ckpt, source):
+        """Non-streaming resume: the response carries the FULL generation —
+        the checkpointed tokens plus the continuation — so the retry is
+        transparent to the caller (same body a never-preempted request
+        would have returned)."""
+        text, finish, usage = await self._splice_resume(ckpt, source)
+        return Completion(
+            model=request.model,
+            choices=[CompletionChoice(index=0, text=text, finish_reason=finish)],
+            usage=usage,
+        )
 
     def _adapter_for(self, request) -> Optional[str]:
         """OpenAI `model` naming a loaded LoRA adapter selects it (vLLM
@@ -380,12 +526,18 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
         return ChatCompletionLogprobs(content=content)
 
     async def _stream_completion(
-        self, request: CompletionRequest, prompt_ids, params, adapter=None
+        self, request: CompletionRequest, prompt_ids, params, adapter=None,
+        source=None,
     ) -> AsyncIterator[Completion]:
+        """`source` overrides the token stream (checkpoint resume) — the
+        chunks then carry only the CONTINUATION deltas, which is exactly
+        what a client holding the pre-drain prefix needs to splice."""
         completion_id = random_uuid("cmpl-")
         n_gen = 0
         text_offset = 0
-        async for out in self._generate(prompt_ids, params, adapter):
+        if source is None:
+            source = self._generate(prompt_ids, params, adapter)
+        async for out in source:
             n_gen = out.num_generated
             lp = None
             if params.logprobs is not None and out.token_id >= 0:
@@ -433,6 +585,28 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
     async def create_chat_completion(
         self, request: ChatCompletionRequest, raw_request=None, context=None
     ):
+        ckpt = self._checkpoint_from_context(context)
+        if ckpt is not None:
+            # preemption-safe resume, chat surface (see create_completion):
+            # progress and sampling come from the checkpoint, stream chunks
+            # carry only the continuation deltas, and the non-stream body
+            # carries the full spliced message
+            if max(request.n or 1, 1) > 1:
+                raise InvalidInput("checkpoint resume supports n=1")
+            # same prefix-logprobs constraint as create_completion
+            if not request.stream and self._logprobs_k(request) is not None:
+                raise InvalidInput(
+                    "checkpoint resume cannot reconstruct logprobs for the "
+                    "checkpointed prefix in a non-streaming response; "
+                    "retry the request without the checkpoint"
+                )
+            source = self._resume_source(ckpt)
+            if request.stream:
+                return self._stream_chat(
+                    request, list(ckpt.prompt_ids), ckpt.sampling_params(),
+                    source=source,
+                )
+            return await self._resumed_chat(request, ckpt, source)
         prompt_ids = self._chat_prompt(request)
         params = self._sampling_from(request, max_len_default=256)
         adapter = self._adapter_for(request)
@@ -444,8 +618,10 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
 
         n = max(request.n, 1)
         results = await asyncio.gather(
-            *[self._run_one(prompt_ids, params, adapter) for _ in range(n)]
+            *[self._run_one(prompt_ids, params, adapter) for _ in range(n)],
+            return_exceptions=True,
         )
+        results = self._raise_gathered(results)
         choices = []
         usage = UsageInfo(prompt_tokens=len(prompt_ids) * n)
         for i, (text, n_gen, finish, entries) in enumerate(results):
@@ -464,9 +640,29 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
         usage.total_tokens = usage.prompt_tokens + usage.completion_tokens
         return ChatCompletion(model=request.model, choices=choices, usage=usage)
 
+    async def _resumed_chat(self, request: ChatCompletionRequest, ckpt, source):
+        """Non-streaming chat resume: the full spliced message (checkpointed
+        prefix + continuation), same body a never-preempted request would
+        have returned."""
+        text, finish, usage = await self._splice_resume(ckpt, source)
+        return ChatCompletion(
+            model=request.model,
+            choices=[ChatCompletionChoice(
+                index=0,
+                message=ChatCompletionResponseMessage(
+                    role="assistant", content=text),
+                finish_reason=finish,
+            )],
+            usage=usage,
+        )
+
     async def _stream_chat(
-        self, request: ChatCompletionRequest, prompt_ids, params, adapter=None
+        self, request: ChatCompletionRequest, prompt_ids, params, adapter=None,
+        source=None,
     ) -> AsyncIterator[ChatCompletionChunk]:
+        """`source` overrides the token stream (checkpoint resume): chunks
+        then carry only the continuation deltas — what a client holding the
+        pre-drain prefix needs to splice."""
         chunk_id = random_uuid("chatcmpl-")
         yield ChatCompletionChunk(
             id=chunk_id,
@@ -478,7 +674,9 @@ class JAXGenerativeModel(OpenAIGenerativeModel):
             ],
         )
         n_gen = 0
-        async for out in self._generate(prompt_ids, params, adapter):
+        if source is None:
+            source = self._generate(prompt_ids, params, adapter)
+        async for out in source:
             n_gen = out.num_generated
             lp = None
             if params.logprobs is not None and out.token_id >= 0:

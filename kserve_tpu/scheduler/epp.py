@@ -31,6 +31,7 @@ from typing import Optional
 
 from aiohttp import web
 
+from ..lifecycle import CHECKPOINT_FIELD_SIZE_LIMIT
 from ..logging import logger
 from .latency import estimate_prompt_len
 from .picker import EndpointPicker
@@ -122,6 +123,10 @@ class EPPServer:
         return web.json_response({
             "endpoint": replica.url,
             "queue_depth": replica.queue_depth,
+            # always READY here today (DRAINING/TERMINATING backends are
+            # excluded from picks like open breakers), surfaced so gateway
+            # callers can log the lifecycle of the backend they were handed
+            "lifecycle": replica.lifecycle,
         })
 
     async def proxy(self, request: web.Request) -> web.StreamResponse:
@@ -134,9 +139,15 @@ class EPPServer:
                 {"error": "no healthy replica"}, status=503
             )
         if self._client is None:
-            # no total timeout: generative streams legitimately run minutes
+            # no total timeout: generative streams legitimately run minutes.
+            # header limits raised to match the replicas' (rest/server.py):
+            # a drained backend's 503 carries an x-generation-checkpoint
+            # response header that grows with generation length, and the
+            # default 8190-byte cap would turn it into a proxy error
             self._client = aiohttp.ClientSession(
-                timeout=aiohttp.ClientTimeout(total=None, sock_connect=10)
+                timeout=aiohttp.ClientTimeout(total=None, sock_connect=10),
+                max_field_size=CHECKPOINT_FIELD_SIZE_LIMIT,
+                max_line_size=CHECKPOINT_FIELD_SIZE_LIMIT,
             )
         headers = {
             k: v for k, v in request.headers.items()
@@ -310,7 +321,13 @@ async def serve(args) -> None:
         asyncio.get_running_loop().create_task(rediscover())
     await picker.start_polling()
     server = EPPServer(picker)
-    runner = web.AppRunner(server.create_application(), access_log=None)
+    # resume retries carry the x-generation-checkpoint REQUEST header
+    # through this proxy; accept the same size the replicas do
+    runner = web.AppRunner(
+        server.create_application(), access_log=None,
+        max_field_size=CHECKPOINT_FIELD_SIZE_LIMIT,
+        max_line_size=CHECKPOINT_FIELD_SIZE_LIMIT,
+    )
     await runner.setup()
     site = web.TCPSite(runner, "0.0.0.0", args.port)
     await site.start()

@@ -48,6 +48,12 @@ class Replica:
     healthy: bool = True
     queue_depth: int = 0
     free_pages: int = 0
+    # replica lifecycle state from /v1/internal/scheduler/state
+    # (kserve_tpu/lifecycle): DRAINING/TERMINATING backends are excluded
+    # from picks — like open breakers — so a draining replica empties
+    # instead of accumulating work it will only checkpoint away.  A fresh
+    # replica on a recycled url starts READY (set_replicas churn contract).
+    lifecycle: str = "READY"
     # per-model (page_size, digest set) — kept separate so a multi-model
     # replica never scores one model's prompt against another's cache
     models: Dict[str, tuple] = field(default_factory=dict)
@@ -149,6 +155,7 @@ class EndpointPicker:
         wedged = wedged or bool(state.get("wedged"))
         r.models = models
         r.healthy = not wedged
+        r.lifecycle = str(state.get("lifecycle") or "READY").upper()
         r.consecutive_failures = 0
         r.last_poll = time.monotonic()
 
@@ -285,12 +292,14 @@ class EndpointPicker:
         prompt_text: Optional[str] = None,
     ) -> Optional[Replica]:
         """Best replica for this request, or None when none is healthy.
-        Replicas with an open circuit breaker are excluded from the pick
-        (half-open replicas stay in as probe traffic); all-excluded falls
-        through to None -> 503 upstream."""
+        Replicas with an open circuit breaker — or a DRAINING/TERMINATING
+        lifecycle state — are excluded from the pick (half-open replicas
+        stay in as probe traffic); all-excluded falls through to None ->
+        503 upstream."""
         healthy = [
             r for r in self.replicas.values()
             if r.healthy
+            and r.lifecycle not in ("DRAINING", "TERMINATING")
             and (self.breakers is None or self.breakers.available(r.url))
         ]
         if not healthy:
@@ -334,6 +343,7 @@ class EndpointPicker:
             {
                 "url": r.url,
                 "healthy": r.healthy,
+                "lifecycle": r.lifecycle,
                 "queue_depth": r.queue_depth,
                 "free_pages": r.free_pages,
                 "digests": len(r.digests),

@@ -56,6 +56,20 @@ def run_async():
     return asyncio.run
 
 
+def counter_value(counter, **labels) -> float:
+    """Current value of a (possibly labeled) prometheus Counter."""
+    return counter.labels(**labels)._value.get()
+
+
+def hist_count(hist) -> float:
+    """Observation count of an unlabeled prometheus Histogram."""
+    for metric in hist.collect():
+        for sample in metric.samples:
+            if sample.name.endswith("_count"):
+                return sample.value
+    return 0.0
+
+
 # Modules dominated by compiled-engine loops (measured: each >30s of the
 # ~10-minute full suite).  `pytest -m "not slow"` is the <2-minute signal
 # to run between milestones; the full suite still gates every round-end

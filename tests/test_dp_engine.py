@@ -91,6 +91,45 @@ class TestDataParallelEngine:
             await dp_engine.stop()
 
     @async_test
+    async def test_drain_aggregates_groups_and_resume_crosses_identity(self):
+        """Lifecycle drain on a dp>1 pod: checkpoints aggregate across the
+        dp groups, carry the SHARED weights identity ("engine", not
+        "engine-dpN"), and any group of a replacement pod accepts them —
+        a per-group label would false-reject every cross-group resume."""
+        from kserve_tpu.lifecycle import GenerationPreempted
+        from kserve_tpu.resilience import FakeClock
+
+        # tp=1: drain/resume semantics don't depend on the intra-replica
+        # sharding, and tp>1 needs jax.shard_map which not every test
+        # environment's jax build ships
+        dp_engine = build_engine(model_config(), make_config(dp=2, tp=1),
+                                 ByteTokenizer(512))
+        caught = []
+
+        async def consume():
+            try:
+                async for _ in dp_engine.generate(
+                    [1, 2, 3], SamplingParams(max_tokens=4)
+                ):
+                    pass
+            except GenerationPreempted as exc:
+                caught.append(exc.checkpoint)
+
+        task = asyncio.create_task(consume())
+        for _ in range(5):
+            await asyncio.sleep(0)  # let the request land in a group queue
+        checkpoints = await dp_engine.drain(clock=FakeClock())
+        await asyncio.wait_for(task, timeout=1.0)
+        assert [c.prompt_ids for c in checkpoints] == [[1, 2, 3]]
+        assert [c.model_name for c in checkpoints] == ["engine"]
+        assert caught and dp_engine.draining
+
+        replacement = build_engine(model_config(), make_config(dp=2, tp=1),
+                                   ByteTokenizer(512))
+        replacement.resume_generation(checkpoints[0])  # any group accepts
+        assert sum(e.resume_count for e in replacement.replicas) == 1
+
+    @async_test
     async def test_cancel_reaches_all_replicas(self):
         engine = build_engine(model_config(), make_config(dp=2), ByteTokenizer(512))
         await engine.start()

@@ -87,6 +87,43 @@ class TestPicker:
         p.set_replicas(["http://b:8080", "http://c:8080"])
         assert sorted(p.replicas) == ["http://b:8080", "http://c:8080"]
 
+    def test_draining_replica_excluded_from_picks(self):
+        """ISSUE 5: a DRAINING backend drops out of the candidate set like
+        an open breaker — its /state lifecycle field is the signal."""
+        p = make_picker()
+        p.observe_state("http://a:8080", {"queue_depth": 0, "free_pages": 50,
+                                          "lifecycle": "DRAINING"})
+        p.observe_state("http://b:8080", {"queue_depth": 9, "free_pages": 1,
+                                          "lifecycle": "READY"})
+        # despite a's far better load, every pick lands on the live replica
+        for _ in range(6):
+            assert p.pick(prompt_ids=[1, 2, 3]).url == "http://b:8080"
+        snap = {s["url"]: s["lifecycle"] for s in p.snapshot()}
+        assert snap == {"http://a:8080": "DRAINING", "http://b:8080": "READY"}
+
+    def test_terminating_and_all_draining_yield_none(self):
+        p = make_picker()
+        p.observe_state("http://a:8080", {"queue_depth": 0,
+                                          "lifecycle": "TERMINATING"})
+        p.observe_state("http://b:8080", {"queue_depth": 0,
+                                          "lifecycle": "DRAINING"})
+        assert p.pick(prompt_ids=[1]) is None  # 503 upstream
+
+    def test_replica_replacement_rejoins_after_drain(self):
+        """Mirror of the breaker-churn contract (PR 4): the replacement
+        pod on a recycled url must start READY, not inherit the drained
+        predecessor's lifecycle."""
+        p = make_picker()
+        p.observe_state("http://a:8080", {"queue_depth": 0,
+                                          "lifecycle": "DRAINING"})
+        p.observe_state("http://b:8080", {"queue_depth": 0})
+        assert p.pick(prompt_ids=[1]).url == "http://b:8080"
+        p.set_replicas(["http://b:8080"])  # drained pod exits
+        p.set_replicas(["http://a:8080", "http://b:8080"])  # replacement
+        p.observe_state("http://b:8080", {"queue_depth": 50})
+        # the fresh replica is back in the set and wins on load
+        assert p.pick(prompt_ids=[1]).url == "http://a:8080"
+
     def test_round_robin_when_strategies_off(self):
         args = build_arg_parser().parse_args(
             ["--replicas", "http://a:8080,http://b:8080", "--strategy", ""]

@@ -38,7 +38,7 @@ from kserve_tpu.resilience import (
 )
 from kserve_tpu.scheduler.picker import EndpointPicker
 
-from conftest import async_test
+from conftest import async_test, counter_value, hist_count
 
 pytestmark = pytest.mark.chaos
 
@@ -533,6 +533,31 @@ class TestInferenceClientChaos:
         assert len(transport.calls) == 3  # exhausted the policy first
 
     @async_test
+    async def test_checkpoint_from_503_body_carried_on_retry(self):
+        """Large checkpoints ride the 503 body only (servers omit the
+        response header past CHECKPOINT_HEADER_SAFE_BYTES so stock parsers
+        don't choke); the retry must still carry the checkpoint — as the
+        request header — so the next replica RESUMES."""
+        from kserve_tpu.lifecycle import CHECKPOINT_HEADER, GenerationCheckpoint
+
+        ckpt = GenerationCheckpoint(request_id="body-1", prompt_ids=[1, 2, 3],
+                                    generated=[7, 8], sampling={"max_tokens": 9})
+        seen = []
+
+        def handler(req):
+            seen.append(req.headers.get(CHECKPOINT_HEADER))
+            if len(seen) == 1:
+                return (503, {"error": "draining", "checkpoint": ckpt.to_dict()})
+            return (200, {"predictions": [[2]]})
+
+        client, transport, _ = make_chaos_client(handler=handler)
+        out = await client.infer("http://m:8080", {"instances": [[1]]},
+                                 model_name="m")
+        assert out == {"predictions": [[2]]}
+        assert seen[0] is None  # first attempt carried nothing
+        assert seen[1] == ckpt.to_header()  # retry resumed from the body
+
+    @async_test
     async def test_no_retry_past_dead_deadline(self):
         client, transport, clock = make_chaos_client(
             specs=[FaultSpec("m", "http_status", status=429,
@@ -922,3 +947,194 @@ class TestEndToEndChaos:
         out = await router.execute_node("probe", {}, {})
         assert out == {"host": "dying"}
         assert router.breakers.state("dying") == "closed"
+
+
+# ---------------- acceptance: drain under load, resume elsewhere ----------------
+
+
+class TestDrainChaos:
+    @async_test
+    async def test_drain_under_load_resumes_token_exact_on_second_replica(self):
+        """ISSUE 5 acceptance: SIGTERM-equivalent drain under load -> the
+        DRAINING replica drops out of EPP picks -> a deterministic preempt
+        fault fires mid-generation -> the in-flight stream is checkpointed
+        inside the drain and resumed on a second replica with a TOKEN-EXACT
+        spliced output (zero lost, zero duplicated), with
+        generation_resumes_total, the tokens-salvaged counter and the
+        drain-duration histogram all observed.  FakeClock throughout — the
+        drain wait, budget and escalation contract run on virtual time."""
+        from test_engine import make_engine
+
+        from kserve_tpu.engine.sampling import SamplingParams
+        from kserve_tpu.lifecycle import (
+            DRAINING,
+            TERMINATING,
+            GenerationPreempted,
+            ReplicaDrainingError,
+            ReplicaLifecycle,
+        )
+        from kserve_tpu.metrics import (
+            DRAIN_DURATION,
+            GENERATION_RESUMES,
+            TOKENS_SALVAGED,
+        )
+
+        # two replicas with identical weights (both seed params from
+        # PRNGKey(1)): greedy decoding is deterministic across them, which
+        # is what makes token-exactness an assertable property
+        replica_a = make_engine(steps_per_sync=2)
+        replica_b = make_engine(steps_per_sync=2)
+        await replica_a.start()
+        await replica_b.start()
+        prompt = [5, 6, 7]
+        params = SamplingParams(max_tokens=24, temperature=0.0, ignore_eos=True)
+        # the reference output: the same request run UNINTERRUPTED on b
+        expected = []
+        async for out in replica_b.generate(prompt, params):
+            expected.append(out.token_id)
+
+        # EPP view of the fleet
+        picker = EndpointPicker(["http://a:8080", "http://b:8080"])
+        picker.observe_state("http://a:8080", {"queue_depth": 0, "lifecycle": "READY"})
+        picker.observe_state("http://b:8080", {"queue_depth": 3, "lifecycle": "READY"})
+        assert picker.pick(prompt_ids=prompt).url == "http://a:8080"
+
+        # in-flight stream on a, mid-generation when the drain lands
+        received = []
+        caught = {}
+
+        async def consume():
+            try:
+                async for out in replica_a.generate(prompt, params,
+                                                    request_id="drained-1"):
+                    received.append(out.token_id)
+            except GenerationPreempted as exc:
+                caught["ckpt"] = exc.checkpoint
+
+        stream_task = asyncio.create_task(consume())
+        while len(received) < 3:
+            await asyncio.sleep(0)
+
+        # SIGTERM-equivalent: lifecycle flips DRAINING on a FakeClock, and
+        # a deterministic preempt fault will evict the sequence mid-drain
+        clock = FakeClock()
+        lifecycle = ReplicaLifecycle(clock=clock, drain_grace_s=60.0)
+        lifecycle.mark_ready()
+        budget = lifecycle.begin_drain()
+        replica_a.fault_plan = FaultPlan(
+            [FaultSpec("engine.preempt", "preempt", count=1)])
+        resumes_before = counter_value(GENERATION_RESUMES, model_name="engine")
+        salvaged_before = counter_value(TOKENS_SALVAGED, model_name="engine")
+        drains_before = hist_count(DRAIN_DURATION)
+
+        # the EPP stops picking the draining replica (its /state now
+        # advertises DRAINING), like an open breaker
+        picker.observe_state("http://a:8080",
+                             {"queue_depth": 0, "lifecycle": DRAINING})
+        for _ in range(6):
+            assert picker.pick(prompt_ids=prompt).url == "http://b:8080"
+
+        # drain a: admission closed, the preempt fault evicts the live
+        # sequence, the drain flushes it into a portable checkpoint
+        checkpoints = await replica_a.drain(deadline=budget, clock=clock)
+        lifecycle.finish_drain()
+        with pytest.raises(ReplicaDrainingError):
+            replica_a.generate(prompt, params)
+        await asyncio.wait_for(stream_task, timeout=2.0)
+        assert replica_a.preemption_count == 1  # the injected preemption
+        assert [c.request_id for c in checkpoints] == ["drained-1"]
+        ckpt = caught["ckpt"]
+        assert ckpt.tokens_salvaged == len(received) > 0
+        # the stream received exactly the checkpointed prefix, in order
+        assert received == ckpt.generated
+
+        # resume on b (the replica every pick now lands on): the re-prefill
+        # emits nothing, decode continues at the NEXT token
+        continuation = []
+        async for out in replica_b.resume_generation(ckpt):
+            continuation.append(out.token_id)
+        spliced = received + continuation
+        assert spliced == expected  # token-exact: zero lost, zero duplicated
+
+        # observability contract
+        assert counter_value(GENERATION_RESUMES,
+                             model_name="engine") == resumes_before + 1
+        assert counter_value(
+            TOKENS_SALVAGED, model_name="engine"
+        ) == salvaged_before + ckpt.tokens_salvaged
+        assert hist_count(DRAIN_DURATION) == drains_before + 1
+        assert lifecycle.state == TERMINATING
+        await replica_a.stop()
+        await replica_b.stop()
+
+    @async_test
+    async def test_drain_budget_lets_short_streams_finish(self):
+        """The other half of the acceptance contract: an in-flight stream
+        that CAN finish inside the drain budget completes normally — no
+        checkpoint, no client disruption."""
+        from test_engine import make_engine
+
+        from kserve_tpu.engine.sampling import SamplingParams
+
+        engine = make_engine(steps_per_sync=2)
+        await engine.start()
+        params = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+        received = []
+
+        async def consume():
+            async for out in engine.generate([9, 8, 7], params):
+                received.append(out)
+
+        task = asyncio.create_task(consume())
+        while len(received) < 1:
+            await asyncio.sleep(0)
+        clock = FakeClock()
+        checkpoints = await engine.drain(
+            deadline=Deadline.after(1000.0, clock), clock=clock)
+        await asyncio.wait_for(task, timeout=2.0)
+        assert checkpoints == []  # finished inside the budget
+        assert len(received) == 6 and received[-1].finished
+        await engine.stop()
+
+    @async_test
+    async def test_escalation_cuts_drain_short_deterministically(self):
+        """Second-SIGTERM contract: escalate() expires the budget IN PLACE
+        and the drain loop observes it on its next virtual-clock poll."""
+        from test_engine import make_engine
+
+        from kserve_tpu.engine.sampling import SamplingParams
+        from kserve_tpu.lifecycle import GenerationPreempted, ReplicaLifecycle
+
+        engine = make_engine(steps_per_sync=1)
+        await engine.start()
+        # long enough that the stream cannot finish while the test polls
+        # (make_engine's max_model_len is 64, so stay under 64 - prompt)
+        params = SamplingParams(max_tokens=48, temperature=0.0, ignore_eos=True)
+        received = []
+        caught = {}
+
+        async def consume():
+            try:
+                async for out in engine.generate([1, 2, 3], params):
+                    received.append(out.token_id)
+            except GenerationPreempted as exc:
+                caught["ckpt"] = exc.checkpoint
+
+        task = asyncio.create_task(consume())
+        while len(received) < 2:
+            await asyncio.sleep(0)
+        clock = FakeClock()
+        lifecycle = ReplicaLifecycle(clock=clock, drain_grace_s=10_000.0)
+        lifecycle.mark_ready()
+        budget = lifecycle.begin_drain()
+        drain_task = asyncio.create_task(engine.drain(deadline=budget, clock=clock))
+        for _ in range(3):
+            await asyncio.sleep(0)
+        lifecycle.escalate()  # second signal mid-drain
+        checkpoints = await asyncio.wait_for(drain_task, timeout=5.0)
+        await asyncio.wait_for(task, timeout=2.0)
+        # the long request had no chance to finish; escalation checkpointed
+        # it instead of waiting out the 10000s budget
+        assert len(checkpoints) == 1
+        assert caught["ckpt"].generated == received
+        await engine.stop()
