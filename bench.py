@@ -8,8 +8,16 @@ Prints ONE JSON line:
 
 Baseline: the BASELINE.json north star (>1000 tok/s/chip for the
 LLMInferenceService path on v5e); vs_baseline = value / 1000.
+
+``--mode latency`` switches to the serving-benchmark shape of the
+vLLM/TGI comparative study (PAPERS.md, arXiv:2511.17593): a concurrency
+sweep reporting TTFT / inter-token-latency / queue-wait percentiles and
+throughput per point (the throughput-vs-latency curve), sourced from the
+engine's own RequestTimeline telemetry (kserve_tpu/observability) and
+appended to MEASUREMENTS.md.  Runs anywhere — CPU smoke shapes off-chip.
 """
 
+import argparse
 import asyncio
 import json
 import os
@@ -454,13 +462,146 @@ async def run_bench():
     return result
 
 
+async def run_latency_sweep(args):
+    """Latency mode: drive the engine at a sweep of offered concurrencies
+    and report TTFT/ITL/queue-wait percentiles + throughput per point —
+    the engine's own RequestTimeline telemetry is the measurement source,
+    so bench numbers and production /admin/telemetry numbers agree by
+    construction."""
+    import random
+
+    import jax
+
+    from kserve_tpu.engine.engine import EngineConfig, LLMEngine
+    from kserve_tpu.engine.sampling import SamplingParams
+    from kserve_tpu.engine.tokenizer import ByteTokenizer
+    from kserve_tpu.models.llama import LlamaConfig
+    from kserve_tpu.observability import TimelineRecorder
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        model_config = LlamaConfig.bench_1b()
+        engine_config = EngineConfig(
+            max_batch_size=48, page_size=16, num_pages=4096,
+            max_pages_per_seq=64, max_prefill_len=512,
+            prefill_buckets=(128, 256, 512), dtype="bfloat16",
+            use_pallas=None, steps_per_sync=64, prefill_batch=16,
+        )
+        prompt_len, max_tokens, warmup = 128, 128, 15
+        sweep = [1, 4, 16, 48]
+    else:
+        model_config = LlamaConfig.tiny(dtype="float32")
+        engine_config = EngineConfig(
+            max_batch_size=4, page_size=8, num_pages=128,
+            max_pages_per_seq=16, max_prefill_len=64,
+            prefill_buckets=(32, 64), dtype="float32", use_pallas=None,
+            steps_per_sync=4, prefill_batch=4,
+        )
+        prompt_len, max_tokens, warmup = 16, 16, 2
+        sweep = [1, 2, 4]
+    if args.concurrency:
+        sweep = [int(c) for c in args.concurrency.split(",") if c]
+    n_requests = args.requests or (48 if on_tpu else 8)
+
+    tokenizer = ByteTokenizer(model_config.vocab_size)
+    engine = LLMEngine(model_config, engine_config, tokenizer, rng_seed=0)
+    await engine.start()
+    rng = random.Random(0)
+    params = SamplingParams(max_tokens=max_tokens, temperature=0.0,
+                            ignore_eos=True)
+
+    def prompt():
+        return [rng.randrange(3, 255) for _ in range(prompt_len)]
+
+    async def one(sem):
+        async with sem:
+            n = 0
+            async for out in engine.generate(prompt(), params):
+                n = out.num_generated
+            return n
+
+    def fmt(p):
+        return {k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in p.items()}
+
+    warm_sem = asyncio.Semaphore(max(sweep))
+    await asyncio.gather(*[one(warm_sem) for _ in range(warmup)])
+    points = []
+    for conc in sweep:
+        # fresh rolling windows per point so percentiles are per-point
+        engine.telemetry = TimelineRecorder()
+        sem = asyncio.Semaphore(conc)
+        start = time.perf_counter()
+        counts = await asyncio.gather(*[one(sem) for _ in range(n_requests)])
+        elapsed = time.perf_counter() - start
+        snap = engine.telemetry.snapshot(max_recent=0)
+        point = {
+            "concurrency": conc,
+            "requests": n_requests,
+            "throughput_tok_s": round(sum(counts) / elapsed, 2),
+            "elapsed_s": round(elapsed, 3),
+            "ttft_s": fmt(snap["ttft_s"]),
+            "itl_s": fmt(snap["itl_s"]),
+            "queue_wait_s": fmt(snap["queue_wait_s"]),
+            "e2e_s": fmt(snap["e2e_s"]),
+        }
+        points.append(point)
+        _PARTIAL[f"latency_c{conc}"] = point
+    await engine.stop()
+    return {
+        "metric": ("llama3_1b_latency_sweep" if on_tpu
+                   else "tiny_latency_sweep_cpu_smoke"),
+        "unit": "s",
+        "mode": "latency",
+        "detail": {
+            "prompt_len": prompt_len,
+            "max_tokens": max_tokens,
+            "backend": jax.default_backend(),
+        },
+        "points": points,
+    }
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="bench.py",
+        description="kserve-tpu engine benchmark (one JSON result line, "
+                    "appended to MEASUREMENTS.md)",
+    )
+    parser.add_argument(
+        "--mode", choices=("throughput", "latency"), default="throughput",
+        help="throughput: headline aggregate tok/s/chip (default, the "
+             "driver contract).  latency: concurrency sweep reporting "
+             "TTFT/inter-token-latency/queue-wait percentiles and the "
+             "throughput-vs-latency curve from engine RequestTimelines",
+    )
+    parser.add_argument(
+        "--concurrency", default="",
+        help="latency mode: comma-separated offered-concurrency sweep "
+             "points (default: 1,4,16,48 on TPU; 1,2,4 on CPU)",
+    )
+    parser.add_argument(
+        "--requests", type=int, default=0,
+        help="latency mode: requests per sweep point (0 = auto)",
+    )
+    return parser
+
+
 if __name__ == "__main__":
+    cli_args = build_arg_parser().parse_args()
+    # kserve_tpu.model_server parses argv at import time (reference-parity
+    # CLI); our flags must not leak into it (--mode is an ambiguous prefix
+    # of --model_name there)
+    sys.argv = sys.argv[:1]
     # armed BEFORE the preflight so a hang inside the probe machinery itself
     # (D-state child, inherited pipes) still yields a result line; budget
     # covers the full retry window plus the bench proper
     watchdog = _arm_watchdog(PREFLIGHT_WINDOW_S + WATCHDOG_SECONDS)
     attempts = _preflight()
-    result = asyncio.run(run_bench())
+    if cli_args.mode == "latency":
+        result = asyncio.run(run_latency_sweep(cli_args))
+    else:
+        result = asyncio.run(run_bench())
     if attempts:
         result.setdefault("detail", {})["preflight_attempts"] = attempts
     watchdog.cancel()

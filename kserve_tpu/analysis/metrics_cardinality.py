@@ -1,0 +1,121 @@
+"""Prometheus label-cardinality gate (jaxlint-style AST pass).
+
+Prometheus label children are never freed, so a label whose value space is
+unbounded — a backend ip:port, a request/trace id, a raw URL path — is a
+slow memory leak and a scrape-size bomb under replica churn.  metrics.py
+already documents the policy (breaker metrics are labeled by state, NOT
+backend); this pass enforces it tree-wide: any ``Counter``/``Gauge``/
+``Histogram``/``Summary`` declaration inside ``kserve_tpu/`` whose label
+list contains a banned name fails lint.
+
+Allowed labels are things with small closed value sets (model_name is
+bounded by the models a replica serves; state/role/component/program are
+enums by construction).
+
+CLI: ``python -m kserve_tpu.analysis.metrics_cardinality [paths...]`` —
+wired into scripts/lint.sh next to jaxlint.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from typing import Iterator, List, Tuple
+
+from .core import iter_python_files
+
+METRIC_TYPES = {"Counter", "Gauge", "Histogram", "Summary"}
+
+# label names whose value space is unbounded in this codebase's vocabulary
+BANNED_LABELS = {
+    "backend", "endpoint", "url", "ip", "address", "host", "port",
+    "request_id", "rid", "trace_id", "span_id", "session", "session_id",
+    "path", "pod", "pod_ip", "replica", "replica_url", "prompt", "user",
+}
+
+
+def _metric_type_name(func: ast.AST) -> str:
+    """The called name for ``Counter(...)`` / ``prometheus_client.Counter``."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def _label_list(call: ast.Call):
+    """The labelnames argument: 3rd positional or ``labelnames=`` kw."""
+    if len(call.args) >= 3:
+        return call.args[2]
+    for kw in call.keywords:
+        if kw.arg == "labelnames":
+            return kw.value
+    return None
+
+
+def scan_source(src: str, path: str) -> List[Tuple[str, int, str]]:
+    """(path, line, message) findings for one file's source."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [(path, e.lineno or 0, f"syntax error: {e.msg}")]
+    findings: List[Tuple[str, int, str]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        mtype = _metric_type_name(node.func)
+        if mtype not in METRIC_TYPES:
+            continue
+        labels = _label_list(node)
+        if labels is None:
+            continue
+        if not isinstance(labels, (ast.List, ast.Tuple)):
+            # a computed label list cannot be audited statically — that is
+            # itself the hazard (labels must be a declared closed set)
+            findings.append((
+                path, node.lineno,
+                f"{mtype} labelnames must be a literal list/tuple "
+                "(computed label sets cannot be cardinality-audited)",
+            ))
+            continue
+        for elt in labels.elts:
+            if not isinstance(elt, ast.Constant) or not isinstance(elt.value, str):
+                findings.append((
+                    path, elt.lineno,
+                    f"{mtype} label must be a string literal",
+                ))
+                continue
+            if elt.value.lower() in BANNED_LABELS:
+                findings.append((
+                    path, elt.lineno,
+                    f"{mtype} label {elt.value!r} is unbounded-cardinality "
+                    "(prometheus label children are never freed); key by a "
+                    "closed enum instead and put the identity in logs/spans",
+                ))
+    return findings
+
+
+def scan_paths(paths) -> Iterator[Tuple[str, int, str]]:
+    for path in iter_python_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        except OSError as e:
+            yield (str(path), 0, f"unreadable: {e}")
+            continue
+        yield from scan_source(src, str(path))
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv) or ["kserve_tpu"]
+    findings = list(scan_paths(args))
+    for path, line, msg in findings:
+        print(f"{path}:{line}: metric-cardinality: {msg}")
+    if findings:
+        print(f"metrics_cardinality: {len(findings)} finding(s)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

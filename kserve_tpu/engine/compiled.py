@@ -18,9 +18,41 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
+from ..metrics import XLA_COMPILES
 from ..models import llama
 from ..parallel import sharding as shd
 from .sampling import apply_penalties, compute_logprobs, sample_tokens
+
+
+class _CompileCounting:
+    """Wrap a jitted program and count its jit-cache misses (compiles AND
+    retraces) into the engine_xla_compiles_total counter, labeled by the
+    program's fixed name.  A growing count at steady state is the recompile
+    alarm ROADMAP item 2's perf oracle needs (shape-bucket drift, weak-type
+    wobble, donation mismatch all show up here before they show up as tail
+    latency)."""
+
+    __slots__ = ("_name", "_fn", "_seen")
+
+    def __init__(self, name: str, fn: Callable):
+        self._name = name
+        self._fn = fn
+        self._seen = 0
+
+    def __call__(self, *args, **kwargs):
+        out = self._fn(*args, **kwargs)
+        try:
+            n = self._fn._cache_size()
+        except AttributeError:  # older jax: counting degrades to a no-op
+            return out
+        if n > self._seen:
+            XLA_COMPILES.labels(program=self._name).inc(n - self._seen)
+            self._seen = n
+        return out
+
+
+def _counted(**named) -> dict:
+    return {k: _CompileCounting(k, v) for k, v in named.items()}
 
 
 @dataclass(frozen=True)
@@ -286,7 +318,7 @@ def build_compiled(model_config, engine_config, mesh) -> CompiledPrograms:
         return fn
 
     n_kv_args = 3  # kv_pages is arg index 3 in the prefill/decode sigs
-    return CompiledPrograms(
+    return CompiledPrograms(**_counted(
         prefill=jax.jit(_make_prefill(False), donate_argnums=(n_kv_args,)),
         prefill_lp=jax.jit(_make_prefill(True), donate_argnums=(n_kv_args,)),
         prefill_chunk=jax.jit(_prefill_chunk, donate_argnums=(4,)),
@@ -305,4 +337,4 @@ def build_compiled(model_config, engine_config, mesh) -> CompiledPrograms:
         ),
         inject=jax.jit(_inject, donate_argnums=(0,)),
         inject_q=jax.jit(_inject_q, donate_argnums=(0,)),
-    )
+    ))

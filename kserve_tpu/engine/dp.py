@@ -182,6 +182,14 @@ class DataParallelEngine:
     ) -> Tuple[int, np.ndarray]:
         return await self._pick().prefill_detached(prompt_ids, params, adapter=adapter)
 
+    def telemetry_snapshot(self) -> dict:
+        """Per-group timelines/percentiles keyed by the group's metrics
+        label (GET /admin/telemetry; the groups are independent engines,
+        so their latency windows must not be merged into one percentile)."""
+        return {
+            eng._mlabel: eng.telemetry_snapshot() for eng in self.replicas
+        }
+
     def cancel(self, request_id: str) -> None:
         for eng in self.replicas:
             eng.cancel(request_id)

@@ -42,10 +42,14 @@ from ..metrics import (
     ENGINE_KV_OFFLOAD_BYTES,
     ENGINE_KV_PAGES_FREE,
     ENGINE_PREEMPTIONS,
+    ENGINE_PREFILL_CHUNK_DURATION,
     ENGINE_QUEUE_DEPTH,
+    ENGINE_STEP_BATCH_COMPOSITION,
+    ENGINE_STEP_DURATION,
     ENGINE_WEDGED,
     GENERATED_TOKENS,
     PROMPT_TOKENS,
+    observe_request_timeline,
 )
 from ..metrics import (
     DEADLINE_REJECTED,
@@ -56,8 +60,15 @@ from ..metrics import (
 from ..lifecycle.checkpoint import GenerationCheckpoint, GenerationPreempted
 from ..lifecycle.state import ReplicaDrainingError
 from ..models import llama
+from ..observability import RequestTimeline, TimelineRecorder, emit_timeline_spans
 from ..parallel import sharding as shd
-from ..resilience import MONOTONIC, Deadline, DeadlineExceededError, current_deadline
+from ..resilience import (
+    MONOTONIC,
+    Clock,
+    Deadline,
+    DeadlineExceededError,
+    current_deadline,
+)
 from .kvcache import (
     KVCacheConfig,
     PageAllocator,
@@ -94,6 +105,7 @@ class LLMEngine:
         checkpoint_label: Optional[str] = None,  # weights identity for resume
         lora_adapters: Optional[Dict[str, str]] = None,
         lora_stacked=None,  # (adapter_ids, per-layer stacks) pre-loaded
+        clock: Optional[Clock] = None,  # telemetry clock (FakeClock in chaos tests)
     ):
         if engine_config.dp > 1:
             raise ValueError(
@@ -116,6 +128,13 @@ class LLMEngine:
                 f"vocab ({model_config.vocab_size}); ids past the embedding "
                 "table would silently clamp under jit")
         self._mlabel = metrics_label
+        # every lifecycle stamp goes through this injectable clock, so the
+        # FakeClock chaos suite asserts exact TTFT/ITL/queue-wait values
+        # (docs/observability.md); real time is the production default
+        self._clock = clock or MONOTONIC
+        # bounded ring of finished timelines + rolling percentile windows
+        # behind GET /admin/telemetry
+        self.telemetry = TimelineRecorder()
         # checkpoints carry this as model_name; resume_generation rejects a
         # mismatch.  Distinct from the metrics label so DP sub-engines
         # (engine-dp0, engine-dp1, ...) share one weights identity and a
@@ -468,8 +487,8 @@ class LLMEngine:
         for req in pending:
             self._discard_resume_kv(req)
             req.queue.put_nowait(make_exc(req))
-        if pending:
-            ENGINE_QUEUE_DEPTH.labels(model_name=self._mlabel).set(0)
+            self._record_terminal(req.timeline, "error")
+        self._set_queue_gauge()
 
     @property
     def running(self) -> bool:
@@ -518,6 +537,57 @@ class LLMEngine:
             self._kv_store.host_used)
         ENGINE_KV_DISK_BYTES.labels(model_name=self._mlabel).set(
             self._kv_store.disk_used)
+
+    def _set_queue_gauge(self) -> None:
+        """THE queue-depth gauge writer.  Every mutation of _waiting calls
+        this unconditionally — a conditional zeroing on one path (the r5
+        fail-all bug) left the gauge stale after stop/drain whenever the
+        queue happened to be empty at flush time."""
+        ENGINE_QUEUE_DEPTH.labels(model_name=self._mlabel).set(
+            len(self._waiting))
+
+    def _set_composition_gauge(self, n_decoding: int) -> None:
+        """Per-step batch composition: how the fixed decode slots split
+        between decoding lanes, long-prompt prefills, and free capacity."""
+        n_prefilling = sum(
+            1 for s in self._slots
+            if s.request_id is not None and s.prefilling is not None
+        )
+        g = ENGINE_STEP_BATCH_COMPOSITION
+        g.labels(model_name=self._mlabel, role="decoding").set(n_decoding)
+        g.labels(model_name=self._mlabel, role="prefilling").set(n_prefilling)
+        g.labels(model_name=self._mlabel, role="free").set(
+            self.config.max_batch_size - n_decoding - n_prefilling)
+
+    def telemetry_snapshot(self) -> dict:
+        """Rolling latency percentiles + recent request timelines (the
+        GET /admin/telemetry payload; observability/introspection.py)."""
+        snap = self.telemetry.snapshot()
+        snap["queue_depth"] = self.queue_depth
+        snap["prefix_cache_hits"] = self.prefix_cache_hits
+        snap["preemptions"] = self.preemption_count
+        return snap
+
+    def _record_terminal(self, tl: Optional[RequestTimeline],
+                         reason: Optional[str]) -> None:
+        """A timeline reached a terminal state: stamp it, feed the ring
+        buffer, export the Prometheus series (finished generations only),
+        and emit the engine child spans when a tracer is configured."""
+        if tl is None or tl.recorded:
+            return
+        tl.recorded = True
+        tl.mark_finished(self._clock.now(), reason)
+        self.telemetry.observe(tl)
+        if reason in ("stop", "length"):
+            observe_request_timeline(self._mlabel, tl)
+        from ..tracing import get_tracer
+
+        tracer = get_tracer()
+        if tracer is not None:
+            try:
+                emit_timeline_spans(tracer, tl)
+            except Exception:  # noqa: BLE001 — telemetry must never kill the loop
+                logger.exception("engine span emission failed")
 
     def _fetch_fault_check(self) -> None:
         """Shared fault seam for _fetch/_fetch_async — one copy, so a new
@@ -585,8 +655,20 @@ class LLMEngine:
             rid, list(prompt_ids), params, queue,
             adapter_id=self._resolve_adapter(adapter),
             deadline=deadline,
+            timeline=self._new_timeline(rid, len(prompt_ids)),
         )
         return self._submit_and_stream(req)
+
+    def _new_timeline(self, rid: str, n_prompt: int) -> RequestTimeline:
+        """Stamp `received` NOW (the sync part of submit) and capture the
+        caller's trace context so engine spans join the request's trace."""
+        from ..tracing import current_trace_context
+
+        tl = RequestTimeline(rid, model_name=self._mlabel,
+                             trace=current_trace_context())
+        tl.n_prompt_tokens = n_prompt
+        tl.mark_received(self._clock.now())
+        return tl
 
     def _check_accepting(self) -> None:
         """Admission gate for the lifecycle layer: a draining (or stopped)
@@ -666,6 +748,7 @@ class LLMEngine:
             kv_data=kv_data, first_token=int(first_token),
             adapter_id=self._resolve_adapter(adapter),
             deadline=deadline,
+            timeline=self._new_timeline(rid, len(prompt_ids)),
         )
         return self._submit_and_stream(req)
 
@@ -677,7 +760,7 @@ class LLMEngine:
         # drain() has returned)
         self._check_accepting()
         self._waiting.append(req)
-        ENGINE_QUEUE_DEPTH.labels(model_name=self._mlabel).set(len(self._waiting))
+        self._set_queue_gauge()
         self._wake.set()
         try:
             while True:
@@ -830,9 +913,16 @@ class LLMEngine:
                 kept.append(r)
             else:
                 self._discard_resume_kv(r)
+                self._record_terminal(r.timeline, "cancelled")
         self._waiting = kept
+        self._set_queue_gauge()
         for i, slot in enumerate(self._slots):
             if slot.request_id == request_id:
+                tl = slot.timeline
+                if tl is not None and tl.finished_at is None:
+                    # client went away mid-generation (stream closed):
+                    # terminal for telemetry even though nothing was sent
+                    self._record_terminal(tl, "cancelled")
                 self._free_pages(slot.pages)
                 slot.reset()
                 self._mark_penalty_dirty(i)
@@ -889,6 +979,12 @@ class LLMEngine:
         """Deliver exc to the slot's stream and release its resources
         (deferred-free-safe: legal while a chained chunk is in flight)."""
         slot.queue.put_nowait(exc)
+        if slot.timeline is not None:
+            if isinstance(exc, GenerationPreempted):
+                slot.timeline.add_event(self._clock.now(), "checkpoint")
+                self._record_terminal(slot.timeline, "preempted")
+            else:
+                self._record_terminal(slot.timeline, "error")
         self._free_pages(slot.pages)
         idx = self._slots.index(slot)
         slot.reset()
@@ -911,8 +1007,11 @@ class LLMEngine:
             )
             out.append(ckpt)
             req.queue.put_nowait(GenerationPreempted(ckpt))
-        if pending:
-            ENGINE_QUEUE_DEPTH.labels(model_name=self._mlabel).set(0)
+            if req.timeline is not None:
+                req.timeline.add_event(
+                    self._clock.now(), "checkpoint", reason=reason)
+                self._record_terminal(req.timeline, "preempted")
+        self._set_queue_gauge()
 
     async def drain(self, deadline: Optional[Deadline] = None,
                     clock=None, poll_s: float = 0.01) -> List[GenerationCheckpoint]:
@@ -1020,10 +1119,14 @@ class LLMEngine:
             rid = f"{checkpoint.request_id}~r{time.monotonic_ns()}"
         else:
             rid = f"req-{time.monotonic_ns()}"
+        tl = self._new_timeline(rid, len(prompt_ids))
+        tl.add_event(self._clock.now(), "resume",
+                     tokens_salvaged=len(generated))
         req = _QueuedRequest(
             rid, prompt_ids, params, queue,
             adapter_id=self._resolve_adapter(checkpoint.adapter),
             deadline=deadline,
+            timeline=tl,
         )
         if generated:
             # replay the detokenizer so continuation text deltas pick up
@@ -1064,7 +1167,7 @@ class LLMEngine:
                     if not self._admit_batch():
                         break
                     did_work = True
-                ENGINE_QUEUE_DEPTH.labels(model_name=self._mlabel).set(len(self._waiting))
+                self._set_queue_gauge()
                 if self._advance_prefills():
                     did_work = True
                 active = [
@@ -1075,6 +1178,7 @@ class LLMEngine:
                 ENGINE_KV_PAGES_FREE.labels(model_name=self._mlabel).set(
                     self.allocator.free_pages
                 )
+                self._set_composition_gauge(len(active))
                 if active:
                     await self._decode_once()
                     did_work = True
@@ -1089,15 +1193,19 @@ class LLMEngine:
             for slot in self._slots:
                 if slot.request_id is not None:
                     slot.queue.put_nowait(e)
+                    self._record_terminal(slot.timeline, "error")
                     slot.reset()
             for req in self._waiting:
                 req.queue.put_nowait(e)
+                self._record_terminal(req.timeline, "error")
             self._waiting.clear()
+            self._set_queue_gauge()
             # requests a crashed _admit_batch popped but never seated: fail
             # their streams and release the pages admission allocated
             for _, req, pages, _, _ in self._admitting:
                 self.allocator.free(pages)
                 req.queue.put_nowait(e)
+                self._record_terminal(req.timeline, "error")
             self._admitting = []
 
     def _drop_expired_waiting(self) -> None:
@@ -1114,8 +1222,10 @@ class LLMEngine:
             req.queue.put_nowait(DeadlineExceededError(
                 f"request {req.request_id} deadline expired while queued"
             ))
+            self._record_terminal(req.timeline, "error")
         if len(kept) != len(self._waiting):
             self._waiting = kept
+            self._set_queue_gauge()
 
     def _free_slot_index(self) -> Optional[int]:
         for i, slot in enumerate(self._slots):
@@ -1188,6 +1298,8 @@ class LLMEngine:
             # still in _waiting and the crash handler fails it there
             pages = list(hits) + self.allocator.allocate(need - len(hits))
             self._waiting.pop(0)
+            if req.timeline is not None:
+                req.timeline.mark_admitted(self._clock.now())
             self._prefix_cache.hits += len(hits)
             admitted.append((free.pop(0), req, pages, len(hits), seq))
         if not admitted:
@@ -1238,6 +1350,7 @@ class LLMEngine:
             for _, req, _, _, _ in admitted
         )
         lp_tuple = None
+        prefill_t0 = self._clock.now()
         if use_fused_call:
             prefill_fn = self._prefill_lp_fn if want_lp else self._prefill_fn
             out = prefill_fn(
@@ -1277,7 +1390,14 @@ class LLMEngine:
             tuple(self._fetch(a) for a in lp_tuple)
             if lp_tuple is not None else None
         )
+        prefill_t1 = self._clock.now()
+        ENGINE_PREFILL_CHUNK_DURATION.labels(model_name=self._mlabel).observe(
+            prefill_t1 - prefill_t0)
+        self.telemetry.record_prefill_chunk(prefill_t1 - prefill_t0)
         for j, (idx, req, pages, _, seq) in enumerate(admitted):
+            if req.timeline is not None:
+                req.timeline.mark_prefill_start(prefill_t0)
+                req.timeline.mark_prefill_end(prefill_t1)
             if req.resume is None:
                 # resume re-prefills are recompute overhead, not new prompt
                 # traffic — don't double-count them
@@ -1330,6 +1450,7 @@ class LLMEngine:
         slot.admitted_at = time.perf_counter()
         slot.adapter_id = req.adapter_id
         slot.deadline = req.deadline
+        slot.timeline = req.timeline
 
     @property
     def prefix_cache_hits(self) -> int:
@@ -1350,10 +1471,12 @@ class LLMEngine:
         need = pages_needed(total + 1, self.config.page_size)
         if need > self.config.max_pages_per_seq:
             self._waiting.remove(req)
+            self._set_queue_gauge()
             req.queue.put_nowait(ValueError(
                 f"prompt needs {need} pages > max_pages_per_seq "
                 f"{self.config.max_pages_per_seq}"
             ))
+            self._record_terminal(req.timeline, "error")
             return True
         if req.resume is not None:
             seq = req.prompt_ids + req.resume["generated"][:-1]
@@ -1381,6 +1504,9 @@ class LLMEngine:
         # the handler covers — owns the request)
         pages = cached + self.allocator.allocate(fresh_needed)
         self._waiting.remove(req)
+        self._set_queue_gauge()
+        if req.timeline is not None:
+            req.timeline.mark_admitted(self._clock.now())
         self._prefix_cache.hits += len(cached)
         # the slot enters "prefilling" state immediately and the run loop
         # advances ONE chunk per iteration — in-flight decode streams keep
@@ -1421,6 +1547,10 @@ class LLMEngine:
                 width = self.config.page_bucket(
                     pages_needed(done + n, self.config.page_size)
                 )
+                chunk_t0 = self._clock.now()
+                tl = pf["req"].timeline
+                if tl is not None:
+                    tl.mark_prefill_start(chunk_t0)
                 pf["logits"], self.kv_pages = self._prefill_chunk_fn(
                     self.params,
                     jnp.asarray(tokens),
@@ -1431,6 +1561,12 @@ class LLMEngine:
                     jnp.asarray(np.asarray([pf["req"].adapter_id], np.int32)),
                 )
                 pf["done"] = done + n
+                chunk_t1 = self._clock.now()
+                ENGINE_PREFILL_CHUNK_DURATION.labels(
+                    model_name=self._mlabel).observe(chunk_t1 - chunk_t0)
+                self.telemetry.record_prefill_chunk(chunk_t1 - chunk_t0)
+                if tl is not None:
+                    tl.mark_prefill_end(chunk_t1)
                 if pf["req"].adapter_id < 0 and pf["req"].resume is None:
                     # register only the pages COMPLETED by this chunk — a
                     # full re-register would re-hash the whole prefix per
@@ -1511,6 +1647,9 @@ class LLMEngine:
         slot.admitted_at = r["admitted_at"]
         slot.adapter_id = req.adapter_id
         slot.deadline = req.deadline
+        slot.timeline = req.timeline
+        if req.timeline is not None:
+            req.timeline.mark_admitted(self._clock.now())
 
     def _admit_injected(self, req: "_QueuedRequest") -> bool:
         """Admit a request whose KV already exists on host: either P/D
@@ -1549,6 +1688,10 @@ class LLMEngine:
         # it — same contract as the batched-prefill path
         pages = self.allocator.allocate(need)
         self._waiting.remove(req)
+        self._set_queue_gauge()
+        if req.timeline is not None:
+            req.timeline.mark_admitted(self._clock.now())
+            req.timeline.mark_prefill_start(self._clock.now())
         entry = (idx, req, pages, 0, None)
         self._admitting.append(entry)
         P = kv.shape[1]
@@ -1571,6 +1714,10 @@ class LLMEngine:
             self.kv_pages = self._inject_fn(
                 self.kv_pages, jnp.asarray(pad(kv)), jnp.asarray(ids)
             )
+        if req.timeline is not None:
+            # KV injection replaces prefill for this request (P/D transfer
+            # or tier-store resume): the scatter IS its prefill phase
+            req.timeline.mark_prefill_end(self._clock.now())
         slot = self._slots[idx]
         if req.resume is not None:
             self._seat_resumed(slot, req, pages)
@@ -1684,10 +1831,14 @@ class LLMEngine:
         and free its pages.  Nothing was emitted, so nothing is lost but
         the chunks already computed."""
         req = slot.prefilling["req"]
+        if req.timeline is not None:
+            req.timeline.add_event(self._clock.now(), "preempt",
+                                   phase="prefill")
         self._free_pages(slot.pages)
         self._mark_penalty_dirty(self._slots.index(slot))
         slot.reset()
         self._waiting.insert(0, req)
+        self._set_queue_gauge()
         self.preemption_count += 1
         ENGINE_PREEMPTIONS.labels(model_name=self._mlabel).inc()
         logger.info("preempted prefilling request %s", req.request_id)
@@ -1741,7 +1892,12 @@ class LLMEngine:
                 kv_key = slot.request_id
             self._set_offload_gauges()
         req = _QueuedRequest(slot.request_id, slot.prompt_ids, slot.params, slot.queue,
-                             adapter_id=slot.adapter_id, deadline=slot.deadline)
+                             adapter_id=slot.adapter_id, deadline=slot.deadline,
+                             timeline=slot.timeline)
+        if slot.timeline is not None:
+            slot.timeline.add_event(
+                self._clock.now(), "preempt", pos=pos,
+                spilled=kv_key is not None)
         req.resume = {
             "generated": slot.generated,
             "detok": slot.detok,
@@ -1756,6 +1912,7 @@ class LLMEngine:
         self._mark_penalty_dirty(self._slots.index(slot))
         slot.reset()
         self._waiting.insert(0, req)
+        self._set_queue_gauge()
         self.preemption_count += 1
         ENGINE_PREEMPTIONS.labels(model_name=self._mlabel).inc()
         logger.info(
@@ -1911,6 +2068,7 @@ class LLMEngine:
     def _dispatch_chunk(self, meta: dict, tokens_dev=None):
         """Launch one decode chunk (async); tokens_dev chains the previous
         chunk's device-resident last tokens, skipping a host round-trip."""
+        meta["_dispatched_at"] = self._clock.now()
         rng = jax.random.fold_in(self._base_rng, self._next_step())
         tokens = tokens_dev if tokens_dev is not None else jnp.asarray(meta["tokens"])
         args = (
@@ -1955,6 +2113,9 @@ class LLMEngine:
         else:
             chunk_np = await self._fetch_async(chunk)  # [steps, B]
             lp_np = None
+        step_s = self._clock.now() - meta["_dispatched_at"]
+        ENGINE_STEP_DURATION.labels(model_name=self._mlabel).observe(step_s)
+        self.telemetry.record_step(step_s)
         active = meta["active"]
         finished_any = False
         routed = 0  # tokens actually delivered — the speculative tail after
@@ -2045,6 +2206,8 @@ class LLMEngine:
               logprob: Optional[float] = None,
               top_logprobs: Optional[List[tuple]] = None):
         """Stream one token; apply stop conditions."""
+        if slot.timeline is not None:
+            slot.timeline.mark_token(self._clock.now())
         n_gen = len(slot.generated)
         params = slot.params
         finish_reason = None
@@ -2079,6 +2242,7 @@ class LLMEngine:
         )
         slot.queue.put_nowait(out)
         if finish_reason is not None:
+            self._record_terminal(slot.timeline, finish_reason)
             self._free_pages(slot.pages)
             slot.reset()
             self._mark_penalty_dirty(self._slots.index(slot))
@@ -2095,6 +2259,7 @@ class LLMEngine:
             cumulative_text=slot.detok.text,
         )
         slot.queue.put_nowait(out)
+        self._record_terminal(slot.timeline, reason)
         self._free_pages(slot.pages)
         slot.reset()
         self._mark_penalty_dirty(self._slots.index(slot))

@@ -237,7 +237,7 @@ class _Slot:
     __slots__ = (
         "request_id", "prompt_len", "prompt_ids", "pages", "pos", "generated",
         "params", "queue", "detok", "stop_texts", "admitted_at", "adapter_id",
-        "prefilling", "deadline",
+        "prefilling", "deadline", "timeline",
     )
 
     def __init__(self):
@@ -249,16 +249,20 @@ class _Slot:
         # the request's propagated resilience.Deadline (None = unbounded);
         # rides the slot so drain checkpoints carry the remaining budget
         self.deadline = None
+        # observability.RequestTimeline stamped by the loop (None only for
+        # an unseated slot) — survives preemption via _QueuedRequest
+        self.timeline = None
 
     def reset(self):
         self.request_id = None
         self.prefilling = None
+        self.timeline = None
 
 
 class _QueuedRequest:
     def __init__(self, request_id, prompt_ids, params, queue,
                  kv_data=None, first_token=None, adapter_id=-1,
-                 deadline=None):
+                 deadline=None, timeline=None):
         self.request_id = request_id
         self.prompt_ids = prompt_ids
         self.params = params
@@ -276,6 +280,10 @@ class _QueuedRequest:
         # admitted_at, kv (host np | None)} — with kv, admission re-injects
         # the spilled pages; without, it re-prefills prompt+generated[:-1]
         self.resume: Optional[dict] = None
+        # observability.RequestTimeline: stamped received at submit, rides
+        # the request across preemption/re-seat so TTFT/queue-wait measure
+        # the CLIENT's experience, not the latest seat's
+        self.timeline = timeline
 
     @property
     def kv_len(self) -> int:

@@ -27,8 +27,9 @@ from urllib.parse import urlsplit
 import httpx
 from aiohttp import web
 
-from ..logging import configure_logging, logger
+from ..logging import bind_log_context, configure_logging, logger
 from ..metrics import record_breaker_transition
+from ..tracing import TraceContext, propagate_headers, trace_scope
 from ..resilience import (
     DEADLINE_HEADER,
     MONOTONIC,
@@ -150,6 +151,9 @@ class GraphRouter:
                 send_headers = dict(headers)
                 if deadline is not None:
                     send_headers[DEADLINE_HEADER] = deadline.to_header()
+                # same propagation path as the EPP proxy / REST client:
+                # each step call is a child hop of the graph request's trace
+                propagate_headers(send_headers)
                 response = await self._client.post(
                     url, json=payload, headers=send_headers
                 )
@@ -309,11 +313,20 @@ class GraphRouter:
         deadline = Deadline.from_header(
             request.headers.get(DEADLINE_HEADER), clock=self.clock
         )
-        try:
-            result = await self.execute_node("root", payload, headers, deadline)
-        except GraphExecutionError as e:
-            return web.json_response({"error": str(e)}, status=e.status)
-        return web.json_response(result)
+        # the graph request's trace context: child of the caller's
+        # traceparent, or a fresh root when the router is the first hop —
+        # every step call below derives its own child from this scope
+        ctx = TraceContext.derive(TraceContext.from_headers(request.headers))
+        with trace_scope(ctx), bind_log_context(
+            request_id=request.headers.get("x-request-id", "-"),
+            trace_id=ctx.trace_id,
+        ):
+            try:
+                result = await self.execute_node(
+                    "root", payload, headers, deadline)
+            except GraphExecutionError as e:
+                return web.json_response({"error": str(e)}, status=e.status)
+            return web.json_response(result)
 
     def create_application(self) -> web.Application:
         app = web.Application()

@@ -30,6 +30,7 @@ from .resilience import (
     current_deadline,
     parse_retry_after,
 )
+from .tracing import TRACEPARENT_HEADER, current_trace_context, propagate_headers
 
 
 @dataclass
@@ -84,6 +85,10 @@ class InferenceRESTClient:
         per attempt."""
         started = self._clock.now()
         attempt = 0
+        # one trace across every retry: each attempt gets a fresh child
+        # span id under the SAME parent (the bound request context, or a
+        # root minted once here when this client is the first hop)
+        trace_parent = current_trace_context()
         while True:
             deadline = current_deadline()
             if deadline is not None and deadline.expired:
@@ -98,6 +103,9 @@ class InferenceRESTClient:
                 send_headers = dict(headers or {})
                 if deadline is not None:
                     send_headers.setdefault(DEADLINE_HEADER, deadline.to_header())
+                if TRACEPARENT_HEADER not in send_headers:
+                    trace_parent = propagate_headers(
+                        send_headers, parent=trace_parent)
                 response = await self._client.post(
                     url, content=content, json=json_body,
                     headers=send_headers, timeout=timeout,

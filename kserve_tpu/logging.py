@@ -1,25 +1,77 @@
 """Structured logging for the data plane.
 
 Parity: reference python/kserve/kserve/logging.py (dictConfig with a server
-logger and a trace logger for per-request latency lines).
+logger and a trace logger for per-request latency lines); extended with
+request_id / trace_id correlation: the REST server binds both into
+contextvars per request (`bind_log_context`), and a logging.Filter stamps
+them onto every record so one `grep rid=...` collects a request's full
+story across middleware, engine, and drain logs.
 """
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import logging
 import logging.config
 import sys
+from typing import Iterator
 
 KSERVE_TPU_LOGGER_NAME = "kserve_tpu"
 KSERVE_TPU_TRACE_LOGGER_NAME = "kserve_tpu.trace"
 KSERVE_TPU_LOGGER_FORMAT = (
-    "%(asctime)s.%(msecs)03d %(process)s %(name)s %(levelname)s [%(funcName)s():%(lineno)s] %(message)s"
+    "%(asctime)s.%(msecs)03d %(process)s %(name)s %(levelname)s "
+    "rid=%(request_id)s tid=%(trace_id)s "
+    "[%(funcName)s():%(lineno)s] %(message)s"
 )
-KSERVE_TPU_TRACE_LOGGER_FORMAT = "%(asctime)s.%(msecs)03d %(name)s %(message)s"
+KSERVE_TPU_TRACE_LOGGER_FORMAT = (
+    "%(asctime)s.%(msecs)03d %(name)s rid=%(request_id)s tid=%(trace_id)s "
+    "%(message)s"
+)
 KSERVE_TPU_LOG_DATE_FORMAT = "%Y-%m-%d %H:%M:%S"
 
 logger = logging.getLogger(KSERVE_TPU_LOGGER_NAME)
 trace_logger = logging.getLogger(KSERVE_TPU_TRACE_LOGGER_NAME)
+
+# request correlation (observability layer): "-" placeholders keep log
+# lines greppable and the formatter happy outside any request scope
+_request_id_var: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "kserve_tpu_log_request_id", default="-"
+)
+_trace_id_var: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "kserve_tpu_log_trace_id", default="-"
+)
+
+
+def current_request_id() -> str:
+    return _request_id_var.get()
+
+
+def current_log_trace_id() -> str:
+    return _trace_id_var.get()
+
+
+@contextlib.contextmanager
+def bind_log_context(request_id: str = "-", trace_id: str = "-") -> Iterator[None]:
+    """Bind request_id/trace_id for every log record emitted inside."""
+    t1 = _request_id_var.set(request_id)
+    t2 = _trace_id_var.set(trace_id)
+    try:
+        yield
+    finally:
+        _trace_id_var.reset(t2)
+        _request_id_var.reset(t1)
+
+
+class RequestContextFilter(logging.Filter):
+    """Stamps the bound request_id/trace_id onto every record (filters run
+    for all records, unlike formatter defaults, so third-party records
+    passing through our handlers format cleanly too)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.request_id = getattr(record, "request_id", None) or _request_id_var.get()
+        record.trace_id = getattr(record, "trace_id", None) or _trace_id_var.get()
+        return True
 
 KSERVE_TPU_LOG_CONFIG = {
     "version": 1,
@@ -36,16 +88,23 @@ KSERVE_TPU_LOG_CONFIG = {
             "datefmt": KSERVE_TPU_LOG_DATE_FORMAT,
         },
     },
+    "filters": {
+        "request_context": {
+            "()": "kserve_tpu.logging.RequestContextFilter",
+        },
+    },
     "handlers": {
         "kserve_tpu": {
             "formatter": "kserve_tpu",
             "class": "logging.StreamHandler",
             "stream": "ext://sys.stderr",
+            "filters": ["request_context"],
         },
         "kserve_tpu_trace": {
             "formatter": "kserve_tpu_trace",
             "class": "logging.StreamHandler",
             "stream": "ext://sys.stderr",
+            "filters": ["request_context"],
         },
     },
     "loggers": {

@@ -106,6 +106,86 @@ TOKENS_SALVAGED = Counter(
     ["model_name"],
 )
 
+# Request-lifecycle telemetry (kserve_tpu/observability — the serving
+# metrics that matter per the vLLM/TGI comparative study, arXiv:2511.17593).
+# Sub-millisecond buckets on ITL because decode steps on-chip are ~1-10ms;
+# TTFT/e2e reach minutes because long-prompt prefill + queueing legitimately
+# do.  All observations come from the engine's injectable Clock.
+_TTFT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, float("inf"),
+)
+_ITL_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+    0.5, 1.0, 2.5, float("inf"),
+)
+REQUEST_TTFT = Histogram(
+    "request_ttft_seconds",
+    "time to first token: request received by the engine -> first token "
+    "emitted (queue wait included — the client experiences it)",
+    ["model_name"], buckets=_TTFT_BUCKETS,
+)
+REQUEST_ITL = Histogram(
+    "request_inter_token_seconds",
+    "inter-token latency: gap between consecutive emitted tokens",
+    ["model_name"], buckets=_ITL_BUCKETS,
+)
+REQUEST_QUEUE_WAIT = Histogram(
+    "request_queue_wait_seconds",
+    "received -> admitted into a decode slot (first admission)",
+    ["model_name"], buckets=_TTFT_BUCKETS,
+)
+REQUEST_E2E = Histogram(
+    "request_e2e_seconds",
+    "received -> finished (full generation wall time)",
+    ["model_name"], buckets=_TTFT_BUCKETS,
+)
+ENGINE_STEP_DURATION = Histogram(
+    "engine_decode_step_seconds",
+    "wall time of one decode step: a steps_per_sync-token chunk dispatched "
+    "and its tokens fetched",
+    ["model_name"], buckets=_ITL_BUCKETS,
+)
+ENGINE_PREFILL_CHUNK_DURATION = Histogram(
+    "engine_prefill_chunk_seconds",
+    "wall time of one compiled prefill call (batched admission or one "
+    "long-prompt chunk)",
+    ["model_name"], buckets=_TTFT_BUCKETS,
+)
+# `program` is the fixed compiled-program name set (engine/compiled.py),
+# bounded by construction — NOT a shape signature (unbounded under bucket
+# drift) nor a request attribute
+XLA_COMPILES = Counter(
+    "engine_xla_compiles_total",
+    "XLA compilations observed (jit cache misses incl. retraces), by "
+    "compiled engine program",
+    ["program"],
+)
+# `role` is a closed enum (decoding/prefilling/free): batch composition per
+# engine step without per-request labels
+ENGINE_STEP_BATCH_COMPOSITION = Gauge(
+    "engine_step_batch_composition",
+    "decode-batch slots by role at the latest engine step "
+    "(decoding | prefilling | free)",
+    ["model_name", "role"],
+)
+
+
+def observe_request_timeline(model_name: str, timeline) -> None:
+    """Export one finished RequestTimeline to the Prometheus histograms
+    (observability/timeline.py keeps the ring-buffer/percentile view)."""
+    if timeline.queue_wait_s is not None:
+        REQUEST_QUEUE_WAIT.labels(model_name=model_name).observe(
+            timeline.queue_wait_s)
+    if timeline.ttft_s is not None:
+        REQUEST_TTFT.labels(model_name=model_name).observe(timeline.ttft_s)
+    if timeline.e2e_s is not None:
+        REQUEST_E2E.labels(model_name=model_name).observe(timeline.e2e_s)
+    itl = REQUEST_ITL.labels(model_name=model_name)
+    for gap in timeline.itls:
+        itl.observe(gap)
+
+
 _LIFECYCLE_STATES = ("STARTING", "READY", "DRAINING", "TERMINATING")
 
 

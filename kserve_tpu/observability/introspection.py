@@ -1,0 +1,154 @@
+"""Admin introspection endpoints: rolling telemetry + on-demand profiling.
+
+- ``GET  /admin/telemetry``  — per-model rolling TTFT/ITL/step-time
+  percentiles plus recent request timelines, straight from each engine's
+  bounded TimelineRecorder (no Prometheus scrape required mid-incident).
+- ``POST /admin/profile``    — capture a ``jax.profiler`` trace for N
+  seconds into a configurable directory; 409 while a capture is already
+  running (the profiler is a process-global singleton in JAX).
+
+Both ride the always-open admin surface (resilience.is_inference_path is
+False for /admin, so shedding/lifecycle gates never block an operator
+mid-drain or mid-overload).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from ..logging import logger
+from ..resilience import MONOTONIC, Clock
+
+PROFILE_DIR_ENV = "KSERVE_TPU_PROFILE_DIR"
+# typed app-config key (aiohttp 3.9 idiom): tests/operators reach the
+# running ProfilerSession via app[PROFILER_KEY]
+PROFILER_KEY: "web.AppKey[ProfilerSession]" = web.AppKey(
+    "observability_profiler", object
+)
+DEFAULT_PROFILE_DIR = "/tmp/kserve-tpu-profiles"
+MAX_PROFILE_SECONDS = 300.0
+
+
+class ProfilerBusyError(RuntimeError):
+    """A capture is already in flight (maps to HTTP 409)."""
+
+
+class ProfilerSession:
+    """One-at-a-time jax.profiler capture.  The clock is injectable so
+    tests drive the capture window without real sleeps; start/stop always
+    run in this process's event loop (jax.profiler is process-global)."""
+
+    def __init__(self, clock: Optional[Clock] = None,
+                 default_dir: Optional[str] = None):
+        self._clock = clock or MONOTONIC
+        self._default_dir = (
+            default_dir
+            or os.environ.get(PROFILE_DIR_ENV, DEFAULT_PROFILE_DIR)
+        )
+        self._task: Optional[asyncio.Task] = None
+        self._current: Optional[dict] = None
+
+    @property
+    def active(self) -> bool:
+        return self._task is not None and not self._task.done()
+
+    def status(self) -> dict:
+        return {"active": self.active, "capture": self._current}
+
+    async def start(self, seconds: float, out_dir: Optional[str] = None) -> dict:
+        if not (0 < seconds <= MAX_PROFILE_SECONDS):
+            raise ValueError(
+                f"profile seconds must be in (0, {MAX_PROFILE_SECONDS:g}]"
+            )
+        if self.active:
+            raise ProfilerBusyError(
+                f"profile capture already running: {self._current}"
+            )
+        target = os.path.join(
+            out_dir or self._default_dir,
+            time.strftime("trace-%Y%m%d-%H%M%S", time.gmtime()),
+        )
+        os.makedirs(target, exist_ok=True)
+        import jax.profiler
+
+        jax.profiler.start_trace(target)
+        self._current = {"dir": target, "seconds": seconds}
+        self._task = asyncio.get_running_loop().create_task(
+            self._finish(seconds)
+        )
+        logger.info("profiler capture started: %s (%.3gs)", target, seconds)
+        return dict(self._current)
+
+    async def _finish(self, seconds: float) -> None:
+        import jax.profiler
+
+        try:
+            await self._clock.sleep(seconds)
+        finally:
+            try:
+                jax.profiler.stop_trace()
+            except RuntimeError as exc:
+                # double-stop / device-side teardown race: the capture is
+                # over either way, only the artifact may be partial
+                logger.warning("profiler stop_trace failed: %s", exc)
+            logger.info("profiler capture finished")
+
+    async def wait(self) -> None:
+        """Test/shutdown helper: block until the running capture ends."""
+        if self._task is not None:
+            await self._task
+
+
+def register_observability_routes(
+    app: web.Application,
+    model_registry,
+    profiler: Optional[ProfilerSession] = None,
+) -> None:
+    profiler = profiler or ProfilerSession()
+    app[PROFILER_KEY] = profiler
+
+    async def telemetry_handler(request: web.Request) -> web.Response:
+        models = {}
+        for name, model in model_registry.get_models().items():
+            engine = getattr(model, "engine", None)
+            snap = getattr(engine, "telemetry_snapshot", None)
+            if callable(snap):
+                models[name] = snap()
+        return web.json_response({
+            "models": models,
+            "profiler": profiler.status(),
+        })
+
+    async def profile_handler(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except ValueError:
+            body = {}
+        if not isinstance(body, dict):
+            body = {}
+        try:
+            seconds = float(body.get("seconds", 2.0))
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": "seconds must be a number"}, status=400
+            )
+        out_dir = body.get("dir")
+        try:
+            info = await profiler.start(seconds, out_dir=out_dir)
+        except ProfilerBusyError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        except (ImportError, RuntimeError, OSError) as e:
+            # no profiler in this build / unwritable dir: the endpoint is
+            # best-effort tooling, not a serving dependency
+            return web.json_response({"error": str(e)}, status=501)
+        return web.json_response(info, status=202)
+
+    app.router.add_get("/admin/telemetry", telemetry_handler)
+    app.router.add_post("/admin/profile", profile_handler)

@@ -1,0 +1,81 @@
+"""Engine-internal child spans, emitted from a finished RequestTimeline.
+
+The engine loop runs detached from any request's asyncio context, so the
+usual start_as_current_span nesting cannot reach it.  Instead every
+timeline carries the TraceContext bound when the request entered
+(tracing.request_context_middleware), and when a generation reaches a
+terminal state the engine emits retrospective queue/prefill/decode spans
+tagged with that trace's ids — so the EPP-proxy span, the replica's
+request span, and these engine internals line up as one trace in any
+backend that groups by trace_id, and in the recording tracers the tests
+use.
+
+Span events carry the timeline's lifecycle events (preemptions,
+checkpoints, resumes); breaker trips ride `tracing.add_span_event` at the
+hop that observed them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .timeline import RequestTimeline
+
+_PHASES = (
+    # (span name, start attr, end attr)
+    ("engine.queue", "received", "admitted"),
+    ("engine.prefill", "prefill_start", "prefill_end"),
+    ("engine.decode", "first_token_at", "finished_at"),
+)
+
+
+def _end(span) -> None:
+    if hasattr(span, "end"):
+        span.end()
+
+
+def _start_span(tracer, name: str, attributes: dict):
+    """tracer.start_span across API generations; contextmanager-only fakes
+    fall back to entering start_as_current_span and ending it inline."""
+    if hasattr(tracer, "start_span"):
+        return tracer.start_span(name, attributes=attributes), None
+    cm = tracer.start_as_current_span(name, attributes=attributes)
+    return cm.__enter__(), cm
+
+
+def emit_timeline_spans(tracer, tl: Optional[RequestTimeline]) -> None:
+    """Emit the engine-internal span tree for one finished timeline.  A
+    None tracer or a timeline with no stamps is a no-op; failures here
+    must never surface into the engine loop (the caller wraps)."""
+    if tracer is None or tl is None:
+        return
+    base = {
+        "kserve.request_id": tl.request_id,
+        "kserve.model": tl.model_name,
+    }
+    if tl.trace is not None:
+        base["trace_id"] = tl.trace.trace_id
+        base["parent_span_id"] = tl.trace.span_id
+    for name, start_attr, end_attr in _PHASES:
+        t0 = getattr(tl, start_attr)
+        t1 = getattr(tl, end_attr)
+        if t0 is None or t1 is None:
+            continue
+        attrs = dict(base)
+        attrs["start_s"] = t0
+        attrs["duration_s"] = t1 - t0
+        if name == "engine.decode":
+            attrs["tokens"] = tl.n_generated
+            if tl.finish_reason:
+                attrs["finish_reason"] = tl.finish_reason
+        span, cm = _start_span(tracer, name, attrs)
+        try:
+            if name == "engine.decode" and hasattr(span, "add_event"):
+                for ev in tl.events:
+                    detail = {k: v for k, v in ev.items() if k != "name"}
+                    span.add_event(ev["name"], attributes=detail)
+        finally:
+            if cm is not None:
+                cm.__exit__(None, None, None)
+            else:
+                _end(span)

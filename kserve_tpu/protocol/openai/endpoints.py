@@ -18,7 +18,7 @@ from pydantic import ValidationError
 
 from ...errors import InvalidInput, ModelNotFound, ModelNotReady
 from ...lifecycle import GenerationPreempted, ReplicaDrainingError
-from ...logging import logger
+from ...logging import current_request_id, logger
 from .dataplane import OpenAIDataPlane
 from .types import (
     ChatCompletionRequest,
@@ -47,14 +47,18 @@ async def _final_event(response: web.StreamResponse, payload: dict) -> None:
 
 
 async def _stream_sse(request: web.Request, iterator: AsyncIterator) -> web.StreamResponse:
-    response = web.StreamResponse(
-        status=200,
-        headers={
-            "Content-Type": "text/event-stream",
-            "Cache-Control": "no-cache",
-            "Connection": "keep-alive",
-        },
-    )
+    headers = {
+        "Content-Type": "text/event-stream",
+        "Cache-Control": "no-cache",
+        "Connection": "keep-alive",
+    }
+    # streamed responses prepare their headers here, before the context
+    # middleware could stamp them — echo the correlation id ourselves so a
+    # client can quote it when reporting a bad stream
+    rid = current_request_id()
+    if rid and rid != "-":
+        headers["x-request-id"] = rid
+    response = web.StreamResponse(status=200, headers=headers)
     await response.prepare(request)
     try:
         async for chunk in iterator:

@@ -162,6 +162,7 @@ class RESTServer:
         shed_config: Optional[ShedConfig] = None,  # None = env defaults
         lifecycle: Optional[ReplicaLifecycle] = None,
         on_drain=None,  # async callable kicked by POST /admin/drain
+        profiler=None,  # observability.ProfilerSession (None = default)
     ):
         self.dataplane = dataplane
         # replica lifecycle (kserve_tpu/lifecycle): drives the admission
@@ -183,12 +184,23 @@ class RESTServer:
         # by default, stale processes silently share (and steal from) the port
         self.reuse_port = reuse_port
         self.ssl_context = ssl_context
+        # POST /admin/profile session (observability/introspection.py);
+        # injectable so tests drive the capture window with a FakeClock
+        self.profiler = profiler
         self._runner: Optional[web.AppRunner] = None
 
     def create_application(self) -> web.Application:
-        from ...tracing import get_tracer, tracing_middleware
+        from ...tracing import (
+            get_tracer,
+            request_context_middleware,
+            tracing_middleware,
+        )
 
-        middlewares = []
+        # request context is OUTERMOST and unconditional: every request
+        # gets a bound TraceContext (child of the caller's traceparent, or
+        # a fresh root) + request id, so engine timelines and log lines
+        # correlate even with no tracer installed
+        middlewares = [request_context_middleware]
         # tracing wraps OUTSIDE error mapping so spans observe the final
         # mapped status (a 404 must be a clean span, not an exception span)
         if get_tracer() is not None:
@@ -229,6 +241,13 @@ class RESTServer:
         )
         if self.lifecycle is not None:
             register_admin_routes(app, self.lifecycle, on_drain=self.on_drain)
+        # observability introspection (docs/observability.md): rolling
+        # TTFT/ITL/step percentiles + on-demand jax.profiler capture
+        from ...observability import register_observability_routes
+
+        register_observability_routes(
+            app, self.dataplane.model_registry, profiler=self.profiler
+        )
         return app
 
     def _total_queue_depth(self) -> int:

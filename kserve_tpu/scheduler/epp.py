@@ -32,7 +32,13 @@ from typing import Optional
 from aiohttp import web
 
 from ..lifecycle import CHECKPOINT_FIELD_SIZE_LIMIT
-from ..logging import logger
+from ..logging import bind_log_context, logger
+from ..tracing import (
+    TraceContext,
+    get_tracer,
+    mark_span_error,
+    propagate_headers,
+)
 from .latency import estimate_prompt_len
 from .picker import EndpointPicker
 
@@ -153,6 +159,49 @@ class EPPServer:
             k: v for k, v in request.headers.items()
             if k.lower() not in HOP_HEADERS
         }
+        # cross-hop tracing: the outgoing traceparent is a child of the
+        # caller's (or a fresh root when the EPP is the trace's first hop),
+        # so EPP proxy -> replica -> engine spans form ONE linked trace.
+        # Same single propagation path the REST client and graph router use.
+        span_ctx = propagate_headers(
+            headers, parent=TraceContext.from_headers(request.headers)
+        )
+        tracer = get_tracer()
+        span_cm = span = None
+        if tracer is not None:
+            span_cm = tracer.start_as_current_span(
+                "epp.proxy",
+                attributes={
+                    "http.method": request.method,
+                    "http.target": request.path,
+                    "trace_id": span_ctx.trace_id,
+                    "span_id": span_ctx.span_id,
+                    "kserve.backend": replica.url,
+                },
+            )
+            span = span_cm.__enter__()
+        try:
+            with bind_log_context(
+                request_id=request.headers.get("x-request-id", "-"),
+                trace_id=span_ctx.trace_id,
+            ):
+                return await self._forward(
+                    request, replica, headers, body, ids, text
+                )
+        except Exception as exc:
+            # same contract as the replica's tracing middleware: an
+            # exception escaping the hop must not leave a clean-looking span
+            if span is not None:
+                mark_span_error(span, exc)
+            raise
+        finally:
+            if span_cm is not None:
+                span_cm.__exit__(None, None, None)
+
+    async def _forward(self, request: web.Request, replica, headers: dict,
+                       body: bytes, ids, text) -> web.StreamResponse:
+        import aiohttp
+
         url = replica.url + request.rel_url.path_qs
         out = None
         # latency observation inputs, captured at PICK time (the depth the
@@ -264,6 +313,13 @@ def build_picker(args) -> EndpointPicker:
         latency_weight = 4.0
     from ..metrics import record_breaker_transition
     from ..resilience import BreakerRegistry
+    from ..tracing import add_span_event
+
+    def on_transition(backend: str, state: str) -> None:
+        record_breaker_transition(backend, state)
+        # span event, not a label: backend identity is unbounded-cardinality
+        # for Prometheus but exactly right on the trace that observed it
+        add_span_event("breaker.transition", state=state, backend=backend)
 
     return EndpointPicker(
         replica_urls=[u for u in args.replicas.split(",") if u],
@@ -272,7 +328,7 @@ def build_picker(args) -> EndpointPicker:
         prefix_weight=4.0 if "prefix-cache" in strategies else 0.0,
         latency_predictor=predictor,
         latency_weight=latency_weight,
-        breakers=BreakerRegistry(on_transition=record_breaker_transition),
+        breakers=BreakerRegistry(on_transition=on_transition),
     )
 
 

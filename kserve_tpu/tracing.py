@@ -1,27 +1,138 @@
-"""OpenTelemetry tracing for the data plane.
+"""OpenTelemetry tracing + W3C trace-context propagation for the data plane.
 
 Parity: the reference's LLMISVC tracing (llmisvc/tracing.go:34-120 injects
 OTEL_* env + --tracing into containers; vLLM then emits spans).  Here the
 serving process itself emits spans: an aiohttp middleware opens one span per
 request, annotated with model name / route / status.
 
-The image ships only the OTel API package; spans are no-ops unless an SDK is
-installed in the serving image and OTEL_EXPORTER_OTLP_ENDPOINT is set (which
-the LLMISVC reconciler does when `tracing.enabled`).  `set_tracer_for_tests`
-lets tests inject a recording tracer without the SDK.
+Two layers, deliberately separable:
+
+- **Propagation** (always on, dependency-free): `TraceContext` implements
+  the W3C `traceparent` header (00-<trace_id>-<span_id>-<flags>).  The
+  REST server binds the incoming context into a contextvar per request
+  (`request_context_middleware`), and every outbound hop — EPP proxy,
+  `InferenceRESTClient` retries, graph-router steps — derives its child
+  header through the single `propagate_headers()` code path, so a
+  multi-hop request stays one trace even when no tracer SDK is installed.
+
+- **Spans** (opt-in): the image ships only the OTel API package; spans are
+  no-ops unless an SDK is installed in the serving image and
+  OTEL_EXPORTER_OTLP_ENDPOINT is set (which the LLMISVC reconciler does
+  when `tracing.enabled`).  `set_tracer_for_tests` lets tests inject a
+  recording tracer without the SDK.
 """
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import os
-from typing import Optional
+from dataclasses import dataclass
+from typing import Iterator, MutableMapping, Optional
 
 from aiohttp import web
 
-from .logging import logger
+from .logging import bind_log_context, logger
 
 _tracer = None
 _configured = False
+
+TRACEPARENT_HEADER = "traceparent"
+
+
+# ---------------------------------------------------------------- W3C context
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """One W3C trace-context hop: 32-hex trace id, 16-hex span id."""
+
+    trace_id: str
+    span_id: str
+    flags: str = "01"
+
+    @staticmethod
+    def new_root() -> "TraceContext":
+        return TraceContext(
+            trace_id=os.urandom(16).hex(), span_id=os.urandom(8).hex()
+        )
+
+    def child(self) -> "TraceContext":
+        """Same trace, fresh span id — the outbound-hop derivation."""
+        return TraceContext(
+            trace_id=self.trace_id, span_id=os.urandom(8).hex(),
+            flags=self.flags,
+        )
+
+    def to_header(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-{self.flags}"
+
+    @staticmethod
+    def parse(header: Optional[str]) -> Optional["TraceContext"]:
+        """Strict-enough W3C parse; malformed headers yield None (the hop
+        then starts a fresh trace rather than 500ing the request)."""
+        if not header:
+            return None
+        parts = header.strip().split("-")
+        if len(parts) < 4:
+            return None
+        version, trace_id, span_id, flags = parts[0], parts[1], parts[2], parts[3]
+        if len(version) != 2 or len(trace_id) != 32 or len(span_id) != 16:
+            return None
+        try:
+            int(trace_id, 16), int(span_id, 16)
+        except ValueError:
+            return None
+        if trace_id == "0" * 32 or span_id == "0" * 16:
+            return None
+        return TraceContext(trace_id=trace_id, span_id=span_id,
+                            flags=flags[:2] or "01")
+
+    @staticmethod
+    def from_headers(headers) -> Optional["TraceContext"]:
+        return TraceContext.parse(headers.get(TRACEPARENT_HEADER))
+
+    @staticmethod
+    def derive(parent: Optional["TraceContext"]) -> "TraceContext":
+        """THE adopt-or-root derivation every hop uses: a child of
+        `parent` when one exists, a fresh root when this process is the
+        trace's first hop."""
+        return parent.child() if parent is not None else TraceContext.new_root()
+
+
+_current_trace: contextvars.ContextVar[Optional[TraceContext]] = (
+    contextvars.ContextVar("kserve_tpu_trace", default=None)
+)
+
+
+def current_trace_context() -> Optional[TraceContext]:
+    return _current_trace.get()
+
+
+@contextlib.contextmanager
+def trace_scope(ctx: Optional[TraceContext]) -> Iterator[Optional[TraceContext]]:
+    token = _current_trace.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _current_trace.reset(token)
+
+
+def propagate_headers(
+    headers: MutableMapping[str, str],
+    parent: Optional[TraceContext] = None,
+) -> TraceContext:
+    """THE outbound header-propagation path (EPP proxy, REST client
+    retries, graph-router steps): write a `traceparent` that is a child of
+    `parent` (or of the bound context), starting a fresh root when this
+    process is the first hop.  Returns the context written so callers can
+    tag their own span with the same ids."""
+    ctx = TraceContext.derive(parent or current_trace_context())
+    headers[TRACEPARENT_HEADER] = ctx.to_header()
+    return ctx
+
+
+# ---------------------------------------------------------------- tracer
 
 
 def setup_tracing(service_name: str = "kserve-tpu") -> None:
@@ -76,6 +187,57 @@ def get_tracer():
     return _tracer
 
 
+def mark_span_error(span, exc: BaseException) -> None:
+    """Record an exception on a span and flip it to ERROR status, across
+    tracer API generations (recording fakes, OTel API, OTel SDK)."""
+    if hasattr(span, "record_exception"):
+        span.record_exception(exc)
+    try:
+        from opentelemetry.trace import Status, StatusCode
+
+        status = Status(StatusCode.ERROR, str(exc))
+    except ImportError:
+        status = "ERROR"
+    if hasattr(span, "set_status"):
+        span.set_status(status)
+    else:
+        span.set_attribute("error", True)
+
+
+def add_span_event(name: str, **attributes) -> None:
+    """Attach an event to the current OTel span, if any (breaker trips,
+    shed decisions).  No-op without the OTel API or an active span."""
+    try:
+        from opentelemetry import trace
+    except ImportError:
+        return
+    span = trace.get_current_span()
+    if span is not None and getattr(span, "is_recording", lambda: False)():
+        span.add_event(name, attributes=attributes)
+
+
+# ---------------------------------------------------------------- middleware
+
+
+@web.middleware
+async def request_context_middleware(request: web.Request, handler):
+    """Always-on (tracer or not): parse the incoming `traceparent`, bind
+    this request's TraceContext (child of the caller's, or a fresh root)
+    and the request id into contextvars so engine timelines and every log
+    line correlate.  Runs OUTSIDE every other middleware."""
+    ctx = TraceContext.derive(TraceContext.from_headers(request.headers))
+    request_id = request.headers.get("x-request-id") or f"req-{os.urandom(6).hex()}"
+    with trace_scope(ctx), bind_log_context(request_id=request_id,
+                                            trace_id=ctx.trace_id):
+        response = await handler(request)
+        if "x-request-id" not in response.headers:
+            try:
+                response.headers["x-request-id"] = request_id
+            except RuntimeError:
+                pass  # streamed response: headers already on the wire
+        return response
+
+
 @web.middleware
 async def tracing_middleware(request: web.Request, handler):
     tracer = get_tracer()
@@ -87,14 +249,34 @@ async def tracing_middleware(request: web.Request, handler):
         route = request.match_info.route.resource.canonical
     except AttributeError:
         route = request.path
+    ctx = current_trace_context()
+    attributes = {
+        "http.method": request.method,
+        "http.target": request.path,
+    }
+    if ctx is not None:
+        attributes["trace_id"] = ctx.trace_id
+        attributes["span_id"] = ctx.span_id
     with tracer.start_as_current_span(
-        f"{request.method} {route}",
-        attributes={
-            "http.method": request.method,
-            "http.target": request.path,
-        },
+        f"{request.method} {route}", attributes=attributes,
     ) as span:
-        response = await handler(request)
+        try:
+            response = await handler(request)
+        except web.HTTPException as http_exc:
+            # aiohttp routing control flow (404/405/413): a clean span with
+            # the FINAL status — routine client errors must not read as
+            # error spans in the backend
+            try:
+                span.set_attribute("http.status_code", http_exc.status)
+            except (AttributeError, TypeError, ValueError):  # pragma: no cover
+                pass
+            raise
+        except Exception as exc:
+            # an exception escaping the handler must not escape the span
+            # unannotated: record it, flip the span to ERROR, re-raise for
+            # whatever sits outside (aiohttp's 500 path)
+            mark_span_error(span, exc)
+            raise
         try:
             span.set_attribute("http.status_code", response.status)
             model = request.match_info.get("model_name")

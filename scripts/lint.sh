@@ -19,4 +19,10 @@ fi
 echo "== jaxlint ${paths[*]}"
 python -m kserve_tpu.analysis "${paths[@]}" || rc=1
 
+# metric-cardinality gate: no Prometheus metric in kserve_tpu/ may declare
+# an unbounded label (backend ip:port, request id, ...) — the policy
+# documented in metrics.py, enforced (docs/observability.md)
+echo "== metrics-cardinality kserve_tpu/"
+python -m kserve_tpu.analysis.metrics_cardinality kserve_tpu/ || rc=1
+
 exit $rc

@@ -1,0 +1,749 @@
+"""End-to-end request telemetry tests (docs/observability.md): FakeClock
+timelines with exact TTFT/ITL/queue-wait histogram assertions, queue-depth
+gauge staleness regressions, cross-hop traceparent propagation, engine
+child spans, introspection endpoints, and the metric-cardinality gate —
+zero real sleeps anywhere."""
+
+import asyncio
+from contextlib import contextmanager
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+from prometheus_client import REGISTRY
+
+import kserve_tpu.tracing as tracing
+from kserve_tpu import ModelRepository
+from kserve_tpu.engine.engine import EngineConfig, LLMEngine
+from kserve_tpu.engine.sampling import SamplingParams
+from kserve_tpu.engine.tokenizer import ByteTokenizer
+from kserve_tpu.lifecycle.checkpoint import GenerationPreempted
+from kserve_tpu.metrics import (
+    observe_request_timeline,
+    record_breaker_transition,
+    set_lifecycle_state,
+)
+from kserve_tpu.models.llama import LlamaConfig
+from kserve_tpu.observability import (
+    PROFILER_KEY,
+    ProfilerSession,
+    RequestTimeline,
+    TimelineRecorder,
+    percentiles,
+)
+from kserve_tpu.protocol.model_repository_extension import ModelRepositoryExtension
+from kserve_tpu.protocol.openai.dataplane import OpenAIDataPlane
+from kserve_tpu.protocol.rest.server import RESTServer
+from kserve_tpu.resilience import Clock, FakeClock
+from kserve_tpu.tracing import TraceContext, propagate_headers, trace_scope
+
+from conftest import async_test
+from test_rest_server import DummyModel
+
+
+def make_engine(clock=None, metrics_label="obs-engine", **cfg_overrides):
+    model_config = LlamaConfig.tiny(dtype="float32")
+    cfg = dict(
+        max_batch_size=4, page_size=8, num_pages=64, max_pages_per_seq=8,
+        max_prefill_len=32, prefill_buckets=(16, 32), dtype="float32",
+        use_pallas=False,
+    )
+    cfg.update(cfg_overrides)
+    tokenizer = ByteTokenizer(model_config.vocab_size)
+    return LLMEngine(model_config, EngineConfig(**cfg), tokenizer,
+                     clock=clock, metrics_label=metrics_label)
+
+
+def hist(name, label, suffix):
+    v = REGISTRY.get_sample_value(f"{name}_{suffix}", {"model_name": label})
+    return v or 0.0
+
+
+def gauge(name, **labels):
+    return REGISTRY.get_sample_value(name, labels)
+
+
+async def collect(agen):
+    outs = []
+    async for out in agen:
+        outs.append(out)
+    return outs
+
+
+class RecordingSpan:
+    def __init__(self, name, attributes):
+        self.name = name
+        self.attributes = dict(attributes or {})
+        self.events = []
+        self.exceptions = []
+        self.status = None
+        self.ended = False
+
+    def set_attribute(self, key, value):
+        self.attributes[key] = value
+
+    def add_event(self, name, attributes=None):
+        self.events.append((name, dict(attributes or {})))
+
+    def record_exception(self, exc):
+        self.exceptions.append(exc)
+
+    def set_status(self, status):
+        self.status = status
+
+    def end(self):
+        self.ended = True
+
+
+class RecordingTracer:
+    """Recording tracer covering both tracer API shapes the code uses:
+    start_as_current_span (middleware/proxy) and start_span (engine)."""
+
+    def __init__(self):
+        self.spans = []
+
+    @contextmanager
+    def start_as_current_span(self, name, attributes=None):
+        span = RecordingSpan(name, attributes)
+        self.spans.append(span)
+        yield span
+
+    def start_span(self, name, attributes=None):
+        span = RecordingSpan(name, attributes)
+        self.spans.append(span)
+        return span
+
+    def named(self, name):
+        return [s for s in self.spans if s.name == name]
+
+
+@pytest.fixture
+def recording_tracer():
+    tracer = RecordingTracer()
+    tracing.set_tracer_for_tests(tracer)
+    try:
+        yield tracer
+    finally:
+        tracing.set_tracer_for_tests(None)
+        tracing._configured = False
+
+
+# ---------------------------------------------------------------- timelines
+
+
+class TestRequestTimeline:
+    def test_scripted_timeline_exact_values(self):
+        """Pure-FakeClock scripted generation: every derived latency is
+        exact virtual time, no tolerance."""
+        clock = FakeClock()
+        tl = RequestTimeline("r1", model_name="m")
+        tl.mark_received(clock.now())          # t=0
+        clock.advance(0.25)
+        tl.mark_admitted(clock.now())          # t=0.25
+        tl.mark_prefill_start(clock.now())
+        clock.advance(0.5)
+        tl.mark_prefill_end(clock.now())       # t=0.75
+        tl.mark_token(clock.now())             # first token at 0.75
+        for _ in range(3):
+            clock.advance(0.1)
+            tl.mark_token(clock.now())
+        tl.mark_finished(clock.now(), "stop")  # t=1.05
+        assert tl.queue_wait_s == 0.25
+        assert tl.ttft_s == 0.75
+        assert tl.prefill_s == 0.5
+        assert tl.itls == pytest.approx([0.1, 0.1, 0.1])
+        assert tl.e2e_s == pytest.approx(1.05)
+        assert tl.n_generated == 4
+        d = tl.to_dict()
+        assert d["finish_reason"] == "stop" and d["ttft_s"] == 0.75
+
+    def test_re_admission_keeps_first_stamps(self):
+        clock = FakeClock()
+        tl = RequestTimeline("r1")
+        tl.mark_received(0.0)
+        tl.mark_admitted(1.0)
+        tl.add_event(1.5, "preempt", pos=7)
+        tl.mark_admitted(9.0)  # re-seat after preemption
+        assert tl.queue_wait_s == 1.0  # first admission wins
+        assert tl.events[0]["name"] == "preempt"
+
+    def test_recorder_windows_and_percentiles(self):
+        rec = TimelineRecorder()
+        for i, reason in enumerate(["stop", "length", "preempted", "error"]):
+            tl = RequestTimeline(f"r{i}")
+            tl.mark_received(0.0)
+            tl.mark_admitted(0.0)
+            tl.mark_token(1.0 + i)
+            tl.mark_finished(2.0, reason)
+            rec.observe(tl)
+        snap = rec.snapshot()
+        # only stop/length count toward latency windows
+        assert snap["counts"] == {
+            "finished": 2, "preempted": 1, "aborted": 1, "decode_steps": 0,
+        }
+        assert snap["ttft_s"]["n"] == 2
+        assert len(snap["recent"]) == 4  # ring keeps everything for debugging
+
+    def test_percentiles_nearest_rank(self):
+        p = percentiles([0.1 * i for i in range(1, 11)])
+        assert p["n"] == 10
+        assert p["p50"] == pytest.approx(0.6)
+        assert p["p99"] == pytest.approx(1.0)
+        assert p["max"] == pytest.approx(1.0)
+        assert percentiles([]) == {"n": 0}
+
+
+# ------------------------------------------------- engine FakeClock chaos
+
+
+class TestEngineTelemetryFakeClock:
+    @async_test
+    async def test_exact_ttft_itl_queue_wait_histograms(self):
+        """THE acceptance test: a scripted generation under FakeClock gives
+        bit-exact histogram observations — queue wait is exactly the
+        virtual time the request sat queued before the engine started, and
+        every decode stamp lands at the same virtual instant (ITL == 0.0
+        exactly), with zero real sleeps."""
+        label = "obs-exact"
+        clock = FakeClock()
+        engine = make_engine(clock=clock, metrics_label=label)
+        params = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+        task = asyncio.create_task(
+            collect(engine.generate([5, 6, 7], params, request_id="scripted"))
+        )
+        for _ in range(3):  # let the submit reach the queue (engine not started)
+            await asyncio.sleep(0)
+        assert engine.queue_depth == 1
+        clock.advance(2.5)  # scripted queue wait
+        await engine.start()
+        outs = await task
+        await engine.stop()
+        assert len(outs) == 4 and outs[-1].finished
+        # exact histogram observations (count AND sum)
+        assert hist("request_queue_wait_seconds", label, "count") == 1
+        assert hist("request_queue_wait_seconds", label, "sum") == 2.5
+        assert hist("request_ttft_seconds", label, "count") == 1
+        assert hist("request_ttft_seconds", label, "sum") == 2.5
+        # 4 tokens -> 3 inter-token gaps, all at the same virtual instant
+        assert hist("request_inter_token_seconds", label, "count") == 3
+        assert hist("request_inter_token_seconds", label, "sum") == 0.0
+        assert hist("request_e2e_seconds", label, "count") == 1
+        assert hist("request_e2e_seconds", label, "sum") == 2.5
+        # rolling introspection agrees with prometheus
+        snap = engine.telemetry_snapshot()
+        assert snap["counts"]["finished"] == 1
+        assert snap["ttft_s"]["p50"] == 2.5
+        assert snap["itl_s"]["p50"] == 0.0
+        assert snap["queue_wait_s"]["max"] == 2.5
+        recent = snap["recent"][0]
+        assert recent["request_id"] == "scripted"
+        assert recent["finish_reason"] == "length"
+        # decode-step/prefill-chunk series observed (virtual durations = 0)
+        assert snap["counts"]["decode_steps"] >= 1
+        assert hist("engine_prefill_chunk_seconds", label, "count") >= 1
+        assert hist("engine_decode_step_seconds", label, "count") >= 1
+
+    @async_test
+    async def test_xla_compile_counter_counts_cache_misses(self):
+        before = REGISTRY.get_sample_value(
+            "engine_xla_compiles_total", {"program": "prefill"}) or 0.0
+        engine = make_engine(metrics_label="obs-compile")
+        await engine.start()
+        params = SamplingParams(max_tokens=2, temperature=0.0, ignore_eos=True)
+        await collect(engine.generate([1, 2, 3], params))
+        first = REGISTRY.get_sample_value(
+            "engine_xla_compiles_total", {"program": "prefill"})
+        assert first is not None and first >= before + 1
+        # one extra trace may land on the second call (the donated
+        # kv_pages' layout settles after the first full cycle) ...
+        await collect(engine.generate([4, 5, 6], params))
+        settled = REGISTRY.get_sample_value(
+            "engine_xla_compiles_total", {"program": "prefill"})
+        # ... but steady state MUST be retrace-free: same shapes, no growth
+        await collect(engine.generate([7, 8, 9], params))
+        await engine.stop()
+        assert REGISTRY.get_sample_value(
+            "engine_xla_compiles_total", {"program": "prefill"}) == settled
+
+
+class TestQueueDepthGauge:
+    """Satellite: the ENGINE_QUEUE_DEPTH gauge can never go stale —
+    every _waiting mutation writes it unconditionally."""
+
+    @async_test
+    async def test_cancel_updates_gauge(self):
+        label = "obs-gauge-cancel"
+        engine = make_engine(metrics_label=label)  # never started: stays queued
+        params = SamplingParams(max_tokens=2)
+        t1 = asyncio.create_task(
+            collect(engine.generate([1, 2], params, request_id="a")))
+        t2 = asyncio.create_task(
+            collect(engine.generate([3, 4], params, request_id="b")))
+        for _ in range(3):
+            await asyncio.sleep(0)
+        assert gauge("engine_queue_depth", model_name=label) == 2
+        engine.cancel("a")
+        assert gauge("engine_queue_depth", model_name=label) == 1
+        engine.cancel("b")
+        assert gauge("engine_queue_depth", model_name=label) == 0
+        t1.cancel(), t2.cancel()
+
+    @async_test
+    async def test_stop_zeroes_gauge_even_when_queue_already_empty(self):
+        """The r5 bug shape: the fail-all path only zeroed the gauge when
+        it flushed a non-empty queue — a stop after the queue emptied
+        through another path left it stale."""
+        label = "obs-gauge-stop"
+        engine = make_engine(metrics_label=label)
+        params = SamplingParams(max_tokens=2)
+        task = asyncio.create_task(
+            collect(engine.generate([1, 2], params, request_id="x")))
+        for _ in range(3):
+            await asyncio.sleep(0)
+        assert gauge("engine_queue_depth", model_name=label) == 1
+        engine.cancel("x")  # empties the queue outside the fail-all path
+        await engine.stop()  # fail-all sees an EMPTY queue; gauge must be 0
+        assert gauge("engine_queue_depth", model_name=label) == 0
+        task.cancel()
+
+    @async_test
+    async def test_drain_checkpoints_queued_and_zeroes_gauge(self):
+        label = "obs-gauge-drain"
+        clock = FakeClock()
+        engine = make_engine(clock=clock, metrics_label=label)
+        params = SamplingParams(max_tokens=4)
+        task = asyncio.create_task(
+            collect(engine.generate([9, 9, 9], params, request_id="d")))
+        for _ in range(3):
+            await asyncio.sleep(0)
+        ckpts = await engine.drain(clock=clock)
+        assert len(ckpts) == 1
+        with pytest.raises(GenerationPreempted):
+            await task
+        assert gauge("engine_queue_depth", model_name=label) == 0
+        # the preempted timeline landed in the ring, not the latency windows
+        snap = engine.telemetry_snapshot()
+        assert snap["counts"]["preempted"] == 1
+        assert snap["ttft_s"] == {"n": 0}
+
+
+# ---------------------------------------------------------------- metrics
+
+
+class TestMetricsHelpers:
+    def test_set_lifecycle_state_one_hot(self):
+        for state in ("STARTING", "READY", "DRAINING", "TERMINATING"):
+            set_lifecycle_state(state)
+            values = {
+                s: gauge("replica_lifecycle_state", state=s)
+                for s in ("STARTING", "READY", "DRAINING", "TERMINATING")
+            }
+            assert values[state] == 1.0
+            assert sum(values.values()) == 1.0  # exactly one hot
+
+    def test_record_breaker_transition_state_label_only(self):
+        before = REGISTRY.get_sample_value(
+            "resilience_breaker_transitions_total", {"state": "open"}) or 0.0
+        record_breaker_transition("10.0.0.1:8080", "open")
+        after = REGISTRY.get_sample_value(
+            "resilience_breaker_transitions_total", {"state": "open"})
+        assert after == before + 1
+        # the backend identity must NOT have become a label
+        assert REGISTRY.get_sample_value(
+            "resilience_breaker_transitions_total",
+            {"state": "open", "backend": "10.0.0.1:8080"}) is None
+
+    @async_test
+    async def test_live_scrape_exposes_ttft_itl_series(self):
+        clock = FakeClock()
+        tl = RequestTimeline("scrape-req", model_name="scrape-model")
+        tl.mark_received(clock.now())
+        clock.advance(0.2)
+        tl.mark_admitted(clock.now())
+        tl.mark_token(clock.now())
+        clock.advance(0.05)
+        tl.mark_token(clock.now())
+        tl.mark_finished(clock.now(), "stop")
+        observe_request_timeline("scrape-model", tl)
+
+        repo = ModelRepository()
+        repo.update(DummyModel())
+        server = RESTServer(OpenAIDataPlane(repo), ModelRepositoryExtension(repo))
+        async with TestClient(TestServer(server.create_application())) as client:
+            res = await client.get("/metrics")
+            assert res.status == 200
+            text = await res.text()
+        from prometheus_client.parser import text_string_to_metric_families
+
+        families = {f.name: f for f in text_string_to_metric_families(text)}
+        assert "request_ttft_seconds" in families
+        assert "request_inter_token_seconds" in families
+        ttft_count = [
+            s for s in families["request_ttft_seconds"].samples
+            if s.name.endswith("_count")
+            and s.labels.get("model_name") == "scrape-model"
+        ]
+        assert ttft_count and ttft_count[0].value == 1
+        itl_sum = [
+            s for s in families["request_inter_token_seconds"].samples
+            if s.name.endswith("_sum")
+            and s.labels.get("model_name") == "scrape-model"
+        ]
+        assert itl_sum and itl_sum[0].value == pytest.approx(0.05)
+
+
+# ------------------------------------------------------------ introspection
+
+
+class _StubEngine:
+    def __init__(self):
+        self.telemetry = TimelineRecorder()
+
+    def telemetry_snapshot(self):
+        snap = self.telemetry.snapshot()
+        snap["queue_depth"] = 0
+        return snap
+
+
+class _GateClock(Clock):
+    """sleep() blocks until the test releases the gate — deterministic
+    'capture in progress' window with zero real sleeps."""
+
+    def __init__(self):
+        self.gate = asyncio.Event()
+
+    async def sleep(self, seconds: float) -> None:
+        await self.gate.wait()
+
+
+class TestIntrospectionEndpoints:
+    def _server(self, profiler=None):
+        repo = ModelRepository()
+        model = DummyModel()
+        model.engine = _StubEngine()
+        tl = RequestTimeline("t-1", model_name="dummy")
+        tl.mark_received(0.0)
+        tl.mark_admitted(0.5)
+        tl.mark_token(1.0)
+        tl.mark_finished(1.5, "stop")
+        model.engine.telemetry.observe(tl)
+        repo.update(model)
+        return RESTServer(
+            OpenAIDataPlane(repo), ModelRepositoryExtension(repo),
+            profiler=profiler,
+        )
+
+    @async_test
+    async def test_admin_telemetry_reports_percentiles_and_recent(self):
+        server = self._server()
+        async with TestClient(TestServer(server.create_application())) as client:
+            res = await client.get("/admin/telemetry")
+            assert res.status == 200
+            body = await res.json()
+        dummy = body["models"]["dummy"]
+        assert dummy["counts"]["finished"] == 1
+        assert dummy["ttft_s"]["p50"] == 1.0
+        assert dummy["recent"][0]["request_id"] == "t-1"
+        assert body["profiler"]["active"] is False
+
+    @async_test
+    async def test_admin_profile_capture_and_409_while_running(self, tmp_path):
+        clock = _GateClock()
+        server = self._server(profiler=ProfilerSession(clock=clock))
+        app = server.create_application()
+        async with TestClient(TestServer(app)) as client:
+            res = await client.post(
+                "/admin/profile",
+                json={"seconds": 30, "dir": str(tmp_path)},
+            )
+            if res.status == 501:
+                pytest.skip("jax.profiler unavailable in this build")
+            assert res.status == 202
+            info = await res.json()
+            assert info["dir"].startswith(str(tmp_path))
+            # second capture while running: 409, not a corrupted trace
+            res2 = await client.post("/admin/profile", json={"seconds": 1})
+            assert res2.status == 409
+            # telemetry endpoint reports the active capture
+            tele = await (await client.get("/admin/telemetry")).json()
+            assert tele["profiler"]["active"] is True
+            clock.gate.set()
+            await app[PROFILER_KEY].wait()
+            res3 = await client.post(
+                "/admin/profile", json={"seconds": 0.01, "dir": str(tmp_path)}
+            )
+            assert res3.status == 202
+            clock.gate.set()
+            await app[PROFILER_KEY].wait()
+
+    @async_test
+    async def test_admin_profile_rejects_bad_seconds(self):
+        server = self._server(profiler=ProfilerSession(clock=_GateClock()))
+        async with TestClient(TestServer(server.create_application())) as client:
+            res = await client.post("/admin/profile", json={"seconds": -1})
+            assert res.status == 400
+            res = await client.post("/admin/profile", json={"seconds": "zzz"})
+            assert res.status == 400
+
+
+# ------------------------------------------------------- trace propagation
+
+
+class TestTraceContext:
+    def test_parse_roundtrip_and_child(self):
+        ctx = TraceContext.new_root()
+        parsed = TraceContext.parse(ctx.to_header())
+        assert parsed == ctx
+        child = ctx.child()
+        assert child.trace_id == ctx.trace_id
+        assert child.span_id != ctx.span_id
+
+    def test_parse_rejects_malformed(self):
+        assert TraceContext.parse(None) is None
+        assert TraceContext.parse("") is None
+        assert TraceContext.parse("00-zz-bad-01") is None
+        assert TraceContext.parse("00-" + "0" * 32 + "-" + "1" * 16 + "-01") is None
+        assert TraceContext.parse("garbage") is None
+
+    def test_propagate_headers_single_code_path(self):
+        root = TraceContext.new_root()
+        headers = {}
+        with trace_scope(root):
+            child = propagate_headers(headers)
+        assert headers["traceparent"] == child.to_header()
+        assert child.trace_id == root.trace_id
+        # first hop with no bound context mints a root
+        headers2 = {}
+        minted = propagate_headers(headers2)
+        assert TraceContext.parse(headers2["traceparent"]) == minted
+
+
+class TestCrossHopTracing:
+    @async_test
+    async def test_epp_proxy_and_replica_form_one_linked_trace(
+        self, recording_tracer
+    ):
+        """EPP proxy span and the replica's request span must share one
+        trace id — the proxy injects a child traceparent, the replica's
+        context middleware adopts it."""
+        import aiohttp
+
+        from kserve_tpu.scheduler.epp import EPPServer
+        from kserve_tpu.scheduler.picker import EndpointPicker
+
+        repo = ModelRepository()
+        repo.update(DummyModel())
+        replica = RESTServer(OpenAIDataPlane(repo), ModelRepositoryExtension(repo))
+        replica_runner = web.AppRunner(replica.create_application())
+        await replica_runner.setup()
+        site = web.TCPSite(replica_runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        replica_url = f"http://127.0.0.1:{port}"
+
+        picker = EndpointPicker([replica_url])
+        epp = EPPServer(picker)
+        epp_runner = web.AppRunner(epp.create_application())
+        await epp_runner.setup()
+        epp_site = web.TCPSite(epp_runner, "127.0.0.1", 0)
+        await epp_site.start()
+        epp_port = epp_site._server.sockets[0].getsockname()[1]
+        try:
+            caller = TraceContext.new_root()
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                    f"http://127.0.0.1:{epp_port}/v1/models/dummy:predict",
+                    json={"instances": [[1, 2]]},
+                    headers={"traceparent": caller.to_header()},
+                ) as resp:
+                    assert resp.status == 200
+            proxy_spans = recording_tracer.named("epp.proxy")
+            replica_spans = recording_tracer.named(
+                "POST /v1/models/{model_name}:predict")
+            assert proxy_spans and replica_spans
+            # one linked trace: caller -> EPP -> replica share the trace id
+            assert proxy_spans[0].attributes["trace_id"] == caller.trace_id
+            assert replica_spans[0].attributes["trace_id"] == caller.trace_id
+            assert replica_spans[0].attributes["http.status_code"] == 200
+        finally:
+            await epp_runner.cleanup()
+            await replica_runner.cleanup()
+
+    @async_test
+    async def test_engine_child_spans_carry_request_trace(self, recording_tracer):
+        """Engine-internal queue/prefill/decode spans join the request's
+        trace: the timeline captures the bound TraceContext at submit and
+        the engine emits spans tagged with its trace id."""
+        clock = FakeClock()
+        engine = make_engine(clock=clock, metrics_label="obs-spans")
+        await engine.start()
+        ctx = TraceContext.new_root()
+        params = SamplingParams(max_tokens=3, temperature=0.0, ignore_eos=True)
+        with trace_scope(ctx):
+            agen = engine.generate([1, 2, 3], params, request_id="span-req")
+        outs = await collect(agen)
+        await engine.stop()
+        assert outs[-1].finished
+        for name in ("engine.queue", "engine.prefill", "engine.decode"):
+            spans = recording_tracer.named(name)
+            assert spans, f"missing {name} span"
+            assert spans[0].attributes["trace_id"] == ctx.trace_id
+            assert spans[0].attributes["kserve.request_id"] == "span-req"
+            assert spans[0].ended
+        decode = recording_tracer.named("engine.decode")[0]
+        assert decode.attributes["tokens"] == 3
+        assert decode.attributes["finish_reason"] == "length"
+
+    @async_test
+    async def test_full_chain_epp_replica_engine_one_trace(
+        self, recording_tracer
+    ):
+        """The acceptance shape end to end: caller -> EPP proxy -> engine-
+        backed replica -> engine internals, every span on ONE trace id."""
+        import aiohttp
+
+        from kserve_tpu.models.llama import LlamaConfig as LC
+        from kserve_tpu.runtimes.generative_server import JAXGenerativeModel
+        from kserve_tpu.scheduler.epp import EPPServer
+        from kserve_tpu.scheduler.picker import EndpointPicker
+
+        model = JAXGenerativeModel(
+            "tinyllm",
+            model_config=LC.tiny(dtype="float32"),
+            engine_config=EngineConfig(
+                max_batch_size=2, page_size=8, num_pages=64,
+                max_pages_per_seq=8, max_prefill_len=32,
+                prefill_buckets=(16, 32), dtype="float32", use_pallas=False,
+            ),
+            random_weights=True,
+        )
+        model.load()
+        await model.start_engine()
+        repo = ModelRepository()
+        repo.update(model)
+        replica = RESTServer(OpenAIDataPlane(repo), ModelRepositoryExtension(repo))
+        replica_runner = web.AppRunner(replica.create_application())
+        await replica_runner.setup()
+        site = web.TCPSite(replica_runner, "127.0.0.1", 0)
+        await site.start()
+        replica_url = (
+            f"http://127.0.0.1:{site._server.sockets[0].getsockname()[1]}"
+        )
+        epp = EPPServer(EndpointPicker([replica_url]))
+        epp_runner = web.AppRunner(epp.create_application())
+        await epp_runner.setup()
+        epp_site = web.TCPSite(epp_runner, "127.0.0.1", 0)
+        await epp_site.start()
+        epp_port = epp_site._server.sockets[0].getsockname()[1]
+        try:
+            caller = TraceContext.new_root()
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                    f"http://127.0.0.1:{epp_port}/openai/v1/completions",
+                    json={"model": "tinyllm", "prompt": "hi",
+                          "max_tokens": 3, "ignore_eos": True},
+                    headers={"traceparent": caller.to_header()},
+                ) as resp:
+                    assert resp.status == 200
+            by_name = {
+                name: recording_tracer.named(name)
+                for name in ("epp.proxy", "engine.queue",
+                             "engine.prefill", "engine.decode")
+            }
+            for name, spans in by_name.items():
+                assert spans, f"missing {name} span"
+                assert spans[0].attributes["trace_id"] == caller.trace_id, name
+            replica_spans = [
+                s for s in recording_tracer.spans
+                if s.name.startswith("POST /openai")
+            ]
+            assert replica_spans
+            assert replica_spans[0].attributes["trace_id"] == caller.trace_id
+        finally:
+            await model.engine.stop()
+            await epp_runner.cleanup()
+            await replica_runner.cleanup()
+
+    @async_test
+    async def test_rest_client_forwards_traceparent_on_retries(self):
+        """Satellite: the InferenceRESTClient carries traceparent on every
+        retry attempt (same trace, fresh span id), alongside the existing
+        deadline/checkpoint headers, through one propagation code path."""
+        import httpx
+
+        from kserve_tpu.inference_client import InferenceRESTClient, RESTConfig
+
+        seen = []
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            seen.append(dict(request.headers))
+            if len(seen) == 1:
+                return httpx.Response(503, headers={"Retry-After": "0"})
+            return httpx.Response(200, json={"predictions": [[2]]})
+
+        client = InferenceRESTClient(RESTConfig(
+            transport=httpx.MockTransport(handler),
+            clock=FakeClock(),
+        ))
+        root = TraceContext.new_root()
+        with trace_scope(root):
+            result = await client.infer(
+                "http://replica", {"instances": [[1]]}, model_name="m"
+            )
+        await client.close()
+        assert result == {"predictions": [[2]]}
+        assert len(seen) == 2
+        ctxs = [TraceContext.parse(h.get("traceparent")) for h in seen]
+        assert all(c is not None for c in ctxs)
+        assert ctxs[0].trace_id == root.trace_id  # one trace across retries
+        assert ctxs[1].trace_id == root.trace_id
+        assert ctxs[0].span_id != ctxs[1].span_id  # fresh hop per attempt
+
+
+# ------------------------------------------------------- cardinality gate
+
+
+class TestMetricsCardinalityGate:
+    def test_flags_unbounded_labels(self):
+        from kserve_tpu.analysis.metrics_cardinality import scan_source
+
+        bad = (
+            "from prometheus_client import Counter\n"
+            "C = Counter('x_total', 'doc', ['backend'])\n"
+            "D = Counter('y_total', 'doc', labelnames=['request_id'])\n"
+        )
+        findings = scan_source(bad, "bad.py")
+        assert len(findings) == 2
+        assert "backend" in findings[0][2]
+        assert "request_id" in findings[1][2]
+
+    def test_flags_computed_label_lists(self):
+        from kserve_tpu.analysis.metrics_cardinality import scan_source
+
+        bad = (
+            "from prometheus_client import Gauge\n"
+            "labels = make_labels()\n"
+            "G = Gauge('x', 'doc', labels)\n"
+        )
+        findings = scan_source(bad, "bad.py")
+        assert len(findings) == 1 and "literal" in findings[0][2]
+
+    def test_bounded_labels_pass(self):
+        from kserve_tpu.analysis.metrics_cardinality import scan_source
+
+        good = (
+            "from prometheus_client import Histogram\n"
+            "H = Histogram('x_seconds', 'doc', ['model_name', 'state'])\n"
+            "N = Histogram('y_seconds', 'doc')\n"
+        )
+        assert scan_source(good, "good.py") == []
+
+    def test_tree_is_clean(self):
+        """The policy metrics.py documents holds across kserve_tpu/ — the
+        same invocation scripts/lint.sh runs in CI."""
+        import os
+
+        from kserve_tpu.analysis.metrics_cardinality import scan_paths
+
+        root = os.path.join(os.path.dirname(__file__), "..", "kserve_tpu")
+        assert list(scan_paths([root])) == []

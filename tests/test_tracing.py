@@ -19,9 +19,17 @@ class FakeSpan:
     def __init__(self, name, attributes):
         self.name = name
         self.attributes = dict(attributes or {})
+        self.exceptions = []
+        self.status = None
 
     def set_attribute(self, key, value):
         self.attributes[key] = value
+
+    def record_exception(self, exc):
+        self.exceptions.append(exc)
+
+    def set_status(self, status):
+        self.status = status
 
 
 class FakeTracer:
@@ -75,6 +83,49 @@ async def test_spans_use_route_template_and_final_status(fake_tracer):
     err_span = fake_tracer.spans[1]
     assert err_span.name == ok_span.name
     assert err_span.attributes["http.status_code"] == 404
+
+
+@async_test
+async def test_handler_exception_is_recorded_and_reraised(fake_tracer):
+    """An exception escaping the handler must not escape the span
+    unannotated: record_exception + ERROR status, then re-raise (here a
+    raw app with ONLY the tracing middleware, so nothing maps the error
+    before the span sees it)."""
+    from aiohttp import web
+
+    async def boom(request):
+        raise RuntimeError("kaput")
+
+    app = web.Application(middlewares=[tracing.tracing_middleware])
+    app.router.add_get("/boom", boom)
+    async with TestClient(TestServer(app)) as client:
+        res = await client.get("/boom")
+        assert res.status == 500  # aiohttp's default mapping, outside the span
+    span = fake_tracer.spans[0]
+    assert len(span.exceptions) == 1
+    assert isinstance(span.exceptions[0], RuntimeError)
+    assert span.status is not None  # ERROR (otel Status when API present)
+    assert "http.status_code" not in span.attributes  # no fake success stamp
+
+
+@async_test
+async def test_request_context_binds_trace_and_request_id(fake_tracer):
+    """The always-on context middleware adopts the caller's traceparent;
+    the span records the derived (same-trace) context ids."""
+    server = make_server()
+    caller_trace = "0af7651916cd43dd8448eb211c80319c"
+    header = f"00-{caller_trace}-b7ad6b7169203331-01"
+    async with TestClient(TestServer(server.create_application())) as client:
+        res = await client.post(
+            "/v1/models/dummy:predict",
+            json={"instances": [[1]]},
+            headers={"traceparent": header, "x-request-id": "rid-42"},
+        )
+        assert res.status == 200
+        assert res.headers["x-request-id"] == "rid-42"
+    span = fake_tracer.spans[0]
+    assert span.attributes["trace_id"] == caller_trace
+    assert span.attributes["span_id"] != "b7ad6b7169203331"  # child hop
 
 
 @async_test
