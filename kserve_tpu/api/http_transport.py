@@ -35,6 +35,7 @@ from ..controlplane.gvk import (
     resource_from_crd,
 )
 from ..logging import logger
+from ..metrics import RETRY_ATTEMPTS
 from ..resilience import RetryPolicy, parse_retry_after
 
 
@@ -122,6 +123,7 @@ class HTTPCluster:
                         attempt, retry_after=retry_after,
                         elapsed=time.monotonic() - started)
                     if delay is not None:
+                        RETRY_ATTEMPTS.labels(component="cluster").inc()
                         # sync bootstrap/controller client — no event loop
                         time.sleep(delay)  # jaxlint: disable=blocking-async
                         continue

@@ -104,8 +104,9 @@ def build_compiled(model_config, engine_config, mesh) -> CompiledPrograms:
         # (single-token steps have nothing to shard over seq).
         from functools import partial as _partial
 
-        from jax import shard_map
         from jax.sharding import PartitionSpec as _P
+
+        from ..parallel.sharding import shard_map
 
         from ..parallel.ring_attention import ring_attention
 

@@ -67,6 +67,7 @@ from ..resilience import (
     Clock,
     Deadline,
     DeadlineExceededError,
+    ReplicaCrashError,
     current_deadline,
 )
 from .kvcache import (
@@ -106,6 +107,15 @@ class LLMEngine:
         lora_adapters: Optional[Dict[str, str]] = None,
         lora_stacked=None,  # (adapter_ids, per-layer stacks) pre-loaded
         clock: Optional[Clock] = None,  # telemetry clock (FakeClock in chaos tests)
+        # the fleet-simulator stub seam (kserve_tpu/sim): an object with
+        # the CompiledPrograms attribute surface replaces the jitted device
+        # programs, and a fetch/fetch_async/close duck of _DeadlineFetcher
+        # replaces the daemon fetch worker — so admission, batching,
+        # preemption, drain and checkpointing all run the REAL scheduler
+        # against a cycle-accurate stub device, deterministically on the
+        # event-loop thread (no fetch-thread scheduling jitter)
+        compiled_programs=None,
+        fetcher=None,
     ):
         if engine_config.dp > 1:
             raise ValueError(
@@ -379,8 +389,10 @@ class LLMEngine:
         # deadline; a timeout flips `wedged` (liveness).  Daemon, not a
         # ThreadPoolExecutor: its non-daemon workers are joined at
         # interpreter exit, so one stuck fetch would hang process shutdown —
-        # the exact failure mode this exists to escape.
-        self._fetcher = _DeadlineFetcher()
+        # the exact failure mode this exists to escape.  The simulator
+        # injects a synchronous fetcher instead (thread handoff order is
+        # the one nondeterminism a deterministic fleet sim cannot keep).
+        self._fetcher = fetcher if fetcher is not None else _DeadlineFetcher()
         self._wedged = False
         # chaos seam (resilience/faults.py): a FaultPlan whose "wedge"
         # specs targeting "engine.fetch" the device-fetch path honors
@@ -398,16 +410,32 @@ class LLMEngine:
         self._penalty_counts = None
         self._penalty_prompt = None
         self._penalty_dirty_rows: Optional[set] = None
-        self._build_compiled()
+        # deterministic admission stamp: a strictly-increasing sequence the
+        # preemption policy orders victims by (newest-first).  A sequence,
+        # not a wall/virtual clock read — two admissions inside one virtual
+        # instant must still have a defined age order or the simulator's
+        # preemption choice (and therefore its whole report) would hinge on
+        # a tie-break
+        self._admission_seq = 0.0
+        self._build_compiled(compiled_programs)
 
     # ---------------- compiled programs ----------------
 
-    def _build_compiled(self):
-        """Jit the device programs (engine/compiled.py) and bind them under
-        the historical attribute names the loop dispatches through."""
-        from .compiled import build_compiled
+    def _next_admission_seq(self) -> float:
+        self._admission_seq += 1.0
+        return self._admission_seq
 
-        p = build_compiled(self.model_config, self.config, self.mesh)
+    def _build_compiled(self, override=None):
+        """Jit the device programs (engine/compiled.py) and bind them under
+        the historical attribute names the loop dispatches through.
+        `override` (the simulator's stub seam) supplies a pre-built program
+        set with the same attribute surface instead."""
+        if override is not None:
+            p = override
+        else:
+            from .compiled import build_compiled
+
+            p = build_compiled(self.model_config, self.config, self.mesh)
         self._prefill_fn = p.prefill
         self._prefill_lp_fn = p.prefill_lp
         self._prefill_chunk_fn = p.prefill_chunk
@@ -596,6 +624,11 @@ class LLMEngine:
             spec = self.fault_plan.decide("engine.fetch")
             if spec is not None and spec.kind == "wedge":
                 raise self._wedge("injected wedge (fault plan)")
+            if spec is not None and spec.kind == "replica_crash":
+                # the process died: no wedge flag, no drain, no checkpoint —
+                # the run loop's crash handler fails every in-flight stream
+                # and clients must recover by retrying from scratch
+                raise ReplicaCrashError("injected replica crash (fault plan)")
 
     def _wedge(self, msg: str) -> EngineWedgedError:
         self._wedged = True
@@ -1102,7 +1135,12 @@ class LLMEngine:
                 raise DeadlineExceededError(
                     "checkpoint deadline budget exhausted before resume"
                 )
-            snapshot = Deadline.after(checkpoint.deadline_remaining_s)
+            # anchored on the ENGINE's clock (clock-injection audit): under
+            # a virtual clock the snapshot budget must expire in virtual
+            # time like every other deadline, or resumes would outlive the
+            # budget their checkpoint carried
+            snapshot = Deadline.after(
+                checkpoint.deadline_remaining_s, self._clock)
             if deadline is None or snapshot.remaining() < deadline.remaining():
                 deadline = snapshot
         generated = [int(t) for t in checkpoint.generated]
@@ -1139,7 +1177,7 @@ class LLMEngine:
                 "detok": detok,
                 "stop_texts": list(params.stop or []),
                 "pos": len(prompt_ids) + len(generated) - 1,
-                "admitted_at": time.perf_counter(),
+                "admitted_at": self._next_admission_seq(),
                 "kv": None,  # cross-replica: always re-prefill
             }
         self.resume_count += 1
@@ -1447,7 +1485,7 @@ class LLMEngine:
         slot.queue = req.queue
         slot.detok = IncrementalDetokenizer(self.tokenizer)
         slot.stop_texts = list(req.params.stop or [])
-        slot.admitted_at = time.perf_counter()
+        slot.admitted_at = self._next_admission_seq()
         slot.adapter_id = req.adapter_id
         slot.deadline = req.deadline
         slot.timeline = req.timeline

@@ -28,7 +28,7 @@ import httpx
 from aiohttp import web
 
 from ..logging import bind_log_context, configure_logging, logger
-from ..metrics import record_breaker_transition
+from ..metrics import RETRY_ATTEMPTS, record_breaker_transition
 from ..tracing import TraceContext, propagate_headers, trace_scope
 from ..resilience import (
     DEADLINE_HEADER,
@@ -206,6 +206,7 @@ class GraphRouter:
             )
             if delay is None:
                 break
+            RETRY_ATTEMPTS.labels(component="graph").inc()
             await self.clock.sleep(delay)
         if soft:
             logger.warning("soft-dependency step failed, continuing: %s", last_exc)

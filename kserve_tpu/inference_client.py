@@ -7,8 +7,6 @@ Parity: reference python/kserve/kserve/inference_client.py
 
 from __future__ import annotations
 
-import asyncio
-import json
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple, Union
 
@@ -21,6 +19,7 @@ from .lifecycle import (
     CHECKPOINT_HEADER_MAX_BYTES,
     GenerationCheckpoint,
 )
+from .metrics import RETRY_ATTEMPTS
 from .model import PredictorProtocol
 from .resilience import (
     DEADLINE_HEADER,
@@ -143,6 +142,7 @@ class InferenceRESTClient:
                 if failure is not None:
                     raise failure
                 return response
+            RETRY_ATTEMPTS.labels(component="rest").inc()
             await self._clock.sleep(delay)
 
     @staticmethod
@@ -175,6 +175,7 @@ class InferenceRESTClient:
                 )
                 if delay is None:
                     raise e
+                RETRY_ATTEMPTS.labels(component="rest").inc()
                 await self._clock.sleep(delay)
 
     def _is_v2(self) -> bool:
@@ -325,32 +326,24 @@ class InferenceGRPCClient:
         timeout: float = 60,
         retries: int = 3,
         retry_policy: Optional[RetryPolicy] = None,
+        clock: Optional[Clock] = None,
     ):
         import grpc
 
         from .protocol.grpc.servicer import build_stub_multicallables
 
         options = list(channel_args or [])
-        # the ad-hoc retryPolicy dict is now a translation of the shared
-        # RetryPolicy so REST and gRPC hops retry under one policy surface
-        policy = retry_policy or RetryPolicy(max_attempts=retries + 1)
-        if policy.max_attempts > 1:
-            service_config = {
-                "methodConfig": [
-                    {
-                        "name": [{"service": "inference.GRPCInferenceService"}],
-                        "retryPolicy": {
-                            "maxAttempts": policy.max_attempts,
-                            "initialBackoff": f"{policy.base_backoff_s:g}s",
-                            "maxBackoff": f"{policy.max_backoff_s:g}s",
-                            "backoffMultiplier": policy.multiplier,
-                            "retryableStatusCodes": ["UNAVAILABLE"],
-                        },
-                    }
-                ]
-            }
-            options.append(("grpc.enable_retries", 1))
-            options.append(("grpc.service_config", json.dumps(service_config)))
+        # retries moved OFF the channel's opaque service-config machinery
+        # onto an explicit app-level loop over the shared RetryPolicy:
+        # channel-internal retries are invisible to observability, so the
+        # request_retry_attempts_total amplification counter (which the
+        # fleet simulator and the dashboards alert on) could never see
+        # them — and stacking both layers would square the amplification.
+        # Only UNAVAILABLE retries (the reference's retryableStatusCodes):
+        # the request never produced a response, so replay is safe.
+        self._retry_policy = retry_policy or RetryPolicy(
+            max_attempts=retries + 1)
+        self._clock = clock or MONOTONIC
         if creds is not None:
             self._channel = grpc.aio.secure_channel(url, creds, options=options)
         elif use_ssl:
@@ -365,6 +358,45 @@ class InferenceGRPCClient:
         self._calls = build_stub_multicallables(self._channel)
         self._timeout = timeout
 
+    async def _call_with_retries(self, name: str, request,
+                                 timeout=None, metadata=None):
+        """One unary call under the shared RetryPolicy: UNAVAILABLE (the
+        backend is down/unreachable — the request never executed) retries
+        with counted attempts; every other status raises as before.  The
+        propagated deadline gates each send (an expired budget is rejected
+        before the RPC, same as the REST loop), caps the per-attempt RPC
+        timeout to the remaining budget, and caps the backoff."""
+        import grpc
+
+        started = self._clock.now()
+        attempt = 0
+        while True:
+            deadline = current_deadline()
+            if deadline is not None and deadline.expired:
+                raise InferenceError(
+                    "request deadline exceeded before send", status="504"
+                )
+            attempt += 1
+            rpc_timeout = timeout or self._timeout
+            if deadline is not None:
+                rpc_timeout = min(rpc_timeout, max(deadline.remaining(), 0.0))
+            try:
+                return await self._calls[name](
+                    request, timeout=rpc_timeout, metadata=metadata,
+                )
+            except grpc.aio.AioRpcError as e:
+                if e.code() != grpc.StatusCode.UNAVAILABLE:
+                    raise
+                delay = self._retry_policy.next_delay(
+                    attempt,
+                    elapsed=self._clock.now() - started,
+                    deadline=current_deadline(),
+                )
+                if delay is None:
+                    raise
+                RETRY_ATTEMPTS.labels(component="grpc").inc()
+                await self._clock.sleep(delay)
+
     async def infer(
         self,
         infer_request: InferRequest,
@@ -372,34 +404,35 @@ class InferenceGRPCClient:
         headers: Optional[List[Tuple[str, str]]] = None,
     ) -> InferResponse:
         req = infer_request.to_grpc() if isinstance(infer_request, InferRequest) else infer_request
-        response = await self._calls["ModelInfer"](
-            req, timeout=timeout or self._timeout, metadata=headers
+        response = await self._call_with_retries(
+            "ModelInfer", req, timeout=timeout, metadata=headers
         )
         return InferResponse.from_grpc(response)
 
     async def is_server_ready(self, timeout=None, headers=None) -> bool:
         from .protocol.grpc import open_inference_pb2 as pb
 
-        res = await self._calls["ServerReady"](
-            pb.ServerReadyRequest(), timeout=timeout or self._timeout, metadata=headers
+        res = await self._call_with_retries(
+            "ServerReady", pb.ServerReadyRequest(),
+            timeout=timeout, metadata=headers,
         )
         return res.ready
 
     async def is_server_live(self, timeout=None, headers=None) -> bool:
         from .protocol.grpc import open_inference_pb2 as pb
 
-        res = await self._calls["ServerLive"](
-            pb.ServerLiveRequest(), timeout=timeout or self._timeout, metadata=headers
+        res = await self._call_with_retries(
+            "ServerLive", pb.ServerLiveRequest(),
+            timeout=timeout, metadata=headers,
         )
         return res.live
 
     async def is_model_ready(self, model_name: str, timeout=None, headers=None) -> bool:
         from .protocol.grpc import open_inference_pb2 as pb
 
-        res = await self._calls["ModelReady"](
-            pb.ModelReadyRequest(name=model_name),
-            timeout=timeout or self._timeout,
-            metadata=headers,
+        res = await self._call_with_retries(
+            "ModelReady", pb.ModelReadyRequest(name=model_name),
+            timeout=timeout, metadata=headers,
         )
         return res.ready
 

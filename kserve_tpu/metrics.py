@@ -75,6 +75,19 @@ DEADLINE_REJECTED = Counter(
     "requests rejected because their propagated deadline had expired",
     ["component"],
 )
+# Retry amplification: every retry a client loop issues BEYOND the first
+# attempt.  rate(request_retry_attempts_total) / rate(first attempts)
+# is the fleet's amplification factor; the simulator asserts it stays
+# bounded (<= 2x) under churn, and production dashboards alarm on the
+# same series.  Components are the literal set of in-repo retry loops:
+# rest (inference_client REST), grpc (inference_client gRPC), graph
+# (graph router steps), cluster (api.http_transport flow control), sim
+# (the fleet simulator's client loop).
+RETRY_ATTEMPTS = Counter(
+    "request_retry_attempts_total",
+    "retry attempts issued beyond a request's first try, per client loop",
+    ["component"],
+)
 
 # Lifecycle layer (kserve_tpu/lifecycle — docs/lifecycle.md): graceful
 # drain + preemption-safe resumable generation.

@@ -251,10 +251,9 @@ def make_sharded_paged_attention(
     silently disable the kernel for every non-windowed tp>1 model).
     `quantized` selects the (int8 pages, scales) cache layout.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    from ..parallel.sharding import MODEL_AXIS
+    from ..parallel.sharding import MODEL_AXIS, shard_map
 
     if interpret and (windowed or scale is not None):
         # the interpret path exists to test the KERNEL's math on CPU, and

@@ -186,7 +186,7 @@ def pipeline_blocks(
     """Stage-sharded transformer stack WITH paged-KV state: the serving
     engine's pipeline-parallel execution path (engine pp>1).  Returns
     ([B, ...] outputs replicated over pipe, updated stacked pages)."""
-    from jax import shard_map
+    from .sharding import shard_map
 
     B = x.shape[0]
     if B % n_microbatches != 0:
@@ -245,7 +245,7 @@ def pipeline_forward(
     The batch is split into `n_microbatches` along dim 0 (must divide B);
     output is the full [B, ...] result, replicated over the pipe axis.
     """
-    from jax import shard_map
+    from .sharding import shard_map
 
     B = x.shape[0]
     if B % n_microbatches != 0:

@@ -24,6 +24,29 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.llama import LlamaConfig
 
+# version-portable shard_map: promoted to `jax.shard_map` in newer jax,
+# only under jax.experimental in the pinned image (0.4.x).  Every in-repo
+# user imports it from HERE (ops/attention.py, engine/compiled.py,
+# parallel/pipeline.py, the parallel-ops tests) so the compat shim lives
+# in exactly one place — `from jax import shard_map` at module scope was
+# tier-1's standing collection error (test_parallel_ops.py).  On 0.4.x
+# the adapter also translates the renamed kwargs: check_vma -> check_rep,
+# and axis_names (manual axes) -> auto (its complement).
+try:
+    from jax import shard_map  # noqa: F401  (jax >= 0.6)
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs,
+                  check_vma=None, axis_names=None):
+        kw = {}
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+        if axis_names is not None:
+            kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+        return _legacy_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
 DATA_AXIS = "data"
 SEQ_AXIS = "seq"
 PIPE_AXIS = "pipe"

@@ -26,6 +26,11 @@ from .deadline import (  # noqa: F401
     current_deadline,
     deadline_scope,
 )
-from .faults import FaultInjectingTransport, FaultPlan, FaultSpec  # noqa: F401
+from .faults import (  # noqa: F401
+    FaultInjectingTransport,
+    FaultPlan,
+    FaultSpec,
+    ReplicaCrashError,
+)
 from .retry import RETRYABLE_STATUSES, RetryPolicy, parse_retry_after  # noqa: F401
 from .shedding import LoadShedder, ShedConfig, shedding_middleware  # noqa: F401

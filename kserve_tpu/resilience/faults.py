@@ -19,13 +19,25 @@ Fault kinds:
 - ``preempt``        the engine forcibly requeues its newest active
                      sequence — the deterministic stand-in for spot/KV
                      preemption the drain/resume chaos tests fire
+- ``replica_crash``  the process died mid-flight: the transport raises a
+                     connect error (nothing is listening anymore) and the
+                     engine's fetch path raises `ReplicaCrashError`, which
+                     kills the run loop WITHOUT a drain — every in-flight
+                     stream fails, nothing is checkpointed (the crash/churn
+                     half of the fleet simulator, kserve_tpu/sim)
+- ``clock_skew``     a slow replica: the injected `latency_s` is scaled by
+                     ``skew`` (transport), and the simulator's stub device
+                     multiplies its compute costs by the same factor — the
+                     deterministic stand-in for thermal throttling or a
+                     noisy neighbor
 
 `FaultInjectingTransport` honors a plan in front of any httpx handler or
-inner transport; `LLMEngine` honors ``wedge`` specs targeted at
-``engine.fetch`` (see engine._fetch) and ``preempt`` specs targeted at
-``engine.preempt`` (see engine._grow_and_preempt — during a drain the
-preempted sequence is checkpointed for cross-replica resume instead of
-being re-seated).
+inner transport; `LLMEngine` honors ``wedge`` and ``replica_crash`` specs
+targeted at ``engine.fetch`` (see engine._fetch) and ``preempt`` specs
+targeted at ``engine.preempt`` (see engine._grow_and_preempt — during a
+drain the preempted sequence is checkpointed for cross-replica resume
+instead of being re-seated); the fleet simulator's stub device honors
+``clock_skew`` specs targeted at ``<replica>.compute``.
 """
 
 from __future__ import annotations
@@ -39,16 +51,29 @@ import httpx
 from .clock import MONOTONIC, Clock
 
 
+class ReplicaCrashError(RuntimeError):
+    """An injected `replica_crash` fault fired inside the engine: the
+    process is gone.  Unlike a wedge (liveness flips, pod restarts) or a
+    drain (checkpoints flow), a crash loses everything in flight — the
+    failure mode retry-from-scratch and token-exact accounting must
+    survive, which is exactly what the fleet simulator injects it for."""
+
+
 @dataclass
 class FaultSpec:
     target: str  # substring matched against the call target
-    kind: str  # latency | connect_error | http_status | wedge | partial_stream | preempt
+    # latency | connect_error | http_status | wedge | partial_stream |
+    # preempt | replica_crash | clock_skew
+    kind: str
     status: int = 503
     latency_s: float = 0.0
     retry_after_s: Optional[float] = None
     probability: float = 1.0  # <1.0 draws from the plan's seeded RNG
     after: int = 0  # skip the first N matching calls
     count: Optional[int] = None  # inject at most N times (None = forever)
+    # clock_skew multiplier: scales latency_s in the transport and the
+    # stub device's compute costs in the fleet simulator
+    skew: float = 1.0
 
 
 class FaultPlan:
@@ -83,6 +108,18 @@ class FaultPlan:
         if kind is None:
             return len(self.log)
         return sum(1 for _, k in self.log if k == kind)
+
+    def disarm(self, spec: FaultSpec) -> None:
+        """Stop `spec` from injecting again WITHOUT removing it from the
+        list — per-spec counters are keyed by list index, so removal would
+        silently corrupt every later spec's state.  Used by callers that
+        arm a one-shot fault against an event that may never come (the
+        fleet simulator's crash-on-idle-replica case: an unconsumed
+        replica_crash spec must not kill the restarted process)."""
+        for i, s in enumerate(self.specs):
+            if s is spec:
+                s.count = self._injected.get(i, 0)
+                return
 
 
 class _TruncatedStream(httpx.AsyncByteStream):
@@ -122,8 +159,16 @@ class FaultInjectingTransport(httpx.AsyncBaseTransport):
         if spec is not None:
             if spec.kind == "latency":
                 await self.clock.sleep(spec.latency_s)
+            elif spec.kind == "clock_skew":
+                # a slow backend, not a dead one: the latency is the spec's
+                # latency scaled by the skew factor, then the call proceeds
+                await self.clock.sleep(spec.latency_s * spec.skew)
             elif spec.kind == "connect_error":
                 raise httpx.ConnectError("injected connect error", request=request)
+            elif spec.kind == "replica_crash":
+                # the process is gone: connection refused from here on
+                raise httpx.ConnectError(
+                    "injected replica crash", request=request)
             elif spec.kind == "wedge":
                 raise httpx.ReadTimeout("injected wedge", request=request)
             elif spec.kind == "partial_stream":

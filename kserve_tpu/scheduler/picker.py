@@ -31,13 +31,12 @@ from __future__ import annotations
 
 import asyncio
 import math
-import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
 from ..logging import logger
-from ..resilience import BreakerRegistry
+from ..resilience import MONOTONIC, BreakerRegistry, Clock
 from .latency import estimate_prompt_len
 from .prefix import text_prefix_digests, token_prefix_digests
 
@@ -88,7 +87,13 @@ class EndpointPicker:
         latency_weight: float = 0.0,  # score penalty per predicted TTFT sec
         error_weight: float = 2.0,  # score penalty per recent HTTP error
         breakers: Optional[BreakerRegistry] = None,  # resilience/breaker.py
+        clock: Clock = MONOTONIC,  # error-decay/poll stamps (sim injects)
     ):
+        # every time the picker reads (poll freshness, error decay) comes
+        # from this injectable clock so the fleet simulator's routing is a
+        # pure function of virtual time — real time would leak wall-clock
+        # jitter into scores and break byte-identical reports
+        self.clock = clock
         self.latency_predictor = latency_predictor
         self.latency_weight = latency_weight
         self.error_weight = error_weight
@@ -157,7 +162,7 @@ class EndpointPicker:
         r.healthy = not wedged
         r.lifecycle = str(state.get("lifecycle") or "READY").upper()
         r.consecutive_failures = 0
-        r.last_poll = time.monotonic()
+        r.last_poll = self.clock.now()
 
     # recent-error half-life: a shedding replica is retried within ~30s of
     # its last error, not banished forever
@@ -166,7 +171,7 @@ class EndpointPicker:
     def decayed_errors(self, r: Replica) -> float:
         if r.error_ewma <= 0.0:
             return 0.0
-        dt = max(time.monotonic() - r.last_error_t, 0.0)
+        dt = max(self.clock.now() - r.last_error_t, 0.0)
         return r.error_ewma * math.exp(-dt / self.ERROR_DECAY_S)
 
     def observe_http_error(self, url: str) -> None:
@@ -177,7 +182,7 @@ class EndpointPicker:
         if r is None:
             return
         r.error_ewma = self.decayed_errors(r) + 1.0
-        r.last_error_t = time.monotonic()
+        r.last_error_t = self.clock.now()
         if self.breakers is not None:
             self.breakers.record_failure(r.url)
 

@@ -1,0 +1,42 @@
+"""Fleet-scale deterministic simulation (docs/simulation.md).
+
+N real `LLMEngine` replicas over a cycle-accurate stub device, routed by
+the real EPP picker through the real resilience/lifecycle layers, driven
+by one discrete-event SimClock and seeded workload/churn generators —
+so SLO goodput under churn (p99 TTFT/ITL, zero lost tokens, bounded
+retry amplification) is a per-PR CPU regression test instead of a
+live-chip experiment.
+"""
+
+from .clock import SimClock, SimDeadlockError  # noqa: F401
+from .fleet import ClientRecord, FleetSim, run_scenario  # noqa: F401
+from .replica import (  # noqa: F401
+    SIM_ADAPTERS,
+    SIM_MODEL_NAME,
+    ReplicaSpec,
+    SimReplica,
+)
+from .report import (  # noqa: F401
+    SLOBudget,
+    SLOViolation,
+    assert_slo,
+    build_report,
+    canonical_json,
+)
+from .scenario import (  # noqa: F401
+    ChurnEvent,
+    Scenario,
+    churn_10k_scenario,
+    smoke_scenario,
+)
+from .stub import (  # noqa: F401
+    SimFetcher,
+    StubCosts,
+    StubDevice,
+    StubPrograms,
+    build_stub_programs,
+    expected_stream,
+    stub_first_token,
+    stub_next_token,
+)
+from .workload import SimRequest, WorkloadConfig, generate_trace  # noqa: F401

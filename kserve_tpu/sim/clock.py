@@ -1,0 +1,145 @@
+"""Discrete-event virtual time for the fleet simulator.
+
+`FakeClock` (resilience/clock.py) advances time the moment anything
+sleeps — perfect for single-replica chaos tests, wrong for a fleet:
+two replicas decoding "concurrently" would serialize, and adding a
+replica would make everyone slower in virtual time.  `SimClock` is a
+real discrete-event scheduler instead: `sleep()` parks the caller on a
+timer heap, and the driver advances time to the earliest pending timer
+only once every runnable coroutine has gone quiet.  Two replicas whose
+stub devices each take 5 virtual ms therefore finish at t=5ms, not
+t=10ms — fleet compute overlaps the way real hardware does.
+
+Determinism: timers fire in (deadline, registration order); the driver
+itself runs on the ordinary asyncio loop, whose FIFO scheduling is
+deterministic as long as nothing touches real I/O or threads (the
+simulator's stub fetcher exists precisely to keep the engine's device
+fetches off the fetch worker thread).  Same tasks + same sleeps = same
+interleaving = byte-identical reports.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import itertools
+from typing import Callable, List, Optional, Tuple
+
+from ..resilience.clock import Clock
+
+
+class SimDeadlockError(RuntimeError):
+    """Every task is blocked, no timer is pending, and the scenario is not
+    complete: the simulation can never make progress again.  Carries the
+    driver's view of what was still outstanding."""
+
+
+class SimClock(Clock):
+    """Virtual monotonic clock with a discrete-event driver."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = start
+        self._seq = itertools.count()
+        # (when, seq, future) — seq breaks ties deterministically in
+        # registration order
+        self._timers: List[Tuple[float, int, asyncio.Future]] = []
+
+    # ---------------- Clock surface ----------------
+
+    def now(self) -> float:
+        return self._now
+
+    async def sleep(self, seconds: float) -> None:
+        if seconds <= 0:
+            # preserve FakeClock's "one event-loop yield" contract so
+            # zero-backoff retries still cede the loop
+            await asyncio.sleep(0)
+            return
+        await self.sleep_until(self._now + seconds)
+
+    async def sleep_until(self, when: float) -> None:
+        if when <= self._now:
+            await asyncio.sleep(0)
+            return
+        fut = asyncio.get_running_loop().create_future()
+        heapq.heappush(self._timers, (when, next(self._seq), fut))
+        await fut
+
+    # ---------------- sync advancement (FakeClock parity) ----------------
+
+    def advance(self, seconds: float) -> None:
+        """Jump virtual time forward without yielding (FakeClock parity for
+        sync call sites — the stub device's blocking prefill fetch).  Due
+        timers fire on the driver's next pass, observing the jumped time."""
+        self._now += max(seconds, 0.0)
+
+    def advance_to(self, when: float) -> None:
+        if when > self._now:
+            self._now = when
+
+    # ---------------- the driver ----------------
+
+    @property
+    def pending_timers(self) -> int:
+        self._prune()
+        return len(self._timers)
+
+    def _prune(self) -> None:
+        while self._timers and self._timers[0][2].done():
+            heapq.heappop(self._timers)  # cancelled waiter: nothing to wake
+
+    def _fire_due(self) -> bool:
+        """Wake every timer whose deadline has been reached (deadline
+        order, then registration order).  True when any waiter was woken."""
+        fired = False
+        while self._timers and self._timers[0][0] <= self._now:
+            _, _, fut = heapq.heappop(self._timers)
+            if not fut.done():
+                fut.set_result(None)
+                fired = True
+        return fired
+
+    async def _settle(self) -> None:
+        """Yield until no other coroutine is runnable.  Uses the loop's
+        ready-queue length when available (CPython's default loop); falls
+        back to a fixed, deterministic number of yields otherwise."""
+        loop = asyncio.get_running_loop()
+        ready = getattr(loop, "_ready", None)
+        if ready is None:
+            for _ in range(64):
+                await asyncio.sleep(0)
+            return
+        while True:
+            await asyncio.sleep(0)
+            if not ready:
+                return
+
+    async def drive(
+        self,
+        until: Callable[[], bool],
+        describe_stuck: Optional[Callable[[], str]] = None,
+    ) -> None:
+        """Run the simulation until `until()` holds: settle the loop, fire
+        due timers, and advance virtual time to the next timer whenever
+        everything is parked.  Raises SimDeadlockError when no timer is
+        pending, nothing is runnable, and `until()` still fails."""
+        while not until():
+            await self._settle()
+            if until():
+                return
+            if self._fire_due():
+                continue
+            self._prune()
+            if not self._timers:
+                detail = describe_stuck() if describe_stuck else ""
+                raise SimDeadlockError(
+                    "simulation stalled: no runnable task, no pending "
+                    f"timer, and the scenario is not complete. {detail}"
+                )
+            self._now = self._timers[0][0]
+            self._fire_due()
+
+    async def drain_timers(self) -> None:
+        """Drive until the timer heap is empty (used after the scenario
+        completes to let in-flight engine work quiesce before teardown)."""
+        await self.drive(until=lambda: self.pending_timers == 0)

@@ -1,0 +1,435 @@
+"""The fleet simulator: real routing/resilience code over stub replicas.
+
+`FleetSim.run()` plays a seeded workload trace against N `SimReplica`s
+through the REAL serving stack: the `EndpointPicker` scores and routes
+every request (prefix affinity, queue depth, breaker and lifecycle
+exclusion), the resilience `RetryPolicy`/`BreakerRegistry`/`LoadShedder`
+decide retries and rejections, and the engines run production admission
+/ batching / preemption / drain / checkpoint logic.  Churn events fire
+against the same SimClock.  The output is a canonical goodput report
+(report.build_report) that is byte-identical for a given scenario+seed.
+
+The client loop mirrors the REST client's retry contract (PR 4/5): a
+preempted stream carries its GenerationCheckpoint to the next attempt
+and the user-visible stream is the salvage splice + continuation; a
+crash retry (no checkpoint) restarts from the prompt and replaces the
+stream.  Every retry is counted into `request_retry_attempts_total`
+{component="sim"} — the same series production dashboards watch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..lifecycle import GenerationPreempted, ReplicaDrainingError
+from ..metrics import RETRY_ATTEMPTS, record_breaker_transition
+from ..observability import RequestTimeline
+from ..resilience import (
+    BreakerConfig,
+    BreakerRegistry,
+    Deadline,
+    DeadlineExceededError,
+    FaultPlan,
+    FaultSpec,
+    RetryPolicy,
+    deadline_scope,
+)
+from ..scheduler.picker import EndpointPicker
+from .clock import SimClock
+from .replica import SimReplica
+from .report import build_report
+from .scenario import ChurnEvent, Scenario
+from .stub import expected_stream
+from .workload import SimRequest, generate_trace
+
+
+@dataclass
+class ClientRecord:
+    """Client-side accounting for one trace request."""
+
+    rid: str
+    kind: str
+    index: int
+    attempts: int = 0
+    sheds: int = 0
+    resumes: int = 0
+    crash_restarts: int = 0
+    no_backend: int = 0
+    outcome: str = "pending"
+    n_tokens: int = 0
+    lost_tokens: int = 0
+    duplicated_tokens: int = 0
+    salvaged_tokens: int = 0
+    token_exact: bool = False
+    ttft_s: Optional[float] = None
+    e2e_s: Optional[float] = None
+    itls: List[float] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "rid": self.rid, "kind": self.kind, "attempts": self.attempts,
+            "sheds": self.sheds, "resumes": self.resumes,
+            "crash_restarts": self.crash_restarts,
+            "no_backend": self.no_backend, "outcome": self.outcome,
+            "n_tokens": self.n_tokens, "lost_tokens": self.lost_tokens,
+            "duplicated_tokens": self.duplicated_tokens,
+            "salvaged_tokens": self.salvaged_tokens,
+            "token_exact": self.token_exact, "ttft_s": self.ttft_s,
+            "e2e_s": self.e2e_s, "itls": self.itls,
+        }
+
+
+class FleetSim:
+    def __init__(self, scenario: Scenario):
+        self.scenario = scenario
+        self.clock = SimClock()
+        self.trace: List[SimRequest] = generate_trace(
+            scenario.workload, scenario.seed)
+        self.replicas: Dict[str, SimReplica] = {}
+        params = None
+        for name in scenario.replica_names():
+            r = SimReplica(name, self.clock, scenario.spec, params=params)
+            r.set_fault_plan(FaultPlan([], seed=scenario.seed))
+            params = r.params
+            self.replicas[name] = r
+        self.by_url = {r.url: r for r in self.replicas.values()}
+        self.picker = EndpointPicker(
+            [r.url for r in self.replicas.values()],
+            clock=self.clock,
+            breakers=BreakerRegistry(
+                BreakerConfig(window=20, failure_threshold=0.5,
+                              min_volume=4, open_for_s=5.0),
+                clock=self.clock,
+                # same transition metric production wires (tests assert a
+                # simulated trip shows up on the real dashboard series)
+                on_transition=record_breaker_transition,
+            ),
+        )
+        # fleet-network fault plan (breaker trips, injected 503s/connect
+        # errors between gateway and replica), matched on the DELIMITED
+        # "<name>/proxy" target — a bare name would substring-match
+        # replica-1 against replica-10+ in larger fleets
+        self.net_plan = FaultPlan([], seed=scenario.seed + 1)
+        self._validate_churn()
+        self.records: List[ClientRecord] = []
+        self._completed = 0
+        self._tasks: List[asyncio.Task] = []
+        self._churn_subtasks: List[asyncio.Task] = []
+
+    # ---------------- fleet plumbing ----------------
+
+    _CHURN_KINDS = frozenset({
+        "preempt", "crash", "drain_restart", "breaker_trip",
+        "shed_storm", "heal_shed", "skew", "heal_skew",
+    })
+    _FLEET_WIDE = frozenset({"shed_storm", "heal_shed"})
+
+    def _validate_churn(self) -> None:
+        """Fail a misconfigured scenario at construction, not silently at
+        its at_s inside a background task (where the error would otherwise
+        read as a churn-free green run)."""
+        for ev in self.scenario.churn:
+            if ev.kind not in self._CHURN_KINDS:
+                raise ValueError(
+                    f"unknown churn kind {ev.kind!r} (at_s={ev.at_s}); "
+                    f"known: {sorted(self._CHURN_KINDS)}")
+            if ev.kind not in self._FLEET_WIDE and (
+                    ev.replica not in self.replicas):
+                raise ValueError(
+                    f"churn event {ev.kind!r} at_s={ev.at_s} names unknown "
+                    f"replica {ev.replica!r}; have "
+                    f"{sorted(self.replicas)}")
+
+    async def _poll_loop(self) -> None:
+        """The EPP's scrape loop: feeds each replica's real scheduler
+        state (or a failure observation for a dead one) to the picker."""
+        while True:
+            for r in self.replicas.values():
+                if r.alive:
+                    self.picker.observe_state(r.url, r.state_payload())
+                else:
+                    self.picker.observe_failure(r.url)
+            await self.clock.sleep(self.scenario.poll_interval_s)
+
+    async def _churn_loop(self) -> None:
+        for ev in sorted(self.scenario.churn, key=lambda e: e.at_s):
+            await self.clock.sleep_until(ev.at_s)
+            self._apply_churn(ev)
+
+    def _apply_churn(self, ev: ChurnEvent) -> None:
+        r = self.replicas.get(ev.replica) if ev.replica else None
+        if ev.kind == "preempt":
+            r.fault_plan.specs.append(FaultSpec(
+                "engine.preempt", "preempt", count=ev.count))
+        elif ev.kind == "crash":
+            self._churn_subtasks.append(asyncio.create_task(
+                self._crash_restart(r, ev.restart_after_s)))
+        elif ev.kind == "drain_restart":
+            self._churn_subtasks.append(asyncio.create_task(
+                self._drain_restart(r, ev.restart_after_s, ev.grace_s)))
+        elif ev.kind == "breaker_trip":
+            self.net_plan.specs.append(FaultSpec(
+                f"{r.name}/proxy", "http_status", status=503,
+                count=ev.count))
+        elif ev.kind == "shed_storm":
+            for rep in self.replicas.values():
+                cfg = rep.shedder.config
+                cfg.queue_watermark = max(
+                    1, int(rep.spec.shed_watermark * ev.factor))
+        elif ev.kind == "heal_shed":
+            for rep in self.replicas.values():
+                rep.shedder.config.queue_watermark = rep.spec.shed_watermark
+        elif ev.kind == "skew":
+            r.device.skew = ev.factor
+        elif ev.kind == "heal_skew":
+            r.device.skew = 1.0
+        else:
+            raise ValueError(f"unknown churn kind {ev.kind!r}")
+
+    async def _crash_restart(self, r: SimReplica, after_s: float) -> None:
+        await r.crash()
+        await self.clock.sleep(after_s)
+        await r.restart()
+        # recycled-address contract: the fresh process must not inherit
+        # the dead one's breaker state
+        self.picker.breakers.forget(r.url)
+
+    async def _drain_restart(self, r: SimReplica, after_s: float,
+                             grace_s) -> None:
+        await r.drain(grace_s)
+        await r.stop()
+        await self.clock.sleep(after_s)
+        await r.restart()
+        self.picker.breakers.forget(r.url)
+
+    async def _spawn_clients(self) -> None:
+        for req in self.trace:
+            await self.clock.sleep_until(req.arrival_s)
+            self._tasks.append(asyncio.create_task(self._client(req)))
+
+    # ---------------- the client ----------------
+
+    async def _client(self, req: SimRequest) -> None:
+        index = len(self.records)
+        rec = ClientRecord(rid=req.rid, kind=req.kind, index=index)
+        self.records.append(rec)
+        tl = RequestTimeline(req.rid, model_name="fleet")
+        tl.mark_received(self.clock.now())
+        started = self.clock.now()
+        deadline = (
+            Deadline.after(req.deadline_s, self.clock)
+            if req.deadline_s is not None else None
+        )
+        policy = RetryPolicy(
+            max_attempts=self.scenario.client_max_attempts,
+            base_backoff_s=0.05, max_backoff_s=0.8,
+            retry_budget_s=self.scenario.client_retry_budget_s,
+            seed=self.scenario.seed * 1_000_003 + index,
+        )
+        ckpt = None
+        shown: List[int] = []
+        while True:
+            rec.attempts += 1
+            status, retry_after, ckpt, shown = await self._attempt(
+                req, rec, tl, ckpt, shown, deadline)
+            if status in ("completed", "deadline_exceeded", "rejected"):
+                rec.outcome = status
+                break
+            delay = policy.next_delay(
+                rec.attempts,
+                retry_after=retry_after,
+                elapsed=self.clock.now() - started,
+                deadline=deadline,
+            )
+            if delay is None:
+                rec.outcome = (
+                    "deadline_exceeded"
+                    if deadline is not None and deadline.expired
+                    else "gave_up"
+                )
+                break
+            RETRY_ATTEMPTS.labels(component="sim").inc()
+            await self.clock.sleep(delay)
+        self._account_tokens(req, rec, shown)
+        tl.mark_finished(self.clock.now(), rec.outcome)
+        rec.ttft_s = tl.ttft_s
+        rec.e2e_s = tl.e2e_s
+        rec.itls = list(tl.itls)
+        self._completed += 1
+
+    async def _attempt(self, req: SimRequest, rec: ClientRecord,
+                       tl: RequestTimeline, ckpt, shown: List[int],
+                       deadline) -> tuple:
+        if deadline is not None and deadline.expired:
+            return "deadline_exceeded", None, ckpt, shown
+        pick = self.picker.pick(prompt_ids=req.prompt_ids)
+        if pick is None:
+            rec.no_backend += 1
+            return "retry", None, ckpt, shown
+        replica = self.by_url[pick.url]
+        # injected network faults between gateway and replica (breaker
+        # trips ride injected 503s; a crashed process is connect-refused);
+        # delimited target: "replica-1/proxy" never matches replica-10+
+        spec = self.net_plan.decide(f"{replica.name}/proxy")
+        if spec is not None and spec.kind in ("connect_error",
+                                              "replica_crash"):
+            self.picker.observe_failure(pick.url)
+            return "retry", None, ckpt, shown
+        if spec is not None and spec.kind == "http_status":
+            self.picker.observe_http_error(pick.url)
+            return "retry", spec.retry_after_s, ckpt, shown
+        if not replica.alive:
+            self.picker.observe_failure(pick.url)
+            return "retry", None, ckpt, shown
+        if not self.picker.breakers.allow(pick.url):
+            return "retry", None, ckpt, shown
+        if replica.shedder.should_shed(replica.engine.queue_depth):
+            rec.sheds += 1
+            self.picker.observe_http_error(pick.url)
+            return "retry", replica.shedder.retry_after_s, ckpt, shown
+        rid_attempt = f"{req.rid}~a{rec.attempts}"
+        # the user-visible stream for this attempt: a resume splices the
+        # checkpoint's salvaged tokens (PR 5's _splice_resume contract), a
+        # fresh attempt replaces the stream entirely
+        shown = list(ckpt.generated) if ckpt is not None else []
+        try:
+            with deadline_scope(deadline):
+                if ckpt is not None:
+                    stream = replica.engine.resume_generation(
+                        ckpt, request_id=rid_attempt)
+                else:
+                    stream = replica.engine.generate(
+                        req.prompt_ids, req.sampling_params(),
+                        request_id=rid_attempt, adapter=req.adapter)
+            async for out in stream:
+                if out.token_id >= 0:
+                    shown.append(out.token_id)
+                    tl.mark_token(self.clock.now())
+                if deadline is not None and deadline.expired:
+                    replica.engine.cancel(rid_attempt)
+                    return "deadline_exceeded", None, ckpt, shown
+                if out.finished:
+                    break
+            self.picker.observe_success(pick.url)
+            return "completed", None, ckpt, shown
+        except GenerationPreempted as exc:
+            rec.resumes += 1
+            prev = len(ckpt.generated) if ckpt is not None else 0
+            new_ckpt = exc.checkpoint
+            rec.salvaged_tokens += max(len(new_ckpt.generated) - prev, 0)
+            # 503 + checkpoint: the replica is going away; train the picker
+            self.picker.observe_http_error(pick.url)
+            return "retry", None, new_ckpt, shown
+        except ReplicaDrainingError:
+            self.picker.observe_http_error(pick.url)
+            return "retry", None, ckpt, shown
+        except DeadlineExceededError:
+            return "deadline_exceeded", None, ckpt, shown
+        except ValueError:
+            # admission rejected the request outright (resume validation,
+            # length bounds): a client bug, not a fleet failure — fatal
+            return "rejected", None, ckpt, shown
+        except RuntimeError:
+            # engine crashed or stopped under us (ReplicaCrashError,
+            # EngineWedgedError, "engine stopped"): the stream is gone;
+            # retry resumes from the last checkpoint if one exists,
+            # from the prompt otherwise
+            rec.crash_restarts += 1
+            self.picker.observe_failure(pick.url)
+            return "retry", None, ckpt, shown
+
+    def _account_tokens(self, req: SimRequest, rec: ClientRecord,
+                        shown: List[int]) -> None:
+        """Token-exact accounting against the stub oracle: a completed
+        request must have delivered EXACTLY its expected stream — anything
+        shorter lost tokens, anything longer (or mismatched) duplicated or
+        corrupted them."""
+        rec.n_tokens = len(shown)
+        if rec.outcome != "completed":
+            return
+        expected = expected_stream(len(req.prompt_ids), req.max_tokens)
+        if shown == expected:
+            rec.token_exact = True
+            return
+        rec.lost_tokens = max(len(expected) - len(shown), 0)
+        rec.duplicated_tokens = max(len(shown) - len(expected), 0)
+        if rec.lost_tokens == 0 and rec.duplicated_tokens == 0:
+            # same length, wrong content: count each mismatch as one lost
+            # (expected token never delivered) and one duplicated
+            # (unexpected token delivered in its place)
+            mismatches = sum(1 for a, b in zip(shown, expected) if a != b)
+            rec.lost_tokens = mismatches
+            rec.duplicated_tokens = mismatches
+
+    # ---------------- the run ----------------
+
+    async def run(self) -> dict:
+        for r in self.replicas.values():
+            await r.start()
+        spawner = asyncio.create_task(self._spawn_clients())
+        churn = asyncio.create_task(self._churn_loop())
+        poll = asyncio.create_task(self._poll_loop())
+        n = len(self.trace)
+
+        def aux_failure():
+            # a dead spawner/churn/restart task must FAIL the run, not
+            # quietly produce a churn-free (or half-populated) green report
+            for t in (spawner, churn, poll, *self._churn_subtasks):
+                if t.done() and not t.cancelled() and t.exception():
+                    return t.exception()
+            return None
+
+        try:
+            await self.clock.drive(
+                until=lambda: self._completed >= n or aux_failure(),
+                describe_stuck=self._describe_stuck,
+            )
+            exc = aux_failure()
+            if exc is not None:
+                raise exc
+            poll.cancel()
+            churn.cancel()
+            spawner.cancel()
+            # flush in-flight engine work (abandoned decodes, pending churn
+            # restarts) so teardown never waits on real time
+            for t in self._churn_subtasks:
+                if not t.done():
+                    t.cancel()
+            await self.clock.drain_timers()
+            finished_at = self.clock.now()
+            for r in self.replicas.values():
+                await r.stop()
+        finally:
+            # failure path (aux exception, SimDeadlockError): the engines'
+            # run-loop tasks must not outlive the run — destroyed-pending
+            # task spam would bury the diagnostic this path exists to raise
+            for t in (poll, churn, spawner, *self._churn_subtasks):
+                t.cancel()
+            for r in self.replicas.values():
+                if r.engine is not None and r.engine.running:
+                    await r.stop()
+        faults = list(self.net_plan.log)
+        for r in self.replicas.values():
+            faults.extend(r.fault_plan.log)
+        return build_report(
+            self.scenario.name, self.scenario.seed,
+            [rec.to_dict() for rec in self.records],
+            [r.summary() for r in self.replicas.values()],
+            faults, finished_at,
+        )
+
+    def _describe_stuck(self) -> str:
+        pending = [rec.rid for rec in self.records
+                   if rec.outcome == "pending"]
+        waiting = len(self.trace) - len(self.records)
+        return (
+            f"{self._completed}/{len(self.trace)} clients complete; "
+            f"{waiting} not yet spawned; in-flight: {pending[:8]}"
+        )
+
+
+async def run_scenario(scenario: Scenario) -> dict:
+    """Build a fleet for `scenario`, run it, return the goodput report."""
+    return await FleetSim(scenario).run()

@@ -1,0 +1,231 @@
+"""One simulated serving replica: a REAL LLMEngine over a stub device.
+
+The engine here is the production class, not a model of it — admission,
+continuous batching, chunked prefill, KV paging, preemption, drain and
+checkpointing all execute the code that serves traffic, against
+`stub.StubPrograms` for the device math and `stub.SimFetcher` for the
+device fetch path.  The replica adds the per-replica pieces the fleet
+layer routes around: a `ReplicaLifecycle` state machine, a `LoadShedder`
+admission gate, the shared seeded `FaultPlan`, and crash / drain /
+restart transitions scheduled by the churn layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..engine.engine import EngineConfig, LLMEngine
+from ..engine.tokenizer import ByteTokenizer
+from ..lifecycle import ReplicaLifecycle
+from ..lifecycle.checkpoint import GenerationCheckpoint
+from ..models import llama
+from ..resilience import FaultPlan, FaultSpec, LoadShedder, ShedConfig
+from .clock import SimClock
+from .stub import SimFetcher, StubCosts, StubDevice, build_stub_programs
+
+# one simulated fleet serves one weights identity: checkpoints captured on
+# any replica resume on any other
+SIM_MODEL_NAME = "sim-llm"
+
+# LoRA adapters the multi-tenant workload selects between.  The stacks are
+# empty per-layer dicts — the stub device never reads adapter tensors, but
+# the ENGINE still runs its real adapter admission policy (adapter
+# requests bypass the shared prefix cache, ride the adapter id through
+# seating and checkpoints, and resume by name on another replica).
+SIM_ADAPTERS = ("tenant-a", "tenant-b", "tenant-c")
+
+
+@dataclass
+class ReplicaSpec:
+    """Sizing + cost knobs for one simulated replica."""
+
+    max_batch_size: int = 4
+    page_size: int = 16
+    num_pages: int = 256
+    max_pages_per_seq: int = 16
+    max_prefill_len: int = 64
+    prefill_buckets: tuple = (32, 64)
+    steps_per_sync: int = 4
+    prefill_batch: int = 4
+    costs: StubCosts = field(default_factory=StubCosts)
+    shed_watermark: int = 24
+    shed_resume_fraction: float = 0.5
+    shed_retry_after_s: float = 0.25
+    drain_grace_s: float = 5.0
+
+    def engine_config(self) -> EngineConfig:
+        return EngineConfig(
+            max_batch_size=self.max_batch_size,
+            page_size=self.page_size,
+            num_pages=self.num_pages,
+            max_pages_per_seq=self.max_pages_per_seq,
+            max_prefill_len=self.max_prefill_len,
+            prefill_buckets=tuple(self.prefill_buckets),
+            steps_per_sync=self.steps_per_sync,
+            prefill_batch=self.prefill_batch,
+            dtype="float32",
+            use_pallas=False,
+        )
+
+
+def _model_config():
+    return llama.LlamaConfig.tiny(dtype="float32")
+
+
+class SimReplica:
+    """A replica the fleet layer can route to, drain, crash and restart."""
+
+    def __init__(self, name: str, clock: SimClock, spec: ReplicaSpec,
+                 params=None):
+        self.name = name
+        self.url = f"http://{name}:8080"
+        self.clock = clock
+        self.spec = spec
+        self.model_config = _model_config()
+        self.tokenizer = ByteTokenizer(self.model_config.vocab_size)
+        # one weights pytree shared across every replica of the fleet (the
+        # stub never reads it, but sharing keeps N-replica setup cheap and
+        # models the "identical weights" resume contract)
+        self.params = params
+        self.device = StubDevice(name, dataclasses.replace(spec.costs), clock)
+        self.fault_plan: Optional[FaultPlan] = None
+        self.shedder = LoadShedder(ShedConfig(
+            queue_watermark=spec.shed_watermark,
+            resume_fraction=spec.shed_resume_fraction,
+            retry_after_s=spec.shed_retry_after_s,
+        ))
+        self.generation = 0  # restart counter (engine identity)
+        self.crashes = 0
+        # engine counters survive restarts here (a fresh engine starts at
+        # zero; the report wants the replica's lifetime totals)
+        self.totals = {
+            "preemptions": 0, "checkpointed": 0, "resumes": 0,
+            "finished": 0,
+        }
+        self.engine: Optional[LLMEngine] = None
+        self.lifecycle: Optional[ReplicaLifecycle] = None
+        self._build_engine()
+
+    def _build_engine(self) -> None:
+        cfg = self.spec.engine_config()
+        programs = build_stub_programs(
+            cfg, self.device, vocab_size=self.model_config.vocab_size)
+        self.engine = LLMEngine(
+            self.model_config,
+            cfg,
+            self.tokenizer,
+            params=self.params,
+            metrics_label=SIM_MODEL_NAME,
+            checkpoint_label=SIM_MODEL_NAME,
+            lora_stacked=(
+                {name: i for i, name in enumerate(SIM_ADAPTERS)},
+                [{} for _ in range(self.model_config.n_layers)],
+            ),
+            clock=self.clock,
+            compiled_programs=programs,
+            fetcher=SimFetcher(self.device, self.clock),
+        )
+        if self.params is None:
+            self.params = self.engine.params
+        self.engine.fault_plan = self.fault_plan
+        self.lifecycle = ReplicaLifecycle(
+            clock=self.clock, drain_grace_s=self.spec.drain_grace_s)
+        self.lifecycle.mark_ready()
+
+    # ---------------- fleet-facing state ----------------
+
+    @property
+    def alive(self) -> bool:
+        """The process answers its port: the engine loop task is running
+        (a crashed loop = connection refused to the fleet layer)."""
+        return self.engine is not None and self.engine.running
+
+    @property
+    def accepting(self) -> bool:
+        return self.alive and self.lifecycle.accepting
+
+    def state_payload(self) -> dict:
+        """What this replica's /v1/internal/scheduler/state would return —
+        fed to the real EndpointPicker by the fleet's poll loop."""
+        state = self.engine.scheduler_state()
+        state["lifecycle"] = self.lifecycle.state
+        return state
+
+    def set_fault_plan(self, plan: Optional[FaultPlan]) -> None:
+        self.fault_plan = plan
+        self.engine.fault_plan = plan
+        self.device.fault_plan = plan
+
+    # ---------------- lifecycle transitions (churn layer) ----------------
+
+    async def start(self) -> None:
+        await self.engine.start()
+
+    async def stop(self) -> None:
+        if self.engine is not None:
+            await self.engine.stop()
+
+    async def drain(
+        self, grace_s: Optional[float] = None,
+    ) -> List[GenerationCheckpoint]:
+        """Graceful drain: lifecycle flips DRAINING (the poll loop pulls
+        this replica out of picks), in-flight work gets the drain budget,
+        the rest is checkpointed to the waiting client streams."""
+        budget = self.lifecycle.begin_drain(grace_s)
+        checkpoints = await self.engine.drain(
+            deadline=budget, clock=self.clock)
+        self.lifecycle.finish_drain()
+        return checkpoints
+
+    async def crash(self) -> None:
+        """Simulated process kill (kill -9 / node loss): every in-flight
+        stream dies with ReplicaCrashError-shaped RuntimeErrors, nothing
+        drains, nothing is checkpointed.  A replica_crash fault is armed
+        first so an engine mid-fetch dies through the real fault seam; the
+        stop tears down whatever the fault did not reach, and an UNFIRED
+        spec is disarmed afterwards — an idle-replica crash must not leave
+        a landmine that kills the restarted process on its first fetch."""
+        self.crashes += 1
+        spec = None
+        if self.fault_plan is not None:
+            spec = FaultSpec("engine.fetch", "replica_crash", count=1)
+            self.fault_plan.specs.append(spec)
+        await self.engine.stop()
+        if spec is not None:
+            self.fault_plan.disarm(spec)
+
+    def _accumulate(self) -> None:
+        e = self.engine
+        self.totals["preemptions"] += e.preemption_count
+        self.totals["checkpointed"] += e.checkpointed_count
+        self.totals["resumes"] += e.resume_count
+        self.totals["finished"] += e.telemetry.finished_count
+
+    def summary(self) -> dict:
+        self_totals = dict(self.totals)
+        e = self.engine
+        return {
+            "name": self.name,
+            "restarts": self.generation,
+            "crashes": self.crashes,
+            "preemptions": self_totals["preemptions"] + e.preemption_count,
+            "checkpointed": self_totals["checkpointed"] + e.checkpointed_count,
+            "resumes": self_totals["resumes"] + e.resume_count,
+            "finished": self_totals["finished"] + e.telemetry.finished_count,
+            "device_dispatches": self.device.dispatches,
+            "lifecycle": self.lifecycle.state,
+        }
+
+    async def restart(self) -> None:
+        """Replace the process on the same url (rolling restart / crash
+        recovery): fresh engine, fresh device timeline, READY lifecycle.
+        The fleet layer must forget the old pod's breaker state (recycled
+        address contract — scheduler/picker.set_replicas)."""
+        await self.stop()
+        self._accumulate()
+        self.generation += 1
+        self.device.reset()
+        self._build_engine()
+        await self.engine.start()

@@ -1,0 +1,165 @@
+"""SLO goodput report + hard assertions for simulator runs.
+
+The report is built from the per-request `RequestTimeline`s the fleet's
+client layer stamps on the SimClock (PR 6's observability spine), plus
+the client-side accounting the timelines cannot carry (attempt counts,
+token-exactness against the stub oracle, shed/error outcomes).  Every
+value is a pure function of virtual time and seeded randomness, so
+`canonical_json(report)` is byte-identical across runs of the same
+scenario + seed — which is itself one of the assertions CI makes.
+
+`assert_slo` turns the report into hard pass/fail: p50/p99 TTFT and ITL
+budgets, zero lost / zero duplicated tokens (token-exact accounting
+across preemption resumes), bounded retry amplification, and shed/error
+budgets.  A violation raises `SLOViolation` listing every breached
+budget, not just the first.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from ..observability import percentiles
+
+
+class SLOViolation(AssertionError):
+    """One or more SLO budgets breached; the message lists all of them."""
+
+
+@dataclass
+class SLOBudget:
+    """Hard budgets for one scenario.  None disables a check."""
+
+    p50_ttft_s: Optional[float] = None
+    p99_ttft_s: Optional[float] = 5.0
+    p99_itl_s: Optional[float] = 1.0
+    p99_e2e_s: Optional[float] = None
+    # completed-with-exact-tokens / submitted
+    min_goodput: float = 0.98
+    max_retry_amplification: float = 2.0
+    max_shed_fraction: Optional[float] = 0.25
+    max_lost_tokens: int = 0
+    max_duplicated_tokens: int = 0
+
+
+def _rounded(stats: Dict[str, Any]) -> Dict[str, Any]:
+    # stable float text: the values are already deterministic, rounding
+    # just keeps the JSON readable
+    return {k: (round(v, 9) if isinstance(v, float) else v)
+            for k, v in sorted(stats.items())}
+
+
+def build_report(scenario_name: str, seed: int, records: List[dict],
+                 replicas: List[dict], faults: List[tuple],
+                 finished_at_s: float) -> Dict[str, Any]:
+    """Aggregate client records (fleet.ClientRecord.to_dict()) into the
+    canonical goodput report."""
+    outcomes: Dict[str, int] = {}
+    for r in records:
+        outcomes[r["outcome"]] = outcomes.get(r["outcome"], 0) + 1
+    completed = [r for r in records if r["outcome"] == "completed"]
+    exact = [r for r in completed if r["token_exact"]]
+    ttft = [r["ttft_s"] for r in completed if r["ttft_s"] is not None]
+    e2e = [r["e2e_s"] for r in completed if r["e2e_s"] is not None]
+    itl: List[float] = []
+    for r in completed:
+        itl.extend(r["itls"])
+    n = len(records)
+    attempts = sum(r["attempts"] for r in records)
+    sheds = sum(r["sheds"] for r in records)
+    report = {
+        "scenario": scenario_name,
+        "seed": seed,
+        "requests": {
+            "submitted": n,
+            "completed": len(completed),
+            "token_exact": len(exact),
+            "outcomes": dict(sorted(outcomes.items())),
+        },
+        "tokens": {
+            "delivered": sum(r["n_tokens"] for r in completed),
+            "lost": sum(r["lost_tokens"] for r in records),
+            "duplicated": sum(r["duplicated_tokens"] for r in records),
+            "salvaged_via_resume": sum(r["salvaged_tokens"] for r in records),
+        },
+        "retries": {
+            "attempts": attempts,
+            "amplification": round(attempts / n, 9) if n else 0.0,
+            "max_attempts_one_request": max(
+                (r["attempts"] for r in records), default=0),
+            "preempt_resumes": sum(r["resumes"] for r in records),
+            "crash_restarts": sum(r["crash_restarts"] for r in records),
+            "sheds_observed": sheds,
+        },
+        "latency": {
+            "ttft_s": _rounded(percentiles(ttft)),
+            "itl_s": _rounded(percentiles(itl)),
+            "e2e_s": _rounded(percentiles(e2e)),
+        },
+        "goodput": round(len(exact) / n, 9) if n else 0.0,
+        "replicas": sorted(replicas, key=lambda r: r["name"]),
+        "faults_injected": {
+            kind: sum(1 for _, k in faults if k == kind)
+            for kind in sorted({k for _, k in faults})
+        },
+        "finished_at_s": round(finished_at_s, 9),
+    }
+    return report
+
+
+def canonical_json(report: Dict[str, Any]) -> str:
+    """The byte form CI compares across same-seed runs."""
+    return json.dumps(report, sort_keys=True, separators=(",", ":"))
+
+
+def assert_slo(report: Dict[str, Any], budget: SLOBudget) -> None:
+    """Raise SLOViolation listing EVERY breached budget."""
+    breaches: List[str] = []
+
+    def check(cond: bool, msg: str) -> None:
+        if not cond:
+            breaches.append(msg)
+
+    lat = report["latency"]
+
+    def pct(block: str, key: str) -> Optional[float]:
+        stats = lat[block]
+        return stats.get(key) if stats.get("n") else None
+
+    if budget.p50_ttft_s is not None:
+        v = pct("ttft_s", "p50")
+        check(v is not None and v <= budget.p50_ttft_s,
+              f"p50 TTFT {v} > budget {budget.p50_ttft_s}")
+    if budget.p99_ttft_s is not None:
+        v = pct("ttft_s", "p99")
+        check(v is not None and v <= budget.p99_ttft_s,
+              f"p99 TTFT {v} > budget {budget.p99_ttft_s}")
+    if budget.p99_itl_s is not None:
+        v = pct("itl_s", "p99")
+        check(v is not None and v <= budget.p99_itl_s,
+              f"p99 ITL {v} > budget {budget.p99_itl_s}")
+    if budget.p99_e2e_s is not None:
+        v = pct("e2e_s", "p99")
+        check(v is not None and v <= budget.p99_e2e_s,
+              f"p99 e2e {v} > budget {budget.p99_e2e_s}")
+    check(report["goodput"] >= budget.min_goodput,
+          f"goodput {report['goodput']} < budget {budget.min_goodput}")
+    check(report["tokens"]["lost"] <= budget.max_lost_tokens,
+          f"lost tokens {report['tokens']['lost']} > "
+          f"{budget.max_lost_tokens}")
+    check(report["tokens"]["duplicated"] <= budget.max_duplicated_tokens,
+          f"duplicated tokens {report['tokens']['duplicated']} > "
+          f"{budget.max_duplicated_tokens}")
+    amp = report["retries"]["amplification"]
+    check(amp <= budget.max_retry_amplification,
+          f"retry amplification {amp} > {budget.max_retry_amplification}")
+    if budget.max_shed_fraction is not None:
+        n = max(report["requests"]["submitted"], 1)
+        frac = report["retries"]["sheds_observed"] / n
+        check(frac <= budget.max_shed_fraction,
+              f"shed fraction {frac:.4f} > {budget.max_shed_fraction}")
+    if breaches:
+        raise SLOViolation(
+            "SLO budget breached:\n  - " + "\n  - ".join(breaches))

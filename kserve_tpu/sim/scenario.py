@@ -1,0 +1,177 @@
+"""Scenario definitions: workload + fleet geometry + scheduled churn.
+
+A `Scenario` is everything a simulation run needs; same scenario + same
+seed = byte-identical goodput report.  `ChurnEvent`s are scheduled
+against the SimClock and applied by the fleet layer on top of the
+resilience FaultPlan machinery:
+
+- ``preempt``        arm N deterministic KV-preemption faults on a replica
+- ``crash``          the replica's next device fetch raises
+                     ReplicaCrashError (run loop dies, nothing is
+                     checkpointed), then the process restarts after
+                     `restart_after_s`
+- ``drain_restart``  rolling-restart step: graceful drain (checkpoints
+                     stream out to clients), stop, restart after
+                     `restart_after_s`
+- ``breaker_trip``   the fleet's network plan serves N injected 503s from
+                     this replica, tripping its breaker in the picker
+- ``shed_storm``     scale every replica's shed watermark by `factor`
+                     (e.g. 0.1 → sheds start at 10% of normal depth);
+                     ``heal_shed`` restores
+- ``skew``           multiply a replica's stub compute costs by `factor`
+                     (slow replica); ``heal_skew`` restores
+
+Two canned scenarios back the test suite: `smoke_scenario()` runs in
+tier-1 on every PR; `churn_10k_scenario()` is the acceptance-scale trace
+(10k requests, 4 replicas, preemptions + rolling restart + breaker trip
++ shed storm) marked slow.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from .replica import ReplicaSpec
+from .report import SLOBudget
+from .stub import StubCosts
+from .workload import WorkloadConfig
+
+# canned-scenario device: ~10ms prefill launch + 0.2ms/prompt-token,
+# 20ms/decode-step — slow enough that bursts queue, drains catch work in
+# flight, and shed watermarks mean something, while 4-lane batches still
+# clear ~200 tok/s/replica so a 10k-request trace finishes in ~20 virtual
+# minutes.  Virtual slowness is free: wall time scales with EVENTS, not
+# with simulated seconds.
+_CANNED_COSTS = StubCosts(
+    prefill_base_s=0.01, prefill_per_token_s=2e-4, decode_step_s=0.02)
+
+
+def _canned_spec() -> ReplicaSpec:
+    return ReplicaSpec(costs=_CANNED_COSTS)
+
+
+@dataclass
+class ChurnEvent:
+    at_s: float
+    kind: str  # preempt | crash | drain_restart | breaker_trip | shed_storm | heal_shed | skew | heal_skew
+    replica: Optional[str] = None  # e.g. "replica-1" (None = fleet-wide)
+    count: int = 1
+    factor: float = 1.0
+    restart_after_s: float = 2.0
+    # drain_restart only: drain-budget override (None = the replica's
+    # spec default; 0.0 = checkpoint everything in flight immediately —
+    # the hard-preemption end of the rolling-restart spectrum)
+    grace_s: Optional[float] = None
+
+
+@dataclass
+class Scenario:
+    name: str
+    seed: int = 0
+    n_replicas: int = 2
+    spec: ReplicaSpec = field(default_factory=ReplicaSpec)
+    workload: WorkloadConfig = field(default_factory=WorkloadConfig)
+    churn: List[ChurnEvent] = field(default_factory=list)
+    budget: SLOBudget = field(default_factory=SLOBudget)
+    poll_interval_s: float = 0.5
+    # generous client persistence: a shed storm resolves in a few virtual
+    # seconds, and a client that gives up during one is a goodput loss the
+    # scenario is supposed to absorb, not accept
+    client_max_attempts: int = 14
+    client_retry_budget_s: float = 240.0
+
+    def replica_names(self) -> List[str]:
+        return [f"replica-{i}" for i in range(self.n_replicas)]
+
+
+def smoke_scenario(seed: int = 7) -> Scenario:
+    """Small-but-complete: 2 replicas, every workload kind, one
+    deterministic preemption, one graceful drain+restart, one breaker
+    trip, and a shed burst — fast enough for tier-1 on every PR."""
+    return Scenario(
+        name="smoke",
+        seed=seed,
+        n_replicas=2,
+        spec=_canned_spec(),
+        workload=WorkloadConfig(
+            n_requests=60, duration_s=30.0,
+            bursts=[(8.0, 12)],
+        ),
+        churn=[
+            # the burst guarantees in-flight work when the churn lands:
+            # preemptions fire mid-decode, and the zero-grace drain
+            # checkpoints the backlog, which must resume token-exactly on
+            # the other replica
+            ChurnEvent(at_s=7.9, kind="shed_storm", factor=0.3),
+            ChurnEvent(at_s=8.2, kind="preempt", replica="replica-0",
+                       count=2),
+            ChurnEvent(at_s=8.6, kind="drain_restart", replica="replica-0",
+                       restart_after_s=2.0, grace_s=0.0),
+            ChurnEvent(at_s=12.0, kind="heal_shed"),
+            ChurnEvent(at_s=14.0, kind="breaker_trip", replica="replica-1",
+                       count=6),
+            # replica-1 is the only replica serving the burst backlog while
+            # replica-0 drains, so a crash here reliably kills live streams
+            # (retry-from-scratch, not resume) and opens a brief full-fleet
+            # outage the retry layer must ride out
+            ChurnEvent(at_s=9.5, kind="crash", replica="replica-1",
+                       restart_after_s=1.5),
+        ],
+        budget=SLOBudget(
+            p99_ttft_s=20.0, p99_itl_s=2.0, min_goodput=0.9,
+            # the smoke deliberately opens a FULL-fleet outage (crash mid
+            # drain), so its amplification budget is looser than the 2x
+            # the 10k acceptance scenario holds the fleet to
+            max_retry_amplification=3.0, max_shed_fraction=1.0,
+        ),
+    )
+
+
+def churn_10k_scenario(seed: int = 1234) -> Scenario:
+    """The acceptance-scale trace (ISSUE 8): 10k requests over 4 replicas
+    with preemptions, a rolling restart, a crash, a breaker trip, a shed
+    storm and a slow-replica skew — deterministic on CPU, zero real
+    sleeps, assert_slo-hard."""
+    return Scenario(
+        name="churn-10k",
+        seed=seed,
+        n_replicas=4,
+        spec=_canned_spec(),
+        workload=WorkloadConfig(
+            n_requests=10_000, duration_s=1200.0,
+            # the 300s burst IS the shed storm's trigger; the later bursts
+            # guarantee live streams exactly when the rolling restart's
+            # zero-grace drains and the crash land, so checkpoints, resumes
+            # and crash retries fire at scale on every run
+            bursts=[(300.0, 120), (419.5, 40), (479.5, 40), (659.5, 30)],
+        ),
+        churn=[
+            ChurnEvent(at_s=60.0, kind="preempt", replica="replica-0",
+                       count=3),
+            ChurnEvent(at_s=150.0, kind="skew", replica="replica-3",
+                       factor=3.0),
+            ChurnEvent(at_s=240.0, kind="breaker_trip", replica="replica-2",
+                       count=12),
+            ChurnEvent(at_s=300.0, kind="shed_storm", factor=0.25),
+            ChurnEvent(at_s=330.0, kind="heal_shed"),
+            # rolling restart: one replica at a time; zero-grace drains
+            # force checkpoint+resume, the last one lets short streams
+            # finish inside the budget
+            ChurnEvent(at_s=420.0, kind="drain_restart", replica="replica-0",
+                       restart_after_s=5.0, grace_s=0.0),
+            ChurnEvent(at_s=480.0, kind="drain_restart", replica="replica-1",
+                       restart_after_s=5.0, grace_s=0.0),
+            ChurnEvent(at_s=540.0, kind="drain_restart", replica="replica-2",
+                       restart_after_s=5.0, grace_s=1.0),
+            ChurnEvent(at_s=600.0, kind="heal_skew", replica="replica-3"),
+            ChurnEvent(at_s=660.0, kind="crash", replica="replica-3",
+                       restart_after_s=5.0),
+            ChurnEvent(at_s=800.0, kind="preempt", replica="replica-1",
+                       count=3),
+        ],
+        budget=SLOBudget(
+            p99_ttft_s=30.0, p99_itl_s=3.0, min_goodput=0.98,
+            max_retry_amplification=2.0, max_shed_fraction=0.2,
+        ),
+    )

@@ -1,0 +1,268 @@
+"""Cycle-accurate stub device for the fleet simulator.
+
+`build_stub_programs()` returns an object with the exact attribute
+surface of `engine.compiled.CompiledPrograms`, so a real `LLMEngine`
+runs its real admission, batching, chunked prefill, preemption, drain
+and checkpoint logic against it — only the device math is replaced:
+
+- tokens come from a deterministic chain (`stub_first_token` /
+  `stub_next_token`) that is a pure function of prompt length and
+  position, so the SAME stream continues token-exactly across
+  preemption, checkpoint and cross-replica resume — which is what lets
+  the goodput report prove zero lost / zero duplicated tokens without
+  comparing against a second uninterrupted run;
+- compute costs are configurable virtual durations (`StubCosts`)
+  charged to a per-replica `StubDevice` timeline, paid when the engine
+  fetches the result: the decode hot loop awaits them on the SimClock
+  (fleet compute overlaps), sync prefill fetches jump the clock
+  (conservative, one call per admission batch);
+- a `clock_skew` FaultSpec targeting ``<replica>.compute`` (or a direct
+  `device.skew` knob) multiplies costs — the deterministic slow-replica
+  stand-in.
+
+`SimFetcher` replaces the engine's daemon fetch worker with an
+event-loop-thread implementation: thread handoff order is the one piece
+of nondeterminism a byte-identical simulation cannot keep.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+import numpy as np
+
+# token ids the stub emits: a printable-ASCII band, clear of BOS/EOS/PAD
+# (ByteTokenizer reserves 256..258) so streams never hit an accidental
+# EOS and detokenize to readable text
+SAFE_LO = 32
+SAFE_BAND = 64
+
+
+def stub_first_token(prompt_len: int) -> int:
+    """First sampled token for a prompt of `prompt_len` tokens."""
+    return SAFE_LO + (prompt_len * 31 + 17) % SAFE_BAND
+
+
+def stub_next_token(prev: int, pos: int) -> int:
+    """Decode chain: the token decoded at KV position `pos` given the
+    previous token.  Depending only on (prev, pos) is what makes the
+    chain resumable: a checkpointed stream re-seated anywhere continues
+    with exactly the token the uninterrupted stream would have had."""
+    return SAFE_LO + ((prev - SAFE_LO) * 7 + pos * 13 + 29) % SAFE_BAND
+
+
+def expected_stream(prompt_len: int, n_tokens: int) -> List[int]:
+    """The exact token stream a request with `prompt_len` prompt tokens
+    generates — the goodput report's token-accounting oracle."""
+    if n_tokens <= 0:
+        return []
+    out = [stub_first_token(prompt_len)]
+    for k in range(1, n_tokens):
+        out.append(stub_next_token(out[-1], prompt_len + k - 1))
+    return out
+
+
+@dataclass
+class StubCosts:
+    """Virtual compute costs, per compiled-program dispatch."""
+
+    prefill_base_s: float = 2e-3  # fixed launch cost per prefill call
+    prefill_per_token_s: float = 2e-5  # per prompt token in the call
+    decode_step_s: float = 2e-3  # per decode step (chunk = steps_per_sync)
+    inject_s: float = 1e-3  # per KV-injection scatter
+
+
+class StubDevice:
+    """One replica's device timeline: dispatches accumulate `busy_until`,
+    fetches wait for it.  `skew` (set directly or via a clock_skew fault
+    targeting ``<name>.compute``) multiplies every subsequent cost."""
+
+    def __init__(self, name: str, costs: StubCosts, clock):
+        self.name = name
+        self.costs = costs
+        self.clock = clock
+        self.busy_until = 0.0
+        self.skew = 1.0
+        # resilience.FaultPlan shared with the engine (SimReplica wires it)
+        self.fault_plan = None
+        self.dispatches = 0
+
+    def dispatch(self, cost_s: float) -> None:
+        cost = cost_s * self.skew
+        if self.fault_plan is not None:
+            spec = self.fault_plan.decide(f"{self.name}.compute")
+            if spec is not None and spec.kind == "clock_skew":
+                cost *= spec.skew
+        self.dispatches += 1
+        self.busy_until = max(self.busy_until, self.clock.now()) + cost
+
+    def reset(self) -> None:
+        """Fresh device for a restarted replica."""
+        self.busy_until = 0.0
+        self.skew = 1.0
+
+
+class SimFetcher:
+    """Duck-type of engine.types._DeadlineFetcher that runs the fetch thunk
+    on the event-loop thread and pays the stub device's accumulated compute
+    time in virtual seconds: the async path parks on the SimClock (other
+    replicas keep running — fleet overlap), the sync path jumps the clock
+    (the engine's batched-prefill fetch is synchronous by design)."""
+
+    def __init__(self, device: StubDevice, clock):
+        self.device = device
+        self.clock = clock
+
+    def fetch(self, fn, timeout_s: float):
+        out = fn()
+        self.clock.advance_to(self.device.busy_until)
+        return out
+
+    async def fetch_async(self, fn, timeout_s: float):
+        out = fn()
+        await self.clock.sleep_until(self.device.busy_until)
+        return out
+
+    def close(self) -> None:
+        pass
+
+
+class StubPrograms:
+    """CompiledPrograms-shaped set of host-math device programs.
+
+    Every function matches the jitted signature it replaces (see
+    engine/compiled.py) and returns plain numpy arrays — the engine's
+    `_fetch`/`_fetch_async` np.asarray conversion is then a no-op and all
+    cost accounting lives on the StubDevice timeline."""
+
+    def __init__(self, engine_config, device: StubDevice,
+                 vocab_size: int = 512):
+        self._cfg = engine_config
+        self._device = device
+        self._vocab = vocab_size
+        self._K = engine_config.max_logprobs
+        self.prefill = self._make_prefill(False)
+        self.prefill_lp = self._make_prefill(True)
+        self.prefill_chunk = self._prefill_chunk
+        self.sample_first = self._make_sample_first(False)
+        self.sample_first_lp = self._make_sample_first(True)
+        self.decode = self._make_decode(False, False)
+        self.decode_lp = self._make_decode(False, True)
+        self.decode_penalized = self._make_decode(True, False)
+        self.decode_penalized_lp = self._make_decode(True, True)
+        self.inject = self._inject
+        self.inject_q = self._inject_q
+
+    # ---------------- prefill ----------------
+
+    def _charge_prefill(self, valid: np.ndarray) -> None:
+        c = self._device.costs
+        self._device.dispatch(
+            c.prefill_base_s + c.prefill_per_token_s * int(valid.sum()))
+
+    def _lp_zeros(self, *lead):
+        lp = np.zeros(lead, np.float32)
+        tv = np.zeros(lead + (self._K,), np.float32)
+        ti = np.zeros(lead + (self._K,), np.int32)
+        return lp, tv, ti
+
+    def _make_prefill(self, with_logprobs: bool):
+        def fn(params, tokens, valid_len, kv_pages, page_ids, state, rng,
+               adapters):
+            valid = np.asarray(valid_len)
+            self._charge_prefill(valid)
+            # fused prefill carries the whole (uncached) sequence per row,
+            # so the row's total length IS its valid count
+            first = np.asarray(
+                [stub_first_token(int(v)) for v in valid], np.int32)
+            if with_logprobs:
+                return first, self._lp_zeros(valid.shape[0]), kv_pages
+            return first, kv_pages
+
+        return fn
+
+    def _prefill_chunk(self, params, tokens, chunk_start, valid_len,
+                       kv_pages, page_ids, adapters):
+        start = np.asarray(chunk_start)
+        valid = np.asarray(valid_len)
+        self._charge_prefill(valid)
+        # "logits" carry each row's total prefilled length so sample_first
+        # reproduces the fused path's first token exactly: chunk_start +
+        # valid == full sequence length on the final chunk, whether the
+        # prefix came from the cache, earlier chunks, or both
+        return _StubLogits(start + valid), kv_pages
+
+    def _make_sample_first(self, with_logprobs: bool):
+        def fn(logits, state, rng, in_prompt):
+            totals = logits.totals
+            first = np.asarray(
+                [stub_first_token(int(t)) for t in totals], np.int32)
+            if with_logprobs:
+                return first, self._lp_zeros(first.shape[0])
+            return first
+
+        return fn
+
+    # ---------------- decode ----------------
+
+    def _make_decode(self, with_penalties: bool, with_logprobs: bool):
+        def fn(params, tokens, pos, kv_pages, page_table, active, capacity,
+               counters, state, rng, adapters, *penalty_arrays):
+            steps = self._cfg.steps_per_sync
+            tok = np.asarray(tokens)
+            pos_np = np.asarray(pos)
+            act = np.asarray(active)
+            cap = np.asarray(capacity)
+            B = tok.shape[0]
+            self._device.dispatch(self._device.costs.decode_step_s * steps)
+            chunk = np.zeros((steps, B), np.int32)
+            for i in range(B):
+                if not act[i]:
+                    continue
+                prev = int(tok[i])
+                p = int(pos_np[i])
+                limit = int(cap[i])
+                for s in range(steps):
+                    if p + s < limit:
+                        # capacity-capped lanes freeze at their last real
+                        # token (mirrors the jitted program's mask), so a
+                        # chained chunk's tokens_dev row is always the
+                        # correct chain predecessor
+                        prev = stub_next_token(prev, p + s)
+                    chunk[s, i] = prev
+            out = chunk
+            if with_logprobs:
+                out = (chunk,) + self._lp_zeros(steps, B)
+            if with_penalties:
+                # counts array rides through untouched (host penalty state
+                # is refreshed from slot lists, never read back)
+                return out, kv_pages, penalty_arrays[1]
+            return out, kv_pages
+
+        return fn
+
+    # ---------------- KV injection (P/D, tier-store resume) ----------------
+
+    def _inject(self, kv_pages, kv_data, ids):
+        self._device.dispatch(self._device.costs.inject_s)
+        return kv_pages
+
+    def _inject_q(self, kv_pages, q, s, ids):
+        self._device.dispatch(self._device.costs.inject_s)
+        return kv_pages
+
+
+class _StubLogits:
+    """Per-row total prefilled length, standing in for the [B, V] logits
+    the real chunked prefill hands to sample_first."""
+
+    __slots__ = ("totals",)
+
+    def __init__(self, totals: np.ndarray):
+        self.totals = np.asarray(totals, np.int64)
+
+
+def build_stub_programs(engine_config, device: StubDevice,
+                        vocab_size: int = 512) -> StubPrograms:
+    return StubPrograms(engine_config, device, vocab_size=vocab_size)

@@ -1,0 +1,138 @@
+"""Seeded workload generators: timestamped request traces for the fleet.
+
+Each generator draws from one `random.Random(seed)` stream, so a trace is
+a pure function of its config — the first half of the simulator's
+determinism contract (the second half is the SimClock's event ordering).
+
+Kinds (mirroring the serving scenarios the repo targets):
+
+- ``chat``          short prompts sharing a common system-prefix (so the
+                    EPP's prefix-affinity scoring has something to bite
+                    on), small token budgets, per-request deadlines — the
+                    SSE-interactive shape
+- ``long_context``  prompts past max_prefill_len, forcing the engine's
+                    chunked-prefill admission path
+- ``lora``          chat-shaped but pinned to a tenant adapter, which
+                    bypasses the shared prefix cache and rides adapter
+                    identity through checkpoints/resume
+- ``batch``         deadline-free bulk generations with larger budgets,
+                    arriving in bursts — the queue-pressure generator
+                    that makes shed storms and KV preemption happen
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..engine.sampling import SamplingParams
+from .replica import SIM_ADAPTERS
+from .stub import SAFE_BAND, SAFE_LO
+
+# shared chat system prompt: page-aligned so prefix-cache hits are whole
+# pages (page_size 16 in the default ReplicaSpec)
+_SYSTEM_PREFIX_LEN = 16
+
+
+@dataclass
+class SimRequest:
+    """One trace entry: everything a client needs to submit and verify."""
+
+    rid: str
+    kind: str
+    arrival_s: float
+    prompt_ids: List[int]
+    max_tokens: int
+    adapter: Optional[str] = None
+    deadline_s: Optional[float] = None
+
+    def sampling_params(self) -> SamplingParams:
+        # greedy + ignore_eos: the stub chain is deterministic and never
+        # emits EOS, so every completed request finishes "length" with
+        # exactly max_tokens tokens — the token-accounting invariant
+        return SamplingParams(
+            max_tokens=self.max_tokens, temperature=0.0, ignore_eos=True)
+
+
+@dataclass
+class WorkloadConfig:
+    """Mix + rate for one trace.  `mix` weights must cover every kind
+    generated; arrivals spread uniformly over `duration_s` except the
+    optional bursts — (at_s, n) spikes of batch requests in one instant,
+    used both as shed storms and to guarantee in-flight work exactly when
+    a churn event lands (a drain that finds an idle replica proves
+    nothing)."""
+
+    n_requests: int = 200
+    duration_s: float = 60.0
+    mix: Dict[str, float] = field(default_factory=lambda: {
+        "chat": 0.55, "long_context": 0.15, "lora": 0.2, "batch": 0.1,
+    })
+    chat_deadline_s: float = 30.0
+    bursts: Optional[List[tuple]] = None  # [(at_s, n), ...]
+    # bounds every prompt+max_tokens must respect (ReplicaSpec geometry)
+    max_model_len: int = 256
+    max_prefill_len: int = 64
+
+
+def _prompt(rng: random.Random, n: int) -> List[int]:
+    return [SAFE_LO + rng.randrange(SAFE_BAND) for _ in range(n)]
+
+
+def generate_trace(config: WorkloadConfig, seed: int) -> List[SimRequest]:
+    """The seeded trace: requests sorted by (arrival, rid)."""
+    rng = random.Random(seed)
+    system_prefix = _prompt(rng, _SYSTEM_PREFIX_LEN)
+    kinds = sorted(config.mix)
+    weights = [config.mix[k] for k in kinds]
+    out: List[SimRequest] = []
+
+    def build(i: int, kind: str, arrival: float) -> SimRequest:
+        if kind == "chat":
+            prompt = system_prefix + _prompt(rng, rng.randint(4, 24))
+            return SimRequest(
+                rid=f"req-{i:05d}-chat", kind=kind, arrival_s=arrival,
+                prompt_ids=prompt, max_tokens=rng.randint(8, 24),
+                deadline_s=config.chat_deadline_s,
+            )
+        if kind == "long_context":
+            lo = config.max_prefill_len + 8
+            hi = min(config.max_model_len - 40, 3 * config.max_prefill_len)
+            prompt = _prompt(rng, rng.randint(lo, hi))
+            return SimRequest(
+                rid=f"req-{i:05d}-long", kind=kind, arrival_s=arrival,
+                prompt_ids=prompt, max_tokens=rng.randint(4, 12),
+            )
+        if kind == "lora":
+            prompt = _prompt(rng, rng.randint(6, 24))
+            return SimRequest(
+                rid=f"req-{i:05d}-lora", kind=kind, arrival_s=arrival,
+                prompt_ids=prompt, max_tokens=rng.randint(8, 24),
+                adapter=SIM_ADAPTERS[rng.randrange(len(SIM_ADAPTERS))],
+                deadline_s=config.chat_deadline_s,
+            )
+        if kind == "batch":
+            prompt = _prompt(rng, rng.randint(8, 32))
+            return SimRequest(
+                rid=f"req-{i:05d}-batch", kind=kind, arrival_s=arrival,
+                prompt_ids=prompt, max_tokens=rng.randint(24, 48),
+            )
+        raise ValueError(f"unknown workload kind {kind!r}")
+
+    for i in range(config.n_requests):
+        kind = rng.choices(kinds, weights=weights)[0]
+        arrival = round(rng.uniform(0.0, config.duration_s), 6)
+        out.append(build(i, kind, arrival))
+    next_id = config.n_requests
+    for at_s, n in config.bursts or ():
+        for _ in range(n):
+            out.append(build(next_id, "batch", float(at_s)))
+            next_id += 1
+    out.sort(key=lambda r: (r.arrival_s, r.rid))
+    for req in out:
+        if len(req.prompt_ids) + req.max_tokens > config.max_model_len:
+            raise ValueError(
+                f"trace bug: {req.rid} exceeds max_model_len "
+                f"{config.max_model_len}")
+    return out

@@ -53,6 +53,29 @@ async def test_grpc_lifecycle_and_infer():
 
 
 @async_test
+async def test_grpc_expired_deadline_rejected_before_send():
+    """The gRPC client's retry loop gates every send on the propagated
+    deadline (same contract as the REST loop): an already-dead budget is
+    rejected locally — the backend never executes work nobody will read."""
+    from kserve_tpu.errors import InferenceError
+    from kserve_tpu.resilience import Deadline, FakeClock, deadline_scope
+
+    repo = ModelRepository()
+    repo.update(DummyModel())
+    server, port = await start_server(repo)
+    try:
+        clock = FakeClock()
+        dead = Deadline.after(1.0, clock)
+        clock.advance(2.0)
+        async with InferenceGRPCClient(f"127.0.0.1:{port}", timeout=10) as client:
+            with deadline_scope(dead):
+                with pytest.raises(InferenceError, match="deadline"):
+                    await client.is_server_live()
+    finally:
+        await server.stop(None)
+
+
+@async_test
 async def test_grpc_model_not_found():
     repo = ModelRepository()
     repo.update(DummyModel())

@@ -7,9 +7,9 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from kserve_tpu.models.moe import MoEConfig, init_moe_params, moe_mlp, moe_param_pspecs
+from kserve_tpu.parallel.sharding import shard_map
 from kserve_tpu.ops.attention import causal_prefill_attention
 from kserve_tpu.parallel.ring_attention import ring_attention
 

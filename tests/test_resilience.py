@@ -246,6 +246,59 @@ class TestFaultPlan:
         assert plan.decide("http://decode-1:8080/v1/x") is not None
         assert plan.decide("http://decode-2:8080/v1/x") is None
 
+    @async_test
+    async def test_replica_crash_kind_is_connect_refused_in_transport(self):
+        """A crashed process answers nothing: the transport maps the
+        replica_crash kind to a connect error (vs http_status, which is a
+        LIVE server refusing work)."""
+        plan = FaultPlan([FaultSpec("dead", "replica_crash", count=1)])
+        transport = FaultInjectingTransport(plan, clock=FakeClock())
+        async with httpx.AsyncClient(transport=transport) as client:
+            with pytest.raises(httpx.ConnectError, match="crash"):
+                await client.get("http://dead:8080/healthz")
+            # count exhausted: the replacement pod answers
+            ok = await client.get("http://dead:8080/healthz")
+            assert ok.status_code == 200
+
+    @async_test
+    async def test_clock_skew_kind_scales_latency_then_proceeds(self):
+        """clock_skew is a SLOW backend, not a dead one: latency_s scales
+        by the skew factor and the call still succeeds."""
+        clock = FakeClock()
+        plan = FaultPlan([
+            FaultSpec("slow", "clock_skew", latency_s=0.5, skew=4.0,
+                      count=1),
+        ])
+        transport = FaultInjectingTransport(plan, clock=clock)
+        async with httpx.AsyncClient(transport=transport) as client:
+            resp = await client.get("http://slow:8080/v1/x")
+            assert resp.status_code == 200
+            assert clock.sleeps == [2.0]  # 0.5s * skew 4
+
+    @async_test
+    async def test_replica_crash_kind_kills_engine_loop(self):
+        """The engine honors replica_crash at its fetch seam: the run loop
+        dies (no drain, no checkpoint) and every in-flight stream fails —
+        the churn case the fleet simulator's crash events inject."""
+        from test_engine import make_engine
+
+        from kserve_tpu.engine.sampling import SamplingParams
+        from kserve_tpu.resilience import ReplicaCrashError
+
+        engine = make_engine()
+        await engine.start()
+        engine.fault_plan = FaultPlan(
+            [FaultSpec("engine.fetch", "replica_crash", count=1)])
+        with pytest.raises(ReplicaCrashError):
+            async for _ in engine.generate(
+                    [1, 2, 3], SamplingParams(max_tokens=4,
+                                              temperature=0.0)):
+                pass
+        assert not engine.running  # loop is dead, not wedged-but-alive
+        assert not engine.wedged
+        assert engine.checkpointed_count == 0
+        await engine.stop()
+
 
 # ---------------- graph router under chaos ----------------
 
@@ -1138,3 +1191,68 @@ class TestDrainChaos:
         assert len(checkpoints) == 1
         assert caught["ckpt"].generated == received
         await engine.stop()
+
+
+# ---------------- chaos shapes as reusable fleet scenarios ----------------
+
+
+class TestFleetScenarioChaos:
+    """The ad-hoc two-replica setups above (drain -> token-exact resume,
+    breaker trip -> reroute, shed -> recover) rebuilt as ONE reusable
+    fleet-simulator scenario (kserve_tpu/sim, ISSUE 8): the same contracts
+    asserted from a deterministic goodput report instead of hand-wired
+    engine pairs.  The live-compiled-engine proofs above stay — they pin
+    the real device math; this pins the fleet behavior at scale (and
+    test_sim.py's slow 10k trace pins it at 10k)."""
+
+    @async_test
+    async def test_two_replica_chaos_shapes_as_one_scenario(self):
+        from kserve_tpu.metrics import BREAKER_TRANSITIONS
+        from kserve_tpu.sim import (
+            ChurnEvent,
+            FleetSim,
+            Scenario,
+            SLOBudget,
+            WorkloadConfig,
+            assert_slo,
+            canonical_json,
+        )
+        from kserve_tpu.sim.scenario import _canned_spec
+
+        scn = Scenario(
+            name="chaos-2replica", seed=11, n_replicas=2,
+            spec=_canned_spec(),
+            workload=WorkloadConfig(n_requests=40, duration_s=20.0,
+                                    bursts=[(6.0, 10)]),
+            churn=[
+                ChurnEvent(at_s=5.9, kind="shed_storm", factor=0.3),
+                ChurnEvent(at_s=6.4, kind="drain_restart",
+                           replica="replica-0", restart_after_s=1.5,
+                           grace_s=0.0),
+                ChurnEvent(at_s=9.0, kind="heal_shed"),
+                ChurnEvent(at_s=11.0, kind="breaker_trip",
+                           replica="replica-1", count=8),
+            ],
+            budget=SLOBudget(p99_ttft_s=20.0, p99_itl_s=2.0,
+                             min_goodput=0.9,
+                             max_retry_amplification=3.0,
+                             max_shed_fraction=1.0),
+        )
+        opens_before = counter_value(BREAKER_TRANSITIONS, state="open")
+        report = await FleetSim(scn).run()
+        assert_slo(report, scn.budget)
+        # drain -> checkpoint -> token-exact resume on the peer replica
+        assert report["retries"]["preempt_resumes"] > 0
+        assert report["tokens"]["salvaged_via_resume"] > 0
+        assert report["tokens"]["lost"] == 0
+        assert report["tokens"]["duplicated"] == 0
+        # breaker trip rides the PRODUCTION transition metric
+        assert counter_value(
+            BREAKER_TRANSITIONS, state="open") > opens_before
+        # shed storm observed, fleet recovered (every request finished)
+        assert report["retries"]["sheds_observed"] > 0
+        assert report["requests"]["outcomes"].get("completed", 0) \
+            == report["requests"]["submitted"]
+        # reusable = rerunnable: same scenario, byte-identical report
+        report2 = await FleetSim(scn).run()
+        assert canonical_json(report) == canonical_json(report2)

@@ -1,0 +1,117 @@
+"""Retrace budget: the engine's compiled programs must not recompile per
+request (ROADMAP item 2b's first perf-oracle gate, PR 6 satellite note).
+
+`engine_xla_compiles_total{program}` counts jit-cache misses per compiled
+program (engine/compiled.py _CompileCounting).  The known-good budget on
+a multi-request CPU run over one shape bucket is:
+
+- ``prefill``: 2 — the first-request compile plus ONE benign retrace on
+  the second request (the donated kv_pages buffer's layout settles after
+  the first donation round-trip), then never again;
+- ``decode``: 1 — a single compile reused forever (fixed slots are the
+  engine's core design bet).
+
+A growing count at steady state is the recompile alarm: shape-bucket
+drift, weak-type wobble, or a donation mismatch shows up HERE before it
+shows up as tail latency on a chip.  This test pins the budget so the
+benign one-time retrace cannot quietly become a per-request recompile.
+"""
+
+import asyncio
+
+from conftest import async_test
+
+from kserve_tpu.engine.sampling import SamplingParams
+from kserve_tpu.metrics import XLA_COMPILES
+
+
+def compile_counts() -> dict:
+    out = {}
+    for metric in XLA_COMPILES.collect():
+        for s in metric.samples:
+            if s.name.endswith("_total"):
+                out[s.labels["program"]] = int(s.value)
+    return out
+
+
+def delta(base: dict) -> dict:
+    cur = compile_counts()
+    return {
+        k: cur.get(k, 0) - base.get(k, 0)
+        for k in set(cur) | set(base)
+        if cur.get(k, 0) != base.get(k, 0)
+    }
+
+
+class TestRetraceBudget:
+    @async_test
+    async def test_multi_request_run_stays_inside_compile_budget(self):
+        from test_engine import make_engine
+
+        engine = make_engine()
+        await engine.start()
+        try:
+            base = compile_counts()
+            params = SamplingParams(
+                max_tokens=4, temperature=0.0, ignore_eos=True)
+
+            async def run_one(i: int):
+                async for _ in engine.generate([5, 6, 7, 8 + i], params):
+                    pass
+
+            await run_one(0)
+            assert delta(base) == {"prefill": 1, "decode": 1}, (
+                "first request must compile exactly one prefill and one "
+                f"decode program, got {delta(base)}"
+            )
+            await run_one(1)
+            assert delta(base) == {"prefill": 2, "decode": 1}, (
+                "second request is allowed exactly the known benign "
+                "prefill retrace (donated kv_pages layout settles), got "
+                f"{delta(base)}"
+            )
+            # steady state: more same-bucket requests compile NOTHING —
+            # the budget this test exists to freeze
+            for i in range(2, 5):
+                await run_one(i)
+            assert delta(base) == {"prefill": 2, "decode": 1}, (
+                "per-request recompile detected at steady state: "
+                f"{delta(base)}"
+            )
+        finally:
+            await engine.stop()
+
+    @async_test
+    async def test_new_bucket_compiles_once_then_reuses(self):
+        from test_engine import make_engine
+
+        engine = make_engine()
+        await engine.start()
+        try:
+            params = SamplingParams(
+                max_tokens=3, temperature=0.0, ignore_eos=True)
+
+            async def run_one(prompt):
+                async for _ in engine.generate(prompt, params):
+                    pass
+
+            # settle the donation retrace inside the small bucket first
+            await run_one([1] * 4)
+            await run_one([2] * 4)
+            base = compile_counts()
+            # a LONGER prompt crosses into the next prefill bucket (>16):
+            # one fresh prefill compile (+ its one-time donation retrace on
+            # re-use), decode untouched
+            await run_one([3] * 20)
+            first = delta(base)
+            assert first.get("decode", 0) == 0, first
+            assert first.get("prefill", 0) == 1, first
+            await run_one([4] * 20)
+            await run_one([5] * 20)
+            settled = delta(base)
+            assert settled.get("prefill", 0) <= 2, (
+                f"new-bucket prefill kept retracing: {settled}"
+            )
+            assert settled.get("decode", 0) == 0, settled
+        finally:
+            await engine.stop()

@@ -1,0 +1,414 @@
+"""Fleet-scale deterministic simulation (kserve_tpu/sim — ISSUE 8).
+
+Layer tests (SimClock event ordering, stub token chain, stub-backed
+engine correctness incl. cross-replica resume) plus the scenario gates:
+the tier-1 smoke scenario proves every churn leg end-to-end on every PR,
+and the slow-marked 10k-request acceptance scenario proves SLO goodput
+at fleet scale — same seed, byte-identical report, assert_slo hard.
+Everything runs on virtual time: zero real sleeps anywhere.
+"""
+
+import asyncio
+
+import pytest
+
+from conftest import async_test, counter_value
+
+from kserve_tpu.engine.sampling import SamplingParams
+from kserve_tpu.metrics import RETRY_ATTEMPTS
+from kserve_tpu.resilience import FaultPlan, FaultSpec
+from kserve_tpu.sim import (
+    FleetSim,
+    ReplicaSpec,
+    Scenario,
+    SimClock,
+    SimReplica,
+    WorkloadConfig,
+    assert_slo,
+    canonical_json,
+    churn_10k_scenario,
+    expected_stream,
+    generate_trace,
+    run_scenario,
+    smoke_scenario,
+    stub_first_token,
+    stub_next_token,
+)
+from kserve_tpu.sim.report import SLOBudget, SLOViolation, build_report
+
+pytestmark = pytest.mark.sim
+
+
+# ---------------- SimClock: discrete-event virtual time ----------------
+
+
+class TestSimClock:
+    @async_test
+    async def test_concurrent_sleeps_overlap(self):
+        """Two 5s sleeps started together both end at t=5 — virtual
+        compute overlaps instead of serializing (the FakeClock behavior
+        this clock exists to replace)."""
+        clock = SimClock()
+        wakes = []
+
+        async def sleeper(name):
+            await clock.sleep(5.0)
+            wakes.append((name, clock.now()))
+
+        t1 = asyncio.create_task(sleeper("a"))
+        t2 = asyncio.create_task(sleeper("b"))
+        await clock.drive(until=lambda: len(wakes) == 2)
+        assert wakes == [("a", 5.0), ("b", 5.0)]
+        await asyncio.gather(t1, t2)
+
+    @async_test
+    async def test_fire_order_is_deadline_then_registration(self):
+        clock = SimClock()
+        order = []
+
+        async def sleeper(name, s):
+            await clock.sleep(s)
+            order.append(name)
+
+        tasks = [asyncio.create_task(sleeper(n, s))
+                 for n, s in (("late", 3.0), ("early", 1.0), ("tie1", 2.0),
+                              ("tie2", 2.0))]
+        await clock.drive(until=lambda: len(order) == 4)
+        assert order == ["early", "tie1", "tie2", "late"]
+        await asyncio.gather(*tasks)
+
+    @async_test
+    async def test_deadlock_is_reported_not_hung(self):
+        from kserve_tpu.sim import SimDeadlockError
+
+        clock = SimClock()
+        never = asyncio.Event()
+        task = asyncio.create_task(never.wait())
+        with pytest.raises(SimDeadlockError):
+            await clock.drive(until=lambda: False)
+        task.cancel()
+
+
+# ---------------- stub token chain ----------------
+
+
+class TestStubChain:
+    def test_chain_is_position_deterministic(self):
+        a = expected_stream(10, 16)
+        b = [stub_first_token(10)]
+        for k in range(1, 16):
+            b.append(stub_next_token(b[-1], 10 + k - 1))
+        assert a == b
+        # resumable: recomputing the tail from any prefix continues exactly
+        # (token k depends on (token k-1, prompt_len + k - 1))
+        tail = [stub_next_token(a[6], 10 + 6)]
+        for k in range(8, 16):
+            tail.append(stub_next_token(tail[-1], 10 + k - 1))
+        assert a[7:] == tail
+
+    def test_band_avoids_special_tokens(self):
+        toks = expected_stream(3, 64)
+        assert all(32 <= t < 96 for t in toks)  # printable, < BOS/EOS/PAD
+
+
+# ---------------- stub-backed engine: production paths, stub device ----
+
+
+def make_sim_replica(clock=None, **spec_overrides):
+    clock = clock or SimClock()
+    return SimReplica("replica-t", clock, ReplicaSpec(**spec_overrides)), clock
+
+
+class TestStubEngine:
+    @async_test
+    async def test_generates_expected_chain_and_charges_virtual_time(self):
+        replica, clock = make_sim_replica()
+        await replica.start()
+        outs = []
+
+        async def consume():
+            async for out in replica.engine.generate(
+                    [40] * 12, SamplingParams(max_tokens=8, temperature=0.0,
+                                              ignore_eos=True),
+                    request_id="r1"):
+                outs.append(out.token_id)
+
+        task = asyncio.create_task(consume())
+        await clock.drive(until=lambda: task.done())
+        assert outs == expected_stream(12, 8)
+        assert clock.now() > 0.0  # stub costs were paid in virtual time
+        await replica.stop()
+
+    @async_test
+    async def test_long_prompt_takes_chunked_prefill_and_matches_chain(self):
+        replica, clock = make_sim_replica()
+        await replica.start()
+        prompt = [50] * 100  # > max_prefill_len 64 -> chunked admission
+        outs = []
+
+        async def consume():
+            async for out in replica.engine.generate(
+                    prompt, SamplingParams(max_tokens=6, temperature=0.0,
+                                           ignore_eos=True),
+                    request_id="r-long"):
+                outs.append(out.token_id)
+
+        task = asyncio.create_task(consume())
+        await clock.drive(until=lambda: task.done())
+        assert outs == expected_stream(100, 6)
+        await replica.stop()
+
+    @async_test
+    async def test_zero_grace_drain_resumes_token_exact_on_second_replica(self):
+        """The PR 5 drain/resume contract, proven through the simulator
+        seam: checkpoint on replica A mid-generation, splice + continue on
+        replica B, and the result equals the oracle chain exactly."""
+        from kserve_tpu.lifecycle import GenerationPreempted
+
+        clock = SimClock()
+        a = SimReplica("replica-a", clock, ReplicaSpec())
+        b = SimReplica("replica-b", clock, ReplicaSpec(), params=a.params)
+        await a.start()
+        await b.start()
+        params = SamplingParams(max_tokens=24, temperature=0.0,
+                                ignore_eos=True)
+        shown = []
+        caught = {}
+
+        async def consume():
+            try:
+                async for out in a.engine.generate([60, 61, 62], params,
+                                                   request_id="d1"):
+                    shown.append(out.token_id)
+            except GenerationPreempted as exc:
+                caught["ckpt"] = exc.checkpoint
+
+        task = asyncio.create_task(consume())
+        await clock.drive(until=lambda: len(shown) >= 3)
+        drain_task = asyncio.create_task(a.drain(0.0))
+        await clock.drive(until=lambda: drain_task.done() and task.done())
+        ckpt = caught["ckpt"]
+        assert ckpt.generated == shown  # token-exact at handoff
+
+        cont = []
+
+        async def resume():
+            async for out in b.engine.resume_generation(ckpt,
+                                                        request_id="d1~r1"):
+                cont.append(out.token_id)
+
+        rtask = asyncio.create_task(resume())
+        await clock.drive(until=lambda: rtask.done())
+        assert shown + cont == expected_stream(3, 24)
+        await a.stop()
+        await b.stop()
+
+    @async_test
+    async def test_crash_on_idle_replica_survives_restart(self):
+        """An idle-replica crash must not leave its armed replica_crash
+        fault behind: the restarted engine's first fetch would otherwise
+        die, leaving the replica permanently dead (review finding)."""
+        replica, clock = make_sim_replica()
+        replica.set_fault_plan(FaultPlan([]))
+        await replica.start()
+        await replica.crash()  # nothing in flight: the fault never fires
+        assert not replica.alive
+        await replica.restart()
+        assert replica.alive
+        outs = []
+
+        async def consume():
+            async for out in replica.engine.generate(
+                    [41] * 6, SamplingParams(max_tokens=4, temperature=0.0,
+                                             ignore_eos=True),
+                    request_id="after-restart"):
+                outs.append(out.token_id)
+
+        task = asyncio.create_task(consume())
+        await clock.drive(until=lambda: task.done())
+        assert outs == expected_stream(6, 4)  # no landmine fired
+        assert replica.alive
+        await replica.stop()
+
+    @async_test
+    async def test_replica_crash_fault_kills_streams_without_checkpoint(self):
+        replica, clock = make_sim_replica()
+        await replica.start()
+        replica.set_fault_plan(FaultPlan(
+            [FaultSpec("engine.fetch", "replica_crash", after=1, count=1)]))
+        errs = []
+
+        async def consume():
+            try:
+                async for _ in replica.engine.generate(
+                        [33] * 8,
+                        SamplingParams(max_tokens=16, temperature=0.0,
+                                       ignore_eos=True),
+                        request_id="c1"):
+                    pass
+            except RuntimeError as exc:
+                errs.append(exc)
+
+        task = asyncio.create_task(consume())
+        await clock.drive(until=lambda: task.done())
+        assert errs and "crash" in str(errs[0])
+        assert not replica.alive  # the loop died: connection refused
+        assert replica.engine.checkpointed_count == 0
+        await replica.stop()
+
+
+# ---------------- workload determinism ----------------
+
+
+class TestWorkload:
+    def test_trace_is_seed_deterministic(self):
+        cfg = WorkloadConfig(n_requests=50, duration_s=10.0)
+        t1 = generate_trace(cfg, seed=3)
+        t2 = generate_trace(cfg, seed=3)
+        assert [(r.rid, r.arrival_s, r.prompt_ids, r.max_tokens, r.adapter)
+                for r in t1] == [
+               (r.rid, r.arrival_s, r.prompt_ids, r.max_tokens, r.adapter)
+               for r in t2]
+        assert generate_trace(cfg, seed=4)[0].prompt_ids != t1[0].prompt_ids
+        kinds = {r.kind for r in t1}
+        assert {"chat", "long_context", "lora", "batch"} <= kinds
+
+
+# ---------------- scenario gates ----------------
+
+
+class TestSmokeScenario:
+    @async_test
+    async def test_smoke_scenario_slo_and_determinism(self):
+        """Tier-1 gate: the smoke scenario (preempt + zero-grace drain +
+        crash-during-drain + breaker trip + shed storm over 2 replicas)
+        passes its SLO budget, proves token-exact resumes, counts retry
+        amplification, and produces a byte-identical report on re-run."""
+        scn = smoke_scenario()
+        sim_retries_before = counter_value(RETRY_ATTEMPTS, component="sim")
+        report = await FleetSim(scn).run()
+        assert_slo(report, scn.budget)
+        # every churn leg actually fired
+        assert report["retries"]["preempt_resumes"] > 0
+        assert report["retries"]["crash_restarts"] > 0
+        assert report["retries"]["sheds_observed"] > 0
+        assert report["tokens"]["salvaged_via_resume"] > 0
+        assert report["faults_injected"].get("http_status", 0) > 0
+        assert report["tokens"]["lost"] == 0
+        assert report["tokens"]["duplicated"] == 0
+        # the sim's client loop exports the shared amplification counter
+        assert counter_value(
+            RETRY_ATTEMPTS, component="sim") > sim_retries_before
+        # same seed -> byte-identical report (fresh fleet, same virtual
+        # history)
+        report2 = await FleetSim(smoke_scenario()).run()
+        assert canonical_json(report) == canonical_json(report2)
+
+    @async_test
+    async def test_different_seed_changes_report(self):
+        r1 = await FleetSim(smoke_scenario(seed=7)).run()
+        r2 = await FleetSim(smoke_scenario(seed=8)).run()
+        assert canonical_json(r1) != canonical_json(r2)
+
+    def test_misconfigured_churn_fails_at_construction(self):
+        """A bad churn event must fail loudly up front, never silently
+        run a churn-free scenario that still reports green (review
+        finding: background-task exceptions were swallowed)."""
+        from kserve_tpu.sim import ChurnEvent
+
+        scn = smoke_scenario()
+        scn.churn.append(ChurnEvent(at_s=1.0, kind="craash",
+                                    replica="replica-0"))
+        with pytest.raises(ValueError, match="unknown churn kind"):
+            FleetSim(scn)
+        scn2 = smoke_scenario()
+        scn2.churn.append(ChurnEvent(at_s=1.0, kind="preempt",
+                                     replica="replica-99"))
+        with pytest.raises(ValueError, match="unknown replica"):
+            FleetSim(scn2)
+
+    def test_breaker_trip_target_is_name_delimited(self):
+        """replica-1's injected proxy faults must never match replica-10+
+        (FaultPlan matches by substring; review finding)."""
+        from kserve_tpu.resilience import FaultPlan, FaultSpec
+
+        plan = FaultPlan([FaultSpec("replica-1/proxy", "http_status",
+                                    status=503, count=5)])
+        assert plan.decide("replica-10/proxy") is None
+        assert plan.decide("replica-1/proxy") is not None
+
+
+class TestSLOReport:
+    def test_assert_slo_lists_every_breach(self):
+        rec = {
+            "rid": "r", "kind": "chat", "attempts": 5, "sheds": 0,
+            "resumes": 0, "crash_restarts": 0, "no_backend": 0,
+            "outcome": "completed", "n_tokens": 4, "lost_tokens": 2,
+            "duplicated_tokens": 1, "salvaged_tokens": 0,
+            "token_exact": False, "ttft_s": 9.0, "e2e_s": 9.5,
+            "itls": [4.0],
+        }
+        report = build_report("t", 0, [rec], [], [], 10.0)
+        with pytest.raises(SLOViolation) as err:
+            assert_slo(report, SLOBudget(
+                p99_ttft_s=1.0, p99_itl_s=1.0, min_goodput=1.0,
+                max_retry_amplification=2.0))
+        msg = str(err.value)
+        for needle in ("p99 TTFT", "p99 ITL", "goodput", "lost tokens",
+                       "duplicated tokens", "retry amplification"):
+            assert needle in msg
+
+    def test_clean_report_passes(self):
+        rec = {
+            "rid": "r", "kind": "chat", "attempts": 1, "sheds": 0,
+            "resumes": 0, "crash_restarts": 0, "no_backend": 0,
+            "outcome": "completed", "n_tokens": 4, "lost_tokens": 0,
+            "duplicated_tokens": 0, "salvaged_tokens": 0,
+            "token_exact": True, "ttft_s": 0.1, "e2e_s": 0.2,
+            "itls": [0.01],
+        }
+        report = build_report("t", 0, [rec], [], [], 1.0)
+        assert_slo(report, SLOBudget())  # no raise
+
+
+@pytest.mark.slow
+class TestChurn10k:
+    @async_test
+    async def test_10k_churn_trace_meets_slo_deterministically(self):
+        """ISSUE 8 acceptance: a seeded 10k-request trace over 4 replicas
+        under preemptions + rolling restart + crash + breaker trip + shed
+        storm + slow-replica skew runs deterministically on CPU with zero
+        real sleeps; same seed produces an identical goodput report twice;
+        assert_slo holds (p99 TTFT/ITL, zero lost/duplicated tokens via
+        token-exact accounting, retry amplification <= 2x)."""
+        scn = churn_10k_scenario()
+        report = await FleetSim(scn).run()
+        assert report["requests"]["submitted"] >= 10_000
+        assert_slo(report, scn.budget)
+        assert report["tokens"]["lost"] == 0
+        assert report["tokens"]["duplicated"] == 0
+        assert report["retries"]["amplification"] <= 2.0
+        # all four replicas served, every churn leg fired
+        assert all(r["finished"] > 0 for r in report["replicas"])
+        assert report["retries"]["preempt_resumes"] > 0
+        assert report["retries"]["crash_restarts"] > 0
+        assert report["retries"]["sheds_observed"] > 0
+        report2 = await FleetSim(churn_10k_scenario()).run()
+        assert canonical_json(report) == canonical_json(report2)
+
+
+# ---------------- run_scenario convenience ----------------
+
+
+class TestRunScenario:
+    @async_test
+    async def test_tiny_custom_scenario(self):
+        scn = Scenario(
+            name="tiny", seed=1, n_replicas=2,
+            workload=WorkloadConfig(n_requests=12, duration_s=4.0),
+            budget=SLOBudget(p99_ttft_s=30.0, p99_itl_s=5.0,
+                             min_goodput=0.9),
+        )
+        report = await run_scenario(scn)
+        assert report["requests"]["submitted"] == 12
+        assert_slo(report, scn.budget)
