@@ -562,6 +562,110 @@ async def run_latency_sweep(args):
     }
 
 
+async def run_mixed_bench(args):
+    """Mixed mode: drive the unified ragged program with simultaneous
+    prefill-heavy and decode-heavy traffic across a sweep of
+    prefill:decode lane ratios, reporting aggregate tok/s plus TTFT/ITL
+    percentiles per point (engine RequestTimelines are the measurement
+    source).  This is the perf surface of ISSUE 9's single-dispatch mixed
+    batching: decode lanes must keep their ITL while long prompts admit
+    in the same program dispatches (docs/kernels.md)."""
+    import random
+
+    import jax
+
+    from kserve_tpu.engine.engine import EngineConfig, LLMEngine
+    from kserve_tpu.engine.sampling import SamplingParams
+    from kserve_tpu.engine.tokenizer import ByteTokenizer
+    from kserve_tpu.models.llama import LlamaConfig
+    from kserve_tpu.observability import TimelineRecorder
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        model_config = LlamaConfig.bench_1b()
+        engine_config = EngineConfig(
+            max_batch_size=48, page_size=16, num_pages=4096,
+            max_pages_per_seq=64, max_prefill_len=512,
+            prefill_buckets=(128, 256, 512), dtype="bfloat16",
+            use_pallas=None, steps_per_sync=64, prefill_batch=16,
+        )
+        long_len, short_len, max_tokens, warmup = 448, 32, 128, 12
+        n_requests = args.requests or 96
+    else:  # CPU smoke so the sweep is runnable anywhere
+        model_config = LlamaConfig.tiny(dtype="float32")
+        engine_config = EngineConfig(
+            max_batch_size=4, page_size=8, num_pages=256,
+            max_pages_per_seq=32, max_prefill_len=32,
+            prefill_buckets=(16, 32), dtype="float32", use_pallas=False,
+            steps_per_sync=4, prefill_batch=4,
+        )
+        long_len, short_len, max_tokens, warmup = 96, 8, 16, 2
+        n_requests = args.requests or 12
+    ratios = [(1, 3), (1, 1), (3, 1)]  # prefill-heavy : decode-heavy
+
+    tokenizer = ByteTokenizer(model_config.vocab_size)
+    engine = LLMEngine(model_config, engine_config, tokenizer, rng_seed=0)
+    assert engine._use_mixed, "mixed bench requires the unified program"
+    await engine.start()
+    rng = random.Random(0)
+
+    def prompt(n):
+        return [rng.randrange(3, 255) for _ in range(n)]
+
+    params = SamplingParams(max_tokens=max_tokens, temperature=0.0,
+                            ignore_eos=True)
+
+    async def one(n_prompt):
+        count = 0
+        async for out in engine.generate(prompt(n_prompt), params):
+            count = out.num_generated
+        return count
+
+    def fmt(p):
+        return {k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in p.items()}
+
+    await asyncio.gather(*[one(short_len) for _ in range(warmup)])
+    points = []
+    for p_share, d_share in ratios:
+        engine.telemetry = TimelineRecorder()
+        n_long = max(1, n_requests * p_share // (p_share + d_share))
+        n_short = max(1, n_requests - n_long)
+        start = time.perf_counter()
+        counts = await asyncio.gather(
+            *[one(long_len) for _ in range(n_long)],
+            *[one(short_len) for _ in range(n_short)],
+        )
+        elapsed = time.perf_counter() - start
+        snap = engine.telemetry.snapshot(max_recent=0)
+        point = {
+            "ratio": f"{p_share}:{d_share}",
+            "long_prompts": n_long,
+            "short_prompts": n_short,
+            "throughput_tok_s": round(sum(counts) / elapsed, 2),
+            "elapsed_s": round(elapsed, 3),
+            "ttft_s": fmt(snap["ttft_s"]),
+            "itl_s": fmt(snap["itl_s"]),
+            "last_step_composition": dict(engine.last_step_composition),
+        }
+        points.append(point)
+        _PARTIAL[f"mixed_{p_share}_{d_share}"] = point
+    await engine.stop()
+    return {
+        "metric": ("llama3_1b_mixed_ratio_sweep" if on_tpu
+                   else "tiny_mixed_ratio_sweep_cpu_smoke"),
+        "unit": "s",
+        "mode": "mixed",
+        "detail": {
+            "long_prompt_len": long_len,
+            "short_prompt_len": short_len,
+            "max_tokens": max_tokens,
+            "backend": jax.default_backend(),
+        },
+        "points": points,
+    }
+
+
 def build_arg_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="bench.py",
@@ -569,11 +673,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     "appended to MEASUREMENTS.md)",
     )
     parser.add_argument(
-        "--mode", choices=("throughput", "latency"), default="throughput",
+        "--mode", choices=("throughput", "latency", "mixed"),
+        default="throughput",
         help="throughput: headline aggregate tok/s/chip (default, the "
              "driver contract).  latency: concurrency sweep reporting "
              "TTFT/inter-token-latency/queue-wait percentiles and the "
-             "throughput-vs-latency curve from engine RequestTimelines",
+             "throughput-vs-latency curve from engine RequestTimelines.  "
+             "mixed: prefill:decode lane-ratio sweep through the unified "
+             "ragged program (tok/s + TTFT/ITL per ratio)",
     )
     parser.add_argument(
         "--concurrency", default="",
@@ -600,6 +707,8 @@ if __name__ == "__main__":
     attempts = _preflight()
     if cli_args.mode == "latency":
         result = asyncio.run(run_latency_sweep(cli_args))
+    elif cli_args.mode == "mixed":
+        result = asyncio.run(run_mixed_bench(cli_args))
     else:
         result = asyncio.run(run_bench())
     if attempts:

@@ -11,5 +11,6 @@ from . import (  # noqa: F401
     excepts,
     hostsync,
     pspec,
+    ragged,
     recompile,
 )

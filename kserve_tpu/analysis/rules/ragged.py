@@ -1,0 +1,90 @@
+"""Rule ``ragged-metadata-host-sync``: host reads of ragged packing
+metadata inside jit-traced code.
+
+The unified ragged program (docs/kernels.md) threads per-sequence packing
+metadata — q_start / q_len / kv_start, the per-token token_seq /
+token_pos, and the kernel's block_seq / block_qoff — through traced code
+as device arrays.  Calling ``.item()`` / ``int()`` / ``float()`` on them
+(or ``.tolist()``, which the generic host-sync rule already flags) forces
+a device->host sync per dispatch, serializing the TPU against the Python
+thread exactly where the mixed program is hottest.  Derive per-token
+views ON DEVICE (ops/attention.ragged_token_metadata) and keep the host
+copy of the metadata in the numpy planning arrays the engine builds
+before dispatch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import FileContext, Finding, Rule, register
+
+#: metadata names covered by the ragged packing contract (docs/kernels.md)
+RAGGED_METADATA_NAMES = {
+    "q_start", "q_len", "kv_start", "token_seq", "token_pos",
+    "block_seq", "block_qoff", "last_idx",
+}
+
+_SCALAR_CASTS = {"int", "float", "bool"}
+
+
+def _base_name(node: ast.AST):
+    """The identifier a metadata access hangs off: `q_start`,
+    `meta.q_start`, `q_start[i]` all resolve to "q_start"."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+@register
+class RaggedMetadataHostSync(Rule):
+    id = "ragged-metadata-host-sync"
+    description = (
+        ".item()/int()/float() on ragged packing metadata inside a "
+        "jit-traced function: a per-dispatch device->host sync on the "
+        "mixed program's hot path"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for fn in ctx.traced_functions():
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for root in body:
+                for node in ast.walk(root):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    # <metadata>.item()
+                    if (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item"
+                        and not node.args
+                        and _base_name(node.func.value)
+                        in RAGGED_METADATA_NAMES
+                    ):
+                        yield self.finding(
+                            ctx, node,
+                            f"{_base_name(node.func.value)}.item() inside "
+                            "a jit-traced function syncs ragged packing "
+                            "metadata to the host; keep it on device "
+                            "(ops/attention.ragged_token_metadata)",
+                        )
+                        continue
+                    # int(<metadata>) / float(<metadata>) / bool(...)
+                    if (
+                        isinstance(node.func, ast.Name)
+                        and node.func.id in _SCALAR_CASTS
+                        and len(node.args) == 1
+                        and _base_name(node.args[0])
+                        in RAGGED_METADATA_NAMES
+                    ):
+                        yield self.finding(
+                            ctx, node,
+                            f"{node.func.id}() on ragged packing metadata "
+                            "inside a jit-traced function is a "
+                            "device->host sync; plan on the host (numpy) "
+                            "or derive on device",
+                        )

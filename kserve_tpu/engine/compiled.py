@@ -57,6 +57,14 @@ def _counted(**named) -> dict:
 
 @dataclass(frozen=True)
 class CompiledPrograms:
+    """The engine's device programs.  `mixed` is the unified ragged
+    prefill+decode program (docs/kernels.md) the engine dispatches by
+    default; the remaining entries are the legacy per-path programs, kept
+    as the fallback behind EngineConfig.use_ragged=False (and for the
+    feature corners mixed doesn't cover yet: per-step logprobs, penalties,
+    P/D detached prefill, pp>1/sp>1).  jit is lazy, so unused legacy
+    programs cost nothing at steady state."""
+
     prefill: Callable
     prefill_lp: Callable
     prefill_chunk: Callable
@@ -68,11 +76,46 @@ class CompiledPrograms:
     decode_penalized_lp: Callable
     inject: Callable
     inject_q: Callable
+    mixed: Callable = None  # None when the config can't build it (pp>1)
 
 
 def build_compiled(model_config, engine_config, mesh) -> CompiledPrograms:
     cfg = engine_config
     mc = model_config
+
+    from jax.sharding import PartitionSpec as _P
+
+    _quantized = getattr(cfg, "kv_quant", None) == "int8"
+
+    def _kv_pin(kv_pages):
+        """Constrain returned kv_pages to the canonical cache sharding.
+
+        Without this, XLA is free to return the donated cache with a
+        differently-SPELLED (equivalent) sharding — observed on CPU: the
+        init arrays carry PartitionSpec(None, None, 'model', None, None)
+        but the program output comes back as PartitionSpec(), so the
+        SECOND dispatch sees a new input signature and recompiles once
+        per program ("the donated kv_pages layout settles", PR 6/7 note).
+        Pinning the output spec makes call 2's signature identical to
+        call 1's: every program compiles exactly once per shape bucket
+        (pinned by tests/test_retrace_budget.py)."""
+        if cfg.pp > 1:
+            # no constraint under pp: the staged shard_map is manual over
+            # `pipe`, and adding a GSPMD constraint to its output makes
+            # this jax's partitioner reject the module (PartitionId under
+            # SPMD).  pp keeps the benign one-time settle retrace instead.
+            return kv_pages
+        page_s = shd.named(mesh, shd.kv_pages_pspec())
+        scale_s = shd.named(mesh, _P(None, None, shd.MODEL_AXIS, None))
+        if _quantized:
+            return [
+                (jax.lax.with_sharding_constraint(p, page_s),
+                 jax.lax.with_sharding_constraint(s, scale_s))
+                for p, s in kv_pages
+            ]
+        return [
+            jax.lax.with_sharding_constraint(p, page_s) for p in kv_pages
+        ]
 
     # the pallas kernel has no GSPMD partitioning rule; under tp/sp>1
     # decode attention runs under shard_map over the model axis instead
@@ -87,11 +130,26 @@ def build_compiled(model_config, engine_config, mesh) -> CompiledPrograms:
             mesh,
             logit_softcap=mc.attn_logit_softcap,
             use_pallas=cfg.use_pallas,
-            quantized=(getattr(cfg, "kv_quant", None) == "int8"),
+            quantized=_quantized,
             scale=mc.attn_scale,
             # static: only windowed models thread the per-layer scalar
             # through (a traced window forces the gather path)
             windowed=mc.sliding_window > 0,
+        )
+
+    # same shard_map seam for the RAGGED attention in the mixed program:
+    # q heads and KV heads shard together over the model axis, packing
+    # metadata is replicated (ops/attention.make_sharded_ragged_attention)
+    ragged_attention_fn = None
+    if cfg.tp > 1 or cfg.sp > 1:
+        from ..ops.attention import make_sharded_ragged_attention
+
+        ragged_attention_fn = make_sharded_ragged_attention(
+            mesh,
+            logit_softcap=mc.attn_logit_softcap,
+            use_pallas=cfg.use_pallas,
+            quantized=_quantized,
+            scale=mc.attn_scale,
         )
 
     attention_fn = None
@@ -173,6 +231,7 @@ def build_compiled(model_config, engine_config, mesh) -> CompiledPrograms:
                 in_prompt,
             )
             first = sample_tokens(logits, state, rng)
+            kv_pages = _kv_pin(kv_pages)
             if with_logprobs:
                 lp, tv, ti = compute_logprobs(logits, first, cfg.max_logprobs)
                 return first, (lp, tv, ti), kv_pages
@@ -252,8 +311,8 @@ def build_compiled(model_config, engine_config, mesh) -> CompiledPrograms:
             rngs = jax.random.split(rng, steps)
             carry, out = jax.lax.scan(body, init, rngs)
             if with_penalties:
-                return out, carry[3], carry[4]
-            return out, carry[3]
+                return out, _kv_pin(carry[3]), carry[4]
+            return out, _kv_pin(carry[3])
 
         return fn
 
@@ -264,40 +323,109 @@ def build_compiled(model_config, engine_config, mesh) -> CompiledPrograms:
         one stacked [L, ...] array (layer axis on pipe) and the payload
         arrives in the same layout, so one scatter covers every stage."""
         if cfg.pp > 1:
-            return kv_pages.at[:, ids].set(kv_data.astype(kv_pages.dtype))
-        return [
+            return _kv_pin(
+                kv_pages.at[:, ids].set(kv_data.astype(kv_pages.dtype)))
+        return _kv_pin([
             layer.at[ids].set(kv_data[i].astype(layer.dtype))
             for i, layer in enumerate(kv_pages)
-        ]
+        ])
 
     def _inject_q(kv_pages, q, s, ids):
         """Quantized-cache variant: scatter int8 pages AND their
         scales (tier-store resume over kv_quant=int8)."""
         if cfg.pp > 1:
             pages, scales = kv_pages
-            return (pages.at[:, ids].set(q.astype(pages.dtype)),
-                    scales.at[:, ids].set(s.astype(scales.dtype)))
-        return [
+            return _kv_pin((pages.at[:, ids].set(q.astype(pages.dtype)),
+                            scales.at[:, ids].set(s.astype(scales.dtype))))
+        return _kv_pin([
             (pages.at[ids].set(q[i].astype(pages.dtype)),
              scales.at[ids].set(s[i].astype(scales.dtype)))
             for i, (pages, scales) in enumerate(kv_pages)
-        ]
+        ])
 
     def _prefill_chunk(params, tokens, chunk_start, valid_len, kv_pages,
                        page_ids, adapter_ids):
         if cfg.pp > 1:
             # staged chunked prefill: unlocks long prompts AND prefix-
             # cache hits under pipeline parallelism
-            return llama.prefill_chunk_pp(
+            logits, kv_pages = llama.prefill_chunk_pp(
                 params, mc, tokens, chunk_start, valid_len, kv_pages,
                 page_ids, cfg.page_size, mesh,
                 _pp_microbatches(tokens.shape[0]),
                 adapter_ids=adapter_ids,
             )
-        return llama.prefill_chunk(
-            params, mc, tokens, chunk_start, valid_len, kv_pages,
-            page_ids, cfg.page_size, adapter_ids=adapter_ids,
-        )
+        else:
+            logits, kv_pages = llama.prefill_chunk(
+                params, mc, tokens, chunk_start, valid_len, kv_pages,
+                page_ids, cfg.page_size, adapter_ids=adapter_ids,
+            )
+        return logits, _kv_pin(kv_pages)
+
+    def _make_mixed():
+        """THE unified ragged program (docs/kernels.md): one dispatch
+        serves an arbitrary mix of prompt chunks and decode lanes.
+
+        Step 0 runs llama.forward_ragged over the packed token buffer —
+        prompt chunks write their KV and decode lanes advance in the SAME
+        causal-masked attention — then samples one token per lane (a
+        finishing prompt's first token; a decode lane's next token).  The
+        remaining steps_per_sync-1 steps are a standard decode scan over
+        every lane host-side planning marked `joins`: decode lanes AND
+        lanes whose prompt just completed, so a short request can prefill
+        and decode its whole budget in one dispatch.  Lanes mid-chunk sit
+        the scan out (joins=False); resumes override the scan's first
+        token with their last generated token (scan_tok0 >= 0) since the
+        ragged sample at a re-prefill boundary is discarded.
+
+        Emits [steps, B] tokens like the legacy decode program; the host
+        consumes per-lane windows (engine._route_mixed)."""
+
+        def fn(params, q_tokens, token_seq, token_pos, q_start, q_len,
+               kv_start, last_idx, kv_pages, page_table, joins, scan_tok0,
+               scan_pos0, step0_emits, capacity, counters, state, rng,
+               adapter_ids):
+            steps = cfg.steps_per_sync
+            rngs = jax.random.split(rng, steps)
+            logits, kv_pages = llama.forward_ragged(
+                params, mc, q_tokens, token_seq, token_pos,
+                q_start, q_len, kv_start, kv_pages, page_table,
+                cfg.page_size, last_idx,
+                adapter_ids=adapter_ids,
+                attention_fn=ragged_attention_fn,
+                use_pallas=cfg.use_pallas,
+            )
+            sampled0 = sample_tokens(logits, state, rngs[0], counters)
+            tokens0 = jnp.where(scan_tok0 >= 0, scan_tok0, sampled0)
+            counters0 = counters + step0_emits
+
+            def body(carry, step_rng):
+                tokens, pos, counters, kv_pages = carry
+                live = joins & (pos < capacity)
+                logits, kv_pages = llama.decode_step(
+                    params, mc, tokens, pos, kv_pages, page_table, live,
+                    cfg.page_size, use_pallas=cfg.use_pallas,
+                    adapter_ids=adapter_ids,
+                    attention_fn=decode_attention_fn,
+                )
+                nxt = sample_tokens(logits, state, step_rng, counters)
+                nxt = jnp.where(live, nxt, tokens)
+                return (
+                    nxt,
+                    pos + live.astype(pos.dtype),
+                    counters + live.astype(counters.dtype),
+                    kv_pages,
+                ), nxt
+
+            if steps > 1:
+                init = (tokens0, scan_pos0, counters0, kv_pages)
+                carry, scan_out = jax.lax.scan(body, init, rngs[1:])
+                out = jnp.concatenate([sampled0[None], scan_out], axis=0)
+                kv_pages = carry[3]
+            else:
+                out = sampled0[None]
+            return out, _kv_pin(kv_pages)
+
+        return fn
 
     def _make_sample_first(with_logprobs: bool):
         def fn(logits, state, rng, in_prompt):
@@ -319,7 +447,13 @@ def build_compiled(model_config, engine_config, mesh) -> CompiledPrograms:
         return fn
 
     n_kv_args = 3  # kv_pages is arg index 3 in the prefill/decode sigs
-    return CompiledPrograms(**_counted(
+    extra = {}
+    if cfg.pp == 1:
+        # the mixed program runs the flat per-layer forward; pp>1 engines
+        # keep the staged legacy programs (use_ragged forces off there)
+        extra = _counted(
+            mixed=jax.jit(_make_mixed(), donate_argnums=(8,)))
+    return CompiledPrograms(**extra, **_counted(
         prefill=jax.jit(_make_prefill(False), donate_argnums=(n_kv_args,)),
         prefill_lp=jax.jit(_make_prefill(True), donate_argnums=(n_kv_args,)),
         prefill_chunk=jax.jit(_prefill_chunk, donate_argnums=(4,)),

@@ -335,7 +335,7 @@ class LLMEngine:
                         dataclasses.replace(cache_cfg, dtype="int8")),
                     self.mesh
                 )
-                scale_sharding = shd.named(
+                scale_sharding = shd.named_canonical(
                     self.mesh,
                     jax.sharding.PartitionSpec(None, None, shd.MODEL_AXIS, None),
                 )
@@ -343,6 +343,9 @@ class LLMEngine:
                 self.kv_pages = list(zip(pages, scales))
         elif engine_config.pp > 1:
             # pipeline mode: one stacked [L, ...] array, layer axis on pipe
+            # NOT canonicalized (unlike the flat cache): the staged pp
+            # shard_map needs the explicit full-rank spec on this jax, and
+            # pp keeps its benign one-time settle retrace anyway
             self.kv_pages = jax.device_put(
                 jnp.zeros(stacked_shape, jnp.dtype(cache_cfg.dtype)),
                 shd.named(self.mesh, shd.stacked_kv_pages_pspec()),
@@ -417,7 +420,57 @@ class LLMEngine:
         # preemption choice (and therefore its whole report) would hinge on
         # a tie-break
         self._admission_seq = 0.0
+        # packed-slice alignment: the Pallas ragged kernel walks BQ-token
+        # blocks that each belong to ONE sequence, so slices must start at
+        # BQ multiples wherever the kernel can be selected; the XLA
+        # reference packs densely
+        from ..ops.attention import _should_use_ragged_pallas
+        from ..ops.pallas_paged_attention import RAGGED_BQ
+
+        kernel_possible = engine_config.use_pallas or (
+            engine_config.use_pallas is None
+            and _should_use_ragged_pallas(
+                model_config.head_dim, jax.default_backend())
+        )
+        self._ragged_align = RAGGED_BQ if kernel_possible else 1
+        # unified ragged program (docs/kernels.md): resolve the use_ragged
+        # knob against what the topology supports.  A pure-decode mixed
+        # step packs max_batch_size aligned single-token slices, so the
+        # largest prefill bucket must cover the batch.
+        mixed_ok = (
+            engine_config.pp == 1
+            and engine_config.sp == 1
+            and engine_config.max_batch_size * self._ragged_align
+            <= engine_config.prefill_buckets[-1]
+        )
+        if engine_config.use_ragged and not mixed_ok:
+            raise NotImplementedError(
+                "use_ragged=True requires pp==1, sp==1 and max_batch_size "
+                "(x the kernel's block alignment) <= the largest prefill "
+                "bucket; set use_ragged=None/False for this topology"
+            )
+        self._use_mixed = (
+            mixed_ok if engine_config.use_ragged is None
+            else bool(engine_config.use_ragged)
+        )
+        # per-step mixed composition (prefill-token vs decode-token counts)
+        # — exported via ENGINE_STEP_BATCH_COMPOSITION and inspectable by
+        # tests/the telemetry endpoint
+        self.last_step_composition: Dict[str, int] = {}
         self._build_compiled(compiled_programs)
+        if self._mixed_fn is None and self._use_mixed:
+            if engine_config.use_ragged:
+                # an EXPLICIT opt-in must not silently serve the legacy
+                # dispatch behavior (different compile-count budget and
+                # batching) — same contract as the topology gate above
+                raise NotImplementedError(
+                    "use_ragged=True but the compiled program set has no "
+                    "`mixed` program (pre-ragged stub or pp build)"
+                )
+            logger.info(
+                "ragged mixed program unavailable in this program set; "
+                "falling back to the legacy dispatch paths")
+            self._use_mixed = False
 
     # ---------------- compiled programs ----------------
 
@@ -447,6 +500,9 @@ class LLMEngine:
         self._decode_penalized_lp_fn = p.decode_penalized_lp
         self._inject_fn = p.inject
         self._inject_q_fn = p.inject_q
+        # the unified ragged program; absent on program sets that predate
+        # it (or pp>1 builds), which forces the legacy dispatch paths
+        self._mixed_fn = getattr(p, "mixed", None)
 
     # ---------------- public API ----------------
 
@@ -1195,31 +1251,32 @@ class LLMEngine:
                 # is failed upfront — seating it would burn prefill+decode
                 # on an answer nobody is waiting for
                 self._drop_expired_waiting()
-                # admission: prefill waiting requests into free slots,
-                # batched so one compiled call covers many prompts.  Paused
+                # admission: seat waiting requests into free slots.  Paused
                 # while draining — anything queued (including KV-pressure
                 # preemptions) belongs to drain()'s checkpoint flush, not a
-                # re-seat on a replica that is going away.
+                # re-seat on a replica that is going away.  Under the
+                # unified ragged program admission is pure bookkeeping
+                # (every request enters as a prefilling slot; its chunks
+                # ride the next mixed dispatches); the legacy path
+                # dispatches the batched prefill program here.
+                admit = self._admit_mixed if self._use_mixed else self._admit_batch
                 while (not self._draining and self._waiting
                        and self._free_slot_index() is not None):
-                    if not self._admit_batch():
+                    if not admit():
                         break
                     did_work = True
                 self._set_queue_gauge()
-                if self._advance_prefills():
-                    did_work = True
-                active = [
-                    s for s in self._slots
-                    if s.request_id is not None and s.prefilling is None
-                ]
-                ENGINE_BATCH_OCCUPANCY.labels(model_name=self._mlabel).set(len(active))
-                ENGINE_KV_PAGES_FREE.labels(model_name=self._mlabel).set(
-                    self.allocator.free_pages
-                )
-                self._set_composition_gauge(len(active))
-                if active:
-                    await self._decode_once()
-                    did_work = True
+                if self._use_mixed:
+                    if await self._step_mixed():
+                        did_work = True
+                else:
+                    if self._advance_prefills():
+                        did_work = True
+                    active = self._active_decode_slots()
+                    self._set_occupancy_gauges(active)
+                    if active:
+                        await self._decode_once()
+                        did_work = True
                 if not did_work:
                     self._wake.clear()
                     await self._wake.wait()
@@ -1228,10 +1285,14 @@ class LLMEngine:
                     await asyncio.sleep(0)
         except Exception as e:  # noqa: BLE001 — engine death must surface
             logger.exception("engine loop crashed")
+            self._pipeline_busy = False  # frees must not defer post-mortem
             for slot in self._slots:
                 if slot.request_id is not None:
                     slot.queue.put_nowait(e)
                     self._record_terminal(slot.timeline, "error")
+                    # release the seat's pages: the allocator outlives the
+                    # loop (stop() can no longer evict a reset slot)
+                    self._free_pages(slot.pages)
                     slot.reset()
             for req in self._waiting:
                 req.queue.put_nowait(e)
@@ -1495,13 +1556,48 @@ class LLMEngine:
         """Pages reused via the prefix cache (observability/tests)."""
         return self._prefix_cache.hits
 
+    def _active_decode_slots(self) -> List[_Slot]:
+        return [
+            s for s in self._slots
+            if s.request_id is not None and s.prefilling is None
+        ]
+
+    def _set_occupancy_gauges(self, active: List[_Slot]) -> None:
+        ENGINE_BATCH_OCCUPANCY.labels(model_name=self._mlabel).set(len(active))
+        ENGINE_KV_PAGES_FREE.labels(model_name=self._mlabel).set(
+            self.allocator.free_pages
+        )
+        self._set_composition_gauge(len(active))
+
+    def _admit_mixed(self) -> bool:
+        """Admission under the unified ragged program: requests with
+        host-resident KV (P/D transfer, tier-store resume) take the inject
+        path; everything else seats as a prefilling slot whose chunks —
+        whether one covering the whole prompt or many — ride the mixed
+        dispatches.  No prefill program runs here."""
+        req = self._waiting[0]
+        has_kv = req.kv_data is not None or (
+            req.resume is not None and req.resume["kv"] is not None
+        )
+        if has_kv:
+            return self._admit_injected(req)
+        return self._admit_prefilling(req)
+
     def _admit_chunked(self, req: "_QueuedRequest",
                        hits: Optional[List[int]] = None) -> bool:
-        """Admit one long-prompt request by chunked prefill: the prompt
-        prefills max_prefill_len-sized chunks into its pages, each chunk
-        attending to the cached history (ops/attention.py
-        chunked_prefill_attention).  Unblocks prompts up to max_model_len
-        without sequence parallelism."""
+        """Admit one long-prompt request by chunked prefill (legacy path:
+        the run loop advances its chunks through the prefill_chunk
+        program).  Unblocks prompts up to max_model_len without sequence
+        parallelism."""
+        return self._admit_prefilling(req, hits)
+
+    def _admit_prefilling(self, req: "_QueuedRequest",
+                          hits: Optional[List[int]] = None) -> bool:
+        """Seat one request as a prefilling slot: allocate its pages (with
+        prefix-cache hits pinned), pop it from the queue, and record the
+        chunk cursor.  Shared by the legacy chunked admission and by EVERY
+        mixed-mode admission (where even short prompts are a single chunk
+        riding the next mixed dispatch)."""
         idx = self._free_slot_index()
         if idx is None:
             return False
@@ -1531,8 +1627,15 @@ class LLMEngine:
         # this sequence reads them)
         self.allocator.share(cached)
         fresh_needed = need - len(cached)
+        # decode headroom only for genuinely long admissions (many chunks
+        # in flight before first token) — a short mixed-mode admission
+        # must not demand more pages than the legacy batched path did
+        headroom = (
+            total - len(cached) * self.config.page_size
+            > self.config.prefill_buckets[-1]
+        )
         if not self._prefix_cache.ensure_allocatable(
-            self._admission_pages(req, fresh_needed, headroom=True)
+            self._admission_pages(req, fresh_needed, headroom=headroom)
         ):
             self.allocator.free(cached)  # release the early reference
             return False
@@ -1622,23 +1725,36 @@ class LLMEngine:
                 progressed = True
         return progressed
 
-    def _finish_prefilling(self, idx: int, slot: _Slot, pf: dict) -> None:
-        req = pf["req"]
-        seq = pf["seq"]
+    def _complete_prefilling(self, idx: int, slot: _Slot, req,
+                             first_token: Optional[int],
+                             lp: tuple = (None, None)) -> None:
+        """A prefilling slot's prompt is fully in the cache: seat it and
+        (fresh path) emit its first token.  The single completion path
+        shared by the legacy chunk loop (_finish_prefilling, which samples
+        the token itself) and the mixed route (where the token is the
+        dispatch's step-0 sample) — the two dispatchers must not drift."""
         pages = slot.pages
-        total = len(seq)
-        PROMPT_TOKENS.labels(model_name=self._mlabel).inc(
-            total if req.resume is None else 0
-        )
-        if req.adapter_id < 0 and req.resume is not None:
-            # non-resume prompts registered incrementally per chunk; the
-            # resume path registers its prompt prefix once here
-            self._prefix_cache.register(req.prompt_ids, pages)
         slot.prefilling = None
         if req.resume is not None:
+            if req.adapter_id < 0:
+                # non-resume prompts registered incrementally per chunk;
+                # the resume path registers its prompt prefix once here
+                self._prefix_cache.register(req.prompt_ids, pages)
             self._seat_resumed(slot, req, pages)
             self._mark_penalty_dirty(idx)
             return
+        PROMPT_TOKENS.labels(model_name=self._mlabel).inc(
+            len(req.prompt_ids))
+        self._seat_fresh(slot, req, pages, first_token)
+        self._mark_penalty_dirty(idx)
+        self._emit(slot, first_token, *lp)
+
+    def _finish_prefilling(self, idx: int, slot: _Slot, pf: dict) -> None:
+        req = pf["req"]
+        if req.resume is not None:
+            self._complete_prefilling(idx, slot, req, None)
+            return
+        seq = pf["seq"]
         state = SamplingState.from_params([req.params])
         rng = jax.random.fold_in(self._base_rng, self._next_step())
         in_prompt = np.zeros((1, self.model_config.vocab_size), bool)
@@ -1654,9 +1770,9 @@ class LLMEngine:
                 pf["logits"], state, rng, jnp.asarray(in_prompt)
             )
         first_token = int(self._fetch(first)[0])
-        self._seat_fresh(slot, req, pages, first_token)
-        self._mark_penalty_dirty(idx)
-        self._emit(slot, first_token, *self._lp_for(req.params, lp_np, 0))
+        self._complete_prefilling(
+            idx, slot, req, first_token,
+            self._lp_for(req.params, lp_np, 0))
 
     def _admission_pages(self, req: "_QueuedRequest", need: int,
                          headroom: bool = False) -> int:
@@ -2239,6 +2355,284 @@ class LLMEngine:
                 break
         self._pipeline_busy = False
         self._flush_deferred_frees()
+
+    # ---------------- unified ragged (mixed) stepping ----------------
+
+    def _needs_legacy_step(self) -> bool:
+        """Per-iteration fallback gate: the mixed program covers neither
+        per-step logprobs nor sampling penalties (engine/compiled.py), so
+        an iteration with any such lane seated runs the legacy dispatches
+        — chunked prefill via prefill_chunk, decode via the penalized /
+        logprob program variants."""
+        for s in self._slots:
+            if s.request_id is None:
+                continue
+            p = (s.prefilling["req"].params if s.prefilling is not None
+                 else s.params)
+            if p.has_penalties or p.logprobs is not None:
+                return True
+        return False
+
+    async def _step_mixed(self) -> bool:
+        """One engine step under the unified ragged program
+        (docs/kernels.md): every prefilling slot contributes its next
+        prompt chunk and every decode lane its next token slice — ONE
+        device dispatch per step, so decode lanes keep advancing while
+        prompts prefill (the prefill/decode scheduler barrier the legacy
+        paths worked around).  Lanes whose prompt completes inside the
+        dispatch seat and keep decoding in the same program (the scan
+        tail), so a short request can prefill AND decode its whole budget
+        in a single dispatch."""
+        if self._needs_legacy_step():
+            did = self._advance_prefills()
+            active = self._active_decode_slots()
+            self._set_occupancy_gauges(active)
+            if active:
+                await self._decode_once()
+                did = True
+            return did
+        meta = self._prepare_chunk(prev=None)
+        prefilling = [
+            (i, s) for i, s in enumerate(self._slots)
+            if s.request_id is not None and s.prefilling is not None
+        ]
+        self._set_occupancy_gauges(self._active_decode_slots())
+        if meta is None and not prefilling:
+            return False
+        plan = self._plan_ragged(meta, prefilling)
+        dispatched_at = self._clock.now()
+        rng = jax.random.fold_in(self._base_rng, self._next_step())
+        out, self.kv_pages = self._mixed_fn(
+            self.params,
+            jnp.asarray(plan["q_tokens"]),
+            jnp.asarray(plan["token_seq"]),
+            jnp.asarray(plan["token_pos"]),
+            jnp.asarray(plan["q_start"]),
+            jnp.asarray(plan["q_len"]),
+            jnp.asarray(plan["kv_start"]),
+            jnp.asarray(plan["last_idx"]),
+            self.kv_pages,
+            jnp.asarray(plan["page_table"]),
+            jnp.asarray(plan["joins"]),
+            jnp.asarray(plan["scan_tok0"]),
+            jnp.asarray(plan["scan_pos0"]),
+            jnp.asarray(plan["step0_emits"]),
+            jnp.asarray(plan["capacity"]),
+            jnp.asarray(plan["counters"]),
+            plan["state"],
+            rng,
+            jnp.asarray(plan["adapters"]),
+        )
+        chunk_np = await self._fetch_async(out)
+        self._route_mixed(plan, chunk_np, dispatched_at)
+        return True
+
+    def _plan_ragged(self, meta: Optional[dict], prefilling) -> dict:
+        """Pack this step's ragged token buffer (host side, numpy): decode
+        lanes first (one token each), then each prefilling slot's next
+        chunk, within one largest-prefill-bucket token budget.  Slices
+        start at self._ragged_align multiples (the Pallas kernel's
+        one-sequence-per-block invariant; 1 on the XLA reference path).
+        Returns the packed arrays plus per-lane routing windows."""
+        B = self.config.max_batch_size
+        ps = self.config.page_size
+        steps = self.config.steps_per_sync
+        align = self._ragged_align
+        budget = self.config.prefill_buckets[-1]
+
+        def aligned(n: int) -> int:
+            return -(-n // align) * align
+
+        q_start = np.zeros((B,), np.int32)
+        q_len = np.zeros((B,), np.int32)
+        kv_start = np.zeros((B,), np.int32)
+        last_idx = np.zeros((B,), np.int32)
+        joins = np.zeros((B,), bool)
+        scan_tok0 = np.full((B,), -1, np.int32)
+        scan_pos0 = np.zeros((B,), np.int32)
+        step0_emits = np.zeros((B,), np.int32)
+        capacity = np.zeros((B,), np.int32)
+        counters = np.zeros((B,), np.int32)
+        adapters = np.full((B,), -1, np.int32)
+        params_list = [SamplingParams() for _ in range(B)]
+        tok_list: List[int] = []
+        seq_list: List[int] = []
+        pos_list: List[int] = []
+        consume: Dict[int, tuple] = {}  # lane -> (first row, n rows)
+        chunks: List[tuple] = []  # (lane, chunk len, final?)
+        offset = 0
+        n_decode = 0
+
+        def place(lane: int, tokens: List[int], positions: List[int]):
+            nonlocal offset, budget
+            n = len(tokens)
+            pad = aligned(n) - n
+            tok_list.extend(tokens + [0] * pad)
+            seq_list.extend([lane] * n + [-1] * pad)
+            pos_list.extend(positions + [0] * pad)
+            q_start[lane] = offset
+            q_len[lane] = n
+            last_idx[lane] = offset + n - 1
+            offset += aligned(n)
+            budget -= aligned(n)
+
+        if meta is not None:
+            for i, slot in enumerate(self._slots):
+                if not meta["active"][i]:
+                    continue
+                pos = int(meta["pos"][i])
+                cap = int(meta["capacity"][i])
+                place(i, [int(meta["tokens"][i])], [pos])
+                kv_start[i] = pos
+                joins[i] = True
+                scan_pos0[i] = pos + 1
+                step0_emits[i] = 1
+                capacity[i] = cap
+                counters[i] = int(meta["counters"][i])
+                adapters[i] = int(meta["adapters"][i])
+                params_list[i] = slot.params
+                consume[i] = (0, min(steps, cap - pos))
+                n_decode += 1
+
+        n_prefill_tokens = 0
+        for i, slot in prefilling:
+            pf = slot.prefilling
+            req = pf["req"]
+            seq, done = pf["seq"], pf["done"]
+            total = len(seq)
+            n = min(total - done, budget)
+            if n <= 0:
+                continue  # out of token budget; this lane rides next step
+            place(i, list(seq[done:done + n]),
+                  list(range(done, done + n)))
+            kv_start[i] = done
+            cap = len(slot.pages) * ps
+            capacity[i] = cap
+            adapters[i] = req.adapter_id
+            params_list[i] = req.params
+            final = done + n >= total
+            if final:
+                joins[i] = True
+                if req.resume is not None:
+                    # the ragged sample at a re-prefill boundary is
+                    # discarded; the scan continues from the checkpoint's
+                    # last generated token at its original position
+                    gen = req.resume["generated"]
+                    scan_tok0[i] = int(gen[-1])
+                    scan_pos0[i] = int(req.resume["pos"])
+                    counters[i] = len(gen)
+                    consume[i] = (1, max(0, min(
+                        steps - 1, cap - int(req.resume["pos"]))))
+                else:
+                    scan_pos0[i] = total
+                    step0_emits[i] = 1
+                    # row 0 (the first token) is emitted at seating; the
+                    # consume window covers the scan tail only
+                    consume[i] = (1, max(0, min(steps - 1, cap - total)))
+            else:
+                consume[i] = (0, 0)
+            chunks.append((i, n, final))
+            n_prefill_tokens += n
+
+        T = -(-self._bucket_for(max(offset, 1)) // align) * align
+        pad = T - offset
+        tok_list.extend([0] * pad)
+        seq_list.extend([-1] * pad)
+        pos_list.extend([0] * pad)
+        width = self.config.page_bucket(max(
+            [len(s.pages) for s in self._slots if s.request_id is not None]
+            or [1]
+        ))
+        page_table = np.zeros((B, width), np.int32)
+        for i, slot in enumerate(self._slots):
+            if slot.request_id is not None and slot.pages:
+                page_table[i, : len(slot.pages)] = slot.pages
+        return {
+            "q_tokens": np.asarray(tok_list, np.int32),
+            "token_seq": np.asarray(seq_list, np.int32),
+            "token_pos": np.asarray(pos_list, np.int32),
+            "q_start": q_start,
+            "q_len": q_len,
+            "kv_start": kv_start,
+            "last_idx": last_idx,
+            "page_table": page_table,
+            "joins": joins,
+            "scan_tok0": scan_tok0,
+            "scan_pos0": scan_pos0,
+            "step0_emits": step0_emits,
+            "capacity": capacity,
+            "counters": counters,
+            "adapters": adapters,
+            "state": SamplingState.from_params(params_list),
+            "consume": consume,
+            "chunks": chunks,
+            "prefill_tokens": n_prefill_tokens,
+            "decode_tokens": n_decode,
+        }
+
+    def _route_mixed(self, plan: dict, chunk_np: np.ndarray,
+                     dispatched_at: float) -> None:
+        """Consume one mixed dispatch's [steps, B] tokens: advance chunk
+        cursors, seat lanes whose prompt completed (emitting their first
+        token), then stream each joining lane's scan window.  Slots
+        evicted while the dispatch was in flight (drain) are observed as
+        empty and their speculative tokens discarded — same contract as
+        the legacy _route_chunk."""
+        now = self._clock.now()
+        step_s = now - dispatched_at
+        ENGINE_STEP_DURATION.labels(model_name=self._mlabel).observe(step_s)
+        self.telemetry.record_step(step_s)
+        if plan["chunks"] and plan["decode_tokens"] == 0:
+            # prefill-chunk duration stays meaningful only for dispatches
+            # that carried NO decode lanes: a fused mixed step's time is
+            # dominated by the decode scan, and recording it here would
+            # inflate prefill-chunk percentiles by the whole scan cost
+            ENGINE_PREFILL_CHUNK_DURATION.labels(
+                model_name=self._mlabel).observe(step_s)
+            self.telemetry.record_prefill_chunk(step_s)
+        comp = {
+            "prefill_tokens": plan["prefill_tokens"],
+            "decode_tokens": plan["decode_tokens"],
+        }
+        self.last_step_composition = comp
+        g = ENGINE_STEP_BATCH_COMPOSITION
+        g.labels(model_name=self._mlabel, role="prefill_tokens").set(
+            comp["prefill_tokens"])
+        g.labels(model_name=self._mlabel, role="decode_tokens").set(
+            comp["decode_tokens"])
+        for i, n, final in plan["chunks"]:
+            slot = self._slots[i]
+            if slot.request_id is None or slot.prefilling is None:
+                continue  # evicted mid-dispatch
+            pf = slot.prefilling
+            req = pf["req"]
+            pf["done"] += n
+            tl = req.timeline
+            if tl is not None:
+                tl.mark_prefill_start(dispatched_at)
+                tl.mark_prefill_end(now)
+            if req.adapter_id < 0 and req.resume is None:
+                covered = min(pf["done"], len(req.prompt_ids))
+                self._prefix_cache.register(
+                    req.prompt_ids[:covered], slot.pages,
+                    start_page=pf.get("registered", 0))
+                pf["registered"] = covered // self.config.page_size
+            if not final:
+                continue
+            self._complete_prefilling(i, slot, req, int(chunk_np[0, i]))
+        routed = 0
+        for i in sorted(plan["consume"]):
+            first_row, n_rows = plan["consume"][i]
+            slot = self._slots[i]
+            for s in range(first_row, first_row + n_rows):
+                if slot.request_id is None:
+                    break  # finished (or evicted); discard speculative tail
+                token = int(chunk_np[s, i])
+                slot.pos += 1
+                slot.generated.append(token)
+                self._emit(slot, token)
+                routed += 1
+        GENERATED_TOKENS.labels(model_name=self._mlabel).inc(routed)
 
     def _emit(self, slot: _Slot, token: int,
               logprob: Optional[float] = None,

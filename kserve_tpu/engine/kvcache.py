@@ -195,6 +195,28 @@ def _scatter_kv(kv_pages, k, v, pages_flat, slot_flat):
     )
 
 
+def write_ragged_kv(
+    kv_pages,  # [num_pages, 2, n_kv, ps, d] or (int8 pages, scales)
+    k: jnp.ndarray,  # [T, n_kv, d] — packed ragged slice keys
+    v: jnp.ndarray,  # [T, n_kv, d]
+    page_table: jnp.ndarray,  # [B, max_pages_per_seq]
+    token_seq: jnp.ndarray,  # [T] sequence index per packed token (-1 = pad)
+    token_pos: jnp.ndarray,  # [T] absolute position per packed token
+    page_size: int,
+):
+    """Ragged-batch scatter: each packed token lands at its sequence's
+    (page, slot) for its absolute position; padding tokens (seq -1) write
+    to the null page.  Decode steps (one token per sequence) and prompt
+    chunks (many) are the same scatter — the write half of the ragged
+    contract (docs/kernels.md)."""
+    valid = token_seq >= 0
+    seq_ix = jnp.maximum(token_seq, 0)
+    page = jnp.where(
+        valid, page_table[seq_ix, token_pos // page_size], 0)
+    slot = token_pos % page_size
+    return _scatter_kv(kv_pages, k[:, None], v[:, None], page, slot)
+
+
 def append_token_kv(
     kv_pages: jnp.ndarray,  # [num_pages, 2, n_kv, ps, d]
     k: jnp.ndarray,  # [B, n_kv, d]

@@ -112,6 +112,17 @@ class EngineConfig:
     # static top-k width for the logprob-emitting program variants (OpenAI
     # caps top_logprobs at 20); requests asking for fewer slice host-side
     max_logprobs: int = 20
+    # unified ragged paged-attention program (docs/kernels.md): prompt
+    # chunks and decode lanes fold into ONE `mixed` dispatch per engine
+    # step, so decode lanes keep advancing while a prompt prefills and the
+    # steady-state compiled-variant count drops to one per shape bucket.
+    # None = auto (on wherever it applies: pp==1, sp==1, and
+    # max_batch_size <= the largest prefill bucket so a pure-decode step
+    # packs).  False = the legacy per-path programs (prefill /
+    # prefill_chunk / decode), kept for one release as the fallback.
+    # Requests needing per-step logprobs or sampling penalties fall back
+    # to the legacy programs per engine iteration even when ragged is on.
+    use_ragged: Optional[bool] = None
 
     def __post_init__(self):
         # prefill buckets must reach max_prefill_len or long prompts would

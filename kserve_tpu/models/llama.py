@@ -27,11 +27,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..engine.kvcache import append_token_kv, write_chunk_kv_batch, write_prompt_kv_batch
+from ..engine.kvcache import (
+    append_token_kv,
+    write_chunk_kv_batch,
+    write_prompt_kv_batch,
+    write_ragged_kv,
+)
 from ..ops.attention import (
     causal_prefill_attention,
     chunked_prefill_attention,
     paged_attention,
+    ragged_paged_attention,
 )
 from ..ops.norms import rms_norm, rms_norm_plus_one
 from ..ops.rotary import apply_rope
@@ -708,6 +714,87 @@ def decode_step(
         x = residual + out
         new_pages.append(pages)
     return _logits(params, x, config)[:, 0], new_pages
+
+
+def forward_ragged(
+    params: Params,
+    config: LlamaConfig,
+    tokens: jnp.ndarray,  # [T] packed ragged token buffer
+    token_seq: jnp.ndarray,  # [T] lane index per token (-1 = padding)
+    token_pos: jnp.ndarray,  # [T] absolute position per token
+    q_start: jnp.ndarray,  # [B] first packed index of each lane's slice
+    q_len: jnp.ndarray,  # [B] slice length (0 = inactive lane)
+    kv_start: jnp.ndarray,  # [B] tokens already cached before the slice
+    kv_pages: List[jnp.ndarray],
+    page_table: jnp.ndarray,  # [B, max_pages]
+    page_size: int,
+    last_idx: jnp.ndarray,  # [B] packed index of each lane's LAST token
+    adapter_ids: Optional[jnp.ndarray] = None,  # [B] LoRA ids (-1 = base)
+    attention_fn=None,  # sharded ragged attention for tp>1 (ops/attention)
+    use_pallas: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, List[jnp.ndarray]]:
+    """The unified mixed-batch forward (docs/kernels.md): every lane
+    contributes an arbitrary-length query slice — a whole prompt, a prompt
+    chunk, or a single decode token — packed into one [T] buffer.  Each
+    layer writes the slice's K/V into the paged cache, then runs ragged
+    paged attention over the pages with the causal mask anchored at each
+    lane's kv offset.  Returns ([B, vocab] logits at each lane's last
+    token, new pages).
+
+    The buffer runs through the stack as a [T, 1, h] token-batch (batch
+    axis = packed tokens), which keeps every per-batch mechanism — LoRA
+    one-hot selection, biases, qk-norm — per-TOKEN, so lanes with
+    different adapters coexist in one mixed dispatch."""
+    T = tokens.shape[0]
+    valid = token_seq >= 0
+    seq_ix = jnp.maximum(token_seq, 0)
+    token_adapters = None
+    if adapter_ids is not None:
+        token_adapters = jnp.where(valid, adapter_ids[seq_ix], -1)
+    onehot = _adapter_onehot(params, token_adapters, T)
+    x = _embed(params, tokens, config)[:, None, :]  # [T, 1, h]
+    positions = token_pos[:, None]
+    new_pages = []
+    for layer, pages in zip(params["layers"], kv_pages):
+        residual = x
+        h = _norm(x, layer["attn_norm"], config)
+        q, k, v = _qkv(layer, h, config, onehot)
+        q = apply_rope(q, positions, config.rope_theta, config.rope_scaling)
+        k = apply_rope(k, positions, config.rope_theta, config.rope_scaling)
+        pages = write_ragged_kv(
+            pages, k[:, 0], v[:, 0], page_table, token_seq, token_pos,
+            page_size,
+        )
+        window = layer.get("attn_window")
+        if attention_fn is not None:
+            attn = attention_fn(
+                q[:, 0], pages, page_table, q_start, q_len, kv_start,
+                window if window is not None else jnp.asarray(0, jnp.int32))
+        else:
+            attn = ragged_paged_attention(
+                q[:, 0], pages, page_table, q_start, q_len, kv_start,
+                logit_softcap=config.attn_logit_softcap,
+                use_pallas=use_pallas,
+                scale=config.attn_scale,
+                window=window,
+            )
+        attn_flat = attn.reshape(T, 1, -1)
+        attn = _maybe_add(
+            dense(attn_flat, layer["wo"]),
+            lora_delta(layer.get("lora"), "wo", attn_flat, onehot),
+        )
+        if config.sandwich_norms:
+            attn = _norm(attn, layer["post_attn_norm"], config)
+        x = residual + attn
+        residual = x
+        h = _norm(x, layer["mlp_norm"], config)
+        out = _mlp(layer, h, config, onehot)
+        if config.sandwich_norms:
+            out = _norm(out, layer["post_mlp_norm"], config)
+        x = residual + out
+        new_pages.append(pages)
+    x_last = x[last_idx, 0]  # [B, h]
+    return _logits(params, x_last[:, None], config)[:, 0], new_pages
 
 
 # ---------------- pipeline-parallel execution (engine pp > 1) ----------------

@@ -1,11 +1,25 @@
-"""Attention ops: causal prefill and paged-KV decode.
+"""Attention ops: causal prefill, paged-KV decode, and the unified ragged
+paged-attention contract for mixed prefill+decode batches.
 
-Two implementations of decode attention over the paged cache:
+Decode attention over the paged cache has two implementations:
 - `paged_attention_xla`: pure-XLA gather + masked softmax (portable, used on
   CPU test meshes and as the safety net).
 - `paged_attention_pallas` (ops/pallas_paged_attention.py): fused kernel that
   streams pages HBM->VMEM without materializing the gathered KV (the Ragged
   Paged Attention approach; see PAPERS.md).
+
+The RAGGED contract (docs/kernels.md) generalizes both: every sequence in
+the batch contributes an arbitrary-length query slice — a full prompt, a
+prompt chunk, or a single decode token — packed into one [T, nq, d] token
+buffer with per-sequence (q_start, q_len, kv_start) metadata.  The caller
+writes the slice's K/V into the paged cache FIRST (kvcache.write_ragged_kv),
+then attention reads everything from pages with a causal mask anchored at
+each sequence's kv offset, so prompt chunks and decode steps fold into the
+same online-softmax program:
+- `ragged_paged_attention_xla`: the gather-based reference (CPU-runnable
+  numerics ground truth; also the production path off-TPU).
+- `ragged_paged_attention_pallas` (ops/pallas_paged_attention.py): the
+  fused kernel, verified against the reference in interpret mode.
 
 Role parity: replaces vLLM's CUDA PagedAttention, which the reference uses
 through the vLLM engine (SURVEY.md §2.3 "Sequence/context parallel" row).
@@ -284,6 +298,167 @@ def make_sharded_paged_attention(
         inner,
         mesh=mesh,
         in_specs=(q_spec, kv_spec, P(None, None), P(None), P()),
+        out_specs=q_spec,
+        check_vma=False,
+    )
+
+
+# ---------------- ragged paged attention (mixed prefill+decode) ----------------
+
+
+def ragged_token_metadata(q_start, q_len, T: int):
+    """Per-token (seq index, local offset, validity) for a packed ragged
+    buffer of T tokens, derived ON DEVICE from the per-sequence metadata —
+    packing metadata must never round-trip through the host inside traced
+    code (jaxlint: ragged-metadata-host-sync).  Tokens outside every
+    sequence's slice get seq index -1."""
+    idx = jnp.arange(T, dtype=jnp.int32)
+    member = (idx[None, :] >= q_start[:, None]) & (
+        idx[None, :] < (q_start + q_len)[:, None]
+    )  # [B, T]
+    valid = member.any(axis=0)
+    token_seq = jnp.where(
+        valid, jnp.argmax(member, axis=0).astype(jnp.int32), -1)
+    token_loc = idx - q_start[jnp.maximum(token_seq, 0)]
+    return token_seq, token_loc, valid
+
+
+def ragged_paged_attention_xla(
+    q: jnp.ndarray,  # [T, nq, d] — packed ragged query buffer
+    kv_pages,  # [num_pages, 2, nkv, ps, d] or (int8 pages, scales)
+    page_table: jnp.ndarray,  # [B, W]
+    q_start: jnp.ndarray,  # [B] first packed index of each sequence's slice
+    q_len: jnp.ndarray,  # [B] slice length (0 = inactive lane)
+    kv_start: jnp.ndarray,  # [B] tokens already cached BEFORE this slice
+    logit_softcap: float = 0.0,
+    scale: Optional[float] = None,
+    window=None,  # traced int32 scalar; >0 = sliding-window width
+) -> jnp.ndarray:
+    """XLA gather reference for the ragged contract (docs/kernels.md).
+
+    The caller has already written the slice's K/V into the pages
+    (kvcache.write_ragged_kv), so attention reads ONLY the paged cache:
+    query token j of sequence i sits at absolute position kv_start[i]+j and
+    attends causally to positions 0..kv_start[i]+j.  Padded table entries
+    point at the null page, whose positions lie beyond every query's causal
+    horizon — the causal mask is the null-page mask.  This is the numerics
+    ground truth the Pallas ragged kernel is tested against, and the
+    production path off-TPU."""
+    T, nq, d = q.shape
+    if scale is None:
+        scale = 1.0 / float(d) ** 0.5
+    k_all, v_all = _gather_history(kv_pages, page_table)  # [B, L, nkv, d]
+    L = k_all.shape[1]
+    nkv = k_all.shape[2]
+    group = nq // nkv
+    token_seq, token_loc, valid = ragged_token_metadata(q_start, q_len, T)
+    seq_ix = jnp.maximum(token_seq, 0)
+    q_pos = kv_start[seq_ix] + token_loc  # [T] absolute query positions
+    k_t = k_all[seq_ix]  # [T, L, nkv, d]
+    v_t = v_all[seq_ix]
+    qg = q.reshape(T, nkv, group, d).astype(jnp.float32)
+    scores = jnp.einsum(
+        "tkgd,tlkd->tkgl", qg, k_t.astype(jnp.float32)) * scale
+    if logit_softcap > 0.0:
+        scores = jnp.tanh(scores / logit_softcap) * logit_softcap
+    kpos = jnp.arange(L, dtype=jnp.int32)
+    mask = (kpos[None, :] <= q_pos[:, None]) & valid[:, None]  # [T, L]
+    if window is not None:
+        dist = q_pos[:, None] - kpos[None, :]
+        mask = mask & ((dist < window) | (window <= 0))
+    scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("tkgl,tlkd->tkgd", weights, v_t.astype(jnp.float32))
+    out = jnp.where(valid[:, None, None], out.reshape(T, nq, d), 0.0)
+    return out.astype(q.dtype)
+
+
+def _should_use_ragged_pallas(d: int, backend: str) -> bool:
+    """Auto-dispatch predicate for the ragged kernel: lane-aligned heads on
+    a TPU backend.  Unlike the decode kernel there is no gather-vs-kernel
+    width crossover — the ragged gather reference materializes [T, L, ...]
+    per token and is strictly a correctness/CPU path."""
+    return d % 128 == 0 and backend == "tpu"
+
+
+def ragged_paged_attention(
+    q: jnp.ndarray,  # [T, nq, d]
+    kv_pages,
+    page_table: jnp.ndarray,  # [B, W]
+    q_start: jnp.ndarray,  # [B]
+    q_len: jnp.ndarray,  # [B]
+    kv_start: jnp.ndarray,  # [B]
+    logit_softcap: float = 0.0,
+    use_pallas: Optional[bool] = None,
+    scale: Optional[float] = None,
+    window=None,  # traced int32 scalar (None = full attention)
+) -> jnp.ndarray:
+    """Dispatch the ragged contract between the fused Pallas kernel and the
+    XLA gather reference.  The ragged kernel (unlike the decode kernel)
+    supports int8 KV pages, sliding windows and scale overrides natively,
+    so the dispatch is purely head-alignment + backend; use_pallas=True
+    forces the kernel (raising on unsupported head_dim), False forces the
+    reference."""
+    d = q.shape[-1]
+    if use_pallas is None:
+        use_pallas = _should_use_ragged_pallas(d, jax.default_backend())
+    if use_pallas:
+        from .pallas_paged_attention import ragged_paged_attention_pallas
+
+        return ragged_paged_attention_pallas(
+            q, kv_pages, page_table, q_start, q_len, kv_start,
+            window=window, logit_softcap=logit_softcap, scale=scale,
+        )
+    return ragged_paged_attention_xla(
+        q, kv_pages, page_table, q_start, q_len, kv_start,
+        logit_softcap=logit_softcap, scale=scale, window=window,
+    )
+
+
+def make_sharded_ragged_attention(
+    mesh,
+    logit_softcap: float = 0.0,
+    use_pallas: Optional[bool] = None,
+    quantized: bool = False,
+    interpret: bool = False,
+    scale: Optional[float] = None,
+):
+    """Ragged paged attention under `shard_map` over the model (head) axis
+    — same seam as make_sharded_paged_attention: q heads and KV heads shard
+    together so GQA group structure is preserved per shard and the op needs
+    no collectives.  Ragged packing metadata is replicated (tiny int32
+    arrays).  The window scalar is always threaded: the ragged kernel masks
+    the window natively, so no static `windowed` escape hatch is needed.
+
+    Returns fn(q [T,nq,d], kv_pages, page_table [B,W], q_start [B],
+    q_len [B], kv_start [B], window [] int32) -> [T,nq,d]."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.sharding import MODEL_AXIS, shard_map
+
+    q_spec = P(None, MODEL_AXIS, None)
+    kv_spec = P(None, None, MODEL_AXIS, None, None)
+    if quantized:
+        kv_spec = (kv_spec, P(None, None, MODEL_AXIS, None))
+
+    def inner(q, kv_pages, page_table, q_start, q_len, kv_start, window):
+        if interpret:
+            from .pallas_paged_attention import ragged_paged_attention_pallas
+
+            return ragged_paged_attention_pallas(
+                q, kv_pages, page_table, q_start, q_len, kv_start,
+                window=window, logit_softcap=logit_softcap, scale=scale,
+                interpret=True)
+        return ragged_paged_attention(
+            q, kv_pages, page_table, q_start, q_len, kv_start,
+            logit_softcap=logit_softcap, use_pallas=use_pallas,
+            scale=scale, window=window)
+
+    return shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, P(None, None), P(None), P(None),
+                  P(None), P()),
         out_specs=q_spec,
         check_vma=False,
     )

@@ -19,10 +19,15 @@ Batching SB sequences multiplies both the DMA parallelism and the matmul
 batch.
 
 This is the Ragged Paged Attention design point (see PAPERS.md) specialized
-to decode (query length 1 per sequence).
+to decode (query length 1 per sequence).  The FULL ragged generalization —
+arbitrary per-sequence query slices (prompt chunks and decode tokens in one
+program) — is `ragged_paged_attention_pallas` below; its packing contract,
+masking rules, VMEM ring budget, int8/sliding-window composition and the
+engine's legacy-fallback flag are documented in docs/kernels.md.
 
-Numerics match ops/attention.paged_attention_xla (tests compare both paths
-in interpret mode; bench exercises the compiled kernel on hardware).
+Numerics match ops/attention.paged_attention_xla and
+ops/attention.ragged_paged_attention_xla respectively (tests compare the
+paths in interpret mode; bench exercises the compiled kernels on hardware).
 """
 
 from __future__ import annotations
@@ -36,6 +41,15 @@ from jax.experimental.pallas import tpu as pltpu
 
 NBUF = 4  # VMEM ring depth (iterations in flight); NBUF-1 ahead
 MAX_SB = 8  # sequences per grid step (VMEM budget: NBUF*SB pages resident)
+
+# jax>=0.5 renamed pltpu.TPUMemorySpace -> MemorySpace (and the HBM member
+# replaced ANY as the name for "stay in device memory, no VMEM block").
+# The 0.4.x fallback keeps interpret-mode tests runnable on CI images that
+# pin the older jax.
+if hasattr(pltpu, "MemorySpace"):
+    _HBM = pltpu.MemorySpace.HBM
+else:  # jax 0.4.x
+    _HBM = pltpu.TPUMemorySpace.ANY
 
 
 def _pick_sb(B: int) -> int:
@@ -119,7 +133,7 @@ def _pallas_call(kernel, B, sb, nq, lane, kv_arr):
             grid=(B // sb,),
             in_specs=[
                 pl.BlockSpec((sb, nq, lane), lambda g, *_: (g, 0, 0)),
-                pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+                pl.BlockSpec(memory_space=_HBM),
             ],
             out_specs=pl.BlockSpec((sb, nq, lane), lambda g, *_: (g, 0, 0)),
             scratch_shapes=[
@@ -378,3 +392,237 @@ def paged_attention_pallas(
         out_shape=jax.ShapeDtypeStruct((B, nq, d), q.dtype),
         interpret=interpret,
     )(page_table, seq_lens, q, kv_pages)
+
+
+# ---------------- ragged paged attention (mixed prefill+decode) ----------------
+#
+# The generalization of the decode kernel above to arbitrary per-sequence
+# query lengths (docs/kernels.md): sequences pack their query slices — a
+# full prompt, a prompt chunk, or a single decode token — into one [T, nq,
+# d] buffer at RAGGED_BQ-aligned offsets.  The grid walks BQ-token blocks;
+# each block belongs to exactly ONE sequence (the alignment invariant) and
+# streams that sequence's KV pages through the same VMEM DMA ring as the
+# decode kernel, folding them into an online-softmax accumulator under a
+# causal mask anchored at the sequence's kv offset.  Decode (q_len=1) and
+# prefill chunks (q_len=C) are the same program; sliding windows, int8 KV
+# pages and scale overrides are masked/dequantized/applied in-kernel.
+
+RAGGED_BQ = 8  # query tokens per grid block (f32 sublane granularity)
+
+
+def _ragged_block_metadata(q_start, q_len, G: int, bq: int):
+    """[G] (sequence index, local query offset) per BQ block, derived on
+    device from the per-sequence metadata (no host reads on packing
+    metadata — the jaxlint ragged-metadata-host-sync contract).  Blocks
+    outside every slice get sequence -1 (the kernel skips them)."""
+    blk0 = jnp.arange(G, dtype=jnp.int32) * bq
+    member = (blk0[None, :] >= q_start[:, None]) & (
+        blk0[None, :] < (q_start + q_len)[:, None]
+    )  # [B, G]
+    hit = member.any(axis=0)
+    block_seq = jnp.where(
+        hit, jnp.argmax(member, axis=0).astype(jnp.int32), -1)
+    block_qoff = jnp.where(
+        hit, blk0 - q_start[jnp.maximum(block_seq, 0)], 0)
+    return block_seq, block_qoff
+
+
+def _ragged_kernel(
+    # scalar prefetch (SMEM)
+    block_seq_ref,  # [G] int32 — sequence owning each BQ block (-1 = pad)
+    block_qoff_ref,  # [G] int32 — block's first query offset in its slice
+    page_table_ref,  # [B, W] int32
+    kv_start_ref,  # [B] int32 — history length per sequence
+    q_len_ref,  # [B] int32
+    window_ref,  # [1] int32 — sliding window (0 = full attention)
+    # inputs
+    q_ref,  # [BQ, nq, d] VMEM block
+    kv_hbm_ref,  # [num_pages, 2, nkv, ps, d] in HBM (int8 when quantized)
+    *rest,  # (scales_hbm?) out_ref, kv_bufs, kv_sems, (s_bufs, s_sems?)
+    bq: int,
+    page_size: int,
+    num_kv_heads: int,
+    head_dim: int,
+    scale: float,
+    logit_softcap: float,
+    quantized: bool,
+):
+    if quantized:
+        scales_hbm_ref, out_ref, kv_bufs, kv_sems, s_bufs, s_sems = rest
+    else:
+        out_ref, kv_bufs, kv_sems = rest
+        scales_hbm_ref = s_bufs = s_sems = None
+
+    g = pl.program_id(0)
+    s_raw = block_seq_ref[g]
+    s = jnp.maximum(s_raw, 0)
+    qoff = block_qoff_ref[g]
+    kv0 = kv_start_ref[s]
+    qn = q_len_ref[s]
+    w = window_ref[0]
+    # keys this block needs: positions 0 .. kv0 + min(qoff+BQ, qn) - 1
+    kv_hi = kv0 + jnp.minimum(qoff + bq, qn)
+    num_pages = jnp.where(
+        s_raw < 0, 0, (kv_hi + page_size - 1) // page_size)
+
+    def start_iter(i, slot):
+        page = page_table_ref[s, i]
+        pltpu.make_async_copy(
+            kv_hbm_ref.at[page], kv_bufs.at[slot], kv_sems.at[slot]
+        ).start()
+        if quantized:
+            pltpu.make_async_copy(
+                scales_hbm_ref.at[page], s_bufs.at[slot], s_sems.at[slot]
+            ).start()
+
+    for j in range(NBUF - 1):
+        @pl.when(j < num_pages)
+        def _(j=j):
+            start_iter(j, j)
+
+    nq = q_ref.shape[1]
+    group = nq // num_kv_heads
+    rows = bq * group
+    # [nkv, BQ*group, d]: row r*group+j is query token r, q-head group j
+    q = (
+        q_ref[...].astype(jnp.float32)
+        .reshape(bq, num_kv_heads, group, head_dim)
+        .transpose(1, 0, 2, 3)
+        .reshape(num_kv_heads, rows, head_dim)
+    )
+    rowq = jax.lax.broadcasted_iota(jnp.int32, (1, rows, 1), 1) // group
+    qpos = kv0 + qoff + rowq  # absolute position per query row
+    qvalid = (qoff + rowq) < qn
+
+    def body(i, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(i, NBUF)
+        pltpu.make_async_copy(
+            kv_hbm_ref.at[0], kv_bufs.at[slot], kv_sems.at[slot]
+        ).wait()
+        if quantized:
+            pltpu.make_async_copy(
+                scales_hbm_ref.at[0], s_bufs.at[slot], s_sems.at[slot]
+            ).wait()
+
+        @pl.when(i + NBUF - 1 < num_pages)
+        def _():
+            start_iter(i + NBUF - 1, jax.lax.rem(i + NBUF - 1, NBUF))
+
+        k = kv_bufs[slot, 0].astype(jnp.float32)  # [nkv, ps, d]
+        v = kv_bufs[slot, 1].astype(jnp.float32)
+        if quantized:
+            k = k * s_bufs[slot, 0].astype(jnp.float32)[..., None]
+            v = v * s_bufs[slot, 1].astype(jnp.float32)[..., None]
+        s_ = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [nkv, BQ*group, ps]
+        if logit_softcap > 0.0:
+            s_ = jnp.tanh(s_ / logit_softcap) * logit_softcap
+        kpos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, page_size), 2)
+        mask = (kpos <= qpos) & qvalid
+        mask = mask & ((qpos - kpos < w) | (w <= 0))
+        s_ = jnp.where(mask, s_, -1e30)
+        m_new = jnp.maximum(m, s_.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s_ - m_new)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # [nkv, BQ*group, d]
+        return m_new, l_new, acc * alpha + pv
+
+    m0 = jnp.full((num_kv_heads, rows, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((num_kv_heads, rows, 1), jnp.float32)
+    acc0 = jnp.zeros((num_kv_heads, rows, head_dim), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, num_pages, body, (m0, l0, acc0))
+    # rows past their slice's q_len never see a valid key: their running
+    # max stays -1e30, so exp(s - m) saturates to 1 and acc collects a
+    # garbage mean of V — mask them to exact zero instead
+    out = jnp.where(qvalid, acc / jnp.maximum(l, 1e-30), 0.0)
+    out_ref[...] = (
+        out.reshape(num_kv_heads, bq, group, head_dim)
+        .transpose(1, 0, 2, 3)
+        .reshape(bq, nq, head_dim)
+        .astype(out_ref.dtype)
+    )
+
+
+def ragged_paged_attention_pallas(
+    q: jnp.ndarray,  # [T, nq, d] — packed at RAGGED_BQ-aligned offsets
+    kv_pages,  # [num_pages, 2, nkv, ps, d] or (int8 pages, scales)
+    page_table: jnp.ndarray,  # [B, W] int32
+    q_start: jnp.ndarray,  # [B] int32 (each a multiple of RAGGED_BQ)
+    q_len: jnp.ndarray,  # [B] int32 (0 = inactive lane)
+    kv_start: jnp.ndarray,  # [B] int32
+    window=None,  # traced int32 scalar or None (full attention)
+    logit_softcap: float = 0.0,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    T, nq, d = q.shape
+    if T % RAGGED_BQ != 0:
+        raise ValueError(
+            f"ragged buffer length {T} not a multiple of RAGGED_BQ="
+            f"{RAGGED_BQ}; pad the packed buffer")
+    if d % 128 != 0 and not interpret:
+        raise ValueError(
+            f"ragged pallas kernel requires head_dim % 128 == 0, got {d}")
+    quantized = isinstance(kv_pages, tuple)
+    if quantized:
+        pages, scales = kv_pages
+        nkv, ps = pages.shape[2], pages.shape[3]
+    else:
+        pages, scales = kv_pages, None
+        nkv, ps = kv_pages.shape[2], kv_pages.shape[3]
+    if scale is None:
+        scale = 1.0 / float(d) ** 0.5
+    G = T // RAGGED_BQ
+    block_seq, block_qoff = _ragged_block_metadata(q_start, q_len, G, RAGGED_BQ)
+    win = jnp.reshape(jnp.asarray(
+        window if window is not None else 0, jnp.int32), (1,))
+    kernel = functools.partial(
+        _ragged_kernel,
+        bq=RAGGED_BQ,
+        page_size=ps,
+        num_kv_heads=nkv,
+        head_dim=d,
+        scale=float(scale),
+        logit_softcap=logit_softcap,
+        quantized=quantized,
+    )
+    in_specs = [
+        pl.BlockSpec((RAGGED_BQ, nq, d), lambda g, *_: (g, 0, 0)),
+        pl.BlockSpec(memory_space=_HBM),
+    ]
+    scratch = [
+        pltpu.VMEM((NBUF,) + pages.shape[1:], pages.dtype),
+        pltpu.SemaphoreType.DMA((NBUF,)),
+    ]
+    operands = [q, pages]
+    if quantized:
+        in_specs.append(pl.BlockSpec(memory_space=_HBM))
+        scratch += [
+            pltpu.VMEM((NBUF,) + scales.shape[1:], scales.dtype),
+            pltpu.SemaphoreType.DMA((NBUF,)),
+        ]
+        operands.append(scales)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=6,
+            grid=(G,),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (RAGGED_BQ, nq, d), lambda g, *_: (g, 0, 0)),
+            scratch_shapes=scratch,
+        ),
+        out_shape=jax.ShapeDtypeStruct((T, nq, d), q.dtype),
+        interpret=interpret,
+    )(block_seq, block_qoff, page_table, kv_start, q_len, win,
+      *operands)

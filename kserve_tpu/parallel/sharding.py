@@ -212,9 +212,40 @@ def shard_params(params, config: LlamaConfig, mesh: Mesh):
 
 
 def shard_kv_pages(kv_pages: List, mesh: Mesh) -> List:
-    sharding = NamedSharding(mesh, kv_pages_pspec())
+    sharding = named_canonical(mesh, kv_pages_pspec())
     return [jax.device_put(p, sharding) for p in kv_pages]
 
 
 def named(mesh: Mesh, spec: P) -> NamedSharding:
     return NamedSharding(mesh, spec)
+
+
+def canonical_pspec(mesh: Mesh, spec: P) -> P:
+    """Spell `spec` the way GSPMD spells program-OUTPUT shardings: axis
+    names with mesh extent 1 drop to None, trailing Nones trim (observed:
+    P(None, None, 'model', None, None) comes back as P() on a tp=1 mesh
+    and as P(None, None, 'model') on tp=2).
+
+    Matters for long-lived DONATED buffers (the KV cache): they are fed
+    back into the next dispatch, so the init-time sharding must be spelled
+    exactly as the program outputs it or the second dispatch sees a "new"
+    input signature and every cache-carrying program recompiles once (the
+    "donated kv_pages layout settles" retrace, pinned away by
+    tests/test_retrace_budget.py)."""
+
+    def keep(ax):
+        if ax is None:
+            return None
+        if isinstance(ax, tuple):
+            kept = tuple(a for a in ax if mesh.shape[a] > 1)
+            return kept if kept else None
+        return ax if mesh.shape[ax] > 1 else None
+
+    parts = [keep(ax) for ax in spec]
+    while parts and parts[-1] is None:
+        parts.pop()
+    return P(*parts)
+
+
+def named_canonical(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, canonical_pspec(mesh, spec))

@@ -88,7 +88,10 @@ class Scenario:
 def smoke_scenario(seed: int = 7) -> Scenario:
     """Small-but-complete: 2 replicas, every workload kind, one
     deterministic preemption, one graceful drain+restart, one breaker
-    trip, and a shed burst — fast enough for tier-1 on every PR."""
+    trip, a shed burst, and a mixed-composition leg (a second burst whose
+    long-context chunked prefills overlap live decode lanes inside the
+    unified ragged program, with a preemption landing mid-overlap) — fast
+    enough for tier-1 on every PR."""
     return Scenario(
         name="smoke",
         seed=seed,
@@ -96,7 +99,11 @@ def smoke_scenario(seed: int = 7) -> Scenario:
         spec=_canned_spec(),
         workload=WorkloadConfig(
             n_requests=60, duration_s=30.0,
-            bursts=[(8.0, 12)],
+            # the 16s burst is the mixed-composition leg: its long_context
+            # share chunk-prefills while the burst's chat/batch lanes
+            # decode, so the stub `mixed` program serves genuinely ragged
+            # batches under the preempt below
+            bursts=[(8.0, 12), (16.0, 10)],
         ),
         churn=[
             # the burst guarantees in-flight work when the churn lands:
@@ -111,6 +118,11 @@ def smoke_scenario(seed: int = 7) -> Scenario:
             ChurnEvent(at_s=12.0, kind="heal_shed"),
             ChurnEvent(at_s=14.0, kind="breaker_trip", replica="replica-1",
                        count=6),
+            # mixed-composition churn: preempt while the 16s burst has
+            # chunked prefills in flight next to decode lanes — the
+            # checkpointed streams must still resume token-exactly
+            ChurnEvent(at_s=16.4, kind="preempt", replica="replica-0",
+                       count=1),
             # replica-1 is the only replica serving the burst backlog while
             # replica-0 drains, so a crash here reliably kills live streams
             # (retry-from-scratch, not resume) and opens a brief full-fleet

@@ -153,6 +153,7 @@ class StubPrograms:
         self.decode_penalized_lp = self._make_decode(True, True)
         self.inject = self._inject
         self.inject_q = self._inject_q
+        self.mixed = self._mixed
 
     # ---------------- prefill ----------------
 
@@ -241,6 +242,68 @@ class StubPrograms:
             return out, kv_pages
 
         return fn
+
+    # ---------------- unified ragged (mixed) program ----------------
+
+    def _mixed(self, params, q_tokens, token_seq, token_pos, q_start,
+               q_len, kv_start, last_idx, kv_pages, page_table, joins,
+               scan_tok0, scan_pos0, step0_emits, capacity, counters,
+               state, rng, adapters):
+        """Host-math twin of engine/compiled.py's mixed program, emitting
+        the SAME deterministic token chain as the legacy stub paths so
+        checkpoint/resume stays token-exact across both program sets and
+        `expected_stream()` remains the oracle.
+
+        Step-0 discrimination mirrors the engine's packing contract: a
+        lane sampling its FIRST token has counters==0 (stub_first_token of
+        its full sequence length); a decode lane has counters>=1 and
+        continues the chain from its packed token; a resume boundary
+        (step0_emits==0 with scan_tok0>=0) re-enters the chain at its
+        checkpointed token."""
+        steps = self._cfg.steps_per_sync
+        toks = np.asarray(q_tokens)
+        qs = np.asarray(q_start)
+        ql = np.asarray(q_len)
+        ks = np.asarray(kv_start)
+        jn = np.asarray(joins)
+        st0 = np.asarray(scan_tok0)
+        sp0 = np.asarray(scan_pos0)
+        emits0 = np.asarray(step0_emits)
+        cap = np.asarray(capacity)
+        cnt = np.asarray(counters)
+        B = qs.shape[0]
+        # cost: the ragged step pays prefill for every packed prompt
+        # token (non-decode lanes) + the scan pays the decode chunk
+        c = self._device.costs
+        n_prefill = int(sum(
+            int(ql[i]) for i in range(B)
+            if ql[i] > 0 and not (emits0[i] == 1 and cnt[i] >= 1)
+        ))
+        cost = c.decode_step_s * steps
+        if n_prefill:
+            cost += c.prefill_base_s + c.prefill_per_token_s * n_prefill
+        self._device.dispatch(cost)
+        chunk = np.zeros((steps, B), np.int32)
+        for i in range(B):
+            if ql[i] <= 0:
+                continue
+            decode_lane = emits0[i] == 1 and cnt[i] >= 1
+            if decode_lane:
+                # packed token is generated[-1] at position kv_start
+                s0 = stub_next_token(int(toks[qs[i]]), int(ks[i]))
+            else:
+                # a completed (or still-chunking: discarded) prompt slice
+                s0 = stub_first_token(int(ks[i]) + int(ql[i]))
+            chunk[0, i] = s0
+            prev = int(st0[i]) if st0[i] >= 0 else s0
+            p = int(sp0[i])
+            limit = int(cap[i])
+            for s in range(1, steps):
+                if jn[i] and p < limit:
+                    prev = stub_next_token(prev, p)
+                    p += 1
+                chunk[s, i] = prev
+        return chunk, kv_pages
 
     # ---------------- KV injection (P/D, tier-store resume) ----------------
 

@@ -557,14 +557,18 @@ class TestPrefixCache:
 
 
 class TestInterleavedLongAdmission:
+    @pytest.mark.parametrize("use_ragged", [None, False])
     @async_test
-    async def test_decode_streams_continue_during_long_admission(self):
-        """A long-prompt admission must not stall in-flight decode streams:
-        chunks and decode dispatches alternate, so the short request keeps
-        emitting between prefill chunks."""
+    async def test_decode_streams_continue_during_long_admission(
+            self, use_ragged):
+        """A long-prompt admission must not stall in-flight decode streams.
+        Under the unified ragged program (use_ragged=None -> on) decode
+        lanes advance IN the same dispatch as each prefill chunk; on the
+        legacy path chunks and decode dispatches alternate.  Either way
+        the short request keeps emitting while the long prompt admits."""
         engine = make_engine(
             max_prefill_len=16, prefill_buckets=(16,), num_pages=128,
-            max_pages_per_seq=64, max_batch_size=4,
+            max_pages_per_seq=64, max_batch_size=4, use_ragged=use_ragged,
         )
         await engine.start()
         short_progress = []
@@ -582,13 +586,21 @@ class TestInterleavedLongAdmission:
                 await asyncio.sleep(0.01)
 
             seen_at_chunk = []
-            orig = engine._prefill_chunk_fn
+            mixed = engine._use_mixed
+            orig = engine._mixed_fn if mixed else engine._prefill_chunk_fn
 
             def spy(*args, **kwargs):
-                seen_at_chunk.append(short_progress[-1])
+                if not mixed or any(
+                    s.prefilling is not None for s in engine._slots
+                    if s.request_id is not None
+                ):
+                    seen_at_chunk.append(short_progress[-1])
                 return orig(*args, **kwargs)
 
-            engine._prefill_chunk_fn = spy
+            if mixed:
+                engine._mixed_fn = spy
+            else:
+                engine._prefill_chunk_fn = spy
             long_prompt = [3 + (i % 500) for i in range(400)]  # 25 chunks
             outs = await collect(
                 engine, long_prompt,
@@ -601,6 +613,96 @@ class TestInterleavedLongAdmission:
         finally:
             task.cancel()
             await engine.stop()
+
+
+class TestMixedBatchUnifiedDispatch:
+    @async_test
+    async def test_mixed_batch_one_dispatch_per_step(self):
+        """Acceptance (ISSUE 9): with the unified ragged program enabled,
+        a mixed batch — decode lanes advancing DURING an in-flight prompt
+        chunk — is served by exactly ONE program dispatch per engine step.
+        Every legacy program is patched to raise, so any residual
+        prefill/decode dispatch fails the test; the FakeClock keeps the
+        telemetry stamps deterministic (zero real sleeps in the engine)."""
+        from kserve_tpu.engine.engine import EngineConfig, LLMEngine
+        from kserve_tpu.engine.tokenizer import ByteTokenizer
+        from kserve_tpu.resilience import FakeClock
+
+        model_config = LlamaConfig.tiny(dtype="float32")
+        clock = FakeClock()
+        engine = LLMEngine(
+            model_config,
+            EngineConfig(
+                max_batch_size=4, page_size=8, num_pages=128,
+                max_pages_per_seq=64, max_prefill_len=16,
+                prefill_buckets=(16,), dtype="float32", use_pallas=False,
+            ),
+            ByteTokenizer(model_config.vocab_size),
+            clock=clock,
+            metrics_label="mixed-acceptance",
+        )
+        assert engine._use_mixed
+
+        def forbidden(*a, **k):
+            raise AssertionError("legacy program dispatched in mixed mode")
+
+        for name in ("_prefill_fn", "_prefill_lp_fn", "_prefill_chunk_fn",
+                     "_decode_fn", "_decode_lp_fn", "_decode_penalized_fn",
+                     "_decode_penalized_lp_fn"):
+            setattr(engine, name, forbidden)
+
+        short_progress = []
+        dispatches = []
+        orig = engine._mixed_fn
+
+        def spy(*args, **kwargs):
+            dispatches.append({
+                "chunk_lanes": sum(
+                    1 for s in engine._slots
+                    if s.request_id is not None and s.prefilling is not None),
+                "decode_lanes": sum(
+                    1 for s in engine._slots
+                    if s.request_id is not None and s.prefilling is None),
+                "short_at": short_progress[-1] if short_progress else 0,
+            })
+            return orig(*args, **kwargs)
+
+        engine._mixed_fn = spy
+        await engine.start()
+
+        async def short():
+            async for out in engine.generate(
+                [1, 2, 3],
+                SamplingParams(max_tokens=120, temperature=0.0,
+                               ignore_eos=True),
+            ):
+                short_progress.append(out.num_generated)
+
+        try:
+            task = asyncio.create_task(short())
+            while not short_progress:
+                await asyncio.sleep(0.01)
+            long_prompt = [3 + (i % 400) for i in range(240)]  # many chunks
+            outs = await collect(
+                engine, long_prompt,
+                SamplingParams(max_tokens=4, temperature=0.0,
+                               ignore_eos=True))
+            assert outs[-1].finished
+            await task
+        finally:
+            await engine.stop()
+
+        mixed = [d for d in dispatches
+                 if d["chunk_lanes"] > 0 and d["decode_lanes"] > 0]
+        assert len(mixed) >= 2, dispatches
+        # the decode stream ADVANCED across chunk-carrying dispatches —
+        # the prefill/decode scheduler barrier is gone
+        assert mixed[-1]["short_at"] > mixed[0]["short_at"], mixed
+        # and every step was one dispatch: no legacy program ever ran
+        # (forbidden() would have raised) and the engine's composition
+        # record shows simultaneous prefill+decode tokens
+        comp = engine.last_step_composition
+        assert set(comp) == {"prefill_tokens", "decode_tokens"}
 
 
 class TestInt8KVCache:

@@ -486,6 +486,75 @@ def test_kserve_tpu_tree_lints_clean():
     assert findings == [], "\n".join(f.render() for f in findings)
 
 
+# ------------------------------------------- ragged-metadata-host-sync
+
+BAD_RAGGED = """
+    import jax
+
+    @jax.jit
+    def mixed_step(q_tokens, q_start, q_len, kv_start):
+        n = int(q_len[0])  # host sync on packing metadata
+        first = q_start.item()
+        return q_tokens[first:first + n]
+"""
+
+GOOD_RAGGED = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def mixed_step(q_tokens, q_start, q_len, kv_start):
+        idx = jnp.arange(q_tokens.shape[0])
+        member = (idx[None] >= q_start[:, None]) & (
+            idx[None] < (q_start + q_len)[:, None])
+        return jnp.where(member.any(0), q_tokens, 0)
+"""
+
+GOOD_RAGGED_HOST = """
+    def plan_ragged(meta, q_start, q_len):
+        # host-side planning over numpy arrays is the intended place for
+        # scalar reads — only TRACED code is in scope for the rule
+        return int(q_len[0]) + q_start.item()
+"""
+
+
+def test_ragged_host_sync_fires_on_item_and_int():
+    rules = rules_of(BAD_RAGGED)
+    assert rules.count("ragged-metadata-host-sync") == 2
+
+
+def test_ragged_host_sync_quiet_on_device_derivation():
+    assert "ragged-metadata-host-sync" not in rules_of(GOOD_RAGGED)
+
+
+def test_ragged_host_sync_quiet_outside_traced_code():
+    assert "ragged-metadata-host-sync" not in rules_of(GOOD_RAGGED_HOST)
+
+
+def test_ragged_host_sync_attribute_and_subscript_bases():
+    src = """
+        import jax
+
+        @jax.jit
+        def step(meta):
+            a = meta.kv_start.item()
+            b = int(meta.block_seq[3])
+            return a + b
+    """
+    assert rules_of(src).count("ragged-metadata-host-sync") == 2
+
+
+def test_ragged_host_sync_suppressed():
+    src = BAD_RAGGED.replace(
+        "n = int(q_len[0])  # host sync on packing metadata",
+        "n = int(q_len[0])  # jaxlint: disable=ragged-metadata-host-sync"
+    ).replace(
+        "first = q_start.item()",
+        "first = q_start.item()  # jaxlint: disable=ragged-metadata-host-sync"
+    )
+    assert "ragged-metadata-host-sync" not in rules_of(src)
+
+
 def test_suppression_budget():
     """≤ 10 jaxlint suppression comments across kserve_tpu/, each carrying
     justification prose in the suppressing comment or the line above."""

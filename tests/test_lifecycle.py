@@ -555,6 +555,7 @@ class TestEngineStopAndDrain:
 
         engine._prefill_fn = boom
         engine._prefill_lp_fn = boom
+        engine._mixed_fn = boom  # the unified path admits via mixed
         try:
             with pytest.raises(RuntimeError, match="injected prefill crash"):
                 await asyncio.wait_for(

@@ -246,24 +246,78 @@ class TestEngineTelemetryFakeClock:
     @async_test
     async def test_xla_compile_counter_counts_cache_misses(self):
         before = REGISTRY.get_sample_value(
-            "engine_xla_compiles_total", {"program": "prefill"}) or 0.0
+            "engine_xla_compiles_total", {"program": "mixed"}) or 0.0
         engine = make_engine(metrics_label="obs-compile")
         await engine.start()
         params = SamplingParams(max_tokens=2, temperature=0.0, ignore_eos=True)
         await collect(engine.generate([1, 2, 3], params))
         first = REGISTRY.get_sample_value(
-            "engine_xla_compiles_total", {"program": "prefill"})
+            "engine_xla_compiles_total", {"program": "mixed"})
         assert first is not None and first >= before + 1
-        # one extra trace may land on the second call (the donated
-        # kv_pages' layout settles after the first full cycle) ...
+        # steady state MUST be retrace-free: same shapes, no growth (the
+        # historical donated-kv_pages settle retrace is fixed — see
+        # tests/test_retrace_budget.py)
         await collect(engine.generate([4, 5, 6], params))
-        settled = REGISTRY.get_sample_value(
-            "engine_xla_compiles_total", {"program": "prefill"})
-        # ... but steady state MUST be retrace-free: same shapes, no growth
         await collect(engine.generate([7, 8, 9], params))
         await engine.stop()
         assert REGISTRY.get_sample_value(
-            "engine_xla_compiles_total", {"program": "prefill"}) == settled
+            "engine_xla_compiles_total", {"program": "mixed"}) == first
+
+    @async_test
+    async def test_request_mixed_batch_ratio(self):
+        """Mixed steps export per-step TOKEN composition, not just lane
+        roles: while a long prompt chunk-prefills alongside a live decode
+        stream, some step must report prefill_tokens > 0 AND
+        decode_tokens > 0 simultaneously — the observable that proves the
+        scheduler barrier is gone — and the gauges must match the
+        engine's last recorded composition."""
+        label = "obs-mixed-ratio"
+        engine = make_engine(
+            metrics_label=label, max_prefill_len=16, prefill_buckets=(16,),
+            num_pages=128, max_pages_per_seq=32,
+        )
+        assert engine._use_mixed
+        await engine.start()
+        mixed_steps = []
+        orig_route = engine._route_mixed
+
+        def spy(plan, chunk_np, dispatched_at):
+            out = orig_route(plan, chunk_np, dispatched_at)
+            mixed_steps.append(dict(engine.last_step_composition))
+            return out
+
+        engine._route_mixed = spy
+        params = SamplingParams(max_tokens=64, temperature=0.0,
+                                ignore_eos=True)
+        try:
+            short_task = asyncio.create_task(
+                collect(engine.generate([1, 2, 3], params)))
+            # wait until the short request is decoding
+            while not any(s.request_id is not None for s in engine._slots):
+                await asyncio.sleep(0.01)
+            long_prompt = [5 + (i % 200) for i in range(200)]
+            await collect(engine.generate(
+                long_prompt,
+                SamplingParams(max_tokens=4, temperature=0.0,
+                               ignore_eos=True)))
+            await short_task
+        finally:
+            await engine.stop()
+        truly_mixed = [
+            c for c in mixed_steps
+            if c.get("prefill_tokens", 0) > 0 and c.get("decode_tokens", 0) > 0
+        ]
+        assert truly_mixed, f"no mixed-composition step seen: {mixed_steps}"
+        # gauges agree with the engine's last composition record
+        last = mixed_steps[-1]
+        assert REGISTRY.get_sample_value(
+            "engine_step_batch_composition",
+            {"model_name": label, "role": "prefill_tokens"},
+        ) == last["prefill_tokens"]
+        assert REGISTRY.get_sample_value(
+            "engine_step_batch_composition",
+            {"model_name": label, "role": "decode_tokens"},
+        ) == last["decode_tokens"]
 
 
 class TestQueueDepthGauge:

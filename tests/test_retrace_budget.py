@@ -1,20 +1,24 @@
 """Retrace budget: the engine's compiled programs must not recompile per
-request (ROADMAP item 2b's first perf-oracle gate, PR 6 satellite note).
+request (ROADMAP item 2b's first perf-oracle gate).
 
 `engine_xla_compiles_total{program}` counts jit-cache misses per compiled
 program (engine/compiled.py _CompileCounting).  The known-good budget on
 a multi-request CPU run over one shape bucket is:
 
-- ``prefill``: 2 — the first-request compile plus ONE benign retrace on
-  the second request (the donated kv_pages buffer's layout settles after
-  the first donation round-trip), then never again;
-- ``decode``: 1 — a single compile reused forever (fixed slots are the
-  engine's core design bet).
+- unified ragged path (default): ``mixed``: 1 — ONE program, compiled
+  once, serving admission prefill, chunked prefill and decode alike.
+- legacy path (use_ragged=False): ``prefill``: 1 and ``decode``: 1.
+
+Both are exactly-once now: the historical benign second-request prefill
+retrace ("donated kv_pages layout settles") was the init-time cache
+sharding being SPELLED differently from the program-output sharding —
+fixed by sharding.canonical_pspec (the init arrays now carry the
+GSPMD-canonical spelling), so the second dispatch's input signature is
+bit-identical to the first's.
 
 A growing count at steady state is the recompile alarm: shape-bucket
 drift, weak-type wobble, or a donation mismatch shows up HERE before it
-shows up as tail latency on a chip.  This test pins the budget so the
-benign one-time retrace cannot quietly become a per-request recompile.
+shows up as tail latency on a chip.
 """
 
 import asyncio
@@ -46,9 +50,13 @@ def delta(base: dict) -> dict:
 class TestRetraceBudget:
     @async_test
     async def test_multi_request_run_stays_inside_compile_budget(self):
+        """Unified ragged path (legacy flag off): the WHOLE serving loop —
+        admission prefill, first token, decode to completion — is one
+        `mixed` program compiled exactly once, then reused forever."""
         from test_engine import make_engine
 
         engine = make_engine()
+        assert engine._use_mixed
         await engine.start()
         try:
             base = compile_counts()
@@ -60,22 +68,44 @@ class TestRetraceBudget:
                     pass
 
             await run_one(0)
-            assert delta(base) == {"prefill": 1, "decode": 1}, (
-                "first request must compile exactly one prefill and one "
-                f"decode program, got {delta(base)}"
-            )
-            await run_one(1)
-            assert delta(base) == {"prefill": 2, "decode": 1}, (
-                "second request is allowed exactly the known benign "
-                "prefill retrace (donated kv_pages layout settles), got "
-                f"{delta(base)}"
+            assert delta(base) == {"mixed": 1}, (
+                "first request must compile exactly one mixed program, "
+                f"got {delta(base)}"
             )
             # steady state: more same-bucket requests compile NOTHING —
-            # the budget this test exists to freeze
-            for i in range(2, 5):
+            # including request 2, where the donated kv_pages used to pay
+            # a benign settle retrace before the canonical-spelling fix
+            for i in range(1, 5):
                 await run_one(i)
-            assert delta(base) == {"prefill": 2, "decode": 1}, (
+            assert delta(base) == {"mixed": 1}, (
                 "per-request recompile detected at steady state: "
+                f"{delta(base)}"
+            )
+        finally:
+            await engine.stop()
+
+    @async_test
+    async def test_legacy_path_compile_budget(self):
+        """use_ragged=False keeps the legacy programs, which now also
+        compile exactly once each (same canonical-spelling fix)."""
+        from test_engine import make_engine
+
+        engine = make_engine(use_ragged=False)
+        assert not engine._use_mixed
+        await engine.start()
+        try:
+            base = compile_counts()
+            params = SamplingParams(
+                max_tokens=4, temperature=0.0, ignore_eos=True)
+
+            async def run_one(i: int):
+                async for _ in engine.generate([5, 6, 7, 8 + i], params):
+                    pass
+
+            for i in range(4):
+                await run_one(i)
+            assert delta(base) == {"prefill": 1, "decode": 1}, (
+                "legacy programs must compile exactly once each, got "
                 f"{delta(base)}"
             )
         finally:
@@ -95,23 +125,18 @@ class TestRetraceBudget:
                 async for _ in engine.generate(prompt, params):
                     pass
 
-            # settle the donation retrace inside the small bucket first
+            # settle the small bucket first
             await run_one([1] * 4)
             await run_one([2] * 4)
             base = compile_counts()
-            # a LONGER prompt crosses into the next prefill bucket (>16):
-            # one fresh prefill compile (+ its one-time donation retrace on
-            # re-use), decode untouched
+            # a LONGER prompt crosses into the next packed-buffer bucket
+            # (>16): exactly one fresh mixed compile, then reuse
             await run_one([3] * 20)
-            first = delta(base)
-            assert first.get("decode", 0) == 0, first
-            assert first.get("prefill", 0) == 1, first
+            assert delta(base) == {"mixed": 1}, delta(base)
             await run_one([4] * 20)
             await run_one([5] * 20)
-            settled = delta(base)
-            assert settled.get("prefill", 0) <= 2, (
-                f"new-bucket prefill kept retracing: {settled}"
+            assert delta(base) == {"mixed": 1}, (
+                f"new-bucket mixed program kept retracing: {delta(base)}"
             )
-            assert settled.get("decode", 0) == 0, settled
         finally:
             await engine.stop()

@@ -59,6 +59,8 @@ class TestWedgedLiveness:
         # host fetch never completes
         engine._decode_fn = lambda *a, **k: (_BlockingChunk(),
                                              engine.kv_pages)
+        engine._mixed_fn = lambda *a, **k: (_BlockingChunk(),
+                                            engine.kv_pages)
 
         params = SamplingParams(max_tokens=4, temperature=0.0,
                                 ignore_eos=True)
