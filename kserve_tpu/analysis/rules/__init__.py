@@ -6,6 +6,7 @@ Add a new rule by dropping a module here that subclasses
 """
 
 from . import (  # noqa: F401
+    aotkey,
     blocking,
     donation,
     excepts,

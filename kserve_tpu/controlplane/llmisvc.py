@@ -28,6 +28,7 @@ from .crds import (
     WorkloadSpec,
 )
 from .objects import (
+    ensure_aot_cache,
     ensure_drain_lifecycle,
     ensure_probes,
     make_object,
@@ -321,6 +322,9 @@ class LLMISVCReconciler:
                 # plus shutdown margin before SIGKILL — no generation dies
                 # inside its budget (docs/lifecycle.md)
                 ensure_drain_lifecycle(c, DRAIN_GRACE_S)
+                # node-local AOT executable cache: warm restarts on this
+                # node skip XLA compilation entirely (docs/coldstart.md)
+                ensure_aot_cache(c, pod_spec)
                 # a user-supplied KSERVE_TPU_DRAIN_GRACE env wins inside
                 # ensure_drain_lifecycle — the grace period must track the
                 # budget the runtime will actually grant, or kubelet

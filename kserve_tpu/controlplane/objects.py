@@ -243,3 +243,43 @@ def ensure_drain_lifecycle(container: dict, drain_grace_s: float,
             "value": f"{drain_grace_s:g}",
         })
     return container
+
+
+# node-local AOT executable cache (docs/coldstart.md): hostPath survives
+# pod churn, so the first replica on a node pays the XLA compile and every
+# later start on that node — scale-up burst, crash restart, wake from
+# zero — deserializes instead of compiling
+AOT_CACHE_MOUNT_PATH = "/var/cache/kserve-tpu-aot"
+AOT_CACHE_HOST_PATH = "/var/cache/kserve-tpu-aot"
+AOT_CACHE_VOLUME = "aot-executable-cache"
+
+
+def ensure_aot_cache(container: dict, pod_spec: dict) -> dict:
+    """Mount the node-local AOT executable cache and point the runtime at
+    it (KSERVE_TPU_AOT_CACHE — engine/aot_cache.py).  A user-supplied env
+    of the same name wins: operators swap the hostPath for a warmed PVC by
+    mounting it themselves and setting the env to its path.  The cache
+    content-digests config/topology/versions, so sharing one hostPath
+    between different models/meshes on a node is safe by construction."""
+    env = container.setdefault("env", [])
+    if not any(e.get("name") == "KSERVE_TPU_AOT_CACHE" for e in env):
+        env.append({
+            "name": "KSERVE_TPU_AOT_CACHE",
+            "value": AOT_CACHE_MOUNT_PATH,
+        })
+        mounts = container.setdefault("volumeMounts", [])
+        if not any(m.get("name") == AOT_CACHE_VOLUME for m in mounts):
+            mounts.append({
+                "name": AOT_CACHE_VOLUME,
+                "mountPath": AOT_CACHE_MOUNT_PATH,
+            })
+        volumes = pod_spec.setdefault("volumes", [])
+        if not any(v.get("name") == AOT_CACHE_VOLUME for v in volumes):
+            volumes.append({
+                "name": AOT_CACHE_VOLUME,
+                "hostPath": {
+                    "path": AOT_CACHE_HOST_PATH,
+                    "type": "DirectoryOrCreate",
+                },
+            })
+    return container

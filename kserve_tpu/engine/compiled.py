@@ -79,7 +79,11 @@ class CompiledPrograms:
     mixed: Callable = None  # None when the config can't build it (pp>1)
 
 
-def build_compiled(model_config, engine_config, mesh) -> CompiledPrograms:
+def build_compiled(model_config, engine_config, mesh,
+                   aot_cache=None) -> CompiledPrograms:
+    """`aot_cache` (an engine/aot_cache.AOTExecutableCache) switches the
+    program set from lazy ``jax.jit`` to persistent per-signature AOT
+    executables — same call surface, zero compiles on a warm start."""
     cfg = engine_config
     mc = model_config
 
@@ -447,29 +451,39 @@ def build_compiled(model_config, engine_config, mesh) -> CompiledPrograms:
         return fn
 
     n_kv_args = 3  # kv_pages is arg index 3 in the prefill/decode sigs
-    extra = {}
+    # program name -> (python fn, donated arg indices).  ONE definition
+    # table serves both dispatch modes below, so a program cannot exist
+    # jitted but be missing from the AOT-cached build (or vice versa).
+    defs = {
+        "prefill": (_make_prefill(False), (n_kv_args,)),
+        "prefill_lp": (_make_prefill(True), (n_kv_args,)),
+        "prefill_chunk": (_prefill_chunk, (4,)),
+        "sample_first": (_make_sample_first(False), ()),
+        "sample_first_lp": (_make_sample_first(True), ()),
+        "decode": (_make_decode(False), (n_kv_args,)),
+        "decode_lp": (_make_decode(False, with_logprobs=True), (n_kv_args,)),
+        # arg 11 = prompt mask (kept across chunks), arg 12 = counts (donated)
+        "decode_penalized": (_make_decode(True), (n_kv_args, 12)),
+        "decode_penalized_lp": (
+            _make_decode(True, with_logprobs=True), (n_kv_args, 12)),
+        "inject": (_inject, (0,)),
+        "inject_q": (_inject_q, (0,)),
+    }
     if cfg.pp == 1:
         # the mixed program runs the flat per-layer forward; pp>1 engines
         # keep the staged legacy programs (use_ragged forces off there)
-        extra = _counted(
-            mixed=jax.jit(_make_mixed(), donate_argnums=(8,)))
-    return CompiledPrograms(**extra, **_counted(
-        prefill=jax.jit(_make_prefill(False), donate_argnums=(n_kv_args,)),
-        prefill_lp=jax.jit(_make_prefill(True), donate_argnums=(n_kv_args,)),
-        prefill_chunk=jax.jit(_prefill_chunk, donate_argnums=(4,)),
-        sample_first=jax.jit(_make_sample_first(False)),
-        sample_first_lp=jax.jit(_make_sample_first(True)),
-        decode=jax.jit(_make_decode(False), donate_argnums=(n_kv_args,)),
-        decode_lp=jax.jit(
-            _make_decode(False, with_logprobs=True), donate_argnums=(n_kv_args,)
-        ),
-        # arg 11 = prompt mask (kept across chunks), arg 12 = counts (donated)
-        decode_penalized=jax.jit(
-            _make_decode(True), donate_argnums=(n_kv_args, 12)
-        ),
-        decode_penalized_lp=jax.jit(
-            _make_decode(True, with_logprobs=True), donate_argnums=(n_kv_args, 12)
-        ),
-        inject=jax.jit(_inject, donate_argnums=(0,)),
-        inject_q=jax.jit(_inject_q, donate_argnums=(0,)),
-    ))
+        defs["mixed"] = (_make_mixed(), (8,))
+    if aot_cache is not None:
+        # persistent AOT path (engine/aot_cache.py): per-signature
+        # executables lowered once and serialized to disk, so a warm
+        # replica start dispatches without a single trace or XLA compile
+        from .aot_cache import AOTProgram
+
+        return CompiledPrograms(**{
+            name: AOTProgram(name, fn, aot_cache, donate_argnums=donate)
+            for name, (fn, donate) in defs.items()
+        })
+    return CompiledPrograms(**_counted(**{
+        name: jax.jit(fn, donate_argnums=donate)
+        for name, (fn, donate) in defs.items()
+    }))

@@ -50,6 +50,7 @@ from ..metrics import (
     GENERATED_TOKENS,
     PROMPT_TOKENS,
     observe_request_timeline,
+    observe_startup_phase,
 )
 from ..metrics import (
     DEADLINE_REJECTED,
@@ -125,6 +126,19 @@ class LLMEngine:
                 "mesh axis"
             )
         self.model_config = model_config
+        # startup-phase accounting (docs/coldstart.md): wall seconds per
+        # phase, observed into engine_startup_seconds once the engine is
+        # serving.  perf_counter (not the injectable telemetry clock) —
+        # startup is host wall time, and the sim replica injects stub
+        # programs so this path never runs under virtual time.
+        self._construct_t0 = time.perf_counter()
+        self.startup_phases: Dict[str, float] = {}
+        # wall seconds spent BEFORE engine construction that belong to
+        # this replica's startup (the server's checkpoint read) — folded
+        # into the ready phase so ready stays the true total and never
+        # reads smaller than the weights phase it contains
+        self.startup_external_s = 0.0
+        self._startup_recorded = False
         # own copy: prefix_cache=None resolves below, and resolving in the
         # caller's dataclass would make a reused config look explicitly set
         engine_config = dataclasses.replace(engine_config)
@@ -189,6 +203,7 @@ class LLMEngine:
 
         if engine_config.weight_quant not in ("none", "int8"):
             raise ValueError(f"weight_quant={engine_config.weight_quant!r}")
+        _weights_t0 = time.perf_counter()
         if params is None:
             params = llama.init_params(
                 model_config, jax.random.PRNGKey(1),
@@ -289,6 +304,10 @@ class LLMEngine:
                     lspecs,
                     is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
                 )
+
+        # device placement done: everything from the quantize/init above
+        # through the LoRA stacks landing on device is the weights phase
+        self.startup_phases["weights"] = time.perf_counter() - _weights_t0
 
         cache_cfg = KVCacheConfig(
             n_layers=model_config.n_layers,
@@ -482,13 +501,50 @@ class LLMEngine:
         """Jit the device programs (engine/compiled.py) and bind them under
         the historical attribute names the loop dispatches through.
         `override` (the simulator's stub seam) supplies a pre-built program
-        set with the same attribute surface instead."""
+        set with the same attribute surface instead.
+
+        With config.aot_cache_dir set, programs build as persistent AOT
+        executables (engine/aot_cache.py) and every entry already on disk
+        for this config digest is deserialized NOW — a warm start reaches
+        its first request with zero traces, zero XLA compiles."""
+        self._aot_cache = None
         if override is not None:
             p = override
         else:
             from .compiled import build_compiled
 
-            p = build_compiled(self.model_config, self.config, self.mesh)
+            cache = None
+            if self.config.aot_cache_dir:
+                from .aot_cache import AOTExecutableCache
+
+                try:
+                    cache = AOTExecutableCache(
+                        self.config.aot_cache_dir, self.model_config,
+                        self.config, self.mesh, label=self._mlabel,
+                    )
+                except OSError as exc:
+                    # an unwritable cache volume must not take down the
+                    # replica — it degrades to today's compile-on-start
+                    logger.warning(
+                        "aot-cache-disabled dir=%s error=%s",
+                        self.config.aot_cache_dir,
+                        f"{type(exc).__name__}: {exc}")
+            p = build_compiled(
+                self.model_config, self.config, self.mesh, aot_cache=cache)
+            self._aot_cache = cache
+            if cache is not None:
+                loaded = sum(
+                    prog.preload()
+                    for prog in (
+                        getattr(p, f.name)
+                        for f in dataclasses.fields(type(p))
+                    )
+                    if prog is not None and hasattr(prog, "preload")
+                )
+                logger.info(
+                    "aot-cache ready: digest=%s preloaded=%d executables "
+                    "(%.3fs)", cache.digest, loaded,
+                    cache.stats.aot_load_s)
         self._prefill_fn = p.prefill
         self._prefill_lp_fn = p.prefill_lp
         self._prefill_chunk_fn = p.prefill_chunk
@@ -514,6 +570,58 @@ class LLMEngine:
                 self.config.max_batch_size, self.config.num_pages,
                 self.config.page_size, self.config.tp,
             )
+            warmup = self.config.aot_warmup
+            if warmup is None:
+                warmup = self._aot_cache is not None
+            if warmup and not self._stopped:
+                await self._aot_warmup()
+            self._record_startup_ready()
+
+    async def _aot_warmup(self):
+        """Drive one tiny generation per prefill bucket through the REAL
+        serving loop before the replica turns ready, so every
+        steady-state program signature is compiled (cold start — and
+        persisted to the AOT cache) or deserialized (warm start) ahead
+        of the first real request.  Driving generate() instead of
+        hand-building abstract signatures means warmup can never drift
+        from what the scheduler actually dispatches."""
+        params = SamplingParams(
+            max_tokens=min(4, max(1, self.config.steps_per_sync)),
+            temperature=0.0, ignore_eos=True,
+        )
+        for bucket in self.config.prefill_buckets:
+            n = min(bucket, self.config.max_model_len - params.max_tokens)
+            if n <= 0:
+                continue
+            try:
+                async for _ in self.generate(
+                    [1] * n, params, request_id=f"aot-warmup-{bucket}"
+                ):
+                    pass
+            except Exception:  # noqa: BLE001 — warmup is an optimization;
+                # a failure here must surface in logs, not block serving
+                logger.exception("aot warmup failed for bucket %d", bucket)
+        # warmup generations are not traffic: give the telemetry ring a
+        # clean start (prometheus counters do keep the handful of warmup
+        # observations — documented in docs/coldstart.md)
+        self.telemetry = TimelineRecorder()
+
+    def _record_startup_ready(self) -> None:
+        """Stamp the ready phase and export every startup phase once
+        (engine_startup_seconds — docs/coldstart.md)."""
+        if self._startup_recorded:
+            return
+        self._startup_recorded = True
+        if self._aot_cache is not None:
+            s = self._aot_cache.stats
+            self.startup_phases["trace"] = s.trace_s
+            self.startup_phases["compile"] = s.compile_s
+            self.startup_phases["aot_load"] = s.aot_load_s
+        self.startup_phases["ready"] = (
+            time.perf_counter() - self._construct_t0
+            + self.startup_external_s)
+        for phase, seconds in self.startup_phases.items():
+            observe_startup_phase(self._mlabel, phase, seconds)
 
     async def stop(self):
         self._stopped = True

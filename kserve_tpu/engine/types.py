@@ -112,6 +112,19 @@ class EngineConfig:
     # static top-k width for the logprob-emitting program variants (OpenAI
     # caps top_logprobs at 20); requests asking for fewer slice host-side
     max_logprobs: int = 20
+    # persistent AOT executable cache (engine/aot_cache.py,
+    # docs/coldstart.md): compiled engine programs are serialized to this
+    # directory keyed by a config/topology/version digest, and a replica
+    # start deserializes instead of tracing — warm starts perform ZERO
+    # XLA compiles.  None = disabled (every start compiles).  The llmisvc
+    # reconciler mounts a node-local hostPath (or warmed PVC) here via
+    # the KSERVE_TPU_AOT_CACHE env.
+    aot_cache_dir: Optional[str] = None
+    # drive one tiny generation per prefill bucket through the serving
+    # loop BEFORE the replica turns ready, so steady-state signatures are
+    # compiled (cold) or loaded (warm) ahead of the first real request.
+    # None = auto (on when aot_cache_dir is set).
+    aot_warmup: Optional[bool] = None
     # unified ragged paged-attention program (docs/kernels.md): prompt
     # chunks and decode lanes fold into ONE `mixed` dispatch per engine
     # step, so decode lanes keep advancing while a prompt prefills and the

@@ -183,6 +183,39 @@ ENGINE_STEP_BATCH_COMPOSITION = Gauge(
     ["model_name", "role"],
 )
 
+# Replica startup phases (kserve_tpu/engine/aot_cache.py — docs/coldstart.md).
+# `phase` is the closed STARTUP_PHASES enum; buckets reach minutes because a
+# cold 8B compile + weight load legitimately does.
+STARTUP_PHASES = ("trace", "compile", "aot_load", "weights", "ready")
+_STARTUP_BUCKETS = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+    60.0, 120.0, 300.0, float("inf"),
+)
+ENGINE_STARTUP = Histogram(
+    "engine_startup_seconds",
+    "replica startup wall time by phase: trace (jaxpr+lowering), compile "
+    "(XLA), aot_load (executable deserialization from the AOT cache), "
+    "weights (checkpoint read + device placement), ready (total "
+    "construct->serving)",
+    ["model_name", "phase"], buckets=_STARTUP_BUCKETS,
+)
+# `program` is the fixed compiled-program name set (same bound as
+# engine_xla_compiles_total); `event` is a closed enum
+AOT_CACHE_EVENTS = Counter(
+    "engine_aot_cache_events_total",
+    "persistent AOT executable cache events (hit | miss | store | invalid), "
+    "by compiled engine program",
+    ["program", "event"],
+)
+
+
+def observe_startup_phase(model_name: str, phase: str, seconds: float) -> None:
+    """Record one engine_startup_seconds observation (phase must be in
+    STARTUP_PHASES; anything else is a programming error worth raising)."""
+    if phase not in STARTUP_PHASES:
+        raise ValueError(f"unknown startup phase {phase!r}")
+    ENGINE_STARTUP.labels(model_name=model_name, phase=phase).observe(seconds)
+
 
 def observe_request_timeline(model_name: str, timeline) -> None:
     """Export one finished RequestTimeline to the Prometheus histograms

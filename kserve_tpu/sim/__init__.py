@@ -27,6 +27,7 @@ from .scenario import (  # noqa: F401
     ChurnEvent,
     Scenario,
     churn_10k_scenario,
+    scale_zero_scenario,
     smoke_scenario,
 )
 from .stub import (  # noqa: F401

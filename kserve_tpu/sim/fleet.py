@@ -123,6 +123,7 @@ class FleetSim:
     _CHURN_KINDS = frozenset({
         "preempt", "crash", "drain_restart", "breaker_trip",
         "shed_storm", "heal_shed", "skew", "heal_skew",
+        "scale_down", "scale_up",
     })
     _FLEET_WIDE = frozenset({"shed_storm", "heal_shed"})
 
@@ -169,6 +170,18 @@ class FleetSim:
         elif ev.kind == "drain_restart":
             self._churn_subtasks.append(asyncio.create_task(
                 self._drain_restart(r, ev.restart_after_s, ev.grace_s)))
+        elif ev.kind == "scale_down":
+            # autoscaler scale-in (to zero when it hits every replica):
+            # graceful drain checkpoints in-flight work out to the
+            # clients, then the pod is GONE until a scale_up — the
+            # gateway (client retry loop) holds and replays
+            self._churn_subtasks.append(asyncio.create_task(
+                self._scale_down(r, ev.grace_s)))
+        elif ev.kind == "scale_up":
+            # wake: fresh pod on the same node — warm AOT cache, so the
+            # stub charges aot_load_s instead of compile_s before ready
+            self._churn_subtasks.append(asyncio.create_task(
+                self._scale_up(r)))
         elif ev.kind == "breaker_trip":
             self.net_plan.specs.append(FaultSpec(
                 f"{r.name}/proxy", "http_status", status=503,
@@ -201,6 +214,14 @@ class FleetSim:
         await r.drain(grace_s)
         await r.stop()
         await self.clock.sleep(after_s)
+        await r.restart()
+        self.picker.breakers.forget(r.url)
+
+    async def _scale_down(self, r: SimReplica, grace_s) -> None:
+        await r.drain(grace_s)
+        await r.stop()
+
+    async def _scale_up(self, r: SimReplica) -> None:
         await r.restart()
         self.picker.breakers.forget(r.url)
 

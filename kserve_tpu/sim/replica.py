@@ -98,6 +98,13 @@ class SimReplica:
         ))
         self.generation = 0  # restart counter (engine identity)
         self.crashes = 0
+        # node-local AOT executable cache state (docs/coldstart.md): the
+        # first build on this "node" compiles cold and populates the
+        # cache; every later build — crash restart, rolling restart, wake
+        # from zero — starts warm.  start_records carries the cold/warm
+        # ready-cost history into the goodput report.
+        self.node_cache_warm = False
+        self.start_records: List[dict] = []
         # engine counters survive restarts here (a fresh engine starts at
         # zero; the report wants the replica's lifetime totals)
         self.totals = {
@@ -111,7 +118,13 @@ class SimReplica:
     def _build_engine(self) -> None:
         cfg = self.spec.engine_config()
         programs = build_stub_programs(
-            cfg, self.device, vocab_size=self.model_config.vocab_size)
+            cfg, self.device, vocab_size=self.model_config.vocab_size,
+            warm=self.node_cache_warm)
+        self.start_records.append({
+            "kind": "warm" if programs.warm else "cold",
+            "cost_s": programs.startup_cost_s,
+        })
+        self.node_cache_warm = True
         self.engine = LLMEngine(
             self.model_config,
             cfg,
@@ -216,6 +229,7 @@ class SimReplica:
             "finished": self_totals["finished"] + e.telemetry.finished_count,
             "device_dispatches": self.device.dispatches,
             "lifecycle": self.lifecycle.state,
+            "starts": [dict(s) for s in self.start_records],
         }
 
     async def restart(self) -> None:

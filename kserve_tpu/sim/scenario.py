@@ -42,9 +42,12 @@ from .workload import WorkloadConfig
 # flight, and shed watermarks mean something, while 4-lane batches still
 # clear ~200 tok/s/replica so a 10k-request trace finishes in ~20 virtual
 # minutes.  Virtual slowness is free: wall time scales with EVENTS, not
-# with simulated seconds.
+# with simulated seconds.  Replica starts pay 1s of cold XLA compile or
+# 50ms of warm AOT executable load (docs/coldstart.md): every canned
+# restart leg now asserts the warm-start delta as a side effect.
 _CANNED_COSTS = StubCosts(
-    prefill_base_s=0.01, prefill_per_token_s=2e-4, decode_step_s=0.02)
+    prefill_base_s=0.01, prefill_per_token_s=2e-4, decode_step_s=0.02,
+    compile_s=1.0, aot_load_s=0.05)
 
 
 def _canned_spec() -> ReplicaSpec:
@@ -91,7 +94,11 @@ def smoke_scenario(seed: int = 7) -> Scenario:
     trip, a shed burst, and a mixed-composition leg (a second burst whose
     long-context chunked prefills overlap live decode lanes inside the
     unified ragged program, with a preemption landing mid-overlap) — fast
-    enough for tier-1 on every PR."""
+    enough for tier-1 on every PR.  The initial builds compile COLD
+    (compile_s each) while every churn restart — replica-0's drain
+    restart, replica-1's crash recovery — comes back WARM off the node's
+    AOT cache (aot_load_s ≪ compile_s), so the cold/warm replica-start
+    delta is asserted in tier-1, not just in the slow traces."""
     return Scenario(
         name="smoke",
         seed=seed,
@@ -137,6 +144,67 @@ def smoke_scenario(seed: int = 7) -> Scenario:
             # the 10k acceptance scenario holds the fleet to
             max_retry_amplification=3.0, max_shed_fraction=1.0,
         ),
+    )
+
+
+def scale_zero_scenario(seed: int = 11) -> Scenario:
+    """Serverless elasticity (ROADMAP item 3, docs/coldstart.md): the
+    fleet scales 0→N→0 under deterministic traffic.  Both replicas build
+    COLD at t=0 (the node AOT caches populate), are scaled to zero almost
+    immediately, wake WARM at ~6s to replay the gateway-held backlog,
+    pass through a SECOND zero window mid-traffic, and wake warm again —
+    no request may drop across either outage, and the warm ready-cost
+    must be a small fraction of the cold one (asserted in tier-1)."""
+    costs = StubCosts(
+        prefill_base_s=0.01, prefill_per_token_s=2e-4, decode_step_s=0.02,
+        # pronounced cold/warm split: 3s of XLA compile vs 0.1s of
+        # executable deserialization — the zero-compile replica start
+        compile_s=3.0, aot_load_s=0.1)
+    return Scenario(
+        name="scale-zero",
+        seed=seed,
+        n_replicas=2,
+        spec=ReplicaSpec(costs=costs),
+        workload=WorkloadConfig(
+            n_requests=30, duration_s=24.0,
+            # the burst lands inside the SECOND zero window: those
+            # requests are held by the retry layer and replayed on wake
+            bursts=[(17.0, 8)],
+        ),
+        churn=[
+            # scale to zero just after launch: cold compiles are wasted
+            # work the warm wakes below never repeat
+            ChurnEvent(at_s=0.3, kind="scale_down", replica="replica-0",
+                       grace_s=0.0),
+            ChurnEvent(at_s=0.3, kind="scale_down", replica="replica-1",
+                       grace_s=0.0),
+            # wake: both replicas come back WARM and replay the backlog
+            ChurnEvent(at_s=6.0, kind="scale_up", replica="replica-0"),
+            ChurnEvent(at_s=6.2, kind="scale_up", replica="replica-1"),
+            # second pass through zero, mid-traffic
+            ChurnEvent(at_s=16.0, kind="scale_down", replica="replica-0",
+                       grace_s=0.0),
+            ChurnEvent(at_s=16.0, kind="scale_down", replica="replica-1",
+                       grace_s=0.0),
+            ChurnEvent(at_s=20.0, kind="scale_up", replica="replica-0"),
+            ChurnEvent(at_s=20.1, kind="scale_up", replica="replica-1"),
+        ],
+        budget=SLOBudget(
+            # TTFT absorbs the zero windows (a request arriving at 0.3
+            # waits ~6s for the wake) — that is the scenario's point; what
+            # may NOT happen is a drop: goodput 1.0, zero lost tokens
+            p99_ttft_s=25.0, p99_itl_s=2.0, min_goodput=1.0,
+            # the "gateway hold" is modeled as the client retry loop
+            # polling through two multi-second zero windows (0.05-0.8s
+            # backoff), so amplification is structurally high here — the
+            # budget bounds it without pretending a parked request is one
+            # attempt.  Production gateways park on a wake signal instead.
+            max_retry_amplification=12.0, max_shed_fraction=1.0,
+        ),
+        # gateway persistence: requests held across a zero window retry
+        # until the fleet wakes
+        client_max_attempts=40,
+        client_retry_budget_s=240.0,
     )
 
 

@@ -71,6 +71,14 @@ class StubCosts:
     prefill_per_token_s: float = 2e-5  # per prompt token in the call
     decode_step_s: float = 2e-3  # per decode step (chunk = steps_per_sync)
     inject_s: float = 1e-3  # per KV-injection scatter
+    # replica-start costs (the AOT-cache story, docs/coldstart.md): a COLD
+    # build pays compile_s (XLA-compiling the program set before ready — on
+    # a chip this is tens of seconds); a WARM build pays aot_load_s
+    # (deserializing persisted executables — orders of magnitude cheaper).
+    # Charged once at StubPrograms build, so the cold/warm ready-time delta
+    # is assertable in tier-1.  Default 0 keeps pre-AOT scenarios unchanged.
+    compile_s: float = 0.0
+    aot_load_s: float = 0.0
 
 
 class StubDevice:
@@ -137,11 +145,19 @@ class StubPrograms:
     cost accounting lives on the StubDevice timeline."""
 
     def __init__(self, engine_config, device: StubDevice,
-                 vocab_size: int = 512):
+                 vocab_size: int = 512, warm: bool = False):
         self._cfg = engine_config
         self._device = device
         self._vocab = vocab_size
         self._K = engine_config.max_logprobs
+        # replica-start cost (mirrors engine.aot_warmup running BEFORE the
+        # replica turns ready): a cold build XLA-compiles the program set,
+        # a warm build deserializes it from the node's AOT cache
+        self.warm = warm
+        self.startup_cost_s = (
+            device.costs.aot_load_s if warm else device.costs.compile_s)
+        if self.startup_cost_s > 0:
+            device.dispatch(self.startup_cost_s)
         self.prefill = self._make_prefill(False)
         self.prefill_lp = self._make_prefill(True)
         self.prefill_chunk = self._prefill_chunk
@@ -327,5 +343,7 @@ class _StubLogits:
 
 
 def build_stub_programs(engine_config, device: StubDevice,
-                        vocab_size: int = 512) -> StubPrograms:
-    return StubPrograms(engine_config, device, vocab_size=vocab_size)
+                        vocab_size: int = 512,
+                        warm: bool = False) -> StubPrograms:
+    return StubPrograms(engine_config, device, vocab_size=vocab_size,
+                        warm=warm)

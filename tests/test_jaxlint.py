@@ -555,6 +555,94 @@ def test_ragged_host_sync_suppressed():
     assert "ragged-metadata-host-sync" not in rules_of(src)
 
 
+# ------------------------------------------- aot-cache-key-drift
+
+BAD_AOTKEY = """
+    AOT_KEY_ENGINE_FIELDS = ("page_size", "steps_per_sync")
+
+    def build_compiled(model_config, engine_config, mesh, aot_cache=None):
+        cfg = engine_config
+        steps = cfg.steps_per_sync          # covered
+        pages = cfg.page_size               # covered
+        fancy = cfg.new_kernel_flag         # NOT in the digest: drift
+        quant = getattr(cfg, "act_quant", None)  # getattr spelling: drift
+        return steps + pages
+"""
+
+GOOD_AOTKEY = """
+    AOT_KEY_ENGINE_FIELDS = ("page_size", "steps_per_sync", "kv_quant")
+
+    def build_compiled(model_config, engine_config, mesh, aot_cache=None):
+        cfg = engine_config
+        quant = getattr(cfg, "kv_quant", None)
+        return cfg.page_size * cfg.steps_per_sync
+"""
+
+GOOD_AOTKEY_ELSEWHERE = """
+    # config reads OUTSIDE build_compiled are not compiled-program
+    # construction: the engine reads scheduling knobs freely
+    def plan_batch(engine_config):
+        return engine_config.queue_policy
+"""
+
+
+def test_aotkey_fires_on_uncovered_reads():
+    rules = rules_of(BAD_AOTKEY)
+    assert rules.count("aot-cache-key-drift") == 2
+
+
+def test_aotkey_quiet_when_fields_covered():
+    assert "aot-cache-key-drift" not in rules_of(GOOD_AOTKEY)
+
+
+def test_aotkey_quiet_outside_build_compiled():
+    assert "aot-cache-key-drift" not in rules_of(GOOD_AOTKEY_ELSEWHERE)
+
+
+def test_aotkey_fires_when_no_field_list_resolvable():
+    src = """
+        def build_compiled(model_config, engine_config, mesh):
+            return engine_config.page_size
+    """
+    assert "aot-cache-key-drift" in rules_of(src)
+
+
+def test_aotkey_resolves_sibling_aot_cache_module(tmp_path):
+    """The real tree layout: the digest list lives in aot_cache.py next
+    to compiled.py — the rule must read it from there."""
+    (tmp_path / "aot_cache.py").write_text(
+        'AOT_KEY_ENGINE_FIELDS = ("page_size",)\n')
+    (tmp_path / "compiled.py").write_text(textwrap.dedent("""
+        def build_compiled(model_config, engine_config, mesh):
+            ok = engine_config.page_size
+            bad = engine_config.brand_new_flag
+            return ok
+    """))
+    findings = lint_paths([str(tmp_path / "compiled.py")])
+    hits = [f for f in findings if f.rule == "aot-cache-key-drift"]
+    assert len(hits) == 1
+    assert "brand_new_flag" in hits[0].message
+
+
+def test_aotkey_suppressed():
+    src = BAD_AOTKEY.replace(
+        "fancy = cfg.new_kernel_flag         # NOT in the digest: drift",
+        "fancy = cfg.new_kernel_flag  # jaxlint: disable=aot-cache-key-drift",
+    ).replace(
+        'quant = getattr(cfg, "act_quant", None)  # getattr spelling: drift',
+        'quant = getattr(cfg, "act_quant", None)  # jaxlint: disable=aot-cache-key-drift',
+    )
+    assert "aot-cache-key-drift" not in rules_of(src)
+
+
+def test_aotkey_real_tree_digest_covers_build_compiled():
+    """The production pair stays in lockstep: engine/compiled.py lints
+    clean under the rule against engine/aot_cache.py's field list."""
+    compiled_py = os.path.join(PKG_DIR, "engine", "compiled.py")
+    findings = lint_paths([compiled_py], select=["aot-cache-key-drift"])
+    assert findings == []
+
+
 def test_suppression_budget():
     """≤ 10 jaxlint suppression comments across kserve_tpu/, each carrying
     justification prose in the suppressing comment or the line above."""

@@ -1133,6 +1133,75 @@ class TestControlPlaneDrain:
         )
 
 
+class TestControlPlaneAOTCache:
+    """The llmisvc reconciler wires the persistent AOT executable cache
+    (docs/coldstart.md): a node-local hostPath mounted into the main
+    container with KSERVE_TPU_AOT_CACHE pointing at it, so replica
+    restarts on the same node start with zero XLA compiles."""
+
+    def _reconcile(self, template=None):
+        from kserve_tpu.controlplane.crds import LLMInferenceService
+        from kserve_tpu.controlplane.llmisvc import LLMISVCReconciler
+
+        workload = {"replicas": 1}
+        if template is not None:
+            workload["template"] = template
+        llm = LLMInferenceService.model_validate({
+            "apiVersion": "serving.kserve.io/v1alpha2",
+            "kind": "LLMInferenceService",
+            "metadata": {"name": "llama", "namespace": "default"},
+            "spec": {
+                "model": {"uri": "hf://meta-llama/Llama-3.2-1B",
+                          "name": "llama"},
+                "workload": workload,
+            },
+        })
+        reconciler = LLMISVCReconciler()
+        spec = reconciler._merge_presets(llm)
+        objects = reconciler._workload(
+            llm, spec.workload, "decode", str(llm.spec.model.uri))
+        deployment = next(o for o in objects if o["kind"] == "Deployment")
+        return deployment["spec"]["template"]["spec"]
+
+    def test_workload_mounts_node_local_aot_cache(self):
+        from kserve_tpu.controlplane.objects import (
+            AOT_CACHE_HOST_PATH,
+            AOT_CACHE_MOUNT_PATH,
+            AOT_CACHE_VOLUME,
+        )
+
+        pod = self._reconcile()
+        main = next(c for c in pod["containers"] if c["name"] == "main")
+        env = {e["name"]: e.get("value") for e in main["env"]}
+        assert env["KSERVE_TPU_AOT_CACHE"] == AOT_CACHE_MOUNT_PATH
+        mount = next(m for m in main["volumeMounts"]
+                     if m["name"] == AOT_CACHE_VOLUME)
+        assert mount["mountPath"] == AOT_CACHE_MOUNT_PATH
+        volume = next(v for v in pod["volumes"]
+                      if v["name"] == AOT_CACHE_VOLUME)
+        assert volume["hostPath"] == {
+            "path": AOT_CACHE_HOST_PATH, "type": "DirectoryOrCreate",
+        }
+
+    def test_user_aot_cache_env_wins(self):
+        """An operator pointing KSERVE_TPU_AOT_CACHE at their own warmed
+        PVC mount must not get the hostPath volume stacked on top."""
+        from kserve_tpu.controlplane.objects import AOT_CACHE_VOLUME
+
+        pod = self._reconcile(template={"containers": [{
+            "name": "main",
+            "env": [{"name": "KSERVE_TPU_AOT_CACHE",
+                     "value": "/mnt/warmed-cache"}],
+        }]})
+        main = next(c for c in pod["containers"] if c["name"] == "main")
+        env = {e["name"]: e.get("value") for e in main["env"]}
+        assert env["KSERVE_TPU_AOT_CACHE"] == "/mnt/warmed-cache"
+        assert not any(m.get("name") == AOT_CACHE_VOLUME
+                       for m in main.get("volumeMounts", []))
+        assert not any(v.get("name") == AOT_CACHE_VOLUME
+                       for v in pod.get("volumes", []))
+
+
 # ---------------- event-loop responsiveness during device fetch ----------------
 
 

@@ -1217,11 +1217,17 @@ class TestFleetScenarioChaos:
             assert_slo,
             canonical_json,
         )
-        from kserve_tpu.sim.scenario import _canned_spec
+        from kserve_tpu.sim import ReplicaSpec, StubCosts
 
         scn = Scenario(
             name="chaos-2replica", seed=11, n_replicas=2,
-            spec=_canned_spec(),
+            # the canned costs, minus replica-start (compile_s/aot_load_s):
+            # this scenario's churn timing is hand-tuned against instant
+            # starts, and startup economics have their own scenario
+            # (scale_zero_scenario / the smoke warm-restart leg)
+            spec=ReplicaSpec(costs=StubCosts(
+                prefill_base_s=0.01, prefill_per_token_s=2e-4,
+                decode_step_s=0.02)),
             workload=WorkloadConfig(n_requests=40, duration_s=20.0,
                                     bursts=[(6.0, 10)]),
             churn=[

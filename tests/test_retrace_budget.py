@@ -22,6 +22,8 @@ shows up as tail latency on a chip.
 """
 
 import asyncio
+import glob
+import os
 
 from conftest import async_test
 
@@ -140,3 +142,149 @@ class TestRetraceBudget:
             )
         finally:
             await engine.stop()
+
+
+class TestWarmStartBudget:
+    """Persistent AOT cache (engine/aot_cache.py, docs/coldstart.md): a
+    replica starting against a populated cache performs ZERO XLA compiles
+    — the warm half of the zero-compile replica-start contract.  The cold
+    engine's own warmup populates the cache; the warm engine preloads it
+    at construction and every dispatch (admission prefill, chunked
+    prefill, decode) runs deserialized executables."""
+
+    async def _run_requests(self, engine, n=3):
+        params = SamplingParams(max_tokens=4, temperature=0.0,
+                                ignore_eos=True)
+        for i in range(n):
+            async for _ in engine.generate([5, 6, 7, 8 + i], params):
+                pass
+
+    @async_test
+    async def test_warm_start_zero_compiles_mixed(self, tmp_path):
+        from test_engine import make_engine
+
+        cold = make_engine(aot_cache_dir=str(tmp_path))
+        assert cold._use_mixed
+        await cold.start()  # warmup compiles + persists every bucket
+        await self._run_requests(cold)
+        await cold.stop()
+        assert cold._aot_cache.stats.compiles >= 1
+
+        warm = make_engine(aot_cache_dir=str(tmp_path))
+        base = compile_counts()
+        await warm.start()
+        try:
+            await self._run_requests(warm)
+            assert delta(base) == {}, (
+                "warm start must perform ZERO XLA compiles, got "
+                f"{delta(base)}"
+            )
+            assert warm._aot_cache.stats.compiles == 0
+            assert warm._aot_cache.stats.loads >= 1
+            assert warm.startup_phases["trace"] == 0.0
+            assert warm.startup_phases["compile"] == 0.0
+            assert warm.startup_phases["aot_load"] > 0.0
+        finally:
+            await warm.stop()
+
+    @async_test
+    async def test_warm_start_zero_compiles_legacy(self, tmp_path):
+        from test_engine import make_engine
+
+        cold = make_engine(aot_cache_dir=str(tmp_path), use_ragged=False)
+        assert not cold._use_mixed
+        await cold.start()
+        await self._run_requests(cold)
+        await cold.stop()
+
+        warm = make_engine(aot_cache_dir=str(tmp_path), use_ragged=False)
+        base = compile_counts()
+        await warm.start()
+        try:
+            await self._run_requests(warm)
+            assert delta(base) == {}, (
+                "legacy warm start must perform ZERO XLA compiles, got "
+                f"{delta(base)}"
+            )
+            assert warm._aot_cache.stats.compiles == 0
+        finally:
+            await warm.stop()
+
+    @async_test
+    async def test_corrupt_cache_entry_falls_back_to_compile(self, tmp_path):
+        """A truncated/garbage entry must cost a compile (surfaced on the
+        engine_aot_cache_events_total{event="invalid"} series and a
+        structured warning log), never a crashed replica start — and the
+        recompile overwrites the bad entry so the NEXT start is clean."""
+        from conftest import counter_value
+
+        from kserve_tpu.metrics import AOT_CACHE_EVENTS
+        from test_engine import make_engine
+
+        cold = make_engine(aot_cache_dir=str(tmp_path))
+        await cold.start()
+        await self._run_requests(cold, n=1)
+        await cold.stop()
+        entries = glob.glob(str(tmp_path / "*" / "*.aotexe"))
+        assert entries, "cold start must have persisted executables"
+        for path in entries:
+            # tiny test fixture write; nothing else runs on this loop
+            with open(path, "wb") as f:  # jaxlint: disable=blocking-async
+                f.write(b"not a pickled executable")
+
+        invalid_before = counter_value(
+            AOT_CACHE_EVENTS, program="mixed", event="invalid")
+        warm = make_engine(aot_cache_dir=str(tmp_path))
+        base = compile_counts()
+        await warm.start()
+        try:
+            await self._run_requests(warm, n=1)
+        finally:
+            await warm.stop()
+        assert delta(base) == {"mixed": 1}, (
+            "corrupt entries must fall back to exactly one fresh compile, "
+            f"got {delta(base)}"
+        )
+        assert warm._aot_cache.stats.invalid >= 1
+        assert counter_value(
+            AOT_CACHE_EVENTS, program="mixed", event="invalid"
+        ) > invalid_before
+        # the recompile re-stored a good entry: a third start is warm again
+        healed = make_engine(aot_cache_dir=str(tmp_path))
+        base = compile_counts()
+        await healed.start()
+        try:
+            await self._run_requests(healed, n=1)
+            assert delta(base) == {}, delta(base)
+        finally:
+            await healed.stop()
+
+    @async_test
+    async def test_config_drift_lands_in_fresh_digest(self, tmp_path):
+        """A digest-relevant config change (steps_per_sync here) must not
+        reuse stale executables: the changed engine compiles fresh under
+        a different digest directory while the original stays intact."""
+        from test_engine import make_engine
+
+        cold = make_engine(aot_cache_dir=str(tmp_path))
+        await cold.start()
+        await self._run_requests(cold, n=1)
+        await cold.stop()
+        digests = {os.path.basename(p)
+                   for p in glob.glob(str(tmp_path / "*")) if os.path.isdir(p)}
+        assert len(digests) == 1
+
+        drifted = make_engine(aot_cache_dir=str(tmp_path), steps_per_sync=2)
+        base = compile_counts()
+        await drifted.start()
+        try:
+            await self._run_requests(drifted, n=1)
+            assert delta(base).get("mixed", 0) >= 1, (
+                "drifted config must compile fresh, not reuse stale "
+                f"executables: {delta(base)}"
+            )
+        finally:
+            await drifted.stop()
+        after = {os.path.basename(p)
+                 for p in glob.glob(str(tmp_path / "*")) if os.path.isdir(p)}
+        assert len(after) == 2 and digests < after

@@ -299,6 +299,17 @@ class TestSmokeScenario:
         # the sim's client loop exports the shared amplification counter
         assert counter_value(
             RETRY_ATTEMPTS, component="sim") > sim_retries_before
+        # the warm-restart leg (AOT cache, docs/coldstart.md): every
+        # replica built COLD exactly once; every churn restart came back
+        # WARM at a fraction of the cold ready cost
+        for rep in report["replicas"]:
+            starts = rep["starts"]
+            assert starts[0]["kind"] == "cold"
+            assert all(s["kind"] == "warm" for s in starts[1:])
+            if len(starts) > 1:
+                assert starts[1]["cost_s"] < starts[0]["cost_s"] / 10
+        assert any(len(rep["starts"]) > 1 for rep in report["replicas"]), (
+            "smoke must exercise at least one warm restart")
         # same seed -> byte-identical report (fresh fleet, same virtual
         # history)
         report2 = await FleetSim(smoke_scenario()).run()
@@ -395,6 +406,54 @@ class TestChurn10k:
         assert report["retries"]["sheds_observed"] > 0
         report2 = await FleetSim(churn_10k_scenario()).run()
         assert canonical_json(report) == canonical_json(report2)
+
+
+# ---------------- scale-to-zero (AOT warm start, docs/coldstart.md) ----------------
+
+
+class TestScaleZeroScenario:
+    @async_test
+    async def test_scale_zero_no_drops_and_warm_wakes(self):
+        """The fleet passes through zero TWICE under live traffic: every
+        gateway-held request replays on wake (goodput 1.0, zero lost /
+        duplicated tokens) and every wake is a WARM start whose ready
+        cost is a small fraction of the cold compile."""
+        from kserve_tpu.sim import scale_zero_scenario
+
+        scn = scale_zero_scenario()
+        report = await FleetSim(scn).run()
+        assert_slo(report, scn.budget)
+        submitted = report["requests"]["submitted"]
+        assert submitted == 38  # 30 steady + 8 burst into the 2nd zero window
+        assert report["requests"]["outcomes"] == {"completed": submitted}, (
+            "scale-to-zero must not drop a single request, got "
+            f"{report['requests']['outcomes']}"
+        )
+        assert report["tokens"]["lost"] == 0
+        assert report["tokens"]["duplicated"] == 0
+        for rep in report["replicas"]:
+            starts = rep["starts"]
+            # cold once, then one warm wake per scale_up
+            assert [s["kind"] for s in starts] == ["cold", "warm", "warm"]
+            assert all(
+                s["cost_s"] <= starts[0]["cost_s"] / 10 for s in starts[1:]
+            ), f"warm wake not ≪ cold: {starts}"
+        # requests held across a zero window actually retried (the
+        # gateway-held + replayed contract)
+        assert report["retries"]["amplification"] > 1.0
+        # determinism: same seed, byte-identical report
+        report2 = await FleetSim(scale_zero_scenario()).run()
+        assert canonical_json(report) == canonical_json(report2)
+
+    @async_test
+    async def test_scale_up_unknown_replica_rejected(self):
+        from kserve_tpu.sim import ChurnEvent, scale_zero_scenario
+
+        scn = scale_zero_scenario()
+        scn.churn.append(ChurnEvent(at_s=1.0, kind="scale_up",
+                                    replica="replica-9"))
+        with pytest.raises(ValueError, match="unknown replica"):
+            FleetSim(scn)
 
 
 # ---------------- run_scenario convenience ----------------
