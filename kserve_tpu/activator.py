@@ -1,38 +1,54 @@
-"""Scale-to-zero activator: buffer requests at zero, wake the workload,
-forward when ready.
+"""Scale-to-zero activator: a hold-and-replay gateway leg.
 
 Knative's serverless path puts its activator in the data path at zero
 (ref pkg/controller/v1beta1/inferenceservice/reconcilers/knative/
 ksvc_reconciler.go:64 + the KPA's activator semantics).  This framework
-declares Knative a non-goal (SURVEY §7) and autoscales with KEDA; KEDA
-alone scales on metrics and cannot wake a scaled-to-zero Deployment for
-the FIRST request — something must sit in the request path.  This is that
-something: an aiohttp reverse proxy the ISVC reconciler routes to when
-`minReplicas: 0` (reconciler.py scale-to-zero branch).  On a request while
-the backend is down it (1) triggers scale-up — in-cluster, a replicas
-patch through the apiserver, same effect as KEDA's http-add-on
-interceptor; in tests, a callback — (2) holds the request while polling
-readiness, (3) forwards, and passes through directly once warm.
+declares Knative a non-goal (SURVEY §7); this is the in-repo data-path
+piece the reconcilers route to when `minReplicas: 0`.
 
-Cold-start budget = pod schedule + server boot + first-compile; the
-activator adds one proxy hop only while scaled to zero (see README
-"Scale to zero").
+PR 12 upgraded it from poll-and-forward to real **hold-and-replay**
+(docs/autoscaling.md): a request arriving while the backend is down is
+*parked* on a bounded, deadline-aware `HoldQueue`
+(kserve_tpu/autoscale/hold.py) — registering the hold triggers exactly
+one scale-up for the whole cohort, a hold that outlives its
+`x-request-deadline` budget gets **504**, an arrival at a full queue
+gets **503 + Retry-After** instead of an unbounded aiohttp hold, and a
+failed wake fails every parked request in one pass.  On release the
+request replays against the backend with streaming preserved
+(chunk-by-chunk proxy) and generation-checkpoint headers intact (the
+proxy session accepts `CHECKPOINT_FIELD_SIZE_LIMIT`-sized fields, so a
+resume retry carrying `x-generation-checkpoint` rides through the zero
+window like any other request).
+
+In-cluster the scale-up is a replicas patch through the apiserver
+(`deployment_scaler`) — the EPP-signal autoscaler
+(kserve_tpu/autoscale) then owns the count from there; in tests it is a
+callback.  Warm requests pass straight through with one proxy hop.
 """
 
 from __future__ import annotations
 
 import asyncio
-import time
 from typing import Awaitable, Callable, Optional
 
 import aiohttp
 from aiohttp import web
 
+from .autoscale.hold import HoldExpiredError, HoldOverflowError, HoldQueue
+from .lifecycle import CHECKPOINT_FIELD_SIZE_LIMIT
 from .logging import logger
+from .metrics import GATEWAY_HOLDS
+from .resilience import MONOTONIC, Clock, Deadline
+from .resilience.deadline import DEADLINE_HEADER
 
 HOP_HEADERS = {"connection", "keep-alive", "transfer-encoding", "upgrade",
                "proxy-authenticate", "proxy-authorization", "te", "trailers",
                "host", "content-length"}
+
+
+class WakeFailedError(RuntimeError):
+    """The backend never became ready inside the wake budget (-> 504 for
+    every request parked behind the wake)."""
 
 
 class Activator:
@@ -44,6 +60,9 @@ class Activator:
         poll_interval: float = 0.25,
         wake_timeout: float = 120.0,
         port: int = 8012,
+        max_holds: int = 512,
+        hold_timeout_s: Optional[float] = None,  # None = wake_timeout
+        clock: Clock = MONOTONIC,
     ):
         self.backend_url = backend_url.rstrip("/")
         self.scale_up = scale_up
@@ -51,19 +70,38 @@ class Activator:
         self.poll_interval = poll_interval
         self.wake_timeout = wake_timeout
         self.port = port
+        self.clock = clock
+        self.holds = HoldQueue(
+            clock=clock,
+            max_holds=max_holds,
+            default_hold_s=(hold_timeout_s if hold_timeout_s is not None
+                            else wake_timeout),
+            retry_after_s=min(wake_timeout / 4, 10.0),
+        )
         self._session: Optional[aiohttp.ClientSession] = None
-        self._wake_lock = asyncio.Lock()
+        self._wake_task: Optional[asyncio.Task] = None
         self._backend_ready = False
-        # a failed wake poisons the cohort briefly: waiters queued behind
-        # the lock fail fast instead of each serially re-polling a full
-        # wake_timeout and firing redundant scale-ups
+        # a failed wake poisons the cohort briefly: requests arriving just
+        # after fail fast instead of parking behind a doomed wake and
+        # firing redundant scale-ups
         self._wake_failed_until = 0.0
-        self.stats = {"buffered": 0, "proxied": 0, "cold_start_s": None}
+        self.stats = {"buffered": 0, "proxied": 0, "cold_start_s": None,
+                      "held_now": 0, "replayed": 0, "expired": 0,
+                      "overflow": 0, "wake_failed": 0}
         self._runner = None
 
     async def _ensure_session(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
-            self._session = aiohttp.ClientSession()
+            # header limits raised to the replicas' (lifecycle contract): a
+            # drained backend's 503 carries an x-generation-checkpoint
+            # response header that grows with generation length, and a
+            # resuming client's REQUEST carries one too — the default
+            # 8190-byte cap would corrupt hold-and-replay for exactly the
+            # requests a zero window preempted
+            self._session = aiohttp.ClientSession(
+                max_field_size=CHECKPOINT_FIELD_SIZE_LIMIT,
+                max_line_size=CHECKPOINT_FIELD_SIZE_LIMIT,
+            )
         return self._session
 
     async def _backend_is_ready(self) -> bool:
@@ -77,54 +115,119 @@ class Activator:
         except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
             return False
 
+    # ---------------- wake (one task per cohort) ----------------
+
+    def _ensure_wake_task(self) -> None:
+        """At most one wake runs at a time: N parked requests share it —
+        they must not fire N scale-ups."""
+        if self._wake_task is None or self._wake_task.done():
+            self._wake_task = asyncio.get_running_loop().create_task(
+                self._wake())
+
     async def _wake(self) -> None:
-        """Trigger scale-up once, then poll readiness.  Concurrent cold
-        requests share one wake (the lock) — N buffered requests must not
-        fire N scale-ups."""
-        async with self._wake_lock:
-            if self._backend_ready:
-                return  # another waiter completed the wake while we queued
-            now = time.monotonic()
-            if now < self._wake_failed_until:
-                raise web.HTTPServiceUnavailable(
-                    text="backend wake recently failed; retry later")
+        try:
             if await self._backend_is_ready():
-                self._backend_ready = True
+                self._mark_ready()
                 return
-            t0 = time.monotonic()
+            t0 = self.clock.now()
             if self.scale_up is not None:
                 await self.scale_up()
             deadline = t0 + self.wake_timeout
-            while time.monotonic() < deadline:
+            while self.clock.now() < deadline:
                 if await self._backend_is_ready():
-                    self._backend_ready = True
-                    self.stats["cold_start_s"] = round(time.monotonic() - t0, 3)
+                    self.stats["cold_start_s"] = round(
+                        self.clock.now() - t0, 3)
                     logger.info("activator: backend awake after %.2fs",
                                 self.stats["cold_start_s"])
+                    self._mark_ready()
                     return
-                await asyncio.sleep(self.poll_interval)
-            self._wake_failed_until = time.monotonic() + min(
+                await self.clock.sleep(self.poll_interval)
+            raise WakeFailedError(
+                f"backend did not become ready within {self.wake_timeout}s")
+        except Exception as exc:  # noqa: BLE001 — a wake failure must fail
+            # the whole parked cohort loudly, whatever its type
+            self._wake_failed_until = self.clock.now() + min(
                 self.wake_timeout / 4, 10.0)
-            raise web.HTTPGatewayTimeout(
-                text=f"backend did not become ready within {self.wake_timeout}s"
-            )
+            failed = exc if isinstance(exc, WakeFailedError) else (
+                WakeFailedError(f"backend wake failed: {exc}"))
+            n = self.holds.fail_all(failed)
+            logger.warning("activator: wake failed (%s); %d holds failed",
+                           exc, n)
+
+    def _mark_ready(self) -> None:
+        self._backend_ready = True
+        released = self.holds.release_all()
+        if released:
+            logger.info("activator: replaying %d held requests", released)
+
+    # ---------------- the data path ----------------
 
     async def handle(self, request: web.Request) -> web.StreamResponse:
         # warm path trusts state — no per-request readiness probe (it
         # would serialize a round-trip per request and misread one slow
         # probe as scaled-to-zero).  A connect failure below flips the
-        # state and retries through the wake path once.
-        if not self._backend_ready:
-            self.stats["buffered"] += 1
-            await self._wake()
+        # state and goes through one hold-and-replay cycle.
         body = await request.read()
+        if not self._backend_ready:
+            terminal = await self._hold(request)
+            if terminal is not None:
+                return terminal
         try:
             return await self._proxy(request, body)
         except (aiohttp.ClientConnectorError, aiohttp.ServerDisconnectedError):
             self._backend_ready = False
-            self.stats["buffered"] += 1
-            await self._wake()
+            terminal = await self._hold(request)
+            if terminal is not None:
+                return terminal
             return await self._proxy(request, body)
+
+    async def _hold(self, request: web.Request) -> Optional[web.Response]:
+        """Park this request until the backend wakes.  None means
+        "released: replay now"; a Response is terminal (504 expired /
+        503 overflow / 504 wake-failed)."""
+        self.stats["buffered"] += 1
+        if self.clock.now() < self._wake_failed_until:
+            return web.json_response(
+                {"error": "backend wake recently failed; retry later"},
+                status=503,
+                headers={"Retry-After": f"{self.holds.retry_after_s:g}"},
+            )
+        deadline = Deadline.from_header(
+            request.headers.get(DEADLINE_HEADER), self.clock)
+        self._ensure_wake_task()
+        if self._backend_ready:
+            # the wake completed synchronously (probe already green):
+            # holding now would park forever behind a release that already
+            # happened
+            return None
+        self.stats["held_now"] = self.holds.held + 1
+        try:
+            await self.holds.hold(deadline)
+        except HoldExpiredError:
+            self.stats["expired"] += 1
+            GATEWAY_HOLDS.labels(outcome="expired").inc()
+            return web.json_response(
+                {"error": "request deadline expired while held for "
+                          "scale-from-zero"},
+                status=504,
+            )
+        except HoldOverflowError as exc:
+            self.stats["overflow"] += 1
+            GATEWAY_HOLDS.labels(outcome="overflow").inc()
+            return web.json_response(
+                {"error": "hold queue full while scaled to zero"},
+                status=503,
+                headers={"Retry-After": f"{exc.retry_after_s:g}"},
+            )
+        except WakeFailedError as exc:
+            self.stats["wake_failed"] += 1
+            GATEWAY_HOLDS.labels(outcome="failed").inc()
+            return web.json_response({"error": str(exc)}, status=504)
+        finally:
+            self.stats["held_now"] = self.holds.held
+        self.stats["replayed"] += 1
+        GATEWAY_HOLDS.labels(outcome="replayed").inc()
+        return None
 
     async def _proxy(self, request: web.Request,
                      body: bytes) -> web.StreamResponse:
@@ -169,6 +272,9 @@ class Activator:
         return self.port
 
     async def stop(self) -> None:
+        if self._wake_task is not None and not self._wake_task.done():
+            self._wake_task.cancel()
+        self.holds.fail_all(WakeFailedError("activator shutting down"))
         if self._session is not None and not self._session.closed:
             await self._session.close()
         if self._runner is not None:
@@ -179,7 +285,9 @@ def deployment_scaler(master: str, deployment: str, namespace: str,
                       token: Optional[str] = None,
                       in_cluster: bool = False):
     """scale_up callback patching Deployment replicas to >=1 through the
-    apiserver (the in-cluster trigger; KEDA scales back down on idle)."""
+    apiserver (the in-cluster scale-from-zero trigger; the EPP-signal
+    autoscaler — kserve_tpu/autoscale — owns the count from 1 upward and
+    returns it to 0 on idle)."""
     from .api.http_transport import HTTPCluster
 
     cluster = (HTTPCluster(master, token=token) if master
@@ -213,6 +321,12 @@ def main(argv=None) -> int:
     parser.add_argument("--in-cluster", action="store_true")
     parser.add_argument("--readiness-path", default="/v2/health/ready")
     parser.add_argument("--wake-timeout", type=float, default=120.0)
+    parser.add_argument("--max-holds", type=int, default=512,
+                        help="bounded hold queue size (overflow -> 503)")
+    parser.add_argument("--hold-timeout", type=float, default=None,
+                        help="default hold budget for requests without an "
+                             "x-request-deadline header (default: "
+                             "--wake-timeout)")
     args = parser.parse_args(argv)
 
     scale_up = None
@@ -223,6 +337,7 @@ def main(argv=None) -> int:
     activator = Activator(
         args.backend, scale_up=scale_up, port=args.port,
         readiness_path=args.readiness_path, wake_timeout=args.wake_timeout,
+        max_holds=args.max_holds, hold_timeout_s=args.hold_timeout,
     )
 
     async def run():

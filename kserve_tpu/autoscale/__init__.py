@@ -1,0 +1,32 @@
+"""EPP-signal autoscaler: the serverless control loop (docs/autoscaling.md).
+
+Closes the loop PR 10 opened: replicas are cheap to start (zero-compile
+AOT warm start), so this subsystem *spends* that cheapness — scaling
+LLMISVC replica counts (including to zero and back) from serving-native
+EPP signals instead of a metrics-blind KEDA trigger, with predictive
+prewarming and a hold-and-replay gateway for requests arriving into the
+zero window.  Every policy ships as a sim scenario first
+(kserve_tpu/sim/scenario.py `autoscale_*`); the config the goodput
+report validates is what the llmisvc reconciler defaults to.
+"""
+
+from .hold import HoldExpiredError, HoldOverflowError, HoldQueue  # noqa: F401
+from .loop import AutoscalerLoop, ReplicaActuator  # noqa: F401
+from .actuator import DeploymentActuator  # noqa: F401
+from .policy import (  # noqa: F401
+    ACTIONS,
+    REASONS,
+    PeriodicDetector,
+    PredictiveConfig,
+    PredictivePolicy,
+    ReactiveConfig,
+    ReactivePolicy,
+    ScalingDecision,
+    ScalingPolicy,
+)
+from .signals import (  # noqa: F401
+    ArrivalHistory,
+    FleetSignals,
+    RateTracker,
+    ReplicaSignals,
+)

@@ -1,0 +1,166 @@
+"""In-cluster autoscaler CLI: `python -m kserve_tpu.autoscale`.
+
+The deployment target the llmisvc reconciler synthesizes next to the
+EPP (controlplane/llmisvc.py `_scaling`): polls the EPP's `/state` for
+the `fleet` FleetSignals block and drives the workload Deployment's
+replica count through the apiserver.  Policy defaults are the
+sim-validated config (autoscale/policy.py) — override per-flag.
+
+A loop failure logs and exits nonzero (pod restart) rather than
+freezing the fleet at its last size; transient EPP scrape failures are
+absorbed by re-serving the last good snapshot for up to
+`--stale-signals-s` before that counts as a failure too.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+from typing import Optional
+
+from ..logging import logger
+from .actuator import DeploymentActuator
+from .loop import AutoscalerLoop
+from .policy import (
+    PredictiveConfig,
+    PredictivePolicy,
+    ReactiveConfig,
+    ReactivePolicy,
+)
+from .signals import FleetSignals
+
+
+class EPPSignalSource:
+    """GET <epp>/state and rebuild `FleetSignals` from its `fleet` block.
+    Keeps the last good snapshot across transient scrape failures, but a
+    snapshot older than `stale_s` raises — routing the fleet on frozen
+    signals forever is the failure mode this subsystem exists to kill."""
+
+    def __init__(self, epp_url: str, stale_s: float = 30.0):
+        self.epp_url = epp_url.rstrip("/")
+        self.stale_s = stale_s
+        self._session = None
+        self._last: Optional[FleetSignals] = None
+        self._last_ok: Optional[float] = None
+
+    async def __call__(self) -> FleetSignals:
+        import time
+
+        import aiohttp
+
+        if self._session is None:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=5.0))
+        now = time.monotonic()
+        try:
+            async with self._session.get(self.epp_url + "/state") as resp:
+                if resp.status != 200:
+                    raise OSError(f"EPP /state returned {resp.status}")
+                payload = await resp.json()
+            fleet = payload.get("fleet")
+            if not isinstance(fleet, dict):
+                raise ValueError("EPP /state payload has no fleet block")
+            self._last = FleetSignals.from_dict(fleet)
+            self._last_ok = now
+            return self._last
+        except (aiohttp.ClientError, OSError, ValueError,
+                asyncio.TimeoutError) as exc:
+            if (self._last is not None and self._last_ok is not None
+                    and now - self._last_ok <= self.stale_s):
+                logger.warning(
+                    "autoscaler: EPP scrape failed (%s); re-serving "
+                    "%.1fs-old signals", exc, now - self._last_ok)
+                return self._last
+            raise
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+
+def build_policy(args):
+    reactive = ReactivePolicy(ReactiveConfig(
+        queue_high_per_replica=args.queue_high,
+        queue_low_per_replica=args.queue_low,
+        shed_rate_up_per_s=args.shed_rate_up,
+        ttft_p99_slo_s=args.ttft_slo,
+        idle_to_zero_s=args.idle_to_zero,
+        up_cooldown_s=args.up_cooldown,
+        down_cooldown_s=args.down_cooldown,
+    ))
+    if args.policy == "reactive":
+        return reactive
+    return PredictivePolicy(reactive=reactive, config=PredictiveConfig())
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser("kserve-tpu-autoscaler")
+    parser.add_argument("--epp-url", required=True,
+                        help="EPP base url (its /state exports FleetSignals)")
+    parser.add_argument("--deployment", required=True)
+    parser.add_argument("--namespace", default="default")
+    parser.add_argument("--master", default="",
+                        help="apiserver url (empty + --in-cluster = pod env)")
+    parser.add_argument("--in-cluster", action="store_true")
+    parser.add_argument("--policy", choices=("reactive", "predictive"),
+                        default="predictive")
+    parser.add_argument("--min-replicas", type=int, default=1)
+    parser.add_argument("--max-replicas", type=int, default=4)
+    parser.add_argument("--pods-per-replica", type=int, default=1,
+                        help="pods per logical replica (slice groups); the "
+                             "Deployment is patched in whole multiples")
+    parser.add_argument("--interval", type=float, default=2.0)
+    parser.add_argument("--stale-signals-s", type=float, default=30.0)
+    # reactive thresholds (defaults = the sim-validated config)
+    parser.add_argument("--queue-high", type=float, default=6.0)
+    parser.add_argument("--queue-low", type=float, default=1.0)
+    parser.add_argument("--shed-rate-up", type=float, default=0.2)
+    parser.add_argument("--ttft-slo", type=float, default=None)
+    parser.add_argument("--idle-to-zero", type=float, default=30.0)
+    parser.add_argument("--up-cooldown", type=float, default=5.0)
+    parser.add_argument("--down-cooldown", type=float, default=30.0)
+    return parser
+
+
+async def serve(args) -> None:
+    from ..api.http_transport import HTTPCluster
+
+    cluster = (HTTPCluster(args.master) if args.master
+               else HTTPCluster("", in_cluster=args.in_cluster))
+    source = EPPSignalSource(args.epp_url, stale_s=args.stale_signals_s)
+    loop = AutoscalerLoop(
+        build_policy(args),
+        source,
+        DeploymentActuator(cluster, args.deployment, args.namespace,
+                           pods_per_replica=args.pods_per_replica),
+        interval_s=args.interval,
+        min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas,
+    )
+    logger.info(
+        "autoscaler: %s policy driving %s/%s in [%d, %d] from %s",
+        args.policy, args.namespace, args.deployment, args.min_replicas,
+        args.max_replicas, args.epp_url)
+    try:
+        await loop.run()
+    finally:
+        await source.close()
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    try:
+        asyncio.run(serve(args))
+    except KeyboardInterrupt:
+        return 0
+    except Exception as exc:  # noqa: BLE001 — terminal: log + nonzero exit
+        # the loop contract: a dead autoscaler must be LOUD (pod restart),
+        # never a silent freeze at the last replica count
+        logger.error("autoscaler loop failed: %s", exc)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

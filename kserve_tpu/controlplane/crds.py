@@ -387,6 +387,11 @@ class KVCacheOffloadingSpec(K8sModel):
 
 class WorkloadSpec(K8sModel):
     replicas: Optional[int] = None
+    # autoscaler bounds (kserve_tpu/autoscale; docs/autoscaling.md):
+    # minReplicas=0 enables scale-to-zero (the activator holds the zero
+    # window), maxReplicas caps the EPP-signal autoscaler's footprint
+    minReplicas: Optional[int] = None
+    maxReplicas: Optional[int] = None
     parallelism: Optional[ParallelismSpec] = None
     template: Optional[Dict[str, Any]] = None  # pod template override
     worker: Optional[Dict[str, Any]] = None  # multi-host worker template

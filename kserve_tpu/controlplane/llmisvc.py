@@ -22,6 +22,8 @@ from typing import Dict, List, Optional, Tuple
 
 from ..lifecycle.state import DEFAULT_DRAIN_GRACE_S, normalize_drain_grace
 from .crds import (
+    AUTOSCALED_REPLICAS_ANNOTATION,
+    AUTOSCALER_CLASS_ANNOTATION,
     LLMInferenceService,
     LLMInferenceServiceConfig,
     ParallelismSpec,
@@ -119,9 +121,19 @@ class LLMISVCReconciler:
             objects.extend(self._route(llm, spec))
             set_condition(status, "RouterReady", True, reason="Reconciled")
 
-        scaler = self._scaling(llm, spec.workload or WorkloadSpec())
-        if scaler is not None:
-            objects.append(scaler)
+        scaling_objs = self._scaling(llm, spec)
+        if scaling_objs:
+            objects.extend(scaling_objs)
+            # an autoscaler owns the decode Deployment's replica count:
+            # mark it so re-reconciles preserve the LIVE count instead of
+            # fighting the scaler back to the spec value
+            # (cluster.py _preserve_autoscaled_replicas)
+            decode_name = f"{llm.metadata.name}-kserve"
+            for obj in objects:
+                if (obj.get("kind") == "Deployment"
+                        and obj["metadata"]["name"] == decode_name):
+                    obj["metadata"].setdefault("annotations", {})[
+                        AUTOSCALED_REPLICAS_ANNOTATION] = "true"
 
         if spec.tracing and spec.tracing.enabled:
             if not spec.tracing.otlpEndpoint:
@@ -566,10 +578,18 @@ class LLMISVCReconciler:
         )
         return ing.synthesize(klass, intent)
 
-    def _scaling(self, llm, workload: WorkloadSpec) -> Optional[dict]:
+    def _scaling(self, llm, spec) -> List[dict]:
+        """Replica-count ownership (docs/autoscaling.md).  Default: the
+        EPP-signal autoscaler (kserve_tpu/autoscale) — a Deployment
+        scraping the scheduler's /state FleetSignals and patching decode
+        replicas with the sim-validated predictive policy.  It needs the
+        EPP in place, so without a router scheduler — or with the
+        `autoscalerClass: keda` annotation — the old KEDA tokens/sec
+        ScaledObject ships instead (metrics-blind, but standalone)."""
+        workload = spec.workload or WorkloadSpec()
         name = f"{llm.metadata.name}-kserve"
-        # KEDA counts pods; a slice replica is hosts*num_slices pods, so the
-        # bounds must be whole-slice multiples or the autoscaler would tear
+        # autoscalers count pods; a slice replica is hosts*num_slices pods,
+        # so the bounds must be whole-slice multiples or scaling would tear
         # a multi-host slice apart
         par = workload.parallelism or ParallelismSpec()
         plan = plan_slice(
@@ -579,15 +599,41 @@ class LLMISVCReconciler:
         if plan.hosts > 1:
             # multi-host groups are fixed-size StatefulSets; scaling them
             # means adding/removing whole groups (a reconcile-level replica
-            # decision), not letting KEDA stretch pod counts mid-slice
-            return None
+            # decision), not stretching pod counts mid-slice
+            return []
         pods_per_replica = plan.hosts * plan.num_slices
-        return make_object(
+        scaler_class = (llm.metadata.annotations or {}).get(
+            AUTOSCALER_CLASS_ANNOTATION, "")
+        epp_enabled = (
+            spec.router is not None
+            and spec.router.scheduler is not None
+            and spec.router.scheduler.enabled
+        )
+        if scaler_class == "none":
+            return []
+        min_replicas = (workload.minReplicas
+                        if workload.minReplicas is not None
+                        else (workload.replicas or 1))
+        max_replicas = (workload.maxReplicas
+                        if workload.maxReplicas is not None
+                        else max((workload.replicas or 1) * 4, 4))
+        if min_replicas > max_replicas:
+            # reject at reconcile time with a readable message — shipping
+            # these bounds would crash-loop the autoscaler pod (its loop
+            # validates max >= min at startup) with the fleet frozen
+            raise ValueError(
+                f"workload.minReplicas {min_replicas} > maxReplicas "
+                f"{max_replicas} (maxReplicas defaults to "
+                "max(replicas*4, 4) when unset)")
+        if epp_enabled and scaler_class != "keda":
+            return [self._epp_autoscaler(
+                llm, name, min_replicas, max_replicas, pods_per_replica)]
+        return [make_object(
             "keda.sh/v1alpha1", "ScaledObject", name, llm.metadata.namespace,
             spec={
                 "scaleTargetRef": {"name": name},
-                "minReplicaCount": (workload.replicas or 1) * pods_per_replica,
-                "maxReplicaCount": max((workload.replicas or 1) * 4, 4) * pods_per_replica,
+                "minReplicaCount": min_replicas * pods_per_replica,
+                "maxReplicaCount": max_replicas * pods_per_replica,
                 "podsPerReplica": pods_per_replica,
                 "triggers": [
                     {
@@ -598,6 +644,51 @@ class LLMISVCReconciler:
                         },
                     }
                 ],
+            },
+        )]
+
+    def _epp_autoscaler(self, llm, workload_name: str, min_replicas: int,
+                        max_replicas: int, pods_per_replica: int) -> dict:
+        """The serverless brain: `python -m kserve_tpu.autoscale` driving
+        the decode Deployment from the EPP's FleetSignals.  Ships the
+        sim-validated predictive policy defaults
+        (sim/scenario.autoscale_burst_scenario is the proving ground).
+        Bounds are in REPLICA units; --pods-per-replica keeps the actuated
+        pod count a whole-slice multiple (the role KEDA's podsPerReplica
+        played), so a num_slices>1 workload is never torn mid-slice."""
+        name = f"{llm.metadata.name}-kserve-autoscaler"
+        namespace = llm.metadata.namespace
+        epp_url = f"http://{llm.metadata.name}-epp.{namespace}:9002"
+        return make_object(
+            "apps/v1", "Deployment", name, namespace,
+            spec={
+                "replicas": 1,
+                "selector": {"matchLabels": {"app": name}},
+                "template": {
+                    "metadata": {"labels": {"app": name}},
+                    "spec": {
+                        "containers": [
+                            {
+                                # ships in this repo, runs from the same
+                                # image as the runtime and the EPP
+                                "name": "autoscaler",
+                                "image": GENERATIVE_IMAGE,
+                                "command": ["python", "-m",
+                                            "kserve_tpu.autoscale"],
+                                "args": [
+                                    f"--epp-url={epp_url}",
+                                    f"--deployment={workload_name}",
+                                    f"--namespace={namespace}",
+                                    "--in-cluster",
+                                    "--policy=predictive",
+                                    f"--min-replicas={min_replicas}",
+                                    f"--max-replicas={max_replicas}",
+                                    f"--pods-per-replica={pods_per_replica}",
+                                ],
+                            }
+                        ]
+                    },
+                },
             },
         )
 

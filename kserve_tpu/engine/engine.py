@@ -706,11 +706,19 @@ class LLMEngine:
         digests = self._prefix_cache.hottest_digests(max_digests)
         return {
             "queue_depth": self.queue_depth,
+            # seated generations: the "work already admitted" half of the
+            # autoscaler's load signal (queue_depth is the waiting half)
+            "inflight": sum(
+                1 for s in self._slots if s.request_id is not None),
             "free_pages": self.allocator.free_pages,
             "page_size": self.config.page_size,
             "running": self.running,
             "wedged": self._wedged,
             "prefix_digests": digests,
+            # rolling TTFT/ITL percentile windows (observability ring):
+            # previously internal to telemetry, surfaced here so the EPP —
+            # and the autoscaler behind it — sees SLO pressure per replica
+            "telemetry": self.telemetry.signal_windows(),
         }
 
     @property

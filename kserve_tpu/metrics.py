@@ -209,6 +209,37 @@ AOT_CACHE_EVENTS = Counter(
 )
 
 
+# Autoscaler (kserve_tpu/autoscale — docs/autoscaling.md).  `action` and
+# `reason` come from the closed ACTIONS/REASONS sets in autoscale/policy.py
+# (every decision is explained in the same vocabulary dashboards see);
+# `signal` is the fixed FleetSignals field enum; `outcome` the closed
+# hold-queue terminal set.  No per-replica/backend labels — per-replica
+# detail lives in the EPP /state snapshot.
+AUTOSCALER_DECISIONS = Counter(
+    "autoscaler_decisions_total",
+    "scaling decisions taken by the EPP-signal autoscaler loop, by action "
+    "and policy reason",
+    ["action", "reason"],
+)
+AUTOSCALER_TARGET_REPLICAS = Gauge(
+    "autoscaler_target_replicas",
+    "replica count the autoscaler currently wants (post-clamp)",
+)
+AUTOSCALER_SIGNAL = Gauge(
+    "autoscaler_signal",
+    "fleet-wide autoscaling signals at the latest decision tick "
+    "(ready_replicas | queue_depth | inflight | shed_rate_per_s | "
+    "arrival_rate_per_s | held_requests | ttft_p99_s)",
+    ["signal"],
+)
+GATEWAY_HOLDS = Counter(
+    "gateway_hold_outcomes_total",
+    "zero-window hold-and-replay outcomes at the gateway "
+    "(replayed | expired | overflow | failed)",
+    ["outcome"],
+)
+
+
 def observe_startup_phase(model_name: str, phase: str, seconds: float) -> None:
     """Record one engine_startup_seconds observation (phase must be in
     STARTUP_PHASES; anything else is a programming error worth raising)."""

@@ -232,6 +232,19 @@ class TimelineRecorder:
             self._e2e.append(tl.e2e_s)
         self._itl.extend(tl.itls)
 
+    def signal_windows(self) -> Dict[str, Any]:
+        """The autoscaling-relevant latency percentiles (the compact
+        subset of snapshot() the EPP /state payload carries per replica —
+        kserve_tpu/autoscale/signals.py ingests this shape)."""
+        ttft = percentiles(self._ttft)
+        itl = percentiles(self._itl)
+        return {
+            "ttft_p50_s": ttft.get("p50"),
+            "ttft_p99_s": ttft.get("p99"),
+            "itl_p99_s": itl.get("p99"),
+            "finished": self.finished_count,
+        }
+
     def record_step(self, seconds: float) -> None:
         """One decode step: a multi-token dispatch+fetch chunk."""
         self.step_count += 1

@@ -270,8 +270,15 @@ class RESTServer:
             engine = getattr(model, "engine", None)
             if engine is not None and hasattr(engine, "scheduler_state"):
                 models[name] = engine.scheduler_state()
+        telemetry = [m.get("telemetry") or {} for m in models.values()]
+
+        def worst(key: str):
+            vals = [t.get(key) for t in telemetry if t.get(key) is not None]
+            return max(vals) if vals else None
+
         agg = {
             "queue_depth": sum(m["queue_depth"] for m in models.values()),
+            "inflight": sum(m.get("inflight", 0) for m in models.values()),
             "free_pages": sum(m["free_pages"] for m in models.values()),
             "models": models,
             # the EPP excludes DRAINING/TERMINATING backends from picks
@@ -279,6 +286,17 @@ class RESTServer:
             "lifecycle": (
                 self.lifecycle.state if self.lifecycle is not None else READY
             ),
+            # admission-shed counters + rolling latency windows: the
+            # serving-native signals the autoscaler scales on
+            # (kserve_tpu/autoscale/signals.py; docs/autoscaling.md)
+            "shed": {
+                "count": self.shedder.shed_count,
+                "shedding": self.shedder.shedding,
+            },
+            "telemetry": {
+                "ttft_p99_s": worst("ttft_p99_s"),
+                "itl_p99_s": worst("itl_p99_s"),
+            },
         }
         return web.json_response(agg)
 

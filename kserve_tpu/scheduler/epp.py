@@ -78,8 +78,34 @@ def extract_affinity(payload: dict) -> tuple:
 
 class EPPServer:
     def __init__(self, picker: EndpointPicker):
+        from ..autoscale.signals import ArrivalHistory, RateTracker
+
         self.picker = picker
         self._client = None
+        # the EPP is the fleet's front door, so it is where the arrival
+        # process is observable: every proxied inference POST is recorded
+        # and /state exports the aggregate FleetSignals block the
+        # autoscaler loop scrapes (docs/autoscaling.md)
+        self.arrivals = ArrivalHistory()
+        # floor on the shed-rate window: /state is scraped by MORE than
+        # the autoscaler (dashboards, operators), and each consult would
+        # otherwise re-baseline the delta — see RateTracker docstring
+        self._shed_rate = RateTracker(min_interval_s=2.0)
+
+    def fleet_signals(self):
+        """The rolling `FleetSignals` snapshot (exported under `fleet` in
+        /state; `python -m kserve_tpu.autoscale` consumes it)."""
+        from ..autoscale.signals import FleetSignals
+
+        now = self.picker.clock.now()
+        states = self.picker.snapshot()
+        sheds_total = sum(int(s.get("sheds_total", 0) or 0) for s in states)
+        return FleetSignals.from_replica_states(
+            states, now,
+            arrival_rate_per_s=self.arrivals.rate(now),
+            arrival_slope_per_s2=self.arrivals.slope(now),
+            shed_rate_per_s=self._shed_rate.update(sheds_total, now),
+        )
 
     def create_application(self) -> web.Application:
         app = web.Application(client_max_size=1024**3)
@@ -100,7 +126,10 @@ class EPPServer:
         return web.json_response({"ok": True})
 
     async def state(self, request: web.Request) -> web.Response:
-        out = {"replicas": self.picker.snapshot()}
+        out = {
+            "replicas": self.picker.snapshot(),
+            "fleet": self.fleet_signals().to_dict(),
+        }
         if self.picker.latency_predictor is not None:
             out["latency"] = self.picker.latency_predictor.snapshot()
         return web.json_response(out)
@@ -138,6 +167,13 @@ class EPPServer:
     async def proxy(self, request: web.Request) -> web.StreamResponse:
         import aiohttp
 
+        from ..resilience.shedding import is_inference_path
+
+        if request.method == "POST" and is_inference_path(request.path):
+            # the arrival-process signal behind predictive prewarming:
+            # recorded at the door, before picking, so a zero-window
+            # request still registers demand
+            self.arrivals.record(self.picker.clock.now())
         ids, text, body = await self._read_affinity(request)
         replica = self.picker.pick(prompt_ids=ids, prompt_text=text)
         if replica is None:

@@ -63,6 +63,15 @@ class Replica:
     # without this it would stay "cold", un-penalized, and WIN every pick
     error_ewma: float = 0.0
     last_error_t: float = 0.0
+    # autoscaling signals carried through from /state (docs/autoscaling.md):
+    # seated generations, lifetime shed count + live shedding flag, and the
+    # replica's rolling TTFT/ITL p99 windows.  The EPP re-exports these in
+    # its own /state so the autoscaler loop scrapes ONE endpoint.
+    inflight: int = 0
+    sheds_total: int = 0
+    shedding: bool = False
+    ttft_p99_s: Optional[float] = None
+    itl_p99_s: Optional[float] = None
 
     @property
     def digests(self) -> frozenset:
@@ -141,6 +150,13 @@ class EndpointPicker:
             return
         r.queue_depth = int(state.get("queue_depth", 0))
         r.free_pages = int(state.get("free_pages", 0))
+        r.inflight = int(state.get("inflight", 0) or 0)
+        shed = state.get("shed") or {}
+        r.sheds_total = int(shed.get("count", 0) or 0)
+        r.shedding = bool(shed.get("shedding"))
+        tel = state.get("telemetry") or {}
+        r.ttft_p99_s = tel.get("ttft_p99_s")
+        r.itl_p99_s = tel.get("itl_p99_s")
         models: Dict[str, tuple] = {}
         wedged = False
         for name, m in (state.get("models") or {}).items():
@@ -350,8 +366,13 @@ class EndpointPicker:
                 "healthy": r.healthy,
                 "lifecycle": r.lifecycle,
                 "queue_depth": r.queue_depth,
+                "inflight": r.inflight,
                 "free_pages": r.free_pages,
                 "digests": len(r.digests),
+                "sheds_total": r.sheds_total,
+                "shedding": r.shedding,
+                "ttft_p99_s": r.ttft_p99_s,
+                "itl_p99_s": r.itl_p99_s,
                 "breaker": (
                     self.breakers.state(r.url)
                     if self.breakers is not None else None

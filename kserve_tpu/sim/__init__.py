@@ -24,8 +24,11 @@ from .report import (  # noqa: F401
     canonical_json,
 )
 from .scenario import (  # noqa: F401
+    AutoscalerSpec,
     ChurnEvent,
     Scenario,
+    autoscale_burst_scenario,
+    autoscale_smoke_scenario,
     churn_10k_scenario,
     scale_zero_scenario,
     smoke_scenario,

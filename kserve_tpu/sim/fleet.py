@@ -23,6 +23,16 @@ import asyncio
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ..autoscale import (
+    AutoscalerLoop,
+    FleetSignals,
+    HoldExpiredError,
+    HoldOverflowError,
+    HoldQueue,
+    RateTracker,
+    ReplicaActuator,
+)
+from ..autoscale.signals import ArrivalHistory
 from ..lifecycle import GenerationPreempted, ReplicaDrainingError
 from ..metrics import RETRY_ATTEMPTS, record_breaker_transition
 from ..observability import RequestTimeline
@@ -57,6 +67,7 @@ class ClientRecord:
     resumes: int = 0
     crash_restarts: int = 0
     no_backend: int = 0
+    held: int = 0  # times parked on the hold-and-replay gateway
     outcome: str = "pending"
     n_tokens: int = 0
     lost_tokens: int = 0
@@ -72,7 +83,8 @@ class ClientRecord:
             "rid": self.rid, "kind": self.kind, "attempts": self.attempts,
             "sheds": self.sheds, "resumes": self.resumes,
             "crash_restarts": self.crash_restarts,
-            "no_backend": self.no_backend, "outcome": self.outcome,
+            "no_backend": self.no_backend, "held": self.held,
+            "outcome": self.outcome,
             "n_tokens": self.n_tokens, "lost_tokens": self.lost_tokens,
             "duplicated_tokens": self.duplicated_tokens,
             "salvaged_tokens": self.salvaged_tokens,
@@ -87,10 +99,19 @@ class FleetSim:
         self.clock = SimClock()
         self.trace: List[SimRequest] = generate_trace(
             scenario.workload, scenario.seed)
+        asc = scenario.autoscaler
         self.replicas: Dict[str, SimReplica] = {}
         params = None
-        for name in scenario.replica_names():
-            r = SimReplica(name, self.clock, scenario.spec, params=params)
+        for i, name in enumerate(scenario.replica_names()):
+            # autoscaler-managed fleets defer replicas beyond the initial
+            # footprint: their first scale-up builds COLD (empty node AOT
+            # cache) — the start cost the policy is charged for
+            build_now = asc is None or i < asc.initial_replicas
+            r = SimReplica(
+                name, self.clock, scenario.spec, params=params,
+                build_now=build_now,
+                node_cache_warm=(asc is not None
+                                 and asc.node_cache_prewarmed))
             r.set_fault_plan(FaultPlan([], seed=scenario.seed))
             params = r.params
             self.replicas[name] = r
@@ -117,6 +138,37 @@ class FleetSim:
         self._completed = 0
         self._tasks: List[asyncio.Task] = []
         self._churn_subtasks: List[asyncio.Task] = []
+        # ---------------- autoscaler-in-the-loop (docs/autoscaling.md)
+        self.autoscaler: Optional[AutoscalerLoop] = None
+        self.hold_queue: Optional[HoldQueue] = None
+        self.arrivals: Optional[ArrivalHistory] = None
+        self._shed_rate = RateTracker()
+        self._desired_on = scenario.n_replicas
+        if asc is not None:
+            if not 0 <= asc.initial_replicas <= scenario.n_replicas:
+                raise ValueError(
+                    f"initial_replicas {asc.initial_replicas} outside "
+                    f"[0, {scenario.n_replicas}]")
+            self._desired_on = asc.initial_replicas
+            self.arrivals = ArrivalHistory()
+            self.autoscaler = AutoscalerLoop(
+                asc.build_policy(),
+                self._fleet_signals,
+                _SimActuator(self),
+                clock=self.clock,
+                interval_s=asc.interval_s,
+                min_replicas=asc.min_replicas,
+                max_replicas=asc.max_replicas or scenario.n_replicas,
+                decision_log=100_000,  # the report wants the full history
+            )
+            # a parked request is the scale-from-zero trigger: the hold
+            # wakes the loop at the instant it registers
+            self.hold_queue = HoldQueue(
+                clock=self.clock,
+                max_holds=asc.hold_max,
+                default_hold_s=asc.hold_timeout_s,
+                on_hold=self.autoscaler.notify_demand,
+            )
 
     # ---------------- fleet plumbing ----------------
 
@@ -152,7 +204,34 @@ class FleetSim:
                     self.picker.observe_state(r.url, r.state_payload())
                 else:
                     self.picker.observe_failure(r.url)
+            self._release_holds()
             await self.clock.sleep(self.scenario.poll_interval_s)
+
+    def _release_holds(self) -> None:
+        """Replay parked requests once any backend is accepting again (the
+        activator's readiness-watch leg, on the sim's poll cadence)."""
+        if self.hold_queue is None or self.hold_queue.held == 0:
+            return
+        if any(r.accepting for r in self.replicas.values()):
+            self.hold_queue.release_all()
+
+    def _fleet_signals(self) -> FleetSignals:
+        """The EPP's FleetSignals export, built from the production picker
+        state (scheduler/picker.snapshot()) exactly like epp.py does —
+        stale by up to one poll interval, as in production."""
+        asc = self.scenario.autoscaler
+        now = self.clock.now()
+        states = self.picker.snapshot()
+        sheds_total = sum(int(s.get("sheds_total", 0) or 0) for s in states)
+        return FleetSignals.from_replica_states(
+            states, now,
+            arrival_rate_per_s=self.arrivals.rate(
+                now, asc.arrival_rate_window_s),
+            arrival_slope_per_s2=self.arrivals.slope(
+                now, asc.arrival_slope_window_s),
+            shed_rate_per_s=self._shed_rate.update(sheds_total, now),
+            held_requests=self.hold_queue.held,
+        )
 
     async def _churn_loop(self) -> None:
         for ev in sorted(self.scenario.churn, key=lambda e: e.at_s):
@@ -236,6 +315,10 @@ class FleetSim:
         index = len(self.records)
         rec = ClientRecord(rid=req.rid, kind=req.kind, index=index)
         self.records.append(rec)
+        if self.arrivals is not None:
+            # the gateway's arrival stamp (predictive policies learn from
+            # this) — recorded at the door, before any pick
+            self.arrivals.record(self.clock.now())
         tl = RequestTimeline(req.rid, model_name="fleet")
         tl.mark_received(self.clock.now())
         started = self.clock.now()
@@ -286,6 +369,27 @@ class FleetSim:
         if deadline is not None and deadline.expired:
             return "deadline_exceeded", None, ckpt, shown
         pick = self.picker.pick(prompt_ids=req.prompt_ids)
+        while pick is None and self.hold_queue is not None:
+            # the hold-and-replay gateway leg: a request arriving into a
+            # zero window (or any no-backend window) parks at the gateway
+            # — registering the hold wakes the autoscaler — and replays
+            # when a replica comes up.  NOT a retry: no attempt is burned,
+            # no backoff is paid, no client persistence is assumed.
+            rec.held += 1
+            try:
+                await self.hold_queue.hold(deadline)
+            except HoldExpiredError:
+                # production maps this to 504 (activator contract)
+                return "deadline_exceeded", None, ckpt, shown
+            except HoldOverflowError as exc:
+                rec.no_backend += 1
+                return "retry", exc.retry_after_s, ckpt, shown
+            except RuntimeError:
+                # fail_all at teardown (or a failed wake): the hold is
+                # gone; fall back to the ordinary retry path
+                rec.no_backend += 1
+                return "retry", None, ckpt, shown
+            pick = self.picker.pick(prompt_ids=req.prompt_ids)
         if pick is None:
             rec.no_backend += 1
             return "retry", None, ckpt, shown
@@ -387,17 +491,27 @@ class FleetSim:
     # ---------------- the run ----------------
 
     async def run(self) -> dict:
-        for r in self.replicas.values():
-            await r.start()
+        for i, r in enumerate(self.replicas.values()):
+            if i < self._desired_on:
+                await r.start()
         spawner = asyncio.create_task(self._spawn_clients())
         churn = asyncio.create_task(self._churn_loop())
         poll = asyncio.create_task(self._poll_loop())
+        # the autoscaler loop is a WATCHED task: an exception inside it
+        # (policy bug, actuation failure) fails the whole run — the same
+        # contract churn tasks carry.  A silently-dead autoscaler would
+        # read as a fleet frozen at its last size under a green report.
+        scaler = (asyncio.create_task(self.autoscaler.run())
+                  if self.autoscaler is not None else None)
+        aux_tasks = [t for t in (spawner, churn, poll, scaler)
+                     if t is not None]
         n = len(self.trace)
 
         def aux_failure():
-            # a dead spawner/churn/restart task must FAIL the run, not
-            # quietly produce a churn-free (or half-populated) green report
-            for t in (spawner, churn, poll, *self._churn_subtasks):
+            # a dead spawner/churn/autoscaler/restart task must FAIL the
+            # run, not quietly produce a churn-free (or half-populated,
+            # or frozen-fleet) green report
+            for t in (*aux_tasks, *self._churn_subtasks):
                 if t.done() and not t.cancelled() and t.exception():
                     return t.exception()
             return None
@@ -413,6 +527,8 @@ class FleetSim:
             poll.cancel()
             churn.cancel()
             spawner.cancel()
+            if scaler is not None:
+                scaler.cancel()
             # flush in-flight engine work (abandoned decodes, pending churn
             # restarts) so teardown never waits on real time
             for t in self._churn_subtasks:
@@ -426,8 +542,11 @@ class FleetSim:
             # failure path (aux exception, SimDeadlockError): the engines'
             # run-loop tasks must not outlive the run — destroyed-pending
             # task spam would bury the diagnostic this path exists to raise
-            for t in (poll, churn, spawner, *self._churn_subtasks):
+            for t in (*aux_tasks, *self._churn_subtasks):
                 t.cancel()
+            if self.hold_queue is not None:
+                self.hold_queue.fail_all(
+                    RuntimeError("simulation torn down"))
             for r in self.replicas.values():
                 if r.engine is not None and r.engine.running:
                     await r.stop()
@@ -439,7 +558,31 @@ class FleetSim:
             [rec.to_dict() for rec in self.records],
             [r.summary() for r in self.replicas.values()],
             faults, finished_at,
+            autoscaler=self._autoscaler_summary(),
         )
+
+    def _autoscaler_summary(self) -> Optional[dict]:
+        """The report's autoscaler block: every decision (reason-counted),
+        hold-gateway outcomes, and the policy's warm-pool bill in
+        replica-minutes — the currency policies are compared in."""
+        if self.autoscaler is None:
+            return None
+        decisions = self.autoscaler.decisions
+        return {
+            "policy": self.scenario.autoscaler.policy,
+            "ticks": self.autoscaler.ticks,
+            "decisions": dict(sorted(
+                self.autoscaler.decision_counts().items())),
+            "scale_ups": sum(1 for d in decisions
+                             if d.action == "scale_up"),
+            "scale_downs": sum(1 for d in decisions
+                               if d.action == "scale_down"),
+            "final_desired": self._desired_on,
+            "replica_up_minutes": round(sum(
+                r.summary()["up_s"] for r in self.replicas.values()
+            ) / 60.0, 9),
+            "holds": dict(sorted(self.hold_queue.stats.items())),
+        }
 
     def _describe_stuck(self) -> str:
         pending = [rec.rid for rec in self.records
@@ -449,6 +592,41 @@ class FleetSim:
             f"{self._completed}/{len(self.trace)} clients complete; "
             f"{waiting} not yet spawned; in-flight: {pending[:8]}"
         )
+
+
+class _SimActuator(ReplicaActuator):
+    """The AutoscalerLoop's hands inside the simulation: scale-up restarts
+    parked replicas in index order (first-ever starts build cold, later
+    wakes warm off the node AOT cache — StubCosts charges either way),
+    scale-down gracefully drains from the top (checkpoints stream out to
+    the held clients).  Awaited inline by the loop, so an actuation
+    failure IS a loop failure IS a run failure."""
+
+    def __init__(self, fleet: FleetSim):
+        self.fleet = fleet
+
+    async def current_replicas(self) -> int:
+        return self.fleet._desired_on
+
+    async def scale_to(self, n: int) -> None:
+        fleet = self.fleet
+        ordered = list(fleet.replicas.values())
+        cur = fleet._desired_on
+        if n > cur:
+            for r in ordered[cur:n]:
+                await r.restart()
+                # recycled-address contract (picker.set_replicas): a fresh
+                # process must not inherit breaker state, and the picker
+                # learns the wake immediately instead of a poll later
+                fleet.picker.breakers.forget(r.url)
+                fleet.picker.observe_state(r.url, r.state_payload())
+        elif n < cur:
+            for r in reversed(ordered[n:cur]):
+                await r.drain(fleet.scenario.autoscaler.drain_grace_s)
+                await r.stop()
+        fleet._desired_on = n
+        if n > cur:
+            fleet._release_holds()
 
 
 async def run_scenario(scenario: Scenario) -> dict:

@@ -78,7 +78,8 @@ class SimReplica:
     """A replica the fleet layer can route to, drain, crash and restart."""
 
     def __init__(self, name: str, clock: SimClock, spec: ReplicaSpec,
-                 params=None):
+                 params=None, build_now: bool = True,
+                 node_cache_warm: bool = False):
         self.name = name
         self.url = f"http://{name}:8080"
         self.clock = clock
@@ -102,8 +103,10 @@ class SimReplica:
         # first build on this "node" compiles cold and populates the
         # cache; every later build — crash restart, rolling restart, wake
         # from zero — starts warm.  start_records carries the cold/warm
-        # ready-cost history into the goodput report.
-        self.node_cache_warm = False
+        # ready-cost history into the goodput report.  (True at
+        # construction = a prior deployment left executables on the node:
+        # the AutoscalerSpec.node_cache_prewarmed scenario knob.)
+        self.node_cache_warm = node_cache_warm
         self.start_records: List[dict] = []
         # engine counters survive restarts here (a fresh engine starts at
         # zero; the report wants the replica's lifetime totals)
@@ -111,9 +114,18 @@ class SimReplica:
             "preemptions": 0, "checkpointed": 0, "resumes": 0,
             "finished": 0,
         }
+        # warm-pool cost accounting (docs/autoscaling.md): virtual seconds
+        # this replica's process was up — the autoscaler's goodput report
+        # charges policies in warm-replica-minutes
+        self.up_total_s = 0.0
+        self._up_since: Optional[float] = None
         self.engine: Optional[LLMEngine] = None
         self.lifecycle: Optional[ReplicaLifecycle] = None
-        self._build_engine()
+        # autoscaler-managed fleets defer the build: a replica that has
+        # never been scaled up has no engine, no device timeline, and —
+        # crucially — a COLD node AOT cache (its first wake pays compile_s)
+        if build_now:
+            self._build_engine()
 
     def _build_engine(self) -> None:
         cfg = self.spec.engine_config()
@@ -164,19 +176,33 @@ class SimReplica:
         fed to the real EndpointPicker by the fleet's poll loop."""
         state = self.engine.scheduler_state()
         state["lifecycle"] = self.lifecycle.state
+        # shed signal (protocol/rest/server.py parity): in the sim the
+        # shedder gates admission in the fleet's client leg, so its counts
+        # live here on the replica
+        state["shed"] = {
+            "count": self.shedder.shed_count,
+            "shedding": self.shedder.shedding,
+        }
         return state
 
     def set_fault_plan(self, plan: Optional[FaultPlan]) -> None:
         self.fault_plan = plan
-        self.engine.fault_plan = plan
+        if self.engine is not None:  # deferred build wires it on build
+            self.engine.fault_plan = plan
         self.device.fault_plan = plan
 
     # ---------------- lifecycle transitions (churn layer) ----------------
 
     async def start(self) -> None:
+        if self.engine is None:
+            self._build_engine()
         await self.engine.start()
+        self._up_since = self.clock.now()
 
     async def stop(self) -> None:
+        if self._up_since is not None:
+            self.up_total_s += self.clock.now() - self._up_since
+            self._up_since = None
         if self.engine is not None:
             await self.engine.stop()
 
@@ -205,7 +231,7 @@ class SimReplica:
         if self.fault_plan is not None:
             spec = FaultSpec("engine.fetch", "replica_crash", count=1)
             self.fault_plan.specs.append(spec)
-        await self.engine.stop()
+        await self.stop()
         if spec is not None:
             self.fault_plan.disarm(spec)
 
@@ -219,27 +245,42 @@ class SimReplica:
     def summary(self) -> dict:
         self_totals = dict(self.totals)
         e = self.engine
+        up_s = self.up_total_s
+        if self._up_since is not None:
+            up_s += self.clock.now() - self._up_since
         return {
             "name": self.name,
             "restarts": self.generation,
             "crashes": self.crashes,
-            "preemptions": self_totals["preemptions"] + e.preemption_count,
-            "checkpointed": self_totals["checkpointed"] + e.checkpointed_count,
-            "resumes": self_totals["resumes"] + e.resume_count,
-            "finished": self_totals["finished"] + e.telemetry.finished_count,
+            "preemptions": self_totals["preemptions"]
+            + (e.preemption_count if e is not None else 0),
+            "checkpointed": self_totals["checkpointed"]
+            + (e.checkpointed_count if e is not None else 0),
+            "resumes": self_totals["resumes"]
+            + (e.resume_count if e is not None else 0),
+            "finished": self_totals["finished"]
+            + (e.telemetry.finished_count if e is not None else 0),
             "device_dispatches": self.device.dispatches,
-            "lifecycle": self.lifecycle.state,
+            "lifecycle": (
+                self.lifecycle.state if self.lifecycle is not None
+                else "SCALED_TO_ZERO"
+            ),
+            "up_s": round(up_s, 9),
             "starts": [dict(s) for s in self.start_records],
         }
 
     async def restart(self) -> None:
         """Replace the process on the same url (rolling restart / crash
-        recovery): fresh engine, fresh device timeline, READY lifecycle.
-        The fleet layer must forget the old pod's breaker state (recycled
+        recovery / autoscaler scale-up): fresh engine, fresh device
+        timeline, READY lifecycle.  A never-built replica (autoscaler
+        deferred build) builds COLD here — its node cache is empty.  The
+        fleet layer must forget the old pod's breaker state (recycled
         address contract — scheduler/picker.set_replicas)."""
-        await self.stop()
-        self._accumulate()
-        self.generation += 1
-        self.device.reset()
+        if self.engine is not None:
+            await self.stop()
+            self._accumulate()
+            self.generation += 1
+            self.device.reset()
         self._build_engine()
         await self.engine.start()
+        self._up_since = self.clock.now()

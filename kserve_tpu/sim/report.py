@@ -53,7 +53,9 @@ def _rounded(stats: Dict[str, Any]) -> Dict[str, Any]:
 
 def build_report(scenario_name: str, seed: int, records: List[dict],
                  replicas: List[dict], faults: List[tuple],
-                 finished_at_s: float) -> Dict[str, Any]:
+                 finished_at_s: float,
+                 autoscaler: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
     """Aggregate client records (fleet.ClientRecord.to_dict()) into the
     canonical goodput report."""
     outcomes: Dict[str, int] = {}
@@ -92,6 +94,9 @@ def build_report(scenario_name: str, seed: int, records: List[dict],
             "preempt_resumes": sum(r["resumes"] for r in records),
             "crash_restarts": sum(r["crash_restarts"] for r in records),
             "sheds_observed": sheds,
+            # gateway holds are NOT attempts: a parked request burns no
+            # retry budget (the hold-and-replay contract)
+            "holds_observed": sum(r.get("held", 0) for r in records),
         },
         "latency": {
             "ttft_s": _rounded(percentiles(ttft)),
@@ -106,6 +111,10 @@ def build_report(scenario_name: str, seed: int, records: List[dict],
         },
         "finished_at_s": round(finished_at_s, 9),
     }
+    if autoscaler is not None:
+        # the autoscaler-in-the-loop block (fleet._autoscaler_summary):
+        # reason-counted decisions, hold outcomes, warm-pool bill
+        report["autoscaler"] = autoscaler
     return report
 
 

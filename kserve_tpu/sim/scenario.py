@@ -32,6 +32,13 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+from ..autoscale.policy import (
+    PredictiveConfig,
+    PredictivePolicy,
+    ReactiveConfig,
+    ReactivePolicy,
+    ScalingPolicy,
+)
 from .replica import ReplicaSpec
 from .report import SLOBudget
 from .stub import StubCosts
@@ -69,6 +76,47 @@ class ChurnEvent:
 
 
 @dataclass
+class AutoscalerSpec:
+    """Autoscaler-in-the-loop configuration (docs/autoscaling.md): when a
+    Scenario carries one, the fleet's replica count is DRIVEN by a live
+    `AutoscalerLoop` instead of being static — `n_replicas` becomes the
+    fleet's maximum footprint, only `initial_replicas` start, and requests
+    arriving while nothing is up are parked on the hold-and-replay gateway
+    (never client-retried).  This is how a policy is expressed as a sim
+    scenario first: the goodput report judges it before the reconciler
+    ships its config."""
+
+    policy: str = "predictive"  # "reactive" | "predictive"
+    min_replicas: int = 0
+    max_replicas: Optional[int] = None  # None = scenario.n_replicas
+    initial_replicas: int = 1
+    interval_s: float = 0.5  # decision tick
+    drain_grace_s: float = 0.5  # scale-down drain budget (checkpoints out)
+    hold_max: int = 256  # bounded gateway hold queue
+    hold_timeout_s: float = 60.0  # default hold budget (deadline-less reqs)
+    # signal smoothing: short windows so sim-scale dynamics (tens of
+    # virtual seconds) register; production defaults are longer
+    arrival_rate_window_s: float = 5.0
+    arrival_slope_window_s: float = 4.0
+    # True = every node's AOT cache starts populated (a prior deployment
+    # left executables on disk — the docs/coldstart.md warmed-PVC recipe),
+    # so even FIRST scale-ups pay aot_load_s, not compile_s.  False keeps
+    # the honest cold-first-build accounting the smoke asserts.
+    node_cache_prewarmed: bool = False
+    reactive: ReactiveConfig = field(default_factory=ReactiveConfig)
+    predictive: PredictiveConfig = field(default_factory=PredictiveConfig)
+
+    def build_policy(self) -> ScalingPolicy:
+        reactive = ReactivePolicy(self.reactive)
+        if self.policy == "reactive":
+            return reactive
+        if self.policy == "predictive":
+            return PredictivePolicy(reactive=reactive,
+                                    config=self.predictive)
+        raise ValueError(f"unknown autoscaler policy {self.policy!r}")
+
+
+@dataclass
 class Scenario:
     name: str
     seed: int = 0
@@ -77,6 +125,7 @@ class Scenario:
     workload: WorkloadConfig = field(default_factory=WorkloadConfig)
     churn: List[ChurnEvent] = field(default_factory=list)
     budget: SLOBudget = field(default_factory=SLOBudget)
+    autoscaler: Optional[AutoscalerSpec] = None
     poll_interval_s: float = 0.5
     # generous client persistence: a shed storm resolves in a few virtual
     # seconds, and a client that gives up during one is a goodput loss the
@@ -205,6 +254,122 @@ def scale_zero_scenario(seed: int = 11) -> Scenario:
         # until the fleet wakes
         client_max_attempts=40,
         client_retry_budget_s=240.0,
+    )
+
+
+def autoscale_smoke_scenario(seed: int = 13,
+                             policy: str = "predictive") -> Scenario:
+    """Autoscaler-in-the-loop smoke (tier-1): one replica serves light
+    traffic, a burst forces a scale-up (the second replica's FIRST build
+    is cold — the autoscaler pays real start costs), the fleet idles down
+    to ZERO, and a second burst lands inside the zero window — every one
+    of those requests is parked on the hold-and-replay gateway (never
+    client-retried), wakes the fleet warm, and replays with zero lost or
+    duplicated tokens.  Byte-identical per seed like every scenario."""
+    return Scenario(
+        name=f"autoscale-smoke-{policy}",
+        seed=seed,
+        n_replicas=2,
+        spec=_canned_spec(),
+        workload=WorkloadConfig(
+            n_requests=30, duration_s=16.0,
+            # burst 1: scale-up pressure while replica-1 has never built
+            # (cold start under autoscaler control); burst 2 arrives ~4s
+            # after the fleet reached zero — the zero-window leg
+            bursts=[(6.0, 10), (30.0, 8)],
+        ),
+        autoscaler=AutoscalerSpec(
+            policy=policy,
+            min_replicas=0,
+            initial_replicas=1,
+            interval_s=0.5,
+            drain_grace_s=0.5,
+            reactive=ReactiveConfig(
+                queue_high_per_replica=5.0,
+                queue_low_per_replica=1.0,
+                idle_to_zero_s=5.0,
+                up_cooldown_s=1.0,
+                down_cooldown_s=3.0,
+            ),
+        ),
+        budget=SLOBudget(
+            # TTFT absorbs the queue behind the cold scale-up and the
+            # zero-window hold; what may NOT happen is a drop
+            p99_ttft_s=20.0, p99_itl_s=2.0, min_goodput=0.98,
+            # holds are NOT retries: the zero window costs no attempts, so
+            # the budget stays tight (contrast scale_zero_scenario's 12x
+            # retry-polling budget — the contract this subsystem replaces)
+            max_retry_amplification=3.0, max_shed_fraction=1.0,
+        ),
+    )
+
+
+def autoscale_burst_scenario(policy: str, seed: int = 21,
+                             n_requests: int = 10_000) -> Scenario:
+    """The policy-judging acceptance trace (slow): a 40-virtual-minute
+    10k-request workload with four identical bursts on a strict period.
+    Run once per policy over the same seed: the PredictivePolicy's
+    periodic learner observes the first three onsets and prewarms the
+    pool before the fourth, which the ReactivePolicy only answers after
+    the queue exists — the burst TTFT p99 delta (at a bounded
+    warm-replica-minute premium) is the number the reconciler defaults
+    were chosen on (tests/test_autoscale.py::TestPolicyAcceptance)."""
+    period = 480.0
+    duration = 2400.0
+    # realistic replica-start bill (docs/coldstart.md): an 8B-int8 wake is
+    # seconds of AOT executable load + streamed weights even with a warm
+    # node cache, not milliseconds — THIS is what makes prewarming a real
+    # policy question.  Nodes start cache-prewarmed (warmed-PVC recipe),
+    # so every wake pays aot_load_s; a cold node would pay compile_s.
+    costs = StubCosts(
+        prefill_base_s=0.01, prefill_per_token_s=2e-4, decode_step_s=0.02,
+        compile_s=45.0, aot_load_s=8.0)
+    return Scenario(
+        name=f"autoscale-burst-{policy}",
+        seed=seed,
+        n_replicas=4,
+        spec=ReplicaSpec(costs=costs),
+        workload=WorkloadConfig(
+            n_requests=n_requests - 320, duration_s=duration,
+            bursts=[(period * k, 80) for k in (1, 2, 3, 4)],
+        ),
+        autoscaler=AutoscalerSpec(
+            policy=policy,
+            min_replicas=1,
+            initial_replicas=1,
+            interval_s=0.5,
+            drain_grace_s=0.5,
+            node_cache_prewarmed=True,
+            reactive=ReactiveConfig(
+                queue_high_per_replica=6.0,
+                queue_low_per_replica=1.0,
+                idle_to_zero_s=30.0,
+                up_cooldown_s=2.0,
+                down_cooldown_s=8.0,
+            ),
+            predictive=PredictiveConfig(
+                # well above background arrival noise (~4 req/s Poisson
+                # jitter reaches slope ~2-3); a real 80-request burst
+                # registers ~20 — spurious slope prewarms are pure
+                # warm-pool waste
+                slope_up_per_s2=6.0,
+                burst_rate_per_s=12.0,
+                min_period_s=60.0,
+                period_tolerance_frac=0.2,
+                min_intervals=2,
+                # the lead must cover the wake bill: replicas prewarmed
+                # 12s out are READY when the predicted burst lands, while
+                # the reactive policy's post-onset wakes spend their first
+                # aot_load_s seconds useless
+                prewarm_lead_s=12.0,
+                prewarm_hold_s=10.0,
+                prewarm_replicas=4,
+            ),
+        ),
+        budget=SLOBudget(
+            p99_ttft_s=30.0, p99_itl_s=3.0, min_goodput=0.98,
+            max_retry_amplification=2.0, max_shed_fraction=0.25,
+        ),
     )
 
 

@@ -1,0 +1,617 @@
+"""The EPP-signal autoscaler (kserve_tpu/autoscale; docs/autoscaling.md).
+
+Four layers, each deterministic with zero real sleeps:
+
+- signals: FleetSignals aggregation, arrival-rate/slope math, counter ->
+  rate tracking
+- policies: reactive thresholds/hysteresis/cooldowns/scale-to-zero,
+  predictive burst-slope + periodic prewarming (pure functions of the
+  snapshot stream — no clock at all)
+- hold queue: bounded, deadline-aware hold-and-replay on a FakeClock
+  (overflow 503 / expiry 504 / FIFO replay ordering)
+- loop: clamping, demand wake, metrics, and the PR-7 contract that an
+  autoscaler-loop exception surfaces as a run() failure — in unit form
+  here and through the fleet simulator in TestSimAutoscale
+- scenarios: the tier-1 autoscale smoke (0->N->0->N with hold-and-replay
+  across the zero window) and the slow reactive-vs-predictive 10k
+  acceptance leg
+"""
+
+import asyncio
+
+import pytest
+
+from kserve_tpu.autoscale import (
+    ArrivalHistory,
+    AutoscalerLoop,
+    FleetSignals,
+    HoldExpiredError,
+    HoldOverflowError,
+    HoldQueue,
+    PredictiveConfig,
+    PredictivePolicy,
+    RateTracker,
+    ReactiveConfig,
+    ReactivePolicy,
+    ReplicaActuator,
+    ScalingDecision,
+)
+from kserve_tpu.autoscale.actuator import DeploymentActuator
+from kserve_tpu.resilience import Deadline, FakeClock
+
+from conftest import async_test, counter_value
+
+
+def sig(at_s=0.0, ready=1, queue=0, inflight=0, held=0, rate=0.0,
+        slope=0.0, shed=0.0, ttft=None, total=None) -> FleetSignals:
+    return FleetSignals(
+        at_s=at_s, ready_replicas=ready,
+        total_replicas=total if total is not None else ready,
+        queue_depth=queue, inflight=inflight, shed_rate_per_s=shed,
+        ttft_p99_s=ttft, arrival_rate_per_s=rate,
+        arrival_slope_per_s2=slope, held_requests=held,
+    )
+
+
+class TestSignals:
+    def test_aggregation_excludes_draining_and_unhealthy(self):
+        states = [
+            {"url": "a", "healthy": True, "lifecycle": "READY",
+             "queue_depth": 3, "inflight": 2,
+             "telemetry": {"ttft_p99_s": 1.5}},
+            {"url": "b", "healthy": True, "lifecycle": "DRAINING",
+             "queue_depth": 9, "inflight": 9},
+            {"url": "c", "healthy": False, "queue_depth": 7},
+            {"url": "d", "healthy": True, "lifecycle": "READY",
+             "queue_depth": 1, "inflight": 0,
+             "telemetry": {"ttft_p99_s": 4.0}},
+        ]
+        s = FleetSignals.from_replica_states(states, at_s=10.0,
+                                             held_requests=2)
+        assert s.ready_replicas == 2
+        assert s.total_replicas == 4
+        assert s.queue_depth == 4  # draining/unhealthy queues excluded
+        assert s.inflight == 2
+        assert s.ttft_p99_s == 4.0  # worst ready replica
+        assert s.held_requests == 2 and s.demand
+
+    def test_shed_block_and_flat_forms_both_parse(self):
+        flat = {"url": "a", "sheds_total": 5, "shedding": True}
+        nested = {"url": "b", "shed": {"count": 7, "shedding": False}}
+        s = FleetSignals.from_replica_states([flat, nested], at_s=0.0)
+        assert s.replicas[0].sheds_total == 5 and s.replicas[0].shedding
+        assert s.replicas[1].sheds_total == 7 and not s.replicas[1].shedding
+
+    def test_wire_round_trip(self):
+        s = FleetSignals.from_replica_states(
+            [{"url": "a", "queue_depth": 2}], at_s=3.0,
+            arrival_rate_per_s=1.5, held_requests=1)
+        back = FleetSignals.from_dict(s.to_dict())
+        assert back == s
+
+    def test_from_dict_ignores_unknown_keys(self):
+        s = FleetSignals.from_dict(
+            {"at_s": 1.0, "queue_depth": 4, "future_field": "x",
+             "replicas": [{"url": "a", "novel": 1}]})
+        assert s.queue_depth == 4
+        assert s.replicas[0].url == "a"
+
+    def test_arrival_rate_and_slope(self):
+        h = ArrivalHistory(bucket_s=1.0, window_s=60.0)
+        for t in (10.0, 10.1, 10.2, 11.0):
+            h.record(t)
+        assert h.rate(12.0, window_s=4.0) == pytest.approx(1.0)
+        # burst onset: 8 arrivals in the recent half, none before
+        h2 = ArrivalHistory()
+        for _ in range(8):
+            h2.record(20.0)
+        assert h2.slope(21.0, window_s=10.0) > 0
+        assert h2.slope(40.0, window_s=10.0) == 0.0  # burst long past
+
+    def test_rate_tracker_handles_counter_reset(self):
+        rt = RateTracker()
+        assert rt.update(10, 1.0) == 0.0  # first observation: no baseline
+        assert rt.update(20, 3.0) == pytest.approx(5.0)
+        assert rt.update(2, 4.0) == 0.0  # replica restart reset
+        assert rt.update(4, 5.0) == pytest.approx(2.0)
+
+    def test_rate_tracker_floor_survives_scraper_storms(self):
+        """A shared tracker consulted by several /state scrapers must not
+        collapse its window to milliseconds (one shed -> hundreds/sec) or
+        let one scraper absorb the delta (autoscaler reads 0 mid-storm)."""
+        rt = RateTracker(min_interval_s=2.0)
+        rt.update(0, 0.0)
+        assert rt.update(10, 5.0) == pytest.approx(2.0)
+        # a dashboard scrape 50ms later: re-serves 2.0, baseline untouched
+        assert rt.update(11, 5.05) == pytest.approx(2.0)
+        # the autoscaler's own next consult still sees the full window
+        assert rt.update(20, 10.0) == pytest.approx(2.0)
+
+
+class TestReactivePolicy:
+    def cfg(self, **kw) -> ReactiveConfig:
+        base = dict(queue_high_per_replica=4.0, queue_low_per_replica=1.0,
+                    idle_to_zero_s=10.0, up_cooldown_s=2.0,
+                    down_cooldown_s=5.0)
+        base.update(kw)
+        return ReactiveConfig(**base)
+
+    def test_queue_pressure_scales_up_then_cooldown_gates(self):
+        p = ReactivePolicy(self.cfg())
+        d = p.decide(sig(at_s=0.0, ready=2, queue=20), current=2)
+        assert d.action == "scale_up" and d.reason == "queue_depth"
+        d2 = p.decide(sig(at_s=1.0, ready=2, queue=20), current=3)
+        assert d2.action == "hold" and d2.reason == "cooldown"
+        d3 = p.decide(sig(at_s=3.5, ready=3, queue=30), current=3)
+        assert d3.action == "scale_up"
+
+    def test_shed_rate_and_ttft_trigger(self):
+        p = ReactivePolicy(self.cfg(ttft_p99_slo_s=2.0))
+        assert p.decide(
+            sig(at_s=0.0, ready=2, queue=0, shed=1.0), 2).reason == "shed_rate"
+        p2 = ReactivePolicy(self.cfg(ttft_p99_slo_s=2.0))
+        assert p2.decide(
+            sig(at_s=0.0, ready=2, inflight=1, ttft=5.0), 2
+        ).reason == "ttft_slo"
+
+    def test_hysteresis_band_steps_down(self):
+        p = ReactivePolicy(self.cfg())
+        # load per ready 1.5 sits inside the band: hold
+        d = p.decide(sig(at_s=0.0, ready=2, queue=1, inflight=2), 2)
+        assert d.action == "hold" and d.reason == "steady"
+        # below the low mark: step down one
+        d2 = p.decide(sig(at_s=1.0, ready=2, inflight=1), 2)
+        assert d2.target == 1 and d2.reason == "low_load"
+
+    def test_idle_scales_to_zero_after_window(self):
+        p = ReactivePolicy(self.cfg())
+        assert p.decide(sig(at_s=0.0, ready=1), 1).action == "hold"
+        assert p.decide(sig(at_s=9.0, ready=1), 1).action == "hold"
+        d = p.decide(sig(at_s=10.0, ready=1), 1)
+        assert d.target == 0 and d.reason == "idle_zero"
+
+    def test_demand_resets_idle_window(self):
+        p = ReactivePolicy(self.cfg())
+        p.decide(sig(at_s=0.0, ready=1), 1)
+        p.decide(sig(at_s=9.0, ready=1, inflight=1), 1)  # demand!
+        d = p.decide(sig(at_s=12.0, ready=1), 1)
+        assert d.action == "hold"  # idle clock restarted at 12
+
+    def test_held_demand_wakes_from_zero_without_cooldown(self):
+        p = ReactivePolicy(self.cfg())
+        # a scale-down just happened; a hold must still wake immediately
+        p.decide(sig(at_s=0.0, ready=1), 1)
+        d = p.decide(sig(at_s=0.5, ready=0, held=9, total=2), 0)
+        assert d.action == "scale_up" and d.reason == "hold_demand"
+        assert d.target >= 2  # backlog-proportional wake (9 held / 4 high)
+
+    def test_zero_with_no_demand_stays_zero(self):
+        p = ReactivePolicy(self.cfg())
+        d = p.decide(sig(at_s=0.0, ready=0, total=2), 0)
+        assert d.target == 0 and d.action == "hold"
+
+    def test_unknown_reason_rejected(self):
+        with pytest.raises(ValueError):
+            ScalingDecision(at_s=0.0, current=1, target=2,
+                            reason="vibes", signals=sig())
+
+
+class TestPredictivePolicy:
+    def build(self, **pkw) -> PredictivePolicy:
+        pcfg = dict(slope_up_per_s2=3.0, burst_rate_per_s=10.0,
+                    min_period_s=5.0, period_tolerance_frac=0.2,
+                    min_intervals=2, prewarm_lead_s=3.0,
+                    prewarm_hold_s=5.0, prewarm_replicas=3)
+        pcfg.update(pkw)
+        return PredictivePolicy(
+            reactive=ReactivePolicy(ReactiveConfig(
+                queue_high_per_replica=4.0, idle_to_zero_s=1000.0)),
+            config=PredictiveConfig(**pcfg))
+
+    def feed_bursts(self, p, onsets, tick_s=1.0, until=None):
+        """Walk the policy through a rate timeline with bursts at
+        `onsets` (rate 20 for one tick, else 1)."""
+        t = 0.0
+        until = until if until is not None else max(onsets) + 1
+        while t <= until:
+            rate = 20.0 if any(abs(t - o) < 0.5 for o in onsets) else 1.0
+            p.decide(sig(at_s=t, ready=1, rate=rate), 1)
+            t += tick_s
+
+    def test_periodic_detector_learns_and_prewarms(self):
+        p = self.build()
+        self.feed_bursts(p, [10.0, 30.0, 50.0])  # period 20 confirmed
+        assert p.detector.predict_next() == pytest.approx(70.0)
+        # inside the prewarm window: pool is bought ahead of the burst
+        d = p.decide(sig(at_s=68.0, ready=1, rate=1.0), 1)
+        assert d.target == 3 and d.reason == "periodic_prewarm"
+        # outside the window: no prewarm
+        d2 = p.decide(sig(at_s=60.0, ready=1, rate=1.0), 1)
+        assert d2.action == "hold"
+
+    def test_irregular_gaps_never_predict(self):
+        p = self.build()
+        self.feed_bursts(p, [10.0, 30.0, 70.0])  # gaps 20 vs 40
+        assert p.detector.predict_next() is None
+
+    def test_slope_trigger_prewarms_one(self):
+        p = self.build()
+        d = p.decide(sig(at_s=0.0, ready=2, rate=5.0, slope=10.0), 2)
+        assert d.target == 3 and d.reason == "burst_slope"
+
+    def test_prediction_is_monotone_over_reactive(self):
+        """Prediction only ADDS capacity: a reactive scale-up bigger than
+        the prewarm pool wins untouched."""
+        p = self.build(prewarm_replicas=2)
+        self.feed_bursts(p, [10.0, 30.0, 50.0])
+        d = p.decide(sig(at_s=69.0, ready=3, queue=40, rate=1.0), 3)
+        assert d.target > 3 and d.reason in ("queue_depth", "cooldown")
+
+
+class TestHoldQueue:
+    @async_test
+    async def test_release_replays_in_arrival_order(self):
+        clock = FakeClock()
+        q = HoldQueue(clock=clock, max_holds=8, default_hold_s=60.0)
+        order = []
+
+        async def holder(name):
+            await q.hold()
+            order.append(name)
+
+        async def run():
+            tasks = [asyncio.ensure_future(holder(f"h{i}"))
+                     for i in range(3)]
+            await asyncio.sleep(0)
+            assert q.held == 3
+            assert q.release_all() == 3
+            await asyncio.gather(*tasks)
+
+        await run()
+        assert order == ["h0", "h1", "h2"]  # FIFO replay
+        assert q.stats["replayed"] == 3 and q.stats["held"] == 3
+
+    @async_test
+    async def test_expired_deadline_rejected_upfront(self):
+        clock = FakeClock()
+        q = HoldQueue(clock=clock)
+        dl = Deadline.after(5.0, clock)
+        clock.advance(6.0)
+        with pytest.raises(HoldExpiredError):
+            await q.hold(dl)
+        assert q.stats["expired"] == 1
+
+    @async_test
+    async def test_hold_expires_at_deadline_not_default(self):
+        clock = FakeClock()
+        q = HoldQueue(clock=clock, default_hold_s=120.0)
+        # FakeClock.sleep advances instantly, so the deadline timer fires
+        # on the first wait: the hold must expire, not park forever
+        with pytest.raises(HoldExpiredError):
+            await q.hold(Deadline.after(2.0, clock))
+        assert clock.sleeps == [2.0]  # budget = deadline, not default
+
+    @async_test
+    async def test_overflow_rejects_newcomer_with_retry_after(self):
+        clock = FakeClock()
+        q = HoldQueue(clock=clock, max_holds=2, retry_after_s=3.0)
+        t1 = asyncio.ensure_future(q.hold())
+        t2 = asyncio.ensure_future(q.hold())
+        await asyncio.sleep(0)
+        assert q.held == 2
+        with pytest.raises(HoldOverflowError) as exc:
+            await q.hold()
+        assert exc.value.retry_after_s == 3.0
+        assert q.stats["overflow"] == 1
+        q.release_all()
+        await asyncio.gather(t1, t2)
+
+    @async_test
+    async def test_overflow_evicts_expired_holds_first(self):
+        clock = FakeClock()
+        q = HoldQueue(clock=clock, max_holds=1, default_hold_s=60.0)
+        t1 = asyncio.ensure_future(q.hold(Deadline.after(5.0, clock)))
+        await asyncio.sleep(0)
+        clock.advance(6.0)  # t1's deadline passed but it still holds a slot
+        t2 = asyncio.ensure_future(q.hold())  # evicts t1, takes the slot
+        await asyncio.sleep(0)
+        with pytest.raises(HoldExpiredError):
+            await t1
+        assert q.held == 1
+        q.release_all()
+        await t2
+        assert q.stats["expired"] == 1 and q.stats["replayed"] == 1
+
+    @async_test
+    async def test_fail_all_propagates_wake_failure(self):
+        clock = FakeClock()
+        q = HoldQueue(clock=clock)
+        t = asyncio.ensure_future(q.hold())
+        await asyncio.sleep(0)
+        boom = RuntimeError("wake failed")
+        assert q.fail_all(boom) == 1
+        with pytest.raises(RuntimeError, match="wake failed"):
+            await t
+        assert q.stats["failed"] == 1
+
+
+class _FakeActuator(ReplicaActuator):
+    def __init__(self, current=1):
+        self.current = current
+        self.calls = []
+
+    async def current_replicas(self) -> int:
+        return self.current
+
+    async def scale_to(self, n: int) -> None:
+        self.calls.append(n)
+        self.current = n
+
+
+class TestAutoscalerLoop:
+    @async_test
+    async def test_tick_actuates_and_clamps(self):
+        clock = FakeClock()
+        actuator = _FakeActuator(current=1)
+        policy = ReactivePolicy(ReactiveConfig(
+            queue_high_per_replica=1.0, max_step_up=10, up_cooldown_s=0.0))
+        loop = AutoscalerLoop(
+            policy, lambda: sig(at_s=clock.now(), ready=1, queue=100),
+            actuator, clock=clock, min_replicas=1, max_replicas=3)
+        d = await loop.tick()
+        assert actuator.calls == [3]  # clamped to max_replicas
+        assert d.target == 3
+        assert d.reason == "queue_depth"
+
+    @async_test
+    async def test_decisions_metrics_are_reason_labelled(self):
+        clock = FakeClock()
+        from kserve_tpu.metrics import AUTOSCALER_DECISIONS
+        before = counter_value(AUTOSCALER_DECISIONS, action="scale_up",
+                               reason="queue_depth")
+        loop = AutoscalerLoop(
+            ReactivePolicy(ReactiveConfig(queue_high_per_replica=1.0,
+                                          up_cooldown_s=0.0)),
+            lambda: sig(at_s=clock.now(), ready=1, queue=50),
+            _FakeActuator(1), clock=clock, max_replicas=4)
+        await loop.tick()
+        assert counter_value(
+            AUTOSCALER_DECISIONS, action="scale_up", reason="queue_depth",
+        ) == before + 1
+
+    @async_test
+    async def test_run_surfaces_signal_failures(self):
+        """The PR-7 contract in unit form: an exception inside the loop
+        escapes run() — no swallowed autoscaler death."""
+        clock = FakeClock()
+
+        def bad_signals():
+            raise RuntimeError("scrape exploded")
+
+        loop = AutoscalerLoop(ReactivePolicy(), bad_signals,
+                              _FakeActuator(1), clock=clock)
+        with pytest.raises(RuntimeError, match="scrape exploded"):
+            await loop.run()
+
+    @async_test
+    async def test_notify_demand_wakes_sleep(self):
+        clock = FakeClock()
+        actuator = _FakeActuator(current=0)
+        held = {"n": 0}
+        loop = AutoscalerLoop(
+            ReactivePolicy(),
+            lambda: sig(at_s=clock.now(), ready=0, held=held["n"], total=2),
+            actuator, clock=clock, interval_s=3600.0, max_replicas=2)
+        task = asyncio.ensure_future(loop.run())
+        for _ in range(6):
+            await asyncio.sleep(0)
+        assert actuator.calls == []  # idle at zero: nothing actuated
+        held["n"] = 4  # a request parks at the gateway...
+        loop.notify_demand()  # ...and pokes the loop awake mid-interval
+        for _ in range(8):
+            await asyncio.sleep(0)
+        assert actuator.calls and actuator.calls[0] >= 1
+        loop.stop()
+        for _ in range(8):
+            await asyncio.sleep(0)
+        assert task.done()
+
+    @async_test
+    async def test_deployment_actuator_patches_replicas(self):
+        store = {"spec": {"replicas": 1}, "kind": "Deployment",
+                 "metadata": {"name": "m-kserve", "namespace": "ns"}}
+
+        class FakeCluster:
+            def __init__(self):
+                self.applied = []
+
+            def get(self, kind, name, namespace):
+                assert (kind, name, namespace) == (
+                    "Deployment", "m-kserve", "ns")
+                return store
+
+            def apply(self, obj):
+                self.applied.append(obj["spec"]["replicas"])
+
+        cluster = FakeCluster()
+        act = DeploymentActuator(cluster, "m-kserve", "ns")
+        assert await act.current_replicas() == 1
+        await act.scale_to(3)
+        assert cluster.applied == [3]
+        await act.scale_to(3)  # already there: no redundant apply
+        assert cluster.applied == [3]
+
+    @async_test
+    async def test_deployment_actuator_keeps_whole_slice_multiples(self):
+        """pods_per_replica > 1: the loop reasons in replicas, the patch
+        lands in pods, and the count is ALWAYS a whole-slice multiple —
+        the invariant KEDA's podsPerReplica carried."""
+        store = {"spec": {"replicas": 2}, "kind": "Deployment",
+                 "metadata": {"name": "m-kserve", "namespace": "ns"}}
+
+        class FakeCluster:
+            def __init__(self):
+                self.applied = []
+
+            def get(self, kind, name, namespace):
+                return store
+
+            def apply(self, obj):
+                self.applied.append(obj["spec"]["replicas"])
+                store["spec"]["replicas"] = obj["spec"]["replicas"]
+
+        cluster = FakeCluster()
+        act = DeploymentActuator(cluster, "m-kserve", "ns",
+                                 pods_per_replica=2)
+        assert await act.current_replicas() == 1  # 2 pods = 1 replica
+        await act.scale_to(3)
+        assert cluster.applied == [6]  # never a half-slice pod count
+        assert await act.current_replicas() == 3
+
+
+@pytest.mark.sim
+class TestSimAutoscale:
+    """Autoscaler-in-the-loop fleet simulation (tier-1): the serverless
+    loop proves itself on the goodput report before any cluster sees it."""
+
+    @async_test
+    async def test_smoke_scenario_0_n_0_n_with_hold_and_replay(self):
+        from kserve_tpu.sim import (
+            FleetSim,
+            assert_slo,
+            autoscale_smoke_scenario,
+            canonical_json,
+        )
+
+        sim = FleetSim(autoscale_smoke_scenario())
+        report = await sim.run()
+        assert_slo(report, sim.scenario.budget)
+        # zero tokens lost or duplicated across scale-to-zero and wake
+        assert report["tokens"]["lost"] == 0
+        assert report["tokens"]["duplicated"] == 0
+        # the fleet really passed through zero and really woke on demand
+        decisions = report["autoscaler"]["decisions"]
+        assert any(k.startswith("scale_down:idle_zero") for k in decisions)
+        assert any(k.startswith("scale_up:hold_demand") for k in decisions)
+        # the zero-window burst was HELD and REPLAYED, never client-retried:
+        # every hold replayed, no request ever saw "no backend"
+        holds = report["autoscaler"]["holds"]
+        assert holds["held"] > 0
+        assert holds["replayed"] == holds["held"]
+        assert holds["expired"] == 0 and holds["overflow"] == 0
+        assert all(rec.no_backend == 0 for rec in sim.records)
+        assert report["retries"]["holds_observed"] > 0
+        # start-cost accounting: replica-1's FIRST build (the autoscaler's
+        # burst scale-up) is cold; the wake from zero is warm off the node
+        # AOT cache at a fraction of the cold bill
+        starts = {r["name"]: r["starts"] for r in report["replicas"]}
+        r1 = starts["replica-1"]
+        assert r1[0]["kind"] == "cold"
+        for s in r1[1:]:
+            assert s["kind"] == "warm"
+            assert s["cost_s"] <= r1[0]["cost_s"] / 10
+        # byte-identical per seed, autoscaler decisions included
+        rerun = await FleetSim(autoscale_smoke_scenario()).run()
+        assert canonical_json(rerun) == canonical_json(report)
+
+    @async_test
+    async def test_autoscaler_loop_failure_fails_the_run(self):
+        """Regression for the PR-7 task contract THROUGH the fleet layer:
+        a policy that explodes mid-run must fail run(), not leave the
+        fleet silently frozen under a green report."""
+        from kserve_tpu.sim import FleetSim, autoscale_smoke_scenario
+
+        class ExplodingPolicy(ReactivePolicy):
+            def decide(self, signals, current):
+                if signals.at_s > 5.0:
+                    raise RuntimeError("policy exploded mid-run")
+                return super().decide(signals, current)
+
+        sim = FleetSim(autoscale_smoke_scenario())
+        sim.autoscaler.policy = ExplodingPolicy()
+        with pytest.raises(RuntimeError, match="policy exploded"):
+            await sim.run()
+
+    @async_test
+    async def test_initial_replicas_validated(self):
+        from kserve_tpu.sim import FleetSim, autoscale_smoke_scenario
+
+        scenario = autoscale_smoke_scenario()
+        scenario.autoscaler.initial_replicas = 7  # > n_replicas=2
+        with pytest.raises(ValueError, match="initial_replicas"):
+            FleetSim(scenario)
+
+
+@pytest.mark.sim
+@pytest.mark.slow
+class TestPolicyAcceptance:
+    """The 10k-trace policy-judging leg (ISSUE 12 acceptance): predictive
+    prewarming must strictly beat reactive scaling on burst TTFT p99 at
+    <= 1 extra warm-replica-minute, with both meeting the SLO budget.
+    The winning config here is what the llmisvc reconciler ships."""
+
+    @staticmethod
+    async def _run(policy):
+        from kserve_tpu.sim import FleetSim, assert_slo, autoscale_burst_scenario
+
+        sim = FleetSim(autoscale_burst_scenario(policy))
+        report = await sim.run()
+        assert_slo(report, sim.scenario.budget)
+        # burst-4 is the first PREDICTED burst (the learner needs three
+        # onsets to confirm the period)
+        rids = {r.rid for r in sim.trace if r.arrival_s == 4 * 480.0}
+        tt = sorted(rec.ttft_s for rec in sim.records
+                    if rec.rid in rids and rec.ttft_s is not None)
+        assert len(tt) == 80  # every burst request completed
+        p99 = tt[min(len(tt) - 1, int(0.99 * len(tt)))]
+        return report, p99
+
+    @async_test
+    async def test_predictive_beats_reactive_on_burst_ttft(self):
+        reactive, r_p99 = await self._run("reactive")
+        predictive, p_p99 = await self._run("predictive")
+        # the predictive run actually predicted (not just slope-reacted)
+        assert any(
+            k.startswith("scale_up:periodic_prewarm")
+            for k in predictive["autoscaler"]["decisions"])
+        # strictly better burst tail latency...
+        assert p_p99 < r_p99, (p_p99, r_p99)
+        # ...by a margin worth shipping (the wake bill reactive pays)
+        assert p_p99 < r_p99 * 0.6
+        # ...at a bounded warm-pool premium
+        extra_min = (predictive["autoscaler"]["replica_up_minutes"]
+                     - reactive["autoscaler"]["replica_up_minutes"])
+        assert extra_min <= 1.0, extra_min
+        # both runs kept perfect token accounting through all the churn
+        for rep in (reactive, predictive):
+            assert rep["tokens"]["lost"] == 0
+            assert rep["tokens"]["duplicated"] == 0
+
+
+class TestEPPSignalExport:
+    def test_fleet_signals_from_picker_state(self):
+        """The EPP /state `fleet` block: picker-ingested replica signals
+        (inflight/shed/telemetry ride /v1/internal/scheduler/state) come
+        back out as one FleetSignals snapshot."""
+        from kserve_tpu.scheduler.epp import EPPServer
+        from kserve_tpu.scheduler.picker import EndpointPicker
+
+        picker = EndpointPicker(["http://a:80", "http://b:80"])
+        picker.observe_state("http://a:80", {
+            "queue_depth": 3, "inflight": 2,
+            "shed": {"count": 4, "shedding": True},
+            "telemetry": {"ttft_p99_s": 1.25, "itl_p99_s": 0.01},
+        })
+        picker.observe_state("http://b:80", {
+            "queue_depth": 1, "inflight": 1, "lifecycle": "DRAINING",
+        })
+        server = EPPServer(picker)
+        server.arrivals.record(picker.clock.now())
+        s = server.fleet_signals()
+        assert s.ready_replicas == 1  # b is draining
+        assert s.queue_depth == 3 and s.inflight == 2
+        assert s.ttft_p99_s == 1.25
+        assert s.arrival_rate_per_s > 0
+        by_url = {r.url: r for r in s.replicas}
+        assert by_url["http://a:80"].sheds_total == 4
+        assert by_url["http://a:80"].shedding

@@ -23,6 +23,8 @@ from kserve_tpu.controlplane.objects import (
 from kserve_tpu.controlplane.registry import RuntimeRegistry, RuntimeSelectionError
 from kserve_tpu.controlplane.topology import TopologyError, plan_slice
 
+from conftest import requires_cryptography
+
 
 class TestStrategicMerge:
     def test_dict_deep_merge(self):
@@ -242,6 +244,7 @@ class TestLLMISVCReconcile:
             "spec": spec,
         }
 
+    @requires_cryptography  # router reconcile synthesizes a TLS cert
     def test_decode_workload_tpu(self):
         mgr = ControllerManager()
         mgr.apply(self._llm())
@@ -255,9 +258,84 @@ class TestLLMISVCReconcile:
         assert mgr.cluster.get("Deployment", "llama-epp") is not None
         assert mgr.cluster.get("InferencePool", "llama-pool") is not None
         assert mgr.cluster.get("HTTPRoute", "llama") is not None
-        scaled = mgr.cluster.get("ScaledObject", "llama-kserve")
-        assert "engine_generated_tokens_total" in scaled["spec"]["triggers"][0]["metadata"]["query"]
+        # with the EPP in place, the EPP-signal autoscaler replaces the
+        # metrics-blind KEDA ScaledObject (docs/autoscaling.md) and the
+        # decode Deployment's replica count becomes autoscaler-owned
+        assert mgr.cluster.get("ScaledObject", "llama-kserve") is None
+        scaler = mgr.cluster.get("Deployment", "llama-kserve-autoscaler")
+        args = scaler["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--epp-url=http://llama-epp.default:9002" in args
+        assert "--deployment=llama-kserve" in args
+        assert "--policy=predictive" in args
+        from kserve_tpu.controlplane.crds import (
+            AUTOSCALED_REPLICAS_ANNOTATION,
+        )
+        assert dep["metadata"]["annotations"][
+            AUTOSCALED_REPLICAS_ANNOTATION] == "true"
 
+    @requires_cryptography
+    def test_keda_annotation_restores_scaledobject(self):
+        mgr = ControllerManager()
+        llm = self._llm()
+        llm["metadata"]["annotations"] = {
+            "serving.kserve.io/autoscalerClass": "keda"}
+        mgr.apply(llm)
+        scaled = mgr.cluster.get("ScaledObject", "llama-kserve")
+        assert "engine_generated_tokens_total" in (
+            scaled["spec"]["triggers"][0]["metadata"]["query"])
+        assert mgr.cluster.get(
+            "Deployment", "llama-kserve-autoscaler") is None
+
+    @requires_cryptography
+    def test_no_scheduler_falls_back_to_keda(self):
+        mgr = ControllerManager()
+        llm = self._llm(router={"scheduler": {"enabled": False}})
+        mgr.apply(llm)
+        assert mgr.cluster.get("ScaledObject", "llama-kserve") is not None
+        assert mgr.cluster.get(
+            "Deployment", "llama-kserve-autoscaler") is None
+
+    @requires_cryptography
+    def test_min_max_replicas_bound_the_autoscaler(self):
+        mgr = ControllerManager()
+        llm = self._llm()
+        llm["spec"]["workload"]["minReplicas"] = 0
+        llm["spec"]["workload"]["maxReplicas"] = 8
+        mgr.apply(llm)
+        scaler = mgr.cluster.get("Deployment", "llama-kserve-autoscaler")
+        args = scaler["spec"]["template"]["spec"]["containers"][0]["args"]
+        assert "--min-replicas=0" in args
+        assert "--max-replicas=8" in args
+        # bounds are replica units; slice granularity rides separately so
+        # the actuated pod count stays a whole-slice multiple
+        assert "--pods-per-replica=1" in args
+
+    def test_keda_fallback_honors_min_replicas(self):
+        """minReplicas: 0 must scale to zero on the KEDA path too — the
+        CRD field is not EPP-autoscaler-only."""
+        from kserve_tpu.controlplane.crds import LLMInferenceService
+        from kserve_tpu.controlplane.llmisvc import LLMISVCReconciler
+
+        llm = self._llm(router=None)  # no scheduler -> KEDA fallback
+        llm["spec"]["workload"]["minReplicas"] = 0
+        objs, _ = LLMISVCReconciler().reconcile(
+            LLMInferenceService.model_validate(llm))
+        scaled = [o for o in objs if o["kind"] == "ScaledObject"][0]
+        assert scaled["spec"]["minReplicaCount"] == 0
+
+    def test_min_above_max_rejected_at_reconcile(self):
+        """min > max must fail the reconcile readably, not ship an
+        autoscaler pod that crash-loops on its own bounds check."""
+        from kserve_tpu.controlplane.crds import LLMInferenceService
+        from kserve_tpu.controlplane.llmisvc import LLMISVCReconciler
+
+        llm = self._llm(router=None)
+        llm["spec"]["workload"]["minReplicas"] = 8  # default max = 4
+        with pytest.raises(ValueError, match="minReplicas 8 > maxReplicas"):
+            LLMISVCReconciler().reconcile(
+                LLMInferenceService.model_validate(llm))
+
+    @requires_cryptography
     def test_prefill_decode_disaggregation(self):
         mgr = ControllerManager()
         mgr.apply(self._llm(prefill={"replicas": 2, "parallelism": {"tensor": 8}}))
@@ -277,6 +355,7 @@ class TestLLMISVCReconcile:
             )
             assert env["NUM_PROCESSES"] == "2"
 
+    @requires_cryptography
     def test_multihost_gets_coordinator(self):
         mgr = ControllerManager()
         mgr.apply(self._llm(workload={"replicas": 1, "parallelism": {"tensor": 8}}))

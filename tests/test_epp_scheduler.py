@@ -15,7 +15,7 @@ from kserve_tpu.scheduler.epp import EPPServer, build_arg_parser, build_picker, 
 from kserve_tpu.scheduler.picker import EndpointPicker
 from kserve_tpu.scheduler.prefix import text_prefix_digests, token_prefix_digests
 
-from conftest import async_test
+from conftest import async_test, requires_cryptography
 
 
 def make_picker(**kw):
@@ -405,6 +405,7 @@ class TestLatencyPredictor:
         for _ in range(4):  # beats the round-robin tiebreak every time
             assert picker.pick(prompt_ids=[1] * 100).url == "http://fast"
 
+    @requires_cryptography  # LLMISVC router reconcile makes a cert
     def test_llmisvc_plugin_gates_slo_strategy(self):
         """CRD parity: the predicted-latency-producer plugin in the inline
         scheduler config flips the EPP strategy (ref

@@ -17,6 +17,8 @@ from kserve_tpu.controlplane.ingress import (
 
 from test_controlplane import make_isvc
 
+from conftest import requires_cryptography
+
 
 def make_intent(**kw):
     kw.setdefault("name", "iris")
@@ -177,6 +179,7 @@ class TestReconcilerSelection:
         route = mgr.cluster.get("HTTPRoute", "tmpl", "default")
         assert route["spec"]["hostnames"] == ["tmpl-default.example.com"]
 
+    @requires_cryptography  # LLMISVC router reconcile makes a cert
     def test_llmisvc_uses_configured_backend(self):
         mgr = ControllerManager(ingress_class="istio")
         mgr.apply({

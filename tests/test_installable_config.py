@@ -9,6 +9,8 @@ import yaml
 from kserve_tpu.controlplane.cluster import ControllerManager
 from kserve_tpu.controlplane.crdgen import CRD_KINDS, crd_manifest, generate
 
+from conftest import requires_cryptography
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CRD_DIR = os.path.join(REPO, "config", "crd")
 PRESET_DIR = os.path.join(REPO, "config", "llmisvc-presets")
@@ -51,6 +53,7 @@ class TestCRDGeneration:
 
 
 class TestPresetLibrary:
+    @requires_cryptography  # preset LLMISVCs carry routers -> certs
     def test_presets_load_and_base_refs_resolve(self):
         mgr = ControllerManager()
         mgr.apply_yaml(PRESET_DIR)
@@ -72,6 +75,7 @@ class TestPresetLibrary:
         assert any(a.startswith("--prefill_url=") for a in args)
         assert "--kv_offload=host" in args
 
+    @requires_cryptography
     def test_live_spec_overrides_preset(self):
         mgr = ControllerManager()
         mgr.apply_yaml(PRESET_DIR)

@@ -109,6 +109,7 @@ class _FakeBackend:
         self.runner = None
         self.port = None
         self.requests = []
+        self.checkpoint_headers = []
 
     async def start(self):
         from aiohttp import web
@@ -118,6 +119,8 @@ class _FakeBackend:
 
         async def predict(request):
             self.requests.append(await request.json())
+            self.checkpoint_headers.append(
+                request.headers.get("x-generation-checkpoint"))
             return web.json_response({"predictions": [1, 2, 3]})
 
         app = web.Application()
@@ -185,6 +188,135 @@ class TestActivatorDataPath:
                 assert stats["buffered"] == 1
                 assert stats["proxied"] == 2
                 assert stats["cold_start_s"] is not None
+        finally:
+            await activator.stop()
+            await backend.stop()
+
+    @async_test
+    async def test_expired_deadline_while_held_gets_504(self):
+        """Hold-and-replay contract: a request whose x-request-deadline
+        budget dies inside the zero window is answered 504 — not parked
+        forever, not silently dropped."""
+        activator = Activator("http://127.0.0.1:1", scale_up=None,
+                              poll_interval=0.05, wake_timeout=30, port=0)
+        act_port = await activator.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                async with session.post(
+                    f"http://127.0.0.1:{act_port}/v1/models/m:predict",
+                    json={"instances": []},
+                    headers={"x-request-deadline": "0.15"},
+                ) as resp:
+                    assert resp.status == 504
+                    body = await resp.json()
+                    assert "deadline" in body["error"]
+            assert activator.stats["expired"] == 1
+            assert activator.stats["replayed"] == 0
+        finally:
+            await activator.stop()
+
+    @async_test
+    async def test_hold_queue_overflow_gets_503_retry_after(self):
+        """The bounded buffer: once max_holds requests are parked, the
+        next arrival is bounced 503 + Retry-After instead of growing an
+        unbounded aiohttp hold set."""
+        activator = Activator("http://127.0.0.1:1", scale_up=None,
+                              poll_interval=0.05, wake_timeout=30,
+                              max_holds=1, port=0)
+        act_port = await activator.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                async def first():
+                    async with session.post(
+                        f"http://127.0.0.1:{act_port}/v1/models/m:predict",
+                        json={}, headers={"x-request-deadline": "0.5"},
+                    ) as resp:
+                        return resp.status
+
+                t1 = asyncio.ensure_future(first())
+                await asyncio.sleep(0.1)  # let it park
+                async with session.post(
+                    f"http://127.0.0.1:{act_port}/v1/models/m:predict",
+                    json={},
+                ) as resp:
+                    assert resp.status == 503
+                    assert "Retry-After" in resp.headers
+                assert await t1 == 504  # the parked one expired normally
+            assert activator.stats["overflow"] == 1
+        finally:
+            await activator.stop()
+
+    @async_test
+    async def test_failed_wake_fails_every_parked_request(self):
+        """One dead backend fails N holds in one pass (504), and the
+        brief poison window bounces immediate follow-ups 503."""
+        activator = Activator("http://127.0.0.1:1", scale_up=None,
+                              poll_interval=0.05, wake_timeout=0.2,
+                              hold_timeout_s=5.0, port=0)
+        act_port = await activator.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                async def one():
+                    async with session.post(
+                        f"http://127.0.0.1:{act_port}/v1/models/m:predict",
+                        json={},
+                    ) as resp:
+                        return resp.status
+
+                statuses = await asyncio.gather(*[one() for _ in range(3)])
+                assert statuses == [504] * 3
+                assert activator.stats["wake_failed"] == 3
+                # poisoned cohort window: fail fast, no new wake fired
+                async with session.post(
+                    f"http://127.0.0.1:{act_port}/v1/models/m:predict",
+                    json={},
+                ) as resp:
+                    assert resp.status == 503
+                    assert "Retry-After" in resp.headers
+        finally:
+            await activator.stop()
+
+    @async_test
+    async def test_replay_preserves_order_and_checkpoint_headers(self):
+        """Released holds replay FIFO and pass generation-checkpoint
+        headers through both directions (the resume-through-zero-window
+        path)."""
+        backend = _FakeBackend()
+
+        async def scale_up():
+            await asyncio.sleep(0.1)
+            await backend.start()
+
+        probe = _FakeBackend()
+        await probe.start()
+        port = probe.port
+        await probe.stop()
+        backend.port = port
+
+        activator = Activator(f"http://127.0.0.1:{port}", scale_up=scale_up,
+                              poll_interval=0.05, wake_timeout=10, port=0)
+        act_port = await activator.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                async def one(i):
+                    async with session.post(
+                        f"http://127.0.0.1:{act_port}/v1/models/m:predict",
+                        json={"i": i},
+                        headers={"x-generation-checkpoint": f"ckpt-{i}"},
+                    ) as resp:
+                        return resp.status
+
+                results = await asyncio.gather(*[one(i) for i in range(3)])
+            assert results == [200] * 3
+            assert activator.stats["replayed"] == 3
+            # every replayed request arrived with its checkpoint header
+            # intact (pairing preserved; strict FIFO wake order is pinned
+            # at the HoldQueue layer in test_autoscale.py — real TCP
+            # connects may interleave delivery)
+            assert sorted(b["i"] for b in backend.requests) == [0, 1, 2]
+            for body, ckpt in zip(backend.requests,
+                                  backend.checkpoint_headers):
+                assert ckpt == f"ckpt-{body['i']}"
         finally:
             await activator.stop()
             await backend.stop()

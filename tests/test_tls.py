@@ -22,7 +22,10 @@ from kserve_tpu.controlplane.tls import (
     should_recreate_certificate,
 )
 
-from conftest import async_test
+from conftest import async_test, requires_cryptography
+
+# every test here exercises real cert creation/validation
+pytestmark = requires_cryptography
 
 
 class TestCertCreation:
