@@ -11,6 +11,7 @@ from . import (  # noqa: F401
     donation,
     excepts,
     hostsync,
+    pagein,
     pspec,
     ragged,
     recompile,

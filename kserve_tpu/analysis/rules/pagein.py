@@ -1,0 +1,85 @@
+"""Rule ``pagein-host-sync``: blocking device syncs inside the
+hierarchical KV store's page-in/upload path.
+
+The async prefix page-in (docs/kv_hierarchy.md, engine._page_in) is
+overlap-or-nothing: the tier/disk read rides the fetch worker
+(``fetch_async`` — the PR 5 seam) and the device upload is a
+DISPATCH-ONLY inject scatter, so decode lanes keep advancing under the
+whole promotion.  One synchronous fetch on that path — a direct
+``fetch()``/``_fetch()`` call, ``.block_until_ready()``,
+``jax.device_get`` or an ``.item()``/``.tolist()`` read of the inject's
+result — silently serializes the upload against the engine loop and the
+overlap the subsystem exists for is gone (it still *works*, which is why
+a linter has to catch it).
+
+Scope: functions whose name contains ``page_in``/``pagein`` (the
+engine's ``_page_in``/``_maybe_page_in`` and any future kvstore upload
+helper).  The blocking work belongs inside the thunk handed to
+``fetch_async`` — which runs on the worker — not in the coroutine body.
+This is the upload-path extension of the ``host-sync`` /
+``ragged-metadata-host-sync`` family.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ..core import FileContext, Finding, Rule, register
+from ..jaxutil import dotted_name
+
+_PAGEIN_NAME = re.compile(r"page_?in", re.IGNORECASE)
+
+#: attribute calls that block the caller on the device
+_BLOCKING_METHODS = {"block_until_ready", "item", "tolist", "to_py"}
+#: sync fetch entry points (the async spelling, fetch_async, is the
+#: REQUIRED one on this path and is not flagged)
+_SYNC_FETCH_ATTRS = {"fetch", "_fetch"}
+_TRANSFER_CALLS = {"jax.device_get", "device_get"}
+
+
+@register
+class PageInHostSync(Rule):
+    id = "pagein-host-sync"
+    description = (
+        "blocking fetch/.block_until_ready()/.item() inside a KV page-in "
+        "function: the async upload path must stay dispatch-only so it "
+        "overlaps decode"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _PAGEIN_NAME.search(node.name):
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = dotted_name(sub.func)
+                if name in _TRANSFER_CALLS:
+                    yield self.finding(
+                        ctx, sub,
+                        f"{name}() inside {node.name}(): a blocking "
+                        "device->host transfer on the page-in path; move "
+                        "it into the fetch_async thunk",
+                    )
+                    continue
+                if not isinstance(sub.func, ast.Attribute):
+                    continue
+                attr = sub.func.attr
+                if attr in _SYNC_FETCH_ATTRS:
+                    yield self.finding(
+                        ctx, sub,
+                        f".{attr}() inside {node.name}(): synchronous "
+                        "fetch on the page-in path serializes the upload "
+                        "against decode; use fetch_async",
+                    )
+                elif attr in _BLOCKING_METHODS and not sub.args:
+                    yield self.finding(
+                        ctx, sub,
+                        f".{attr}() inside {node.name}(): blocks on the "
+                        "device result — the page-in upload must stay "
+                        "dispatch-only",
+                    )

@@ -28,7 +28,7 @@ from __future__ import annotations
 
 from collections import deque
 from dataclasses import asdict, dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 
 @dataclass(frozen=True)
@@ -45,6 +45,13 @@ class ReplicaSignals:
     shedding: bool = False
     ttft_p99_s: Optional[float] = None
     itl_p99_s: Optional[float] = None
+    # hierarchical prefix-store block (docs/kv_hierarchy.md): resident
+    # digest count + hit/miss/demotion/page-in tallies, carried verbatim
+    # from the replica's /state through the picker snapshot.  The first
+    # cut of the global prefix index (ROADMAP item 2): a prefix-aware
+    # router reads which replica already holds a prompt's pages — and a
+    # scale-from-zero policy knows a wake will be prefix-HOT, not cold.
+    prefix_store: Optional[Mapping] = None
 
 
 @dataclass(frozen=True)
@@ -127,6 +134,7 @@ class FleetSignals:
                 shedding=bool(s.get("shedding", shed.get("shedding"))),
                 ttft_p99_s=s.get("ttft_p99_s", tel.get("ttft_p99_s")),
                 itl_p99_s=s.get("itl_p99_s", tel.get("itl_p99_s")),
+                prefix_store=s.get("prefix_store"),
             ))
         ready = [
             r for r in reps

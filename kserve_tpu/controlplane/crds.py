@@ -373,16 +373,31 @@ class SecondaryTierSpec(K8sModel):
     fileSystem: Optional[FileSystemTierSpec] = None
 
 
+class PersistentPrefixCacheSpec(K8sModel):
+    """Content-addressed persistent prefix store (kserve_tpu/kvstore,
+    docs/kv_hierarchy.md): reused/evicted prefix-cache pages persist as
+    digest-named files on the node-local hostPath the AOT executable
+    cache already mounts, so a restarted or autoscaler-woken replica
+    serves shared-system-prompt traffic with prefix hits from request
+    one.  `path` overrides the default subdir of the AOT-cache mount."""
+
+    enabled: bool = False
+    path: Optional[str] = None
+
+
 class KVCacheOffloadingSpec(K8sModel):
     """HBM -> host RAM (-> disk) KV tiering (parity:
-    llm_inference_service_types.go:188-260; engine/kv_tiers.py is the
-    runtime)."""
+    llm_inference_service_types.go:188-260; kserve_tpu/kvstore is the
+    runtime — docs/kv_hierarchy.md)."""
 
     enabled: bool = False
     hostMemoryGi: Optional[int] = None
     evictionPolicy: Literal["lru", "arc"] = "lru"
     # ordered secondary tiers; the engine cascades host RAM -> disk
     secondary: List[SecondaryTierSpec] = Field(default_factory=list)
+    # durable prefix layer below the tiers; independent of `enabled`
+    # (a deployment may want persistent prefixes without host offload)
+    persistentPrefixCache: Optional[PersistentPrefixCacheSpec] = None
 
 
 class WorkloadSpec(K8sModel):

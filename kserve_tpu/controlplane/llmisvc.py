@@ -32,6 +32,7 @@ from .crds import (
 from .objects import (
     ensure_aot_cache,
     ensure_drain_lifecycle,
+    ensure_kv_persist,
     ensure_probes,
     make_object,
     set_condition,
@@ -209,6 +210,14 @@ class LLMISVCReconciler:
             args.append("--role=decode")
             args.append(f"--prefill_url={prefill_url}")
         kv_disk = None  # (volume dict, mount dict, size_gib, storage_req)
+        # persistent prefix store (docs/kv_hierarchy.md): independent of
+        # the host-offload gate — env applied in the container pass below
+        # (False = not requested; None = requested at the default path)
+        kv_persist: "str | bool | None" = False
+        if workload.kvCacheOffloading:
+            pp = workload.kvCacheOffloading.persistentPrefixCache
+            if pp is not None and pp.enabled:
+                kv_persist = pp.path
         if workload.kvCacheOffloading and workload.kvCacheOffloading.enabled:
             kv = workload.kvCacheOffloading
             args.append("--kv_offload=host")
@@ -337,6 +346,11 @@ class LLMISVCReconciler:
                 # node-local AOT executable cache: warm restarts on this
                 # node skip XLA compilation entirely (docs/coldstart.md)
                 ensure_aot_cache(c, pod_spec)
+                if kv_persist is not False:
+                    # persistent prefix store next to the executables on
+                    # the same hostPath: the woken replica starts HOT,
+                    # not just compiled (docs/kv_hierarchy.md)
+                    ensure_kv_persist(c, pod_spec, kv_persist)
                 # a user-supplied KSERVE_TPU_DRAIN_GRACE env wins inside
                 # ensure_drain_lifecycle — the grace period must track the
                 # budget the runtime will actually grant, or kubelet

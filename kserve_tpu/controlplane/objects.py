@@ -283,3 +283,28 @@ def ensure_aot_cache(container: dict, pod_spec: dict) -> dict:
                 },
             })
     return container
+
+
+# the persistent prefix store (docs/kv_hierarchy.md) lives NEXT TO the
+# AOT executables on the same node-local hostPath: one mount, two
+# persistence layers, so a woken replica starts both compiled AND hot
+KV_PERSIST_DEFAULT_PATH = AOT_CACHE_MOUNT_PATH + "/kv-prefix"
+
+
+def ensure_kv_persist(container: dict, pod_spec: dict,
+                      path: "str | None" = None) -> dict:
+    """Point the runtime at the persistent prefix directory
+    (KSERVE_TPU_KV_PERSIST — kvstore/persist.py) on the AOT-cache
+    hostPath, mounting it first if nothing else did.  A user-supplied env
+    of the same name wins — operators swap in a warmed PVC exactly like
+    they do for the AOT cache.  Content addressing (digest-chained file
+    names commit to tokens + page size) makes sharing one directory
+    between models on a node safe by construction."""
+    ensure_aot_cache(container, pod_spec)
+    env = container.setdefault("env", [])
+    if not any(e.get("name") == "KSERVE_TPU_KV_PERSIST" for e in env):
+        env.append({
+            "name": "KSERVE_TPU_KV_PERSIST",
+            "value": path or KV_PERSIST_DEFAULT_PATH,
+        })
+    return container

@@ -56,6 +56,8 @@ from ..metrics import (
     DEADLINE_REJECTED,
     GENERATION_CHECKPOINTS,
     GENERATION_RESUMES,
+    KV_PAGEIN_SECONDS,
+    KV_PREFIX_HIT_TOKENS,
     TOKENS_SALVAGED,
 )
 from ..lifecycle.checkpoint import GenerationCheckpoint, GenerationPreempted
@@ -394,18 +396,27 @@ class LLMEngine:
         self._task: Optional[asyncio.Task] = None
         self._pipeline_busy = False
         self._deferred_free: List[int] = []
-        # tiered KV offload store (kv_offload="host": RAM tier + optional
-        # disk tier with lru/arc demotion — kv_tiers.py)
+        # hierarchical KV store (kserve_tpu/kvstore, docs/kv_hierarchy.md):
+        # host-RAM/disk tiers take preempted-sequence spills AND demoted
+        # prefix-cache pages; the content-addressed persistent layer keeps
+        # prefix pages across restarts.  Clock-injectable so sim spill
+        # traffic stays byte-identical per seed.
         self._kv_store = None
-        if engine_config.kv_offload == "host":
-            from .kv_tiers import KVTierStore, TierConfig
+        if engine_config.kv_offload == "host" or engine_config.kv_persist_dir:
+            from ..kvstore import HierarchicalKVStore, KVStoreConfig
 
-            self._kv_store = KVTierStore(TierConfig(
+            self._kv_store = HierarchicalKVStore(KVStoreConfig(
                 host_bytes=int(engine_config.kv_offload_gib * (1 << 30)),
                 disk_bytes=int(engine_config.kv_offload_disk_gib * (1 << 30)),
                 disk_dir=engine_config.kv_offload_dir,
                 policy=engine_config.kv_offload_policy,
-            ))
+                persist_dir=engine_config.kv_persist_dir,
+            ), clock=self._clock)
+        # async prefix page-in / persist write-through bookkeeping: tasks
+        # are tracked so stop() can cancel them, and in-flight persist
+        # digests are deduplicated across admission passes
+        self._pagein_tasks: set = set()
+        self._persisting: set = set()
         self.preemption_count = 0
         # wedge detection: device fetches run on a DAEMON worker with a
         # deadline; a timeout flips `wedged` (liveness).  Daemon, not a
@@ -420,12 +431,15 @@ class LLMEngine:
         # specs targeting "engine.fetch" the device-fetch path honors
         self.fault_plan = None
         # prefix cache (engine/prefix_cache.py): chained page key -> page
-        # id, LRU-evicted on pressure; holds one allocator ref per page
+        # id, LRU-evicted on pressure; holds one allocator ref per page.
+        # Evictions are offered to the hierarchical store's demote seam
+        # instead of being dropped (HBM -> host RAM -> disk -> persist).
         from .prefix_cache import PrefixCache
 
         self._prefix_cache = PrefixCache(
             engine_config.page_size, engine_config.prefix_cache,
             self.allocator,
+            demote_cb=self._demote_prefix_pages,
         )
         # device-resident [B, V] penalty state; row-level updates on batch
         # composition changes (dirty_rows None => full rebuild needed)
@@ -657,6 +671,11 @@ class LLMEngine:
         for slot in self._slots:
             if slot.request_id is not None:
                 self._evict_slot(slot, RuntimeError("engine stopped"))
+        # page-in / persist write-through tasks park on the fetch worker;
+        # cancel them before closing it so none awakens into a dead engine
+        for task in list(self._pagein_tasks):
+            task.cancel()
+        self._pagein_tasks.clear()
         # close AFTER the loop task is done: an in-flight chunk draining
         # through _fetch must reach a live worker (close-first would stall
         # the drain a full step deadline, then false-flag a wedge)
@@ -704,7 +723,7 @@ class LLMEngine:
         the role the GIE EPP's metrics scrape plays for the reference
         (ref llmisvc/scheduler.go:73-521)."""
         digests = self._prefix_cache.hottest_digests(max_digests)
-        return {
+        state = {
             "queue_depth": self.queue_depth,
             # seated generations: the "work already admitted" half of the
             # autoscaler's load signal (queue_depth is the waiting half)
@@ -720,6 +739,17 @@ class LLMEngine:
             # and the autoscaler behind it — sees SLO pressure per replica
             "telemetry": self.telemetry.signal_windows(),
         }
+        if self._kv_store is not None:
+            # hierarchical prefix-store block (docs/kv_hierarchy.md): the
+            # resident-digest count + hit/miss/demotion/page-in tallies the
+            # EPP fleet block re-exports — the first cut of item 2's global
+            # prefix index.  adopted_hit_tokens counts hits served from
+            # pages this process NEVER prefilled (the hot-wake proof).
+            stats = self._kv_store.stats_dict()
+            stats["adopted_hit_tokens"] = (
+                self._prefix_cache.adopted_hits * self.config.page_size)
+            state["prefix_store"] = stats
+        return state
 
     @property
     def _offload_bytes(self) -> int:
@@ -737,6 +767,243 @@ class LLMEngine:
             self._kv_store.host_used)
         ENGINE_KV_DISK_BYTES.labels(model_name=self._mlabel).set(
             self._kv_store.disk_used)
+
+    # ---------------- hierarchical prefix store (docs/kv_hierarchy.md) ----------------
+
+    def _gather_pages_device(self, page_ids: List[int]) -> Dict[str, Any]:
+        """Dispatch-only gather of whole KV pages into host-layout device
+        arrays ({name: [L, P, ...]}).  Callers either fetch synchronously
+        (the preemption spill) or hand the arrays to the fetch worker
+        (persist write-through) — the dispatch itself never blocks, and
+        the four cache layouts (plain/int8 x flat/pp-stacked) live in ONE
+        place instead of one per caller."""
+        ids = jnp.asarray(np.asarray(page_ids, np.int32))
+        if self.config.kv_quant == "int8" and self.config.pp > 1:
+            pages, scales = self.kv_pages
+            return {"kv_q": pages[:, ids], "kv_s": scales[:, ids]}
+        if self.config.kv_quant == "int8":
+            return {
+                "kv_q": jnp.stack([layer[0][ids] for layer in self.kv_pages]),
+                "kv_s": jnp.stack([layer[1][ids] for layer in self.kv_pages]),
+            }
+        if self.config.pp > 1:
+            # stacked cache: one gather covers every stage's layers
+            return {"kv": self.kv_pages[:, ids]}
+        return {"kv": jnp.stack([layer[ids] for layer in self.kv_pages])}
+
+    def _demote_prefix_pages(self, evicted: List[tuple]) -> None:
+        """PrefixCache eviction seam: gather the evicted pages' KV (one
+        device gather + fetch) and demote them into the host/disk tiers
+        keyed by their digest chain keys.  The fetch is SYNCHRONOUS by
+        design — the allocator reuses these pages the moment the seam
+        returns, so their contents must be captured first (the same
+        contract as the preemption spill); the cost is bounded by the
+        eviction burst.  Demotion is tiers-only (persist=False): the
+        persistent layer is fed exclusively by persist-on-REUSE, so
+        one-shot prompts being evicted can never grow the uncapped
+        durable directory.  Content addressing makes re-demotion free:
+        digests already resident below HBM skip the gather.  Skipped
+        while a chained decode chunk is in flight (the gather would read
+        a cache version the in-flight program is superseding) — those
+        pages simply drop, the pre-store behavior, and a drop is a perf
+        event never a correctness one."""
+        store = self._kv_store
+        if (store is None or not store.accepts_prefix_pages
+                or self._pipeline_busy or self._stopped):
+            return
+        pairs = [(k, p) for k, p in evicted
+                 if store.prefix_tier_of(k) is None]
+        if not pairs:
+            return
+        dev = self._gather_pages_device([p for _, p in pairs])
+        fetched = {name: self._fetch(v) for name, v in dev.items()}
+        for i, (key, _) in enumerate(pairs):
+            # contiguous copy, not a view: a view would pin the WHOLE
+            # multi-page gather in host RAM while the tier accounts for
+            # one page of it
+            store.put_prefix(
+                key,
+                {name: np.ascontiguousarray(arr[:, i:i + 1])
+                 for name, arr in fetched.items()},
+                persist=False,
+            )
+        store.record_demotion(len(pairs))
+        self._set_offload_gauges()
+
+    def _count_prefix_hits(self, keys: List[bytes], hits: List[int]) -> None:
+        """Admission served `hits` pages from the HBM prefix cache: count
+        pages + tokens, and trigger the persist-on-reuse write-through —
+        a HIT proves the prefix is shared, which is exactly the page
+        worth keeping across restarts (one-shot prompts never reach the
+        persistent layer, so it cannot thrash)."""
+        if not hits:
+            return
+        self._prefix_cache.hits += len(hits)
+        # adopted hits are counted HERE, per admission actually served —
+        # counting inside lookup_run would tally every retried lookup of
+        # a held request and inflate the hot-wake metric
+        if keys:
+            self._prefix_cache.count_adopted_hits(keys[:len(hits)])
+        KV_PREFIX_HIT_TOKENS.labels(model_name=self._mlabel, tier="hbm").inc(
+            len(hits) * self.config.page_size)
+        if self._kv_store is not None and keys:
+            self._maybe_persist_prefix(keys[:len(hits)], hits)
+
+    def _track_task(self, coro) -> None:
+        task = asyncio.get_running_loop().create_task(coro)
+        self._pagein_tasks.add(task)
+        task.add_done_callback(self._pagein_tasks.discard)
+
+    def _maybe_persist_prefix(self, keys: List[bytes],
+                              pages: List[int]) -> None:
+        store = self._kv_store
+        if self._stopped:
+            return
+        need = [k for k in store.needs_persist(keys)
+                if k not in self._persisting]
+        if not need:
+            return
+        page_of = dict(zip(keys, pages))
+        # the gather is DISPATCHED now, while the pages are live and
+        # referenced; the blocking device->host read and the file writes
+        # ride the fetch worker so decode never waits on them
+        dev = self._gather_pages_device([page_of[k] for k in need])
+        self._persisting.update(need)
+        self._track_task(self._persist_pages(need, dev))
+
+    async def _persist_pages(self, keys: List[bytes], dev: Dict) -> None:
+        try:
+            fetched = await self._fetcher.fetch_async(
+                lambda: {k: np.asarray(v) for k, v in dev.items()},
+                self.config.step_deadline_s)
+            store = self._kv_store
+            for i, key in enumerate(keys):
+                store.put_prefix(
+                    key,
+                    {name: np.ascontiguousarray(arr[:, i:i + 1])
+                     for name, arr in fetched.items()})
+            self._set_offload_gauges()
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — persistence is an optimization;
+            # the page stays HBM-resident and serving continues
+            logger.exception("prefix persist write-through failed")
+        finally:
+            for key in keys:
+                self._persisting.discard(key)
+
+    def _maybe_page_in(self, req: "_QueuedRequest", keys: List[bytes],
+                       n_hbm: int) -> bool:
+        """Hierarchical-store admission hook: when a request's digest
+        chain continues past its HBM-cached run into tier-resident pages,
+        schedule an ASYNC page-in (tier/disk read on the fetch worker,
+        one inject dispatch, adopt into the HBM cache) and hold the
+        request back; decode keeps running under the upload, and the
+        retried admission prefills only the still-uncached tail.  True =
+        page-in pending, do not seat this request yet."""
+        if req.pagein == "pending":
+            return True
+        if (req.pagein == "done" or self._kv_store is None
+                or self._draining or self._stopped or len(keys) <= n_hbm):
+            return False
+        run = self._kv_store.longest_prefix_run(keys[n_hbm:])
+        # room for the incoming pages may come from evicting COLD cached
+        # pages (which demote in turn — hierarchy rotation, not loss);
+        # only a cache that stays full of hotter pages vetoes the page-in
+        if not run or not self._prefix_cache.ensure_allocatable(len(run)):
+            # nothing resident (or no headroom worth competing for):
+            # remember the verdict so every admission retry is O(1)
+            req.pagein = "done"
+            return False
+        req.pagein = "pending"
+        self._track_task(self._page_in(req, run))
+        return True
+
+    async def _page_in(self, req: "_QueuedRequest", run: List[tuple]) -> None:
+        """Upload one tier-resident prefix run back into device pages.
+        The tier/disk reads happen off the event loop (fetch_async — the
+        PR 5 seam, so decode overlaps the I/O); the device upload is the
+        same inject scatter the P/D and spill-resume paths already
+        dispatch, so no new program shape is traced and steady-state
+        compile counts hold.  NO host syncs on this path: the inject is
+        dispatch-only, nothing fetches its result (jaxlint
+        pagein-host-sync guards exactly this)."""
+        store = self._kv_store
+        t0 = self._clock.now()
+        try:
+            digests = [d for d, _ in run]
+
+            def read():
+                out = []
+                for digest in digests:
+                    got = store.get_prefix(digest)
+                    if got is None:
+                        break  # dropped/corrupt underneath us: truncate
+                    out.append(got)
+                return out
+
+            try:
+                payloads = await self._fetcher.fetch_async(
+                    read, self.config.step_deadline_s)
+            except (RuntimeError, TimeoutError):
+                return  # engine stopping / fetcher closed
+            if self._stopped or self._draining:
+                return
+            entries = []  # (digest, payload, source tier)
+            for digest, got in zip(digests, payloads):
+                if self._prefix_cache.contains_key(digest):
+                    continue  # a concurrent page-in/prefill won the race
+                entries.append((digest, got[0], got[1]))
+            if not entries or not self.allocator.can_allocate(len(entries)):
+                return
+            pages = self.allocator.allocate(len(entries))
+            try:
+                n = len(entries)
+                bucket = self.config.page_bucket(n)
+                ids = np.zeros((bucket,), np.int32)
+                ids[:n] = pages
+
+                def packed(name: str):
+                    arr = np.concatenate(
+                        [payload[name] for _, payload, _ in entries], axis=1)
+                    out = np.zeros(
+                        arr.shape[:1] + (bucket,) + arr.shape[2:], arr.dtype)
+                    out[:, :n] = arr
+                    return jnp.asarray(out)
+
+                if "kv_q" in entries[0][1]:
+                    self.kv_pages = self._inject_q_fn(
+                        self.kv_pages, packed("kv_q"), packed("kv_s"),
+                        jnp.asarray(ids))
+                else:
+                    self.kv_pages = self._inject_fn(
+                        self.kv_pages, packed("kv"), jnp.asarray(ids))
+                # the cache takes ownership of the freshly-allocated refs
+                self._prefix_cache.adopt(
+                    [(digest, page) for (digest, _, _), page
+                     in zip(entries, pages)])
+            except BaseException:
+                self.allocator.free(pages)
+                raise
+            ps = self.config.page_size
+            pages_by_tier: Dict[str, int] = {}
+            for _, _, tier in entries:
+                pages_by_tier[tier] = pages_by_tier.get(tier, 0) + 1
+            tokens_by_tier = {t: c * ps for t, c in pages_by_tier.items()}
+            store.record_pagein(pages_by_tier, tokens_by_tier)
+            for tier, tokens in tokens_by_tier.items():
+                KV_PREFIX_HIT_TOKENS.labels(
+                    model_name=self._mlabel, tier=tier).inc(tokens)
+            KV_PAGEIN_SECONDS.labels(model_name=self._mlabel).observe(
+                self._clock.now() - t0)
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — page-in is an optimization;
+            # the held request re-prefills its whole tail instead
+            logger.exception("prefix page-in failed")
+        finally:
+            req.pagein = "done"
+            self._wake.set()
 
     def _set_queue_gauge(self) -> None:
         """THE queue-depth gauge writer.  Every mutation of _waiting calls
@@ -1491,15 +1758,21 @@ class LLMEngine:
                 req.prompt_ids + req.resume["generated"][:-1]
                 if req.resume is not None else req.prompt_ids
             )
-            hits = (
-                self._prefix_cache.lookup(seq)
-                if req.adapter_id < 0 and not use_fused else []
-            )
+            if req.adapter_id < 0 and not use_fused:
+                hits, pkeys = self._prefix_cache.lookup_run(seq)
+                if self._maybe_page_in(req, pkeys, len(hits)):
+                    # tier-resident prefix uploading; hold this request
+                    # (decode keeps running) and flush what we have
+                    if admitted:
+                        break
+                    return False
+            else:
+                hits, pkeys = [], []
             tail = req.kv_len - len(hits) * ps
             if tail > chunk_cap:
                 if admitted:
                     break  # flush the batched prefill first
-                return self._admit_chunked(req, hits)
+                return self._admit_chunked(req, hits, pkeys)
             need = pages_needed(req.kv_len + 1, ps)
             # pin cache hits before eviction can free them (see
             # _admit_chunked for why this must precede _ensure_allocatable)
@@ -1515,7 +1788,7 @@ class LLMEngine:
             self._waiting.pop(0)
             if req.timeline is not None:
                 req.timeline.mark_admitted(self._clock.now())
-            self._prefix_cache.hits += len(hits)
+            self._count_prefix_hits(pkeys, hits)
             admitted.append((free.pop(0), req, pages, len(hits), seq))
         if not admitted:
             return False
@@ -1700,15 +1973,17 @@ class LLMEngine:
         return self._admit_prefilling(req)
 
     def _admit_chunked(self, req: "_QueuedRequest",
-                       hits: Optional[List[int]] = None) -> bool:
+                       hits: Optional[List[int]] = None,
+                       keys: Optional[List[bytes]] = None) -> bool:
         """Admit one long-prompt request by chunked prefill (legacy path:
         the run loop advances its chunks through the prefill_chunk
         program).  Unblocks prompts up to max_model_len without sequence
         parallelism."""
-        return self._admit_prefilling(req, hits)
+        return self._admit_prefilling(req, hits, keys)
 
     def _admit_prefilling(self, req: "_QueuedRequest",
-                          hits: Optional[List[int]] = None) -> bool:
+                          hits: Optional[List[int]] = None,
+                          keys: Optional[List[bytes]] = None) -> bool:
         """Seat one request as a prefilling slot: allocate its pages (with
         prefix-cache hits pinned), pop it from the queue, and record the
         chunk cursor.  Shared by the legacy chunked admission and by EVERY
@@ -1735,7 +2010,12 @@ class LLMEngine:
         # LoRA adapters produce adapter-specific KV: only base-model
         # requests share the prefix cache
         if hits is None:
-            hits = self._prefix_cache.lookup(seq) if req.adapter_id < 0 else []
+            if req.adapter_id < 0:
+                hits, keys = self._prefix_cache.lookup_run(seq)
+                if self._maybe_page_in(req, keys, len(hits)):
+                    return False  # tier pages uploading; retried on wake
+            else:
+                hits = []
         cached = list(hits)
         # take our reference BEFORE eviction runs: eviction may drop these
         # pages from the cache, but a live ref keeps them off the free list
@@ -1764,7 +2044,7 @@ class LLMEngine:
         self._set_queue_gauge()
         if req.timeline is not None:
             req.timeline.mark_admitted(self._clock.now())
-        self._prefix_cache.hits += len(cached)
+        self._count_prefix_hits(keys or [], cached)
         # the slot enters "prefilling" state immediately and the run loop
         # advances ONE chunk per iteration — in-flight decode streams keep
         # emitting between chunks, and the queue behind this request isn't
@@ -2138,26 +2418,11 @@ class LLMEngine:
             and not self._draining
             and self._kv_store.would_fit(nbytes)
         ):
-            ids = jnp.asarray(np.asarray(slot.pages[:P], np.int32))
-            if self.config.kv_quant == "int8" and self.config.pp > 1:
-                pages, scales = self.kv_pages
-                payload = {
-                    "kv_q": self._fetch(pages[:, ids]),
-                    "kv_s": self._fetch(scales[:, ids]),
-                }
-            elif self.config.kv_quant == "int8":
-                payload = {
-                    "kv_q": self._fetch(
-                        jnp.stack([layer[0][ids] for layer in self.kv_pages])),
-                    "kv_s": self._fetch(
-                        jnp.stack([layer[1][ids] for layer in self.kv_pages])),
-                }
-            elif self.config.pp > 1:
-                # stacked cache: one gather covers every stage's layers
-                payload = {"kv": self._fetch(self.kv_pages[:, ids])}
-            else:
-                payload = {"kv": self._fetch(
-                    jnp.stack([layer[ids] for layer in self.kv_pages]))}
+            payload = {
+                name: self._fetch(v)
+                for name, v in self._gather_pages_device(
+                    slot.pages[:P]).items()
+            }
             if self._kv_store.put(slot.request_id, payload):
                 kv_key = slot.request_id
             self._set_offload_gauges()

@@ -10,47 +10,86 @@ failing admission or preempting anything.
 Keys are blake2b digest chains (scheduler/prefix.py) — the SAME digests
 the EPP endpoint picker scores against, so routing affinity and cache
 hits cannot drift apart.
+
+This is the HBM layer of the hierarchical KV store
+(docs/kv_hierarchy.md).  Two seams connect it to the tiers below:
+
+- ``demote_cb`` — evicted (key, page) pairs are offered to the engine
+  BEFORE their pages are reusable, so their contents can be gathered
+  into the host/disk/persistent tiers instead of being dropped;
+- ``adopt`` — the async page-in path inserts tier-resident pages it has
+  uploaded back to the device, so the next admission's ``lookup`` hits
+  them exactly like locally-prefilled pages.  Adopted keys are tracked:
+  ``adopted_hits`` counts ADMISSIONS SERVED from pages that were never
+  prefilled in this process life (``count_adopted_hits``, called by the
+  engine per seated request) — the hot-wake proof the scale-zero
+  scenario asserts on.
 """
 
 from __future__ import annotations
 
-from collections import OrderedDict
-from typing import List
+from typing import Callable, List, Optional, Sequence, Tuple
 
+from collections import OrderedDict
+
+from ..logging import logger
 from ..scheduler.prefix import token_prefix_digests
 
 
 class PrefixCache:
-    def __init__(self, page_size: int, enabled: bool, allocator):
+    def __init__(self, page_size: int, enabled: bool, allocator,
+                 demote_cb: Optional[Callable] = None):
         self.page_size = page_size
         self.enabled = enabled
         self.allocator = allocator
         # chained page key -> page id, LRU-ordered (front = coldest)
         self._pages: "OrderedDict[bytes, int]" = OrderedDict()
         self.hits = 0  # pages reused (observability/tests)
+        # eviction seam: called with [(key, page_id)] while page contents
+        # are still valid (nothing re-allocates until it returns)
+        self._demote_cb = demote_cb
+        # keys inserted by tier page-in rather than local prefill
+        self.adopted: set = set()
+        self.adopted_hits = 0  # lookup hits on adopted pages
 
     def __len__(self) -> int:
         return len(self._pages)
 
-    def _keys(self, seq: List[int], for_lookup: bool) -> List[bytes]:
+    def _keys(self, seq: Sequence[int], for_lookup: bool) -> List[bytes]:
         """Digest-chained page keys for page-aligned prefixes of `seq`
         (blake2b over prev_digest || page tokens: O(page) per key, no
         nested-tuple rehash blowup)."""
         return token_prefix_digests(seq, self.page_size, for_lookup)
 
-    def lookup(self, seq: List[int]) -> List[int]:
+    def contains_key(self, key: bytes) -> bool:
+        return key in self._pages
+
+    def lookup(self, seq: Sequence[int]) -> List[int]:
         """Longest cached page run for this sequence (pages NOT yet
         shared — the caller shares on admission)."""
+        return self.lookup_run(seq)[0]
+
+    def lookup_run(self, seq: Sequence[int]) -> Tuple[List[int], List[bytes]]:
+        """(cached page run, FULL lookup key chain) — the key chain is
+        what admission hands to the tier store to find pages resident
+        below HBM (kvstore.longest_prefix_run on keys[len(pages):])."""
         if not self.enabled:
-            return []
-        pages = []
-        for key in self._keys(seq, for_lookup=True):
+            return [], []
+        keys = self._keys(seq, for_lookup=True)
+        pages: List[int] = []
+        for key in keys:
             page = self._pages.get(key)
             if page is None:
                 break
             self._pages.move_to_end(key)  # LRU touch
             pages.append(page)
-        return pages
+        return pages, keys
+
+    def count_adopted_hits(self, hit_keys: Sequence[bytes]) -> None:
+        """Tally hits on adopted (paged-in) entries.  Called by the
+        engine per ADMISSION SERVED, not per lookup — a held request's
+        retried lookups must not inflate the hot-wake metric."""
+        self.adopted_hits += sum(1 for k in hit_keys if k in self.adopted)
 
     def register(self, prompt_ids: List[int], pages: List[int],
                  start_page: int = 0) -> None:
@@ -65,16 +104,51 @@ class PrefixCache:
             self._pages[key] = page
             self.allocator.share([page])  # the cache's own reference
 
+    def adopt(self, entries: Sequence[Tuple[bytes, int]]) -> None:
+        """Insert paged-in entries.  The cache takes OWNERSHIP of each
+        page's existing allocator reference (the page-in path allocated
+        them for the cache, not for a request); a key that raced in via
+        register/another page-in keeps its incumbent and the duplicate
+        page is freed."""
+        if not self.enabled:
+            for _, page in entries:
+                self.allocator.free([page])
+            return
+        for key, page in entries:
+            if key in self._pages:
+                self.allocator.free([page])
+                continue
+            self._pages[key] = page
+            self.adopted.add(key)
+
     def ensure_allocatable(self, n: int) -> bool:
         """can_allocate with LRU eviction as the pressure valve: cold
         cached pages are dropped (their cache ref freed) before admission
-        fails or anything gets preempted."""
+        fails or anything gets preempted.  Evicted pages are offered to
+        the demote seam FIRST — their contents are only reusable after
+        the callback returns, so the tier store can gather them."""
+        evicted: List[Tuple[bytes, int]] = []
         while not self.allocator.can_allocate(n) and self._pages:
-            _, page = self._pages.popitem(last=False)
+            key, page = self._pages.popitem(last=False)
+            evicted.append((key, page))
+            self.adopted.discard(key)
+            # free NOW so the loop's can_allocate observes it; the pages
+            # stay physically intact until the demote callback below
+            # returns (nothing allocates before ensure_allocatable's
+            # caller regains control)
             self.allocator.free([page])
+        if evicted and self._demote_cb is not None:
+            try:
+                self._demote_cb(evicted)
+            except Exception:  # noqa: BLE001 — demotion is an optimization;
+                # a failed gather/store must never fail the admission that
+                # triggered the eviction
+                logger.exception("prefix-page demotion failed")
         return self.allocator.can_allocate(n)
 
     def hottest_digests(self, max_digests: int) -> List[str]:
         """Hex digests, most-recently-used LAST slice (the EPP picker's
         affinity advertisement)."""
+        if max_digests <= 0:
+            return []
         return [k.hex() for k in list(self._pages.keys())[-max_digests:]]

@@ -63,6 +63,17 @@ class EngineConfig:
     kv_offload_disk_gib: float = 0.0
     kv_offload_dir: str = "/tmp/kserve-tpu-kv"
     kv_offload_policy: str = "lru"  # lru | arc
+    # content-addressed persistent prefix store (kvstore/persist.py,
+    # docs/kv_hierarchy.md): evicted/reused prefix-cache pages are written
+    # as digest-named files under this directory (env KSERVE_TPU_KV_PERSIST;
+    # the llmisvc reconciler points it at a subdir of the AOT-cache
+    # hostPath), and a restarted/woken replica indexes them at construction
+    # and pages hot prefixes back into HBM on first use — shared-system-
+    # prompt traffic gets prefix hits from request one.  None = disabled.
+    # Enabling it (or kv_offload="host") also turns prefix-cache evictions
+    # into tier demotions and admission into a tier-aware page-in path.
+    # Host-side only: deliberately NOT part of the AOT cache key.
+    kv_persist_dir: Optional[str] = None
     # int8 KV quantization (kvcache.py): halves decode KV traffic and
     # doubles capacity; per-row absmax scales ride a parallel array.
     # Composes with tiered offload (tuple payloads spill/inject both
@@ -304,6 +315,12 @@ class _QueuedRequest:
         # admitted_at, kv (host np | None)} — with kv, admission re-injects
         # the spilled pages; without, it re-prefills prompt+generated[:-1]
         self.resume: Optional[dict] = None
+        # hierarchical-store page-in state (engine._maybe_page_in): None =
+        # not yet consulted, "pending" = an async tier->device upload for
+        # this request's prefix is in flight (admission waits, decode
+        # continues), "done" = consulted — admit on whatever the HBM
+        # prefix cache now holds
+        self.pagein: Optional[str] = None
         # observability.RequestTimeline: stamped received at submit, rides
         # the request across preemption/re-seat so TTFT/queue-wait measure
         # the CLIENT's experience, not the latest seat's

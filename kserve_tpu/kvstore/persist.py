@@ -1,0 +1,173 @@
+"""Content-addressed persistent prefix store: digest-named KV page files
+that survive process restarts (docs/kv_hierarchy.md).
+
+Each file holds the device KV of ONE page-aligned prefix page, named by
+the blake2b digest chain key the engine's prefix cache (and the EPP's
+affinity scoring) already uses — content addressing falls out of the
+chain: the digest commits to every token of the prefix AND the page
+size, so a file can never be replayed against the wrong prompt.  A
+restarted or autoscaler-woken replica indexes the directory at
+construction and pages hot prefixes back into HBM on first use, serving
+shared-system-prompt traffic with prefix hits from request one (the
+composition with PR 10/12's zero-compile wake: the replica starts hot,
+not just compiled).
+
+The directory is meant to live NEXT TO the AOT executable cache on the
+same node-local hostPath (controlplane/objects.ensure_kv_persist) — the
+two persistence layers share one deploy story.
+
+Failure semantics (the whole point of content addressing):
+
+- writes are atomic tmp+rename; a torn write is structurally invisible,
+- a corrupt / truncated / shape-skewed entry logs a structured warning,
+  counts a ``corrupt`` event, is unlinked best-effort, and reads as a
+  miss — the engine re-prefills.  A dropped page is a performance
+  event, never a correctness one.
+- every filesystem error is survivable: a read-only or full volume
+  degrades the layer to a no-op, it never takes down serving.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Callable, Dict, List, Optional, Set
+
+import numpy as np
+
+from ..logging import logger
+
+Payload = Dict[str, np.ndarray]
+
+#: bump when the entry layout changes; old entries read as corrupt
+#: (logged + re-prefilled), never misread
+PERSIST_FORMAT = 1
+
+_PREFIX = "px-"
+_SUFFIX = ".kvpage"
+
+
+def kv_persist_dir_from_env() -> Optional[str]:
+    """Deploy knob: ``KSERVE_TPU_KV_PERSIST`` names the persistent prefix
+    directory (the llmisvc reconciler points it at a subdir of the AOT
+    cache hostPath).  Empty/unset = the layer is disabled."""
+    value = os.environ.get("KSERVE_TPU_KV_PERSIST", "").strip()
+    return value or None
+
+
+class PersistentPrefixStore:
+    """One digest -> one ``px-<hex>.kvpage`` npz file under `root`."""
+
+    def __init__(self, root: str,
+                 on_event: Optional[Callable[[str, str], None]] = None):
+        self.root = root
+        self._on_event = on_event
+        self._digests: Set[bytes] = set()
+        self.writable = True
+        try:
+            os.makedirs(root, exist_ok=True)
+        except OSError as exc:
+            logger.warning(
+                "kv-persist-disabled dir=%s error=%s", root,
+                f"{type(exc).__name__}: {exc}")
+            self.writable = False
+        self._index()
+
+    def _event(self, event: str) -> None:
+        if self._on_event is not None:
+            self._on_event("persist", event)
+
+    def _index(self) -> None:
+        """Scan the directory once at construction: the resident digest
+        set a woken replica advertises before it has prefilled anything."""
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return
+        for name in names:
+            if not (name.startswith(_PREFIX) and name.endswith(_SUFFIX)):
+                continue
+            hexdigest = name[len(_PREFIX):-len(_SUFFIX)]
+            try:
+                self._digests.add(bytes.fromhex(hexdigest))
+            except ValueError:
+                continue  # foreign file; ignored, never deleted
+
+    def _path(self, digest: bytes) -> str:
+        return os.path.join(self.root, f"{_PREFIX}{digest.hex()}{_SUFFIX}")
+
+    def __len__(self) -> int:
+        return len(self._digests)
+
+    def __contains__(self, digest: bytes) -> bool:
+        return digest in self._digests
+
+    def digests(self) -> List[bytes]:
+        return sorted(self._digests)
+
+    def store(self, digest: bytes, payload: Payload) -> bool:
+        """Persist one page payload (atomic tmp+rename).  Content
+        addressed: an existing entry is never rewritten.  Best-effort —
+        a full/read-only volume logs and returns False."""
+        if not self.writable:
+            return False
+        if digest in self._digests:
+            return True
+        tmp_name = None
+        try:
+            with tempfile.NamedTemporaryFile(
+                "wb", dir=self.root, suffix=".tmp", delete=False
+            ) as f:
+                tmp_name = f.name
+                np.savez(
+                    f,
+                    fmt=np.int64(PERSIST_FORMAT),
+                    **payload,
+                )
+            os.replace(tmp_name, self._path(digest))
+            tmp_name = None
+            self._digests.add(digest)
+            self._event("store")
+            return True
+        except (OSError, ValueError) as exc:
+            logger.warning(
+                "kv-persist-store-failed digest=%s error=%s",
+                digest.hex(), f"{type(exc).__name__}: {exc}")
+            return False
+        finally:
+            if tmp_name is not None:
+                try:
+                    os.unlink(tmp_name)
+                except OSError:
+                    pass
+
+    def load(self, digest: bytes) -> Optional[Payload]:
+        """Read one page payload; None on miss or ANY corruption (the
+        entry is unlinked best-effort and the engine re-prefills — a bad
+        file must cost a prefill, never a crash)."""
+        if digest not in self._digests:
+            return None
+        path = self._path(digest)
+        try:
+            with np.load(path) as data:
+                fmt = int(data["fmt"])
+                if fmt != PERSIST_FORMAT:
+                    raise ValueError(f"format skew: {fmt} != {PERSIST_FORMAT}")
+                return {
+                    k: data[k] for k in data.files if k != "fmt"
+                }
+        except Exception as exc:  # noqa: BLE001 — corrupt-entry containment:
+            # np.load surfaces OSError/ValueError/BadZipFile/KeyError
+            # depending on where the file is torn; all of them mean the
+            # same thing here (log, count, miss, re-prefill)
+            self._event("corrupt")
+            logger.warning(
+                "kv-persist-entry-corrupt digest=%s path=%s error=%s: "
+                "page will be re-prefilled", digest.hex(), path,
+                f"{type(exc).__name__}: {exc}")
+            self._digests.discard(digest)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return None

@@ -56,6 +56,37 @@ ENGINE_KV_DISK_BYTES = Gauge(
     "KV bytes currently parked in the disk tier", ["model_name"],
 )
 
+# Hierarchical KV store (kserve_tpu/kvstore — docs/kv_hierarchy.md).
+# `tier` is the closed tier set (host | disk | persist — HBM never emits
+# tier events: its eviction IS the host demote); `event` the closed
+# movement enum.  No digest/request labels — per-digest detail lives in
+# the /state prefix_store block.
+KV_TIER_EVENTS = Counter(
+    "kv_tier_events_total",
+    "hierarchical KV store page movements (demote | pagein | drop | "
+    "store | corrupt), by tier",
+    ["tier", "event"],
+)
+# `tier` is the closed source set: hbm counts admission hits served from
+# the device-resident prefix cache; host/disk/persist count tokens paged
+# in from that tier (and therefore served as hits instead of prefilled)
+KV_PREFIX_HIT_TOKENS = Counter(
+    "kv_prefix_hit_tokens_total",
+    "prompt tokens served from cached prefix pages instead of being "
+    "prefilled, by the tier that held them (hbm | host | disk | persist)",
+    ["model_name", "tier"],
+)
+KV_PAGEIN_SECONDS = Histogram(
+    "kv_pagein_seconds",
+    "wall time of one async prefix page-in: tier read scheduled -> pages "
+    "uploaded and adopted into the HBM prefix cache",
+    ["model_name"],
+    buckets=(
+        0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+        0.5, 1.0, 2.5, 5.0, float("inf"),
+    ),
+)
+
 # Resilience layer (kserve_tpu/resilience — docs/resilience.md).
 # Labeled by state only: backend identity is a pod ip:port, an unbounded
 # label cardinality under replica churn (prometheus label children are

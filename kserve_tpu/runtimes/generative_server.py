@@ -22,6 +22,7 @@ import os
 from typing import AsyncIterator, List, Optional, Union
 
 from ..engine.aot_cache import aot_cache_dir_from_env
+from ..kvstore.persist import kv_persist_dir_from_env
 from ..engine.engine import EngineConfig, LLMEngine
 from ..engine.sampling import SamplingParams
 from ..engine.tokenizer import load_tokenizer
@@ -794,6 +795,13 @@ def main(argv=None):
         "defaults to $KSERVE_TPU_AOT_CACHE — a populated cache makes "
         "replica start perform zero XLA compiles",
     )
+    parser.add_argument(
+        "--kv_persist_dir", default=None,
+        help="content-addressed persistent prefix store directory "
+        "(docs/kv_hierarchy.md); defaults to $KSERVE_TPU_KV_PERSIST — a "
+        "populated store makes a restarted replica serve shared-prefix "
+        "traffic with cache hits from request one",
+    )
     args = parser.parse_args(argv)
 
     model_config = _NAMED_CONFIGS[args.model_config]() if args.model_config else None
@@ -816,6 +824,7 @@ def main(argv=None):
         kv_offload_dir=args.kv_offload_dir,
         kv_offload_policy=args.kv_offload_policy,
         aot_cache_dir=args.aot_cache_dir or aot_cache_dir_from_env(),
+        kv_persist_dir=args.kv_persist_dir or kv_persist_dir_from_env(),
     )
     lora_adapters = None
     if args.lora_adapters:

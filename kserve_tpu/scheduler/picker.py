@@ -72,6 +72,11 @@ class Replica:
     shedding: bool = False
     ttft_p99_s: Optional[float] = None
     itl_p99_s: Optional[float] = None
+    # hierarchical prefix-store stats carried through from /state
+    # (docs/kv_hierarchy.md): resident digest count + hit/miss/demotion/
+    # page-in tallies per replica — the first cut of the global prefix
+    # index (ROADMAP item 2).  Re-exported in the EPP /state fleet block.
+    prefix_store: Optional[Dict] = None
 
     @property
     def digests(self) -> frozenset:
@@ -159,12 +164,32 @@ class EndpointPicker:
         r.itl_p99_s = tel.get("itl_p99_s")
         models: Dict[str, tuple] = {}
         wedged = False
+        prefix_store: Optional[Dict] = None
+
+        def merge_prefix_store(block):
+            nonlocal prefix_store
+            if not isinstance(block, dict):
+                return
+            if prefix_store is None:
+                prefix_store = dict(block)
+                return
+            # multi-model replica: counts sum; nested dicts merge by key
+            for k, v in block.items():
+                if isinstance(v, (int, float)):
+                    prefix_store[k] = prefix_store.get(k, 0) + v
+                elif isinstance(v, dict):
+                    merged = dict(prefix_store.get(k) or {})
+                    for kk, vv in v.items():
+                        merged[kk] = merged.get(kk, 0) + vv
+                    prefix_store[k] = merged
+
         for name, m in (state.get("models") or {}).items():
             models[name] = (
                 int(m.get("page_size", 16)),
                 frozenset(bytes.fromhex(d) for d in m.get("prefix_digests", ())),
             )
             wedged = wedged or bool(m.get("wedged"))
+            merge_prefix_store(m.get("prefix_store"))
         # flat form (engine.scheduler_state() given directly, tests)
         if "prefix_digests" in state or "page_size" in state:
             models[""] = (
@@ -174,6 +199,8 @@ class EndpointPicker:
                 ),
             )
         wedged = wedged or bool(state.get("wedged"))
+        merge_prefix_store(state.get("prefix_store"))
+        r.prefix_store = prefix_store
         r.models = models
         r.healthy = not wedged
         r.lifecycle = str(state.get("lifecycle") or "READY").upper()
@@ -373,6 +400,7 @@ class EndpointPicker:
                 "shedding": r.shedding,
                 "ttft_p99_s": r.ttft_p99_s,
                 "itl_p99_s": r.itl_p99_s,
+                "prefix_store": r.prefix_store,
                 "breaker": (
                     self.breakers.state(r.url)
                     if self.breakers is not None else None

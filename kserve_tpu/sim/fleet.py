@@ -550,6 +550,8 @@ class FleetSim:
             for r in self.replicas.values():
                 if r.engine is not None and r.engine.running:
                     await r.stop()
+        for r in self.replicas.values():
+            r.cleanup()  # the run owns the nodes' persist dirs
         faults = list(self.net_plan.log)
         for r in self.replicas.values():
             faults.extend(r.fault_plan.log)

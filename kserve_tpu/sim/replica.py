@@ -54,6 +54,13 @@ class ReplicaSpec:
     shed_resume_fraction: float = 0.5
     shed_retry_after_s: float = 0.25
     drain_grace_s: float = 5.0
+    # hierarchical KV store knobs (docs/kv_hierarchy.md): kv_persist gives
+    # each replica a node-local persistent prefix directory that SURVIVES
+    # restarts/scale-to-zero within the run (the hot-wake leg asserts the
+    # woken engine pages hot prefixes back in); kv_host_gib adds the
+    # host-RAM spill/demotion tier
+    kv_persist: bool = False
+    kv_host_gib: float = 0.0
 
     def engine_config(self) -> EngineConfig:
         return EngineConfig(
@@ -67,6 +74,8 @@ class ReplicaSpec:
             prefill_batch=self.prefill_batch,
             dtype="float32",
             use_pallas=False,
+            kv_offload="host" if self.kv_host_gib > 0 else "none",
+            kv_offload_gib=self.kv_host_gib,
         )
 
 
@@ -108,11 +117,27 @@ class SimReplica:
         # the AutoscalerSpec.node_cache_prewarmed scenario knob.)
         self.node_cache_warm = node_cache_warm
         self.start_records: List[dict] = []
+        # node-local persistent prefix store (docs/kv_hierarchy.md): like
+        # the AOT cache above, the directory belongs to the NODE, so it
+        # survives crash restarts, rolling restarts and scale-to-zero
+        # wakes within the run — that persistence is what the hot-wake
+        # scenario leg measures
+        self.persist_dir: Optional[str] = None
+        if spec.kv_persist:
+            import tempfile
+
+            self.persist_dir = tempfile.mkdtemp(
+                prefix=f"kserve-sim-kvpx-{name}-")
         # engine counters survive restarts here (a fresh engine starts at
         # zero; the report wants the replica's lifetime totals)
         self.totals = {
             "preemptions": 0, "checkpointed": 0, "resumes": 0,
             "finished": 0,
+        }
+        self.prefix_totals = {
+            "hits": 0, "misses": 0, "demotions": 0, "pageins": 0,
+            "pagein_tokens": 0, "persist_writes": 0, "drops": 0,
+            "adopted_hit_tokens": 0,
         }
         # warm-pool cost accounting (docs/autoscaling.md): virtual seconds
         # this replica's process was up — the autoscaler's goodput report
@@ -129,6 +154,7 @@ class SimReplica:
 
     def _build_engine(self) -> None:
         cfg = self.spec.engine_config()
+        cfg.kv_persist_dir = self.persist_dir
         programs = build_stub_programs(
             cfg, self.device, vocab_size=self.model_config.vocab_size,
             warm=self.node_cache_warm)
@@ -218,6 +244,15 @@ class SimReplica:
         self.lifecycle.finish_drain()
         return checkpoints
 
+    def cleanup(self) -> None:
+        """Remove the node-local persistent prefix directory (end of the
+        simulation run — the 'node' is decommissioned)."""
+        if self.persist_dir is not None:
+            import shutil
+
+            shutil.rmtree(self.persist_dir, ignore_errors=True)
+            self.persist_dir = None
+
     async def crash(self) -> None:
         """Simulated process kill (kill -9 / node loss): every in-flight
         stream dies with ReplicaCrashError-shaped RuntimeErrors, nothing
@@ -235,12 +270,25 @@ class SimReplica:
         if spec is not None:
             self.fault_plan.disarm(spec)
 
+    def _engine_prefix_stats(self, e) -> dict:
+        """This engine life's prefix-store tallies (zeros when the store
+        is off) in the prefix_totals key set."""
+        out = {k: 0 for k in self.prefix_totals}
+        if e is None or e._kv_store is None:
+            return out
+        stats = e.scheduler_state(max_digests=0).get("prefix_store") or {}
+        for k in out:
+            out[k] = int(stats.get(k, 0) or 0)
+        return out
+
     def _accumulate(self) -> None:
         e = self.engine
         self.totals["preemptions"] += e.preemption_count
         self.totals["checkpointed"] += e.checkpointed_count
         self.totals["resumes"] += e.resume_count
         self.totals["finished"] += e.telemetry.finished_count
+        for k, v in self._engine_prefix_stats(e).items():
+            self.prefix_totals[k] += v
 
     def summary(self) -> dict:
         self_totals = dict(self.totals)
@@ -248,7 +296,7 @@ class SimReplica:
         up_s = self.up_total_s
         if self._up_since is not None:
             up_s += self.clock.now() - self._up_since
-        return {
+        out = {
             "name": self.name,
             "restarts": self.generation,
             "crashes": self.crashes,
@@ -268,6 +316,15 @@ class SimReplica:
             "up_s": round(up_s, 9),
             "starts": [dict(s) for s in self.start_records],
         }
+        if self.spec.kv_persist or self.spec.kv_host_gib > 0:
+            # lifetime prefix-store tallies (fixed, sorted key set so the
+            # report stays canonical-json byte-identical per seed)
+            live = self._engine_prefix_stats(e)
+            out["prefix_store"] = {
+                k: self.prefix_totals[k] + live[k]
+                for k in sorted(self.prefix_totals)
+            }
+        return out
 
     async def restart(self) -> None:
         """Replace the process on the same url (rolling restart / crash

@@ -257,6 +257,57 @@ def scale_zero_scenario(seed: int = 11) -> Scenario:
     )
 
 
+def prefix_store_scenario(seed: int = 17) -> Scenario:
+    """Hot-wake proof (docs/kv_hierarchy.md): chat traffic dominated by
+    one shared system prefix rides the fleet through a scale-to-zero
+    window.  Life 0 serves the first chat wave — the shared prefix page
+    is registered, REUSED, and therefore written through to each node's
+    persistent prefix store — then the fleet passes through zero and the
+    woken engines page the prefix back in from the node's durable files:
+    warm-prefix TTFT with prefix hits from request one, before any
+    same-life prefill registered those digests (prefix_store
+    adopted_hit_tokens > 0 in the replica summaries is exactly that
+    claim).  Goodput 1.0, zero lost/duplicated tokens, byte-identical
+    per seed — the tier-1 leg of ISSUE 13's acceptance."""
+    costs = StubCosts(
+        prefill_base_s=0.01, prefill_per_token_s=2e-4, decode_step_s=0.02,
+        compile_s=3.0, aot_load_s=0.1)
+    return Scenario(
+        name="prefix-store",
+        seed=seed,
+        n_replicas=2,
+        spec=ReplicaSpec(costs=costs, kv_persist=True),
+        workload=WorkloadConfig(
+            n_requests=40, duration_s=24.0,
+            # chat-dominant: the shared system prefix is the traffic shape
+            # the persistent store exists for; the batch leg keeps some
+            # non-prefix pressure in the mix
+            mix={"chat": 0.85, "batch": 0.15},
+            bursts=[(14.0, 6)],
+        ),
+        churn=[
+            # ~8s of life-0 chat (prefix registered + reused + persisted),
+            # then the whole fleet scales to zero mid-trace and wakes warm
+            ChurnEvent(at_s=8.0, kind="scale_down", replica="replica-0",
+                       grace_s=0.0),
+            ChurnEvent(at_s=8.0, kind="scale_down", replica="replica-1",
+                       grace_s=0.0),
+            ChurnEvent(at_s=12.0, kind="scale_up", replica="replica-0"),
+            ChurnEvent(at_s=12.2, kind="scale_up", replica="replica-1"),
+        ],
+        budget=SLOBudget(
+            # the zero window is absorbed in TTFT; what may NOT happen is
+            # a drop or a duplicated token across the wake
+            p99_ttft_s=25.0, p99_itl_s=2.0, min_goodput=1.0,
+            # client-retry polling through the zero window (see
+            # scale_zero_scenario's note on why this is structurally high)
+            max_retry_amplification=12.0, max_shed_fraction=1.0,
+        ),
+        client_max_attempts=40,
+        client_retry_budget_s=240.0,
+    )
+
+
 def autoscale_smoke_scenario(seed: int = 13,
                              policy: str = "predictive") -> Scenario:
     """Autoscaler-in-the-loop smoke (tier-1): one replica serves light
@@ -382,7 +433,10 @@ def churn_10k_scenario(seed: int = 1234) -> Scenario:
         name="churn-10k",
         seed=seed,
         n_replicas=4,
-        spec=_canned_spec(),
+        # the prefix-store leg: every node persists its hot prefixes, so
+        # the rolling-restart/crash recoveries inside the trace come back
+        # prefix-HOT (pageins > 0 asserted by the slow acceptance test)
+        spec=ReplicaSpec(costs=_CANNED_COSTS, kv_persist=True),
         workload=WorkloadConfig(
             n_requests=10_000, duration_s=1200.0,
             # the 300s burst IS the shed storm's trigger; the later bursts

@@ -643,6 +643,76 @@ def test_aotkey_real_tree_digest_covers_build_compiled():
     assert findings == []
 
 
+# ------------------------------------------- pagein-host-sync
+
+BAD_PAGEIN = """
+    import jax
+
+    async def _page_in(self, req, run):
+        payloads = self._fetcher.fetch(read, 30.0)  # sync fetch: serializes
+        out = self._inject_fn(self.kv_pages, payloads, ids)
+        out.block_until_ready()  # waits on the upload
+        n = out[0].item()  # reads the inject result
+        return n
+"""
+
+GOOD_PAGEIN = """
+    import jax.numpy as jnp
+
+    async def _page_in(self, req, run):
+        # blocking work rides the fetch worker; the upload is
+        # dispatch-only and nothing reads its result
+        payloads = await self._fetcher.fetch_async(read, 30.0)
+        self.kv_pages = self._inject_fn(
+            self.kv_pages, jnp.asarray(payloads), jnp.asarray(ids))
+        self._prefix_cache.adopt(entries)
+"""
+
+GOOD_NON_PAGEIN = """
+    def spill(self, slot):
+        # the preemption spill is synchronous BY DESIGN (nothing overlaps
+        # a preemption) — only page-in-named functions are in scope
+        return self._fetch(self.kv_pages)
+"""
+
+
+def test_pagein_host_sync_fires_on_sync_fetch_and_blocking_reads():
+    rules = rules_of(BAD_PAGEIN)
+    assert rules.count("pagein-host-sync") == 3
+
+
+def test_pagein_host_sync_quiet_on_async_dispatch_only_path():
+    assert "pagein-host-sync" not in rules_of(GOOD_PAGEIN)
+
+
+def test_pagein_host_sync_quiet_outside_pagein_functions():
+    assert "pagein-host-sync" not in rules_of(GOOD_NON_PAGEIN)
+
+
+def test_pagein_host_sync_covers_maybe_page_in_spelling():
+    src = """
+        def _maybe_page_in(self, req, keys):
+            run = self._kv_store.longest_prefix_run(keys)
+            return jax.device_get(run)
+    """
+    assert rules_of(src).count("pagein-host-sync") == 1
+
+
+def test_pagein_host_sync_suppressed():
+    src = BAD_PAGEIN.replace(
+        "payloads = self._fetcher.fetch(read, 30.0)  # sync fetch: serializes",
+        "payloads = self._fetcher.fetch(read, 30.0)  "
+        "# jaxlint: disable=pagein-host-sync"
+    ).replace(
+        "out.block_until_ready()  # waits on the upload",
+        "out.block_until_ready()  # jaxlint: disable=pagein-host-sync"
+    ).replace(
+        "n = out[0].item()  # reads the inject result",
+        "n = out[0].item()  # jaxlint: disable=pagein-host-sync"
+    )
+    assert "pagein-host-sync" not in rules_of(src)
+
+
 def test_suppression_budget():
     """≤ 10 jaxlint suppression comments across kserve_tpu/, each carrying
     justification prose in the suppressing comment or the line above."""

@@ -1202,6 +1202,84 @@ class TestControlPlaneAOTCache:
                        for v in pod.get("volumes", []))
 
 
+class TestControlPlaneKVPersist:
+    """kvCacheOffloading.persistentPrefixCache (docs/kv_hierarchy.md): the
+    persistent prefix store rides the SAME node-local hostPath as the AOT
+    executable cache — one mount, two persistence layers, and the env
+    KSERVE_TPU_KV_PERSIST points the runtime at its subdir."""
+
+    def _reconcile(self, kv=None):
+        from kserve_tpu.controlplane.crds import LLMInferenceService
+        from kserve_tpu.controlplane.llmisvc import LLMISVCReconciler
+
+        workload = {"replicas": 1}
+        if kv is not None:
+            workload["kvCacheOffloading"] = kv
+        llm = LLMInferenceService.model_validate({
+            "apiVersion": "serving.kserve.io/v1alpha2",
+            "kind": "LLMInferenceService",
+            "metadata": {"name": "llama", "namespace": "default"},
+            "spec": {
+                "model": {"uri": "hf://meta-llama/Llama-3.2-1B",
+                          "name": "llama"},
+                "workload": workload,
+            },
+        })
+        reconciler = LLMISVCReconciler()
+        spec = reconciler._merge_presets(llm)
+        objects = reconciler._workload(
+            llm, spec.workload, "decode", str(llm.spec.model.uri))
+        deployment = next(o for o in objects if o["kind"] == "Deployment")
+        return deployment["spec"]["template"]["spec"]
+
+    def _main_env(self, pod):
+        main = next(c for c in pod["containers"] if c["name"] == "main")
+        return main, {e["name"]: e.get("value") for e in main["env"]}
+
+    def test_enabled_spec_sets_env_on_aot_mount(self):
+        from kserve_tpu.controlplane.objects import (
+            AOT_CACHE_VOLUME,
+            KV_PERSIST_DEFAULT_PATH,
+        )
+
+        pod = self._reconcile(kv={
+            "persistentPrefixCache": {"enabled": True},
+        })
+        main, env = self._main_env(pod)
+        assert env["KSERVE_TPU_KV_PERSIST"] == KV_PERSIST_DEFAULT_PATH
+        # the prefix dir lives under the AOT cache mount — no second volume
+        assert any(m.get("name") == AOT_CACHE_VOLUME
+                   for m in main["volumeMounts"])
+        # independent of host offload: no --kv_offload args synthesized
+        assert not any(a.startswith("--kv_offload") for a in main["args"])
+
+    def test_custom_path_and_user_env_win(self):
+        pod = self._reconcile(kv={
+            "enabled": True, "hostMemoryGi": 4,
+            "persistentPrefixCache": {"enabled": True,
+                                      "path": "/mnt/warm/kv"},
+        })
+        _, env = self._main_env(pod)
+        assert env["KSERVE_TPU_KV_PERSIST"] == "/mnt/warm/kv"
+
+    def test_disabled_or_absent_leaves_no_env(self):
+        for kv in (None, {"enabled": True, "hostMemoryGi": 4},
+                   {"persistentPrefixCache": {"enabled": False}}):
+            _, env = self._main_env(self._reconcile(kv=kv))
+            assert "KSERVE_TPU_KV_PERSIST" not in env, kv
+
+    def test_crd_schema_carries_persistent_prefix_cache(self):
+        from kserve_tpu.controlplane.crdgen import crd_manifest
+
+        manifest = crd_manifest("LLMInferenceService")
+        schema = manifest["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+        kv = (schema["properties"]["spec"]["properties"]["workload"]
+              ["properties"]["kvCacheOffloading"]["properties"])
+        assert "persistentPrefixCache" in kv
+        assert set(kv["persistentPrefixCache"]["properties"]) == {
+            "enabled", "path"}
+
+
 # ---------------- event-loop responsiveness during device fetch ----------------
 
 

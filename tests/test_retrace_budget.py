@@ -288,3 +288,57 @@ class TestWarmStartBudget:
         after = {os.path.basename(p)
                  for p in glob.glob(str(tmp_path / "*")) if os.path.isdir(p)}
         assert len(after) == 2 and digests < after
+
+
+class TestPageInRetraceBudget:
+    """Hierarchical prefix page-in (docs/kv_hierarchy.md): promoting
+    tier-resident pages back to the device rides the EXISTING inject
+    scatter, so a replica woken into shared-prefix traffic compiles the
+    same steady-state program set plus exactly one inject — and nothing
+    ever again.  A growing count here would mean the page-in path is
+    retracing per request, silently serializing every wake."""
+
+    @async_test
+    async def test_pagein_adds_one_inject_then_freezes(self, tmp_path):
+        from test_engine import make_engine
+
+        prefix = list(range(3, 35))  # 4 full pages of 8
+        params = SamplingParams(max_tokens=4, temperature=0.0,
+                                ignore_eos=True)
+
+        async def run_one(engine, tail):
+            async for _ in engine.generate(prefix + tail, params):
+                pass
+
+        cold = make_engine(kv_persist_dir=str(tmp_path))
+        await cold.start()
+        await run_one(cold, [100, 101])
+        await run_one(cold, [110, 111])  # reuse -> persist write-through
+        import time as _time
+        t0 = _time.monotonic()
+        while cold.scheduler_state()["prefix_store"]["persist_digests"] < 4:
+            assert _time.monotonic() - t0 < 10.0
+            await asyncio.sleep(0.01)
+        await cold.stop()
+
+        warm = make_engine(kv_persist_dir=str(tmp_path))
+        await warm.start()
+        try:
+            base = compile_counts()
+            await run_one(warm, [100, 101])
+            first = delta(base)
+            assert first == {"mixed": 1, "inject": 1}, (
+                "a hot wake is one mixed compile + one inject for the "
+                f"page-in scatter, got {first}"
+            )
+            assert warm.scheduler_state()[
+                "prefix_store"]["pageins"] >= 4
+            # steady state: same-prefix traffic (varying tails) compiles
+            # NOTHING further — no retrace from the page-in path
+            for i in range(4):
+                await run_one(warm, [120 + i, 121 + i])
+            assert delta(base) == {"mixed": 1, "inject": 1}, (
+                f"page-in path retraced at steady state: {delta(base)}"
+            )
+        finally:
+            await warm.stop()

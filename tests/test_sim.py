@@ -404,6 +404,14 @@ class TestChurn10k:
         assert report["retries"]["preempt_resumes"] > 0
         assert report["retries"]["crash_restarts"] > 0
         assert report["retries"]["sheds_observed"] > 0
+        # prefix-store leg (ISSUE 13): nodes persist their hot prefixes,
+        # so the trace's restart recoveries come back prefix-HOT — pages
+        # flow back in from the durable store at scale
+        stores = [r["prefix_store"] for r in report["replicas"]]
+        assert all(s is not None for s in stores)
+        assert sum(s["persist_writes"] for s in stores) > 0
+        assert sum(s["pageins"] for s in stores) > 0
+        assert sum(s["adopted_hit_tokens"] for s in stores) > 0
         report2 = await FleetSim(churn_10k_scenario()).run()
         assert canonical_json(report) == canonical_json(report2)
 
@@ -443,6 +451,41 @@ class TestScaleZeroScenario:
         assert report["retries"]["amplification"] > 1.0
         # determinism: same seed, byte-identical report
         report2 = await FleetSim(scale_zero_scenario()).run()
+        assert canonical_json(report) == canonical_json(report2)
+
+    @async_test
+    async def test_prefix_store_hot_wake(self):
+        """ISSUE 13 acceptance (docs/kv_hierarchy.md): shared-prefix chat
+        traffic through a scale-to-zero window.  Life 0 persists the
+        reused system prefix; the woken replicas page it back in from the
+        node's durable store and serve prefix hits from request one —
+        prefix-hit tokens > 0 before any same-life prefill registered
+        them (adopted_hit_tokens).  Goodput 1.0, zero lost/duplicated
+        tokens, byte-identical per seed."""
+        from kserve_tpu.sim import prefix_store_scenario
+
+        scn = prefix_store_scenario()
+        report = await FleetSim(scn).run()
+        assert_slo(report, scn.budget)
+        submitted = report["requests"]["submitted"]
+        assert report["requests"]["outcomes"] == {"completed": submitted}
+        assert report["tokens"]["lost"] == 0
+        assert report["tokens"]["duplicated"] == 0
+        stores = [r["prefix_store"] for r in report["replicas"]]
+        assert all(s is not None for s in stores)
+        # life 0 persisted the shared prefix on every node...
+        assert all(s["persist_writes"] > 0 for s in stores), stores
+        # ...and every woken engine paged it back in and SERVED it: hits
+        # on pages that were never prefilled in that process life
+        assert all(s["pageins"] > 0 for s in stores), stores
+        assert all(s["pagein_tokens"] > 0 for s in stores), stores
+        assert all(s["adopted_hit_tokens"] > 0 for s in stores), stores
+        # wakes stayed warm on the AOT side too: hot AND compiled
+        for rep in report["replicas"]:
+            kinds = [s["kind"] for s in rep["starts"]]
+            assert kinds == ["cold", "warm"], kinds
+        # determinism: same seed, byte-identical report
+        report2 = await FleetSim(prefix_store_scenario()).run()
         assert canonical_json(report) == canonical_json(report2)
 
     @async_test
