@@ -1,0 +1,64 @@
+"""Rule ``task-leak``: an ``asyncio`` task created and dropped.
+
+``create_task(...)`` whose result is discarded — a bare expression
+statement, neither assigned, appended to a registry, passed onward, nor
+awaited — is a double hazard in this codebase:
+
+1. the event loop holds tasks only WEAKLY: a dropped Task can be
+   garbage-collected mid-flight and silently never finish (the EPP's
+   endpoint rediscovery loop was exactly this shape);
+2. an orphan task can never be cancelled at ``stop()`` and is invisible
+   to the engine watchdog's task-stall accounting
+   (engine/watchdog.py) — the gray-failure defense only reaps tasks it
+   can enumerate.
+
+Keep a strong reference (assign it, add it to a tracked set with a
+done-callback, or use a helper like ``engine._track_task``).  Genuine
+fire-and-forget is rare enough to justify per-line suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import FileContext, Finding, Rule, register
+
+
+def _is_create_task(call: ast.Call) -> bool:
+    """Matches ``asyncio.create_task(...)``, ``loop.create_task(...)``
+    and ``asyncio.get_running_loop().create_task(...)`` (any attribute
+    spelling), plus a bare ``create_task(...)`` import."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        return func.attr == "create_task"
+    if isinstance(func, ast.Name):
+        return func.id == "create_task"
+    return False
+
+
+@register
+class TaskLeak(Rule):
+    id = "task-leak"
+    description = (
+        "create_task(...) result dropped: the loop holds tasks weakly "
+        "(GC can kill it mid-flight), stop() cannot cancel it, and the "
+        "watchdog's stall accounting cannot see it"
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            # only a bare expression statement drops the Task; any other
+            # position (assignment, argument, await, return, append)
+            # keeps a reference the caller can manage
+            if not isinstance(node, ast.Expr):
+                continue
+            call = node.value
+            if isinstance(call, ast.Call) and _is_create_task(call):
+                yield self.finding(
+                    ctx, call,
+                    "create_task(...) result dropped — keep a strong "
+                    "reference (assign / track in a registry with a "
+                    "done-callback) so GC, stop() and the watchdog can "
+                    "all see the task",
+                )

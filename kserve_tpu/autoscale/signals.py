@@ -52,6 +52,12 @@ class ReplicaSignals:
     # router reads which replica already holds a prompt's pages — and a
     # scale-from-zero policy knows a wake will be prefix-HOT, not cold.
     prefix_store: Optional[Mapping] = None
+    # gray-failure health status (scheduler/health.py): healthy |
+    # degraded | quarantined.  A quarantined replica is excluded from
+    # picks, so it must be excluded from ready_replicas too — otherwise
+    # a gray replica SUPPRESSES the very scale-up that would route
+    # around it (ReactivePolicy sizes load per ready replica).
+    health_status: str = "healthy"
 
 
 @dataclass(frozen=True)
@@ -62,8 +68,9 @@ class FleetSignals:
     replay byte-identically."""
 
     at_s: float = 0.0
-    ready_replicas: int = 0  # healthy + READY (pickable backends)
+    ready_replicas: int = 0  # healthy + READY + not quarantined (pickable)
     total_replicas: int = 0  # every replica the source knows, up or down
+    quarantined_replicas: int = 0  # gray replicas excluded from picks
     queue_depth: int = 0  # summed admission queues
     inflight: int = 0  # summed seated generations
     shed_rate_per_s: float = 0.0  # fleet 429s/sec since the last snapshot
@@ -135,17 +142,27 @@ class FleetSignals:
                 ttft_p99_s=s.get("ttft_p99_s", tel.get("ttft_p99_s")),
                 itl_p99_s=s.get("itl_p99_s", tel.get("itl_p99_s")),
                 prefix_store=s.get("prefix_store"),
+                health_status=str(
+                    (s.get("health") or {}).get("status")
+                    or s.get("health_status") or "healthy"),
             ))
         ready = [
             r for r in reps
             if r.healthy and r.lifecycle not in ("DRAINING", "TERMINATING")
+            # a quarantined replica takes no picks: counting it as ready
+            # would let a gray replica suppress the scale-up that routes
+            # around it (ReactivePolicy divides load by ready_replicas)
+            and r.health_status != "quarantined"
         ]
+        quarantined = sum(
+            1 for r in reps if r.health_status == "quarantined")
         ttfts = [r.ttft_p99_s for r in ready if r.ttft_p99_s is not None]
         itls = [r.itl_p99_s for r in ready if r.itl_p99_s is not None]
         return cls(
             at_s=at_s,
             ready_replicas=len(ready),
             total_replicas=len(reps),
+            quarantined_replicas=quarantined,
             queue_depth=sum(r.queue_depth for r in ready),
             inflight=sum(r.inflight for r in ready),
             shed_rate_per_s=shed_rate_per_s,
@@ -167,15 +184,40 @@ class ArrivalHistory:
     means the arrival process is accelerating (burst onset).  Purely
     arithmetic over (time, count) pairs: deterministic under virtual
     clocks and cheap enough for the proxy hot path.
+
+    `wall_anchor_s` maps the (monotonic / virtual) timestamps this
+    history records onto wall-clock epoch seconds: ``wall_time(t) =
+    wall_anchor_s + t``.  Day-scale periodic detection (time-of-day
+    traffic profiles, ROADMAP 1c) needs a wall anchor the simulator can
+    FABRICATE — a scenario sets "t=0 is 03:00 UTC" and the learned
+    periodic profile becomes testable without real days passing.  None
+    leaves the history anchor-less (today's behavior); the EPP reads
+    ``KSERVE_TPU_WALL_ANCHOR`` to anchor production histories.
     """
 
-    def __init__(self, bucket_s: float = 1.0, window_s: float = 120.0):
+    def __init__(self, bucket_s: float = 1.0, window_s: float = 120.0,
+                 wall_anchor_s: Optional[float] = None):
         if bucket_s <= 0:
             raise ValueError("bucket_s must be > 0")
         self.bucket_s = bucket_s
         self.window_s = window_s
+        self.wall_anchor_s = wall_anchor_s
         self._buckets: "deque[Tuple[int, int]]" = deque()  # (bucket, count)
         self.total = 0
+
+    def wall_time(self, t: float) -> Optional[float]:
+        """Epoch seconds for clock time `t` (None when un-anchored)."""
+        if self.wall_anchor_s is None:
+            return None
+        return self.wall_anchor_s + t
+
+    def time_of_day_s(self, t: float) -> Optional[float]:
+        """Seconds-past-midnight for clock time `t` — the bucketing key a
+        day-scale periodic learner profiles on (None when un-anchored)."""
+        wall = self.wall_time(t)
+        if wall is None:
+            return None
+        return wall % 86400.0
 
     def record(self, t: float, n: int = 1) -> None:
         b = int(t / self.bucket_s)

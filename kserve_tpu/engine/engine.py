@@ -430,6 +430,29 @@ class LLMEngine:
         # chaos seam (resilience/faults.py): a FaultPlan whose "wedge"
         # specs targeting "engine.fetch" the device-fetch path honors
         self.fault_plan = None
+        # gray-failure watchdog (engine/watchdog.py, docs/resilience.md):
+        # seated-or-queued work with no forward motion past the stall
+        # budget flips readiness and self-drains with checkpoints.  The
+        # owning server (or SimReplica) hooks on_stall_confirmed to flip
+        # its ReplicaLifecycle so readiness probes go red too.
+        self._watchdog = None
+        self.on_stall_confirmed = None
+        if engine_config.watchdog:
+            from .watchdog import EngineWatchdog, WatchdogConfig
+
+            self._watchdog = EngineWatchdog(
+                WatchdogConfig(
+                    interval_s=engine_config.watchdog_interval_s,
+                    suspect_after_s=engine_config.watchdog_suspect_s,
+                    confirm_after_s=engine_config.watchdog_confirm_s,
+                    task_stall_s=engine_config.watchdog_task_stall_s,
+                    salvage_grace_s=engine_config.watchdog_salvage_grace_s,
+                ),
+                clock=self._clock,
+                busy=self._has_live_work,
+                on_confirmed=self._stall_confirmed,
+                tasks=lambda: self._pagein_tasks,
+            )
         # prefix cache (engine/prefix_cache.py): chained page key -> page
         # id, LRU-evicted on pressure; holds one allocator ref per page.
         # Evictions are offered to the hierarchical store's demote seam
@@ -579,6 +602,8 @@ class LLMEngine:
     async def start(self):
         if self._task is None:
             self._task = asyncio.create_task(self._run_loop())
+            if self._watchdog is not None:
+                self._watchdog.start()
             logger.info(
                 "LLM engine started: slots=%d pages=%d page_size=%d tp=%d",
                 self.config.max_batch_size, self.config.num_pages,
@@ -639,6 +664,7 @@ class LLMEngine:
 
     async def stop(self):
         self._stopped = True
+        self.stop_watchdog()
         self._wake.set()
         # fail queued-but-unseated requests NOW, before waiting on the loop
         # task: their asyncio queues would otherwise never see another put
@@ -739,6 +765,11 @@ class LLMEngine:
             # and the autoscaler behind it — sees SLO pressure per replica
             "telemetry": self.telemetry.signal_windows(),
         }
+        if self._watchdog is not None:
+            # gray-failure watchdog block (docs/resilience.md): the EPP's
+            # fleet health scoring quarantines on stall_suspected /
+            # stall_confirmed — the signal a liveness probe cannot see
+            state["watchdog"] = self._watchdog.snapshot()
         if self._kv_store is not None:
             # hierarchical prefix-store block (docs/kv_hierarchy.md): the
             # resident-digest count + hit/miss/demotion/page-in tallies the
@@ -851,6 +882,10 @@ class LLMEngine:
 
     def _track_task(self, coro) -> None:
         task = asyncio.get_running_loop().create_task(coro)
+        # start stamp for the watchdog's task-stall accounting: a tracked
+        # task alive past the stall budget is cancelled, not left pinning
+        # the request it was supposed to unblock
+        task._wd_started_s = self._clock.now()
         self._pagein_tasks.add(task)
         task.add_done_callback(self._pagein_tasks.discard)
 
@@ -1056,6 +1091,59 @@ class LLMEngine:
             except Exception:  # noqa: BLE001 — telemetry must never kill the loop
                 logger.exception("engine span emission failed")
 
+    # ---------------- gray-failure watchdog (docs/resilience.md) ----------------
+
+    def _has_live_work(self) -> bool:
+        """Watchdog busy probe: anything seated or queued that should be
+        making forward progress."""
+        return bool(self._waiting) or any(
+            s.request_id is not None for s in self._slots)
+
+    def _note_progress(self) -> None:
+        if self._watchdog is not None:
+            self._watchdog.note_progress()
+
+    def stop_watchdog(self) -> None:
+        """Stop the watchdog tick task (engine.stop does this; the fleet
+        simulator also calls it before draining its timer heap — a live
+        watchdog re-arms a virtual timer every interval forever)."""
+        if self._watchdog is not None:
+            self._watchdog.stop()
+
+    def _stall_confirmed(self, reason: str) -> None:
+        """Watchdog confirm hook: flip readiness and self-drain with
+        checkpoints.  The drain salvages every in-flight token through
+        the PR 5 checkpoint path — each stream sees GenerationPreempted
+        with a portable checkpoint and resumes on a healthy replica —
+        instead of holding streams hostage until the client deadline or
+        a kubelet SIGKILL loses everything."""
+        if self.on_stall_confirmed is not None:
+            try:
+                self.on_stall_confirmed(reason)
+            except Exception:  # noqa: BLE001 — a broken lifecycle hook must
+                # not block the salvage drain below
+                logger.exception("on_stall_confirmed hook failed")
+        # tracked for stop() cancellation but deliberately NOT stamped
+        # with _wd_started_s (_track_task would): the watchdog's task
+        # reaper must never cancel its own salvage drain mid-checkpoint
+        task = asyncio.get_running_loop().create_task(
+            self._stall_self_drain())
+        self._pagein_tasks.add(task)
+        task.add_done_callback(self._pagein_tasks.discard)
+
+    async def _stall_self_drain(self) -> None:
+        deadline = Deadline.after(
+            self.config.watchdog_salvage_grace_s, self._clock)
+        try:
+            checkpoints = await self.drain(
+                deadline=deadline, clock=self._clock, reason="stall")
+            logger.error(
+                "watchdog self-drain complete: %d generation(s) "
+                "checkpointed for migration", len(checkpoints))
+        except Exception:  # noqa: BLE001 — the stall state is already
+            # exported; a failed salvage must not crash the process
+            logger.exception("watchdog self-drain failed")
+
     def _fetch_fault_check(self) -> None:
         """Shared fault seam for _fetch/_fetch_async — one copy, so a new
         fault kind can't be honored in one fetch path and not the other."""
@@ -1096,11 +1184,17 @@ class LLMEngine:
         blocking wait here starves every other coroutine for the full step
         duration.  Same fault seam and wedge mapping as _fetch."""
         self._fetch_fault_check()
+        wd = self._watchdog
+        if wd is not None:
+            wd.fetch_started()
         try:
             return await self._fetcher.fetch_async(
                 lambda: np.asarray(x), self.config.step_deadline_s)
         except TimeoutError:
             raise self._fetch_timeout() from None
+        finally:
+            if wd is not None:
+                wd.fetch_done()
 
     def generate(
         self,
@@ -1486,7 +1580,8 @@ class LLMEngine:
         self._set_queue_gauge()
 
     async def drain(self, deadline: Optional[Deadline] = None,
-                    clock=None, poll_s: float = 0.01) -> List[GenerationCheckpoint]:
+                    clock=None, poll_s: float = 0.01,
+                    reason: str = "drain") -> List[GenerationCheckpoint]:
         """Graceful drain (SIGTERM / POST /admin/drain): stop admitting,
         give in-flight generations until `deadline` (the replica's drain
         budget — lifecycle.begin_drain()) to finish, then snapshot whatever
@@ -1496,20 +1591,23 @@ class LLMEngine:
         healthy replica could spend better.  `clock` is the chaos-test seam
         (FakeClock => the wait is virtual); escalation (second SIGTERM)
         expires `deadline` in place, which this loop observes on its next
-        poll.  Returns the checkpoints, newest last."""
+        poll.  `reason` labels the checkpoints ("drain" for lifecycle
+        drains, "stall" for the watchdog's self-drain — the sim's client
+        layer counts stall-reason resumes as migrations).  Returns the
+        checkpoints, newest last."""
         self._draining = True
         clk = clock or MONOTONIC
         checkpoints: List[GenerationCheckpoint] = []
         while True:
             # KV-pressure preemptions during the drain land back in
             # _waiting; flush them each pass instead of re-seating
-            self._checkpoint_waiting("drain", checkpoints)
+            self._checkpoint_waiting(reason, checkpoints)
             active = [s for s in self._slots if s.request_id is not None]
             if not active:
                 break
             if deadline is not None and deadline.expired:
                 for slot in active:
-                    ckpt = self._checkpoint_slot(slot, "drain")
+                    ckpt = self._checkpoint_slot(slot, reason)
                     checkpoints.append(ckpt)
                     self._evict_slot(slot, GenerationPreempted(ckpt))
                 self._wake.set()
@@ -1660,6 +1758,11 @@ class LLMEngine:
                     if active:
                         await self._decode_once()
                         did_work = True
+                if did_work:
+                    # watchdog heartbeat: the loop completed an iteration
+                    # that moved work forward (admission, prefill chunk,
+                    # or a routed dispatch)
+                    self._note_progress()
                 if not did_work:
                     self._wake.clear()
                     await self._wake.wait()
@@ -2673,6 +2776,10 @@ class LLMEngine:
                 self._finish(slot, "length")
                 finished_any = True
         GENERATED_TOKENS.labels(model_name=self._mlabel).inc(routed)
+        if routed or finished_any:
+            # stamp here, not only in the run loop: the depth-2 pipeline
+            # can chain chunks for a long stretch without returning to it
+            self._note_progress()
         return finished_any
 
     async def _decode_once(self):
@@ -3014,6 +3121,8 @@ class LLMEngine:
                 self._emit(slot, token)
                 routed += 1
         GENERATED_TOKENS.labels(model_name=self._mlabel).inc(routed)
+        if routed or plan["chunks"]:
+            self._note_progress()
 
     def _emit(self, slot: _Slot, token: int,
               logprob: Optional[float] = None,

@@ -147,6 +147,23 @@ class EngineConfig:
     # Requests needing per-step logprobs or sampling penalties fall back
     # to the legacy programs per engine iteration even when ragged is on.
     use_ragged: Optional[bool] = None
+    # gray-failure watchdog (engine/watchdog.py, docs/resilience.md): a
+    # clock-injectable monitor that tracks loop heartbeat, dispatch
+    # progress, fetch-worker liveness and tracked-task stalls; a
+    # CONFIRMED stall flips readiness and self-drains with checkpoints
+    # (the PR 5 salvage path) instead of holding streams hostage until
+    # the client deadline or a kubelet SIGKILL.  Off by default: a
+    # cold-compiling engine legitimately pauses longer than any useful
+    # stall budget — the fleet simulator enables it with tight budgets,
+    # production opts in via KSERVE_TPU_WATCHDOG once the AOT cache
+    # keeps steady-state dispatch pause-free.  Host-side only:
+    # deliberately NOT part of the AOT cache key.
+    watchdog: bool = False
+    watchdog_interval_s: float = 0.5
+    watchdog_suspect_s: float = 5.0
+    watchdog_confirm_s: float = 5.0
+    watchdog_task_stall_s: float = 30.0
+    watchdog_salvage_grace_s: float = 0.0
 
     def __post_init__(self):
         # prefill buckets must reach max_prefill_len or long prompts would

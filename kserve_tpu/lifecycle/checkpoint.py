@@ -57,7 +57,9 @@ class GenerationCheckpoint:
     # remaining request-deadline budget at snapshot time (None = unbounded);
     # relative seconds, same contract as the x-request-deadline header
     deadline_remaining_s: Optional[float] = None
-    reason: str = "drain"  # drain | preempt
+    # drain (lifecycle drain) | preempt (KV pressure) | stall (watchdog
+    # self-drain) | hedge (client-side stall-triggered migration)
+    reason: str = "drain"
 
     @classmethod
     def capture(

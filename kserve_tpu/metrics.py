@@ -150,6 +150,42 @@ TOKENS_SALVAGED = Counter(
     ["model_name"],
 )
 
+# Gray-failure immune system (engine/watchdog.py + scheduler/health.py —
+# docs/resilience.md).  `stat` and `transition` are closed enums; replica
+# identity is deliberately NOT a label (unbounded under churn — the
+# cardinality policy above): per-replica scores/status ride the picker
+# snapshot and EPP /state.  `reason` comes from the closed checkpoint
+# reason set ("stall" = watchdog self-drain rescued the stream, "hedge" =
+# the client's inter-token hedge migrated it off a slow replica).
+REPLICA_HEALTH_SCORE = Gauge(
+    "replica_health_score",
+    "fleet health-score distribution at the latest poll (min | median | "
+    "max over replicas; per-replica scores live in the EPP /state)",
+    ["stat"],
+)
+QUARANTINE_TRANSITIONS = Counter(
+    "replica_quarantine_transitions_total",
+    "gray-failure health state transitions "
+    "(quarantine | reintroduce | degrade | restore)",
+    ["transition"],
+)
+GENERATION_MIGRATIONS = Counter(
+    "generation_migrations_total",
+    "live generations migrated off a sick replica and resumed elsewhere, "
+    "by trigger (stall = watchdog self-drain checkpoint, hedge = "
+    "client-side inter-token-gap hedge)",
+    ["reason"],
+)
+
+
+def record_quarantine_transition(transition: str) -> None:
+    """FleetHealth transition hook; replica identity stays in /state."""
+    QUARANTINE_TRANSITIONS.labels(transition=transition).inc()
+
+
+def record_generation_migration(reason: str) -> None:
+    GENERATION_MIGRATIONS.labels(reason=reason).inc()
+
 # Request-lifecycle telemetry (kserve_tpu/observability — the serving
 # metrics that matter per the vLLM/TGI comparative study, arXiv:2511.17593).
 # Sub-millisecond buckets on ITL because decode steps on-chip are ~1-10ms;

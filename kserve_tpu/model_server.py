@@ -154,6 +154,8 @@ class ModelServer:
         self._setup_asyncio_executor()
         for model in engine_models:
             task = asyncio.create_task(_start_engine(model))
+            task.add_done_callback(
+                lambda _t, m=model: self._wire_stall_hook(m))
             self._engine_tasks.append(task)
         self._rest_server = RESTServer(
             self.dataplane,
@@ -174,6 +176,25 @@ class ModelServer:
             )
             self._grpc_task = asyncio.create_task(self._grpc_server.start(self.max_threads))
         self.lifecycle.mark_ready()
+
+    def _wire_stall_hook(self, model) -> None:
+        """Gray-failure watchdog wiring (docs/resilience.md): a confirmed
+        engine stall must flip THIS replica's readiness red — the engine
+        self-drains its streams internally, but only the server lifecycle
+        makes the readiness probe (and with it the endpoint controller)
+        see it.  Liveness stays green: checkpoints must outlive the
+        stall, a kubelet kill would lose them."""
+        engine = getattr(model, "engine", None)
+        if engine is None or not hasattr(engine, "on_stall_confirmed"):
+            return
+
+        def on_stall(reason: str) -> None:
+            logger.error(
+                "engine stall confirmed (%s): flipping replica readiness "
+                "(DRAINING)", reason)
+            self.lifecycle.begin_drain()
+
+        engine.on_stall_confirmed = on_stall
 
     async def drain_async(self) -> List[GenerationCheckpoint]:
         """Graceful drain: flip DRAINING (readiness red, liveness green,

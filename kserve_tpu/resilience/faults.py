@@ -31,13 +31,24 @@ Fault kinds:
                      deterministic stand-in for thermal throttling or a
                      noisy neighbor
 
-`FaultInjectingTransport` honors a plan in front of any httpx handler or
-inner transport; `LLMEngine` honors ``wedge`` and ``replica_crash`` specs
-targeted at ``engine.fetch`` (see engine._fetch) and ``preempt`` specs
-targeted at ``engine.preempt`` (see engine._grow_and_preempt — during a
-drain the preempted sequence is checkpointed for cross-replica resume
-instead of being re-seated); the fleet simulator's stub device honors
-``clock_skew`` specs targeted at ``<replica>.compute``.
+Gray-fault kinds (docs/resilience.md — failures that are NOT binary:
+the backend stays up, answers probes, and quietly stops doing useful
+work; detected by the engine watchdog + fleet health scoring, not by
+liveness or breakers):
+
+- ``slow_decode``    the backend serves, ``skew`` times slower: the
+                     transport sleeps ``latency_s * skew`` then proceeds;
+                     the simulator's stub device multiplies decode costs
+                     (a degraded host that still answers everything)
+- ``wedged_fetch``   the backend's fetch worker stops making progress
+                     while the process stays alive: the transport raises
+                     ReadTimeout; the simulator parks the replica's async
+                     device fetches until a heal (liveness stays green —
+                     the engine watchdog is what catches it)
+- ``flapping``       alternates healthy and sick per matching call:
+                     odd injections raise ConnectError, even ones sleep
+                     ``latency_s * skew`` and proceed (a flapping NIC /
+                     link that defeats naive consecutive-failure counts)
 """
 
 from __future__ import annotations
@@ -63,7 +74,8 @@ class ReplicaCrashError(RuntimeError):
 class FaultSpec:
     target: str  # substring matched against the call target
     # latency | connect_error | http_status | wedge | partial_stream |
-    # preempt | replica_crash | clock_skew
+    # preempt | replica_crash | clock_skew | slow_decode | wedged_fetch |
+    # flapping
     kind: str
     status: int = 503
     latency_s: float = 0.0
@@ -151,6 +163,8 @@ class FaultInjectingTransport(httpx.AsyncBaseTransport):
         self.inner = inner
         self.clock = clock
         self.calls: List[str] = []  # pass-through + faulted targets, in order
+        # flapping state: per-spec injection parity (odd = sick leg)
+        self._flaps: Dict[int, int] = {}
 
     async def handle_async_request(self, request: httpx.Request) -> httpx.Response:
         target = request.url.host or str(request.url)
@@ -159,10 +173,24 @@ class FaultInjectingTransport(httpx.AsyncBaseTransport):
         if spec is not None:
             if spec.kind == "latency":
                 await self.clock.sleep(spec.latency_s)
-            elif spec.kind == "clock_skew":
+            elif spec.kind in ("clock_skew", "slow_decode"):
                 # a slow backend, not a dead one: the latency is the spec's
                 # latency scaled by the skew factor, then the call proceeds
                 await self.clock.sleep(spec.latency_s * spec.skew)
+            elif spec.kind == "flapping":
+                # alternates per injection: odd = link down, even = slow
+                # but serving — the gray shape that defeats consecutive-
+                # failure thresholds (it keeps resetting them)
+                n = self._flaps[id(spec)] = self._flaps.get(id(spec), 0) + 1
+                if n % 2:
+                    raise httpx.ConnectError(
+                        "injected flapping (down leg)", request=request)
+                await self.clock.sleep(spec.latency_s * spec.skew)
+            elif spec.kind == "wedged_fetch":
+                # the backend's worker is stuck while the process lives:
+                # from the network's view the read never completes
+                raise httpx.ReadTimeout(
+                    "injected wedged fetch", request=request)
             elif spec.kind == "connect_error":
                 raise httpx.ConnectError("injected connect error", request=request)
             elif spec.kind == "replica_crash":

@@ -22,6 +22,7 @@ import os
 from typing import AsyncIterator, List, Optional, Union
 
 from ..engine.aot_cache import aot_cache_dir_from_env
+from ..engine.watchdog import watchdog_enabled_from_env
 from ..kvstore.persist import kv_persist_dir_from_env
 from ..engine.engine import EngineConfig, LLMEngine
 from ..engine.sampling import SamplingParams
@@ -802,6 +803,14 @@ def main(argv=None):
         "populated store makes a restarted replica serve shared-prefix "
         "traffic with cache hits from request one",
     )
+    parser.add_argument(
+        "--watchdog", default=None, choices=("on", "off"),
+        help="gray-failure engine watchdog (docs/resilience.md): a "
+        "confirmed no-progress stall flips readiness and self-drains "
+        "with checkpoints instead of waiting for the client deadline "
+        "or kubelet; defaults to $KSERVE_TPU_WATCHDOG (off).  Enable "
+        "once a warm AOT cache keeps steady-state dispatch compile-free",
+    )
     args = parser.parse_args(argv)
 
     model_config = _NAMED_CONFIGS[args.model_config]() if args.model_config else None
@@ -825,6 +834,8 @@ def main(argv=None):
         kv_offload_policy=args.kv_offload_policy,
         aot_cache_dir=args.aot_cache_dir or aot_cache_dir_from_env(),
         kv_persist_dir=args.kv_persist_dir or kv_persist_dir_from_env(),
+        watchdog=(args.watchdog == "on" if args.watchdog is not None
+                  else watchdog_enabled_from_env()),
     )
     lora_adapters = None
     if args.lora_adapters:

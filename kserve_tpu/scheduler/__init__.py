@@ -8,4 +8,11 @@ by live queue depth and prefix-cache affinity and proxies/picks per
 request.
 """
 
+from .health import (  # noqa: F401
+    DEGRADED,
+    HEALTHY,
+    QUARANTINED,
+    FleetHealth,
+    HealthConfig,
+)
 from .picker import EndpointPicker, Replica  # noqa: F401

@@ -85,8 +85,27 @@ class EPPServer:
         # the EPP is the fleet's front door, so it is where the arrival
         # process is observable: every proxied inference POST is recorded
         # and /state exports the aggregate FleetSignals block the
-        # autoscaler loop scrapes (docs/autoscaling.md)
-        self.arrivals = ArrivalHistory()
+        # autoscaler loop scrapes (docs/autoscaling.md).  An optional
+        # wall anchor feeds day-scale periodic detection (ROADMAP 1c) —
+        # the simulator fabricates one, production sets
+        # KSERVE_TPU_WALL_ANCHOR to CURRENT epoch seconds (or it stays
+        # None: no time-of-day profile, today's behavior).  Arrivals are
+        # stamped on the picker clock (monotonic, arbitrary zero), so
+        # the stored anchor is rebased to THIS clock's now: wall_time(t)
+        # = anchor_epoch + (t - now_at_init) — using the raw epoch value
+        # against monotonic stamps would be off by the host's uptime.
+        anchor_s = None
+        raw_anchor = os.environ.get("KSERVE_TPU_WALL_ANCHOR")
+        if raw_anchor:
+            try:
+                anchor_s = float(raw_anchor) - picker.clock.now()
+            except ValueError:
+                # an optional observability knob must not take down the
+                # fleet's front door
+                logger.warning(
+                    "ignoring malformed KSERVE_TPU_WALL_ANCHOR=%r "
+                    "(expected epoch seconds)", raw_anchor)
+        self.arrivals = ArrivalHistory(wall_anchor_s=anchor_s)
         # floor on the shed-rate window: /state is scraped by MORE than
         # the autoscaler (dashboards, operators), and each consult would
         # otherwise re-baseline the delta — see RateTracker docstring
@@ -150,7 +169,13 @@ class EPPServer:
 
     async def pick(self, request: web.Request) -> web.Response:
         ids, text, _ = await self._read_affinity(request)
-        replica = self.picker.pick(prompt_ids=ids, prompt_text=text)
+        # advisory decision only: the caller routes the request itself
+        # and never reports back, so this path must not consume canary
+        # picks (an unreported canary cannot close the reintroduction
+        # proof loop — it would just feed one real request per interval
+        # to the known-sick replica)
+        replica, _ = self.picker.pick_ex(
+            prompt_ids=ids, prompt_text=text, allow_canary=False)
         if replica is None:
             return web.json_response(
                 {"error": "no healthy replica"}, status=503
@@ -175,7 +200,11 @@ class EPPServer:
             # request still registers demand
             self.arrivals.record(self.picker.clock.now())
         ids, text, body = await self._read_affinity(request)
-        replica = self.picker.pick(prompt_ids=ids, prompt_text=text)
+        # is_canary marks a quarantine re-probe riding this request: its
+        # outcome (incl. measured latency) must be reported back so the
+        # health layer can reintroduce — or keep quarantining — on proof
+        replica, is_canary = self.picker.pick_ex(
+            prompt_ids=ids, prompt_text=text)
         if replica is None:
             return web.json_response(
                 {"error": "no healthy replica"}, status=503
@@ -222,7 +251,8 @@ class EPPServer:
                 trace_id=span_ctx.trace_id,
             ):
                 return await self._forward(
-                    request, replica, headers, body, ids, text
+                    request, replica, headers, body, ids, text,
+                    is_canary=is_canary,
                 )
         except Exception as exc:
             # same contract as the replica's tracing middleware: an
@@ -235,7 +265,8 @@ class EPPServer:
                 span_cm.__exit__(None, None, None)
 
     async def _forward(self, request: web.Request, replica, headers: dict,
-                       body: bytes, ids, text) -> web.StreamResponse:
+                       body: bytes, ids, text,
+                       is_canary: bool = False) -> web.StreamResponse:
         import aiohttp
 
         url = replica.url + request.rel_url.path_qs
@@ -269,6 +300,19 @@ class EPPServer:
                     # breaker bookkeeping: a served 2xx closes a half-open
                     # breaker and clears the failure streak
                     self.picker.observe_success(replica.url)
+                    if is_canary:
+                        # canary proof carries its MEASURED latency: a
+                        # 200 served at gray-sick speed must not
+                        # reintroduce (scheduler/health.py judges the
+                        # TTFT / per-token time vs the fleet medians)
+                        total_s = time.monotonic() - t0
+                        tpot = (
+                            (total_s - ttft) / (chunks - 1)
+                            if ttft is not None and chunks > 1 else None)
+                        self.picker.observe_canary(
+                            replica.url, True, ttft_s=ttft, tpot_s=tpot)
+                elif is_canary:
+                    self.picker.observe_canary(replica.url, False)
                 if upstream.status == 429 or upstream.status >= 500:
                     # REPLICA-health statuses only: 429 shedding / 5xx
                     # failures penalize picking (a shedder never trains the
@@ -297,6 +341,8 @@ class EPPServer:
                 return out
         except (aiohttp.ClientError, OSError, asyncio.TimeoutError) as exc:
             logger.warning("epp proxy to %s failed: %s", replica.url, exc)
+            if is_canary:
+                self.picker.observe_canary(replica.url, False)
             if out is None or not out.prepared:
                 # the replica never produced a response: a replica-side
                 # fault.  Once headers are flowing, the error is just as
@@ -410,7 +456,10 @@ async def serve(args) -> None:
                     logger.warning("epp endpoint discovery failed: %s", exc)
                 await asyncio.sleep(10.0)
 
-        asyncio.get_running_loop().create_task(rediscover())
+        # strong reference (jaxlint task-leak): a dropped Task is weakly
+        # held by the loop — GC could silently kill rediscovery, and an
+        # orphan task can never be cancelled or stall-accounted
+        _rediscover_task = asyncio.get_running_loop().create_task(rediscover())  # noqa: F841
     await picker.start_polling()
     server = EPPServer(picker)
     # resume retries carry the x-generation-checkpoint REQUEST header

@@ -37,6 +37,7 @@ from typing import Dict, List, Optional, Sequence
 
 from ..logging import logger
 from ..resilience import MONOTONIC, BreakerRegistry, Clock
+from .health import FleetHealth
 from .latency import estimate_prompt_len
 from .prefix import text_prefix_digests, token_prefix_digests
 
@@ -77,6 +78,11 @@ class Replica:
     # page-in tallies per replica — the first cut of the global prefix
     # index (ROADMAP item 2).  Re-exported in the EPP /state fleet block.
     prefix_store: Optional[Dict] = None
+    # engine watchdog state carried through from /state (the worst state
+    # across a multi-model replica's engines): ok | stall_suspected |
+    # stall_confirmed — the gray-failure signal fleet health scoring
+    # quarantines on (scheduler/health.py, docs/resilience.md)
+    watchdog: str = "ok"
 
     @property
     def digests(self) -> frozenset:
@@ -102,12 +108,20 @@ class EndpointPicker:
         error_weight: float = 2.0,  # score penalty per recent HTTP error
         breakers: Optional[BreakerRegistry] = None,  # resilience/breaker.py
         clock: Clock = MONOTONIC,  # error-decay/poll stamps (sim injects)
+        health: Optional[FleetHealth] = None,  # scheduler/health.py
+        health_weight: float = 4.0,  # score penalty per point of lost health
     ):
         # every time the picker reads (poll freshness, error decay) comes
         # from this injectable clock so the fleet simulator's routing is a
         # pure function of virtual time — real time would leak wall-clock
         # jitter into scores and break byte-identical reports
         self.clock = clock
+        # gray-failure health layer (docs/resilience.md): always present —
+        # with default thresholds it only bites on genuine outliers, and
+        # quarantine (score-driven, canary-exited) stays DISTINCT from the
+        # breaker (served-error-driven, timer-half-opened) below
+        self.health = health if health is not None else FleetHealth(clock=clock)
+        self.health_weight = health_weight
         self.latency_predictor = latency_predictor
         self.latency_weight = latency_weight
         self.error_weight = error_weight
@@ -145,6 +159,9 @@ class EndpointPicker:
                     # same churn contract for breaker state: a fresh pod on
                     # a recycled ip:port starts closed, not open
                     self.breakers.forget(u)
+                # ...and for health: a recycled url must not inherit the
+                # dead pod's quarantine
+                self.health.forget(u)
         for u in urls:
             self.replicas.setdefault(u, Replica(url=u))
 
@@ -165,6 +182,18 @@ class EndpointPicker:
         models: Dict[str, tuple] = {}
         wedged = False
         prefix_store: Optional[Dict] = None
+        wd_state = "ok"
+        _WD_ORDER = {"ok": 0, "stall_suspected": 1, "stall_confirmed": 2}
+
+        def merge_watchdog(block):
+            # the worst engine's state wins on a multi-model replica: one
+            # stalled engine makes the whole pod a gray backend
+            nonlocal wd_state
+            if not isinstance(block, dict):
+                return
+            s = str(block.get("state") or "ok")
+            if _WD_ORDER.get(s, 0) > _WD_ORDER.get(wd_state, 0):
+                wd_state = s
 
         def merge_prefix_store(block):
             nonlocal prefix_store
@@ -190,6 +219,7 @@ class EndpointPicker:
             )
             wedged = wedged or bool(m.get("wedged"))
             merge_prefix_store(m.get("prefix_store"))
+            merge_watchdog(m.get("watchdog"))
         # flat form (engine.scheduler_state() given directly, tests)
         if "prefix_digests" in state or "page_size" in state:
             models[""] = (
@@ -200,12 +230,19 @@ class EndpointPicker:
             )
         wedged = wedged or bool(state.get("wedged"))
         merge_prefix_store(state.get("prefix_store"))
+        merge_watchdog(state.get("watchdog"))
         r.prefix_store = prefix_store
         r.models = models
         r.healthy = not wedged
+        r.watchdog = wd_state
         r.lifecycle = str(state.get("lifecycle") or "READY").upper()
         r.consecutive_failures = 0
         r.last_poll = self.clock.now()
+        # gray-failure scoring: fold this poll's signals (latency-window
+        # outliers vs the fleet, queue drain, watchdog state, recent
+        # errors) into the replica's EWMA health score
+        self.health.observe(r, self.replicas.values(),
+                            error_level=self.decayed_errors(r))
 
     # recent-error half-life: a shedding replica is retried within ~30s of
     # its last error, not banished forever
@@ -228,6 +265,7 @@ class EndpointPicker:
         r.last_error_t = self.clock.now()
         if self.breakers is not None:
             self.breakers.record_failure(r.url)
+        self.health.record_canary(r.url, ok=False)
 
     def observe_success(self, url: str) -> None:
         """A 2xx served through the proxy: closes a half-open breaker and
@@ -238,6 +276,10 @@ class EndpointPicker:
         r.consecutive_failures = 0
         if self.breakers is not None:
             self.breakers.record_success(r.url)
+        # deliberately NOT canary proof: a stream seated BEFORE the
+        # quarantine completing would otherwise count as a probe result
+        # — only observe_canary (attributed to the pick that was the
+        # canary) can reintroduce
 
     def observe_failure(self, url: str) -> None:
         r = self.replicas.get(url.rstrip("/"))
@@ -248,6 +290,7 @@ class EndpointPicker:
             r.healthy = False
         if self.breakers is not None:
             self.breakers.record_failure(r.url)
+        self.health.record_canary(r.url, ok=False)
 
     async def refresh_once(self) -> None:
         import aiohttp
@@ -334,24 +377,63 @@ class EndpointPicker:
             hits += 1
         return hits
 
+    def observe_canary(self, url: str, ok: bool,
+                       ttft_s: Optional[float] = None,
+                       tpot_s: Optional[float] = None) -> None:
+        """Report the outcome of a canary pick (pick_ex returned
+        is_canary=True).  Optional latency measurements let the health
+        layer reject a 200-but-gray-slow probe (scheduler/health.py)."""
+        self.health.record_canary(url, ok, ttft_s=ttft_s, tpot_s=tpot_s)
+
     def pick(
         self,
         prompt_ids: Optional[Sequence[int]] = None,
         prompt_text: Optional[str] = None,
     ) -> Optional[Replica]:
-        """Best replica for this request, or None when none is healthy.
+        """pick_ex without the canary marker (legacy callers).  A canary
+        pick made through here never gets its outcome reported; the
+        health layer re-arms it after canary_timeout_s."""
+        return self.pick_ex(prompt_ids=prompt_ids, prompt_text=prompt_text)[0]
+
+    def pick_ex(
+        self,
+        prompt_ids: Optional[Sequence[int]] = None,
+        prompt_text: Optional[str] = None,
+        allow_canary: bool = True,
+    ) -> tuple:
+        """(replica, is_canary).  Best replica for this request, or
+        (None, False) when none is healthy.  `allow_canary=False` is for
+        callers that cannot report the probe's outcome (the advisory
+        /pick API): a canary whose result never comes back would burn
+        one real request per interval on the sick replica for nothing.
         Replicas with an open circuit breaker — or a DRAINING/TERMINATING
         lifecycle state — are excluded from the pick (half-open replicas
-        stay in as probe traffic); all-excluded falls through to None ->
-        503 upstream."""
-        healthy = [
+        stay in as probe traffic); QUARANTINED replicas (gray-failure
+        health, scheduler/health.py) are excluded too, except that one
+        due for its periodic canary re-probe carries exactly one live
+        request — the reintroduction path.  All-excluded falls through
+        to None -> 503 upstream."""
+        now = self.clock.now()
+        candidates = [
             r for r in self.replicas.values()
             if r.healthy
             and r.lifecycle not in ("DRAINING", "TERMINATING")
             and (self.breakers is None or self.breakers.available(r.url))
         ]
+        healthy = [r for r in candidates
+                   if not self.health.is_quarantined(r.url)]
+        # canary re-probe: at most one quarantined replica per reprobe
+        # interval rides a real request.  With healthy peers it steals one
+        # pick; with NONE it is the only recovery path (an all-quarantined
+        # fleet must not deadlock into permanent 503s).
+        if allow_canary:
+            for r in candidates:
+                if (self.health.is_quarantined(r.url)
+                        and self.health.wants_canary(r.url, now)):
+                    self.health.canary_started(r.url, now)
+                    return r, True
         if not healthy:
-            return None
+            return None, False
         prompt_len = estimate_prompt_len(prompt_ids, prompt_text)
         scored = []
         chains: Dict[int, List[bytes]] = {}
@@ -362,6 +444,15 @@ class EndpointPicker:
             )
             score = hits * self.prefix_weight - r.queue_depth * self.queue_weight
             score -= self.error_weight * self.decayed_errors(r)
+            # gray-degradation weight reduction: a DEGRADED replica sheds
+            # pick share smoothly before quarantine hard-cuts it.  Gated
+            # on status, not raw score: healthy replicas' score jitter
+            # must not break the equal-score ties that round-robin a
+            # same-instant burst across the fleet (queue depths are
+            # stale within one poll interval — a continuous penalty
+            # would aim the whole burst at a single replica)
+            if self.health.status(r.url) != "healthy":
+                score -= self.health_weight * (1.0 - self.health.score(r.url))
             if self.latency_predictor is not None and self.latency_weight > 0:
                 # SLO-aware term: penalize replicas the online model expects
                 # to be slow for THIS prompt at THEIR current depth; cold
@@ -377,7 +468,7 @@ class EndpointPicker:
         self._rr = (self._rr + 1) % max(len(healthy), 1)
         if prompt_text:
             self._learn_text(best.url, prompt_text)
-        return best
+        return best, False
 
     def _learn_text(self, url: str, text: str) -> None:
         for key in text_prefix_digests(text):
@@ -401,6 +492,8 @@ class EndpointPicker:
                 "ttft_p99_s": r.ttft_p99_s,
                 "itl_p99_s": r.itl_p99_s,
                 "prefix_store": r.prefix_store,
+                "watchdog": r.watchdog,
+                "health": self.health.snapshot(r.url),
                 "breaker": (
                     self.breakers.state(r.url)
                     if self.breakers is not None else None

@@ -30,6 +30,7 @@ from .scenario import (  # noqa: F401
     autoscale_burst_scenario,
     autoscale_smoke_scenario,
     churn_10k_scenario,
+    gray_failure_scenario,
     prefix_store_scenario,
     scale_zero_scenario,
     smoke_scenario,

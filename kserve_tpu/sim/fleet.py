@@ -34,7 +34,13 @@ from ..autoscale import (
 )
 from ..autoscale.signals import ArrivalHistory
 from ..lifecycle import GenerationPreempted, ReplicaDrainingError
-from ..metrics import RETRY_ATTEMPTS, record_breaker_transition
+from ..lifecycle.checkpoint import GenerationCheckpoint
+from ..logging import logger
+from ..metrics import (
+    RETRY_ATTEMPTS,
+    record_breaker_transition,
+    record_generation_migration,
+)
 from ..observability import RequestTimeline
 from ..resilience import (
     BreakerConfig,
@@ -46,9 +52,10 @@ from ..resilience import (
     RetryPolicy,
     deadline_scope,
 )
+from ..scheduler.health import FleetHealth
 from ..scheduler.picker import EndpointPicker
 from .clock import SimClock
-from .replica import SimReplica
+from .replica import SIM_MODEL_NAME, SimReplica
 from .report import build_report
 from .scenario import ChurnEvent, Scenario
 from .stub import expected_stream
@@ -66,6 +73,7 @@ class ClientRecord:
     sheds: int = 0
     resumes: int = 0
     crash_restarts: int = 0
+    migrations: int = 0  # stall-triggered moves off a gray replica
     no_backend: int = 0
     held: int = 0  # times parked on the hold-and-replay gateway
     outcome: str = "pending"
@@ -83,6 +91,7 @@ class ClientRecord:
             "rid": self.rid, "kind": self.kind, "attempts": self.attempts,
             "sheds": self.sheds, "resumes": self.resumes,
             "crash_restarts": self.crash_restarts,
+            "migrations": self.migrations,
             "no_backend": self.no_backend, "held": self.held,
             "outcome": self.outcome,
             "n_tokens": self.n_tokens, "lost_tokens": self.lost_tokens,
@@ -119,6 +128,10 @@ class FleetSim:
         self.picker = EndpointPicker(
             [r.url for r in self.replicas.values()],
             clock=self.clock,
+            # gray-failure health layer (scheduler/health.py): scenario-
+            # tunable config; None takes the picker's production defaults
+            health=(FleetHealth(scenario.health, clock=self.clock)
+                    if scenario.health is not None else None),
             breakers=BreakerRegistry(
                 BreakerConfig(window=20, failure_threshold=0.5,
                               min_volume=4, open_for_s=5.0),
@@ -150,7 +163,9 @@ class FleetSim:
                     f"initial_replicas {asc.initial_replicas} outside "
                     f"[0, {scenario.n_replicas}]")
             self._desired_on = asc.initial_replicas
-            self.arrivals = ArrivalHistory()
+            # wall anchor (ROADMAP 1c): lets a scenario fabricate a
+            # time-of-day mapping for day-scale periodic detection
+            self.arrivals = ArrivalHistory(wall_anchor_s=asc.wall_anchor_s)
             self.autoscaler = AutoscalerLoop(
                 asc.build_policy(),
                 self._fleet_signals,
@@ -176,6 +191,7 @@ class FleetSim:
         "preempt", "crash", "drain_restart", "breaker_trip",
         "shed_storm", "heal_shed", "skew", "heal_skew",
         "scale_down", "scale_up",
+        "slow_decode", "wedged_fetch", "flapping",
     })
     _FLEET_WIDE = frozenset({"shed_storm", "heal_shed"})
 
@@ -275,8 +291,22 @@ class FleetSim:
                 rep.shedder.config.queue_watermark = rep.spec.shed_watermark
         elif ev.kind == "skew":
             r.device.skew = ev.factor
+        elif ev.kind == "slow_decode":
+            # gray: the replica stays alive, polls green, and serves
+            # `factor`x slower — only health-score outlier detection
+            # (and the client's inter-token hedge) route around it
+            r.device.skew = ev.factor
+        elif ev.kind == "wedged_fetch":
+            # gray: the fetch worker stops delivering for `factor`
+            # virtual seconds; liveness stays green — the engine
+            # watchdog must confirm the stall and self-drain
+            r.device.wedge_fetch_until(self.clock.now() + ev.factor)
+        elif ev.kind == "flapping":
+            # gray: compute alternates normal / factor-slow in period_s
+            # windows — the shape that defeats consecutive-failure counts
+            r.device.flap(ev.period_s, ev.factor)
         elif ev.kind == "heal_skew":
-            r.device.skew = 1.0
+            r.device.heal_gray()
         else:
             raise ValueError(f"unknown churn kind {ev.kind!r}")
 
@@ -285,8 +315,9 @@ class FleetSim:
         await self.clock.sleep(after_s)
         await r.restart()
         # recycled-address contract: the fresh process must not inherit
-        # the dead one's breaker state
+        # the dead one's breaker state — or its quarantine
         self.picker.breakers.forget(r.url)
+        self.picker.health.forget(r.url)
 
     async def _drain_restart(self, r: SimReplica, after_s: float,
                              grace_s) -> None:
@@ -295,6 +326,7 @@ class FleetSim:
         await self.clock.sleep(after_s)
         await r.restart()
         self.picker.breakers.forget(r.url)
+        self.picker.health.forget(r.url)
 
     async def _scale_down(self, r: SimReplica, grace_s) -> None:
         await r.drain(grace_s)
@@ -368,7 +400,10 @@ class FleetSim:
                        deadline) -> tuple:
         if deadline is not None and deadline.expired:
             return "deadline_exceeded", None, ckpt, shown
-        pick = self.picker.pick(prompt_ids=req.prompt_ids)
+        # is_canary: this request is a quarantined replica's re-probe —
+        # its completion must be reported as canary proof (a sick canary
+        # fails via the hedge's note_stall or the error paths)
+        pick, is_canary = self.picker.pick_ex(prompt_ids=req.prompt_ids)
         while pick is None and self.hold_queue is not None:
             # the hold-and-replay gateway leg: a request arriving into a
             # zero window (or any no-backend window) parks at the gateway
@@ -389,7 +424,7 @@ class FleetSim:
                 # gone; fall back to the ordinary retry path
                 rec.no_backend += 1
                 return "retry", None, ckpt, shown
-            pick = self.picker.pick(prompt_ids=req.prompt_ids)
+            pick, is_canary = self.picker.pick_ex(prompt_ids=req.prompt_ids)
         if pick is None:
             rec.no_backend += 1
             return "retry", None, ckpt, shown
@@ -428,9 +463,69 @@ class FleetSim:
                     stream = replica.engine.generate(
                         req.prompt_ids, req.sampling_params(),
                         request_id=rid_attempt, adapter=req.adapter)
-            async for out in stream:
+            hedge = self.scenario.hedge_itl_s
+            if hedge is None:
+                # no hedging: the plain iteration — a per-token
+                # ensure_future would add a Task allocation per token to
+                # every pre-gray scenario for nothing
+                async for out in stream:
+                    if out.token_id >= 0:
+                        shown.append(out.token_id)
+                        tl.mark_token(self.clock.now())
+                    if deadline is not None and deadline.expired:
+                        replica.engine.cancel(rid_attempt)
+                        return "deadline_exceeded", None, ckpt, shown
+                    if out.finished:
+                        break
+                self.picker.observe_success(pick.url)
+                if is_canary:
+                    self.picker.observe_canary(pick.url, True)
+                return "completed", None, ckpt, shown
+            it = stream.__aiter__()
+            got_token = False
+            while True:
+                nxt = asyncio.ensure_future(it.__anext__())
+                if got_token:
+                    # stall-triggered migration (docs/resilience.md): an
+                    # inter-token gap past the hedge deadline means this
+                    # stream is parked on a gray replica.  Checkpoint it
+                    # CLIENT-side from the tokens already shown (token-
+                    # exact: the stub chain is a pure function of
+                    # (prompt_len, position)), cancel the sick seat, and
+                    # re-submit to a healthy replica.
+                    timer = asyncio.ensure_future(self.clock.sleep(hedge))
+                    await asyncio.wait({nxt, timer},
+                                       return_when=asyncio.FIRST_COMPLETED)
+                    if not nxt.done():
+                        # cancel BOTH: a stranded hedge timer would sit
+                        # on the SimClock heap and drag finished_at_s
+                        # forward at drain_timers
+                        timer.cancel()
+                        nxt.cancel()
+                        migrated = await self._migrate_stalled(
+                            replica, rid_attempt, nxt, it, pick.url)
+                        if migrated:
+                            new_ckpt = GenerationCheckpoint.capture(
+                                request_id=req.rid,
+                                prompt_ids=req.prompt_ids,
+                                generated=shown,
+                                params=req.sampling_params(),
+                                adapter=req.adapter,
+                                model_name=SIM_MODEL_NAME,
+                                deadline=deadline,
+                                reason="hedge")
+                            rec.migrations += 1
+                            record_generation_migration("hedge")
+                            return "retry", 0.0, new_ckpt, shown
+                    else:
+                        timer.cancel()
+                try:
+                    out = await nxt
+                except StopAsyncIteration:
+                    break
                 if out.token_id >= 0:
                     shown.append(out.token_id)
+                    got_token = True
                     tl.mark_token(self.clock.now())
                 if deadline is not None and deadline.expired:
                     replica.engine.cancel(rid_attempt)
@@ -438,12 +533,19 @@ class FleetSim:
                 if out.finished:
                     break
             self.picker.observe_success(pick.url)
+            if is_canary:
+                self.picker.observe_canary(pick.url, True)
             return "completed", None, ckpt, shown
         except GenerationPreempted as exc:
             rec.resumes += 1
             prev = len(ckpt.generated) if ckpt is not None else 0
             new_ckpt = exc.checkpoint
             rec.salvaged_tokens += max(len(new_ckpt.generated) - prev, 0)
+            if new_ckpt.reason == "stall":
+                # the replica's watchdog confirmed a stall and self-
+                # drained: this resume IS a stall-triggered migration
+                rec.migrations += 1
+                record_generation_migration("stall")
             # 503 + checkpoint: the replica is going away; train the picker
             self.picker.observe_http_error(pick.url)
             return "retry", None, new_ckpt, shown
@@ -464,6 +566,33 @@ class FleetSim:
             rec.crash_restarts += 1
             self.picker.observe_failure(pick.url)
             return "retry", None, ckpt, shown
+
+    async def _migrate_stalled(self, replica, rid_attempt: str,
+                               nxt, it, url: str) -> bool:
+        """Tear down a hedge-stalled stream: unwind the cancelled
+        __anext__, close the generator (its finally releases the engine
+        seat), cancel any residual engine state, and hand the health
+        layer its stall evidence.  Always returns True — whatever the
+        dying stream raised, the client-side checkpoint supersedes it
+        (an engine-side checkpoint racing in here carries at most the
+        same prefix the client already holds in `shown`)."""
+        try:
+            await nxt
+        except (asyncio.CancelledError, StopAsyncIteration):
+            pass
+        except Exception as exc:  # noqa: BLE001 — a concurrent preempt /
+            # crash surfacing in the cancelled step is superseded by the
+            # migration; log for the determinism post-mortems
+            logger.debug("stalled stream %s raised during migration: %s",
+                         rid_attempt, exc)
+        try:
+            await it.aclose()
+        except Exception as exc:  # noqa: BLE001 — same: the stream is dead
+            logger.debug("aclose of stalled stream %s failed: %s",
+                         rid_attempt, exc)
+        replica.engine.cancel(rid_attempt)
+        self.picker.health.note_stall(url)
+        return True
 
     def _account_tokens(self, req: SimRequest, rec: ClientRecord,
                         shown: List[int]) -> None:
@@ -534,6 +663,11 @@ class FleetSim:
             for t in self._churn_subtasks:
                 if not t.done():
                     t.cancel()
+            # watchdog tick loops re-arm a virtual timer every interval
+            # forever — stop them or drain_timers below never empties
+            for r in self.replicas.values():
+                if r.engine is not None:
+                    r.engine.stop_watchdog()
             await self.clock.drain_timers()
             finished_at = self.clock.now()
             for r in self.replicas.values():
@@ -561,7 +695,29 @@ class FleetSim:
             [r.summary() for r in self.replicas.values()],
             faults, finished_at,
             autoscaler=self._autoscaler_summary(),
+            health=self._health_summary(),
         )
+
+    def _health_summary(self) -> Optional[dict]:
+        """The report's gray-failure block: every health transition
+        (quarantine / reintroduce / degrade / restore) with its virtual
+        timestamp — the detection-budget evidence the gray scenario
+        asserts on.  None when the run saw no transitions (keeps
+        pre-gray scenario reports unchanged)."""
+        transitions = self.picker.health.transitions
+        if not transitions:
+            return None
+        counts: Dict[str, int] = {}
+        for _, _, tr in transitions:
+            counts[tr] = counts.get(tr, 0) + 1
+        return {
+            "transitions": [
+                {"at_s": t, "replica": self.by_url[url].name,
+                 "transition": tr}
+                for t, url, tr in transitions
+            ],
+            "counts": dict(sorted(counts.items())),
+        }
 
     def _autoscaler_summary(self) -> Optional[dict]:
         """The report's autoscaler block: every decision (reason-counted),
@@ -618,9 +774,10 @@ class _SimActuator(ReplicaActuator):
             for r in ordered[cur:n]:
                 await r.restart()
                 # recycled-address contract (picker.set_replicas): a fresh
-                # process must not inherit breaker state, and the picker
-                # learns the wake immediately instead of a poll later
+                # process must not inherit breaker or health state, and
+                # the picker learns the wake immediately, not a poll later
                 fleet.picker.breakers.forget(r.url)
+                fleet.picker.health.forget(r.url)
                 fleet.picker.observe_state(r.url, r.state_payload())
         elif n < cur:
             for r in reversed(ordered[n:cur]):

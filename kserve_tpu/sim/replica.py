@@ -61,6 +61,14 @@ class ReplicaSpec:
     # host-RAM spill/demotion tier
     kv_persist: bool = False
     kv_host_gib: float = 0.0
+    # gray-failure watchdog (engine/watchdog.py, docs/resilience.md):
+    # tight budgets are honest here — stub devices never compile, so any
+    # multi-second no-progress window with seated work IS a stall.
+    # suspect + confirm + one tick is the sim's detection budget (~2.5s).
+    watchdog: bool = False
+    watchdog_interval_s: float = 0.25
+    watchdog_suspect_s: float = 1.0
+    watchdog_confirm_s: float = 1.0
 
     def engine_config(self) -> EngineConfig:
         return EngineConfig(
@@ -76,6 +84,10 @@ class ReplicaSpec:
             use_pallas=False,
             kv_offload="host" if self.kv_host_gib > 0 else "none",
             kv_offload_gib=self.kv_host_gib,
+            watchdog=self.watchdog,
+            watchdog_interval_s=self.watchdog_interval_s,
+            watchdog_suspect_s=self.watchdog_suspect_s,
+            watchdog_confirm_s=self.watchdog_confirm_s,
         )
 
 
@@ -134,6 +146,10 @@ class SimReplica:
             "preemptions": 0, "checkpointed": 0, "resumes": 0,
             "finished": 0,
         }
+        # watchdog counters accumulated across engine lives (summary
+        # exports them when spec.watchdog — the gray-failure proof)
+        self.watchdog_totals = {"suspected": 0, "confirmed": 0,
+                                "cancelled_tasks": 0}
         self.prefix_totals = {
             "hits": 0, "misses": 0, "demotions": 0, "pageins": 0,
             "pagein_tokens": 0, "persist_writes": 0, "drops": 0,
@@ -181,9 +197,18 @@ class SimReplica:
         if self.params is None:
             self.params = self.engine.params
         self.engine.fault_plan = self.fault_plan
+        # watchdog readiness flip: a confirmed stall drains the ENGINE
+        # internally; this hook flips the replica's lifecycle so the
+        # poll loop pulls it from picks (readiness red) while the
+        # process — and its checkpoints — stay alive (no hard kill)
+        self.engine.on_stall_confirmed = self._on_stall_confirmed
         self.lifecycle = ReplicaLifecycle(
             clock=self.clock, drain_grace_s=self.spec.drain_grace_s)
         self.lifecycle.mark_ready()
+
+    def _on_stall_confirmed(self, reason: str) -> None:
+        if self.lifecycle is not None and self.lifecycle.accepting:
+            self.lifecycle.begin_drain(0.0)
 
     # ---------------- fleet-facing state ----------------
 
@@ -281,6 +306,16 @@ class SimReplica:
             out[k] = int(stats.get(k, 0) or 0)
         return out
 
+    def _engine_watchdog_stats(self, e) -> dict:
+        out = {k: 0 for k in self.watchdog_totals}
+        wd = getattr(e, "_watchdog", None) if e is not None else None
+        if wd is None:
+            return out
+        out["suspected"] = wd.suspected_count
+        out["confirmed"] = wd.confirmed_count
+        out["cancelled_tasks"] = wd.cancelled_tasks
+        return out
+
     def _accumulate(self) -> None:
         e = self.engine
         self.totals["preemptions"] += e.preemption_count
@@ -289,6 +324,8 @@ class SimReplica:
         self.totals["finished"] += e.telemetry.finished_count
         for k, v in self._engine_prefix_stats(e).items():
             self.prefix_totals[k] += v
+        for k, v in self._engine_watchdog_stats(e).items():
+            self.watchdog_totals[k] += v
 
     def summary(self) -> dict:
         self_totals = dict(self.totals)
@@ -323,6 +360,12 @@ class SimReplica:
             out["prefix_store"] = {
                 k: self.prefix_totals[k] + live[k]
                 for k in sorted(self.prefix_totals)
+            }
+        if self.spec.watchdog:
+            live_wd = self._engine_watchdog_stats(e)
+            out["watchdog"] = {
+                k: self.watchdog_totals[k] + live_wd[k]
+                for k in sorted(self.watchdog_totals)
             }
         return out
 

@@ -54,7 +54,8 @@ def _rounded(stats: Dict[str, Any]) -> Dict[str, Any]:
 def build_report(scenario_name: str, seed: int, records: List[dict],
                  replicas: List[dict], faults: List[tuple],
                  finished_at_s: float,
-                 autoscaler: Optional[Dict[str, Any]] = None
+                 autoscaler: Optional[Dict[str, Any]] = None,
+                 health: Optional[Dict[str, Any]] = None
                  ) -> Dict[str, Any]:
     """Aggregate client records (fleet.ClientRecord.to_dict()) into the
     canonical goodput report."""
@@ -93,6 +94,9 @@ def build_report(scenario_name: str, seed: int, records: List[dict],
                 (r["attempts"] for r in records), default=0),
             "preempt_resumes": sum(r["resumes"] for r in records),
             "crash_restarts": sum(r["crash_restarts"] for r in records),
+            # stall-triggered migrations off gray replicas (hedge fired
+            # client-side, or a watchdog self-drain checkpoint resumed)
+            "migrations": sum(r.get("migrations", 0) for r in records),
             "sheds_observed": sheds,
             # gateway holds are NOT attempts: a parked request burns no
             # retry budget (the hold-and-replay contract)
@@ -115,6 +119,11 @@ def build_report(scenario_name: str, seed: int, records: List[dict],
         # the autoscaler-in-the-loop block (fleet._autoscaler_summary):
         # reason-counted decisions, hold outcomes, warm-pool bill
         report["autoscaler"] = autoscaler
+    if health is not None:
+        # gray-failure block (fleet._health_summary): quarantine /
+        # reintroduce transitions with virtual timestamps — the
+        # detection-budget evidence
+        report["health"] = health
     return report
 
 

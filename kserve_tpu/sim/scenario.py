@@ -21,10 +21,24 @@ resilience FaultPlan machinery:
 - ``skew``           multiply a replica's stub compute costs by `factor`
                      (slow replica); ``heal_skew`` restores
 
-Two canned scenarios back the test suite: `smoke_scenario()` runs in
-tier-1 on every PR; `churn_10k_scenario()` is the acceptance-scale trace
-(10k requests, 4 replicas, preemptions + rolling restart + breaker trip
-+ shed storm) marked slow.
+Gray-fault kinds (docs/resilience.md — the replica stays alive, polls
+green, and passes liveness through all of these; only the watchdog /
+health-score / hedge defense catches them):
+
+- ``slow_decode``    the replica serves `factor`x slower (a degraded
+                     host); ``heal_skew`` restores
+- ``wedged_fetch``   the replica's async device-fetch path delivers
+                     nothing for `factor` virtual seconds — dispatches
+                     land, tokens never arrive; the engine watchdog
+                     must confirm the stall and self-drain
+- ``flapping``       compute alternates normal / `factor`-slow in
+                     `period_s` windows; ``heal_skew`` restores
+
+Canned scenarios back the test suite: `smoke_scenario()` and
+`gray_failure_scenario()` run in tier-1 on every PR;
+`churn_10k_scenario()` is the acceptance-scale trace (10k requests,
+4 replicas, preemptions + rolling restart + breaker trip + shed storm +
+a gray slow-replica leg) marked slow.
 """
 
 from __future__ import annotations
@@ -39,6 +53,7 @@ from ..autoscale.policy import (
     ReactivePolicy,
     ScalingPolicy,
 )
+from ..scheduler.health import HealthConfig
 from .replica import ReplicaSpec
 from .report import SLOBudget
 from .stub import StubCosts
@@ -64,15 +79,22 @@ def _canned_spec() -> ReplicaSpec:
 @dataclass
 class ChurnEvent:
     at_s: float
-    kind: str  # preempt | crash | drain_restart | breaker_trip | shed_storm | heal_shed | skew | heal_skew
+    # preempt | crash | drain_restart | breaker_trip | shed_storm |
+    # heal_shed | skew | heal_skew | slow_decode | wedged_fetch | flapping
+    kind: str
     replica: Optional[str] = None  # e.g. "replica-1" (None = fleet-wide)
     count: int = 1
+    # skew/slow_decode/flapping: the compute multiplier; wedged_fetch:
+    # the wedge duration in virtual seconds
     factor: float = 1.0
     restart_after_s: float = 2.0
     # drain_restart only: drain-budget override (None = the replica's
     # spec default; 0.0 = checkpoint everything in flight immediately —
     # the hard-preemption end of the rolling-restart spectrum)
     grace_s: Optional[float] = None
+    # flapping only: the alternation window (normal for one period,
+    # factor-slow for the next)
+    period_s: float = 2.0
 
 
 @dataclass
@@ -103,6 +125,11 @@ class AutoscalerSpec:
     # so even FIRST scale-ups pay aot_load_s, not compile_s.  False keeps
     # the honest cold-first-build accounting the smoke asserts.
     node_cache_prewarmed: bool = False
+    # wall-clock anchor for the fleet's ArrivalHistory (ROADMAP 1c):
+    # epoch seconds corresponding to virtual t=0, so day-scale periodic
+    # detection can be FABRICATED in the sim ("t=0 is 03:00 UTC").
+    # None = un-anchored (no time-of-day profile, today's behavior).
+    wall_anchor_s: Optional[float] = None
     reactive: ReactiveConfig = field(default_factory=ReactiveConfig)
     predictive: PredictiveConfig = field(default_factory=PredictiveConfig)
 
@@ -127,6 +154,14 @@ class Scenario:
     budget: SLOBudget = field(default_factory=SLOBudget)
     autoscaler: Optional[AutoscalerSpec] = None
     poll_interval_s: float = 0.5
+    # stall-triggered migration (docs/resilience.md): an inter-token gap
+    # past this deadline checkpoints the stream client-side, cancels it
+    # on the (gray-slow) replica, and re-submits it to a healthy one —
+    # token-exact via the stub oracle.  None disables (pre-gray behavior).
+    hedge_itl_s: Optional[float] = None
+    # gray-failure health scoring config for the picker's FleetHealth
+    # (scheduler/health.py); None takes the production defaults
+    health: Optional[HealthConfig] = None
     # generous client persistence: a shed storm resolves in a few virtual
     # seconds, and a client that gives up during one is a goodput loss the
     # scenario is supposed to absorb, not accept
@@ -192,6 +227,83 @@ def smoke_scenario(seed: int = 7) -> Scenario:
             # drain), so its amplification budget is looser than the 2x
             # the 10k acceptance scenario holds the fleet to
             max_retry_amplification=3.0, max_shed_fraction=1.0,
+        ),
+    )
+
+
+def gray_failure_scenario(seed: int = 23) -> Scenario:
+    """Gray-failure immune system, end to end (tier-1; ISSUE 14,
+    docs/resilience.md).  Three replicas serve a mixed trace; mid-burst
+    replica-1 turns 15x slow (``slow_decode`` — alive, polls green,
+    passes liveness) and replica-2's fetch worker wedges
+    (``wedged_fetch`` — dispatches land, tokens never arrive).  The
+    defense has three layers, all exercised here:
+
+    - replica-2's engine WATCHDOG confirms the stall inside its
+      suspect+confirm budget, flips readiness and self-drains — every
+      in-flight token is salvaged into checkpoints (reason="stall") that
+      resume token-exactly on healthy replicas (no kubelet-style hard
+      kill anywhere in this scenario);
+    - the EPP's fleet HEALTH scoring spots replica-1 as a latency
+      outlier vs the fleet median and QUARANTINES it (distinct from
+      breaker-open: no served errors ever happen), weight-reducing
+      first, excluding after;
+    - streams already seated on replica-1 are rescued by the client's
+      inter-token HEDGE: a gap past hedge_itl_s checkpoints the stream
+      client-side, cancels the sick seat, and re-submits — token-exact
+      via the stub oracle.
+
+    replica-1 heals at 16s and must be REINTRODUCED by canary re-probes
+    (quarantine is reversible); replica-2 stays drained (production
+    would restart the pod).  Goodput 1.0, zero lost/duplicated tokens,
+    byte-identical per seed."""
+    return Scenario(
+        name="gray-failure",
+        seed=seed,
+        n_replicas=3,
+        spec=ReplicaSpec(
+            costs=_CANNED_COSTS,
+            watchdog=True,
+            # suspect+confirm+tick ≈ 4.25s detection budget: comfortably
+            # above the slowest single slow-replica dispatch (~1.5s at
+            # 15x — merely-slow must NOT confirm; quarantine handles it)
+            # and far under the client deadlines the stall would burn
+            watchdog_suspect_s=2.0,
+            watchdog_confirm_s=2.0,
+        ),
+        workload=WorkloadConfig(
+            n_requests=60, duration_s=30.0,
+            # burst 1 guarantees in-flight streams on every replica when
+            # the gray faults land; burst 2 provides the post-heal
+            # traffic that refreshes windows and carries the canaries
+            bursts=[(5.0, 10), (14.0, 8)],
+        ),
+        churn=[
+            ChurnEvent(at_s=6.0, kind="slow_decode", replica="replica-1",
+                       factor=15.0),
+            # mid-burst, so replica-2 has seated streams the moment its
+            # fetch worker wedges — the stall clock starts immediately
+            # (a wedge on an idle replica stalls nothing until the next
+            # request lands)
+            ChurnEvent(at_s=5.5, kind="wedged_fetch", replica="replica-2",
+                       factor=60.0),
+            ChurnEvent(at_s=16.0, kind="heal_skew", replica="replica-1"),
+        ],
+        hedge_itl_s=1.0,
+        health=HealthConfig(
+            # sim-scale cadences: canary every 2s so reintroduction fits
+            # inside the trace; grace covers the stale-window refresh
+            reprobe_interval_s=2.0,
+            canary_timeout_s=4.0,
+            heal_successes=2,
+            reintroduce_grace_s=6.0,
+        ),
+        budget=SLOBudget(
+            # TTFT/ITL absorb detection + migration (a rescued stream
+            # pays the hedge gap + one resume re-prefill); what may NOT
+            # happen is a drop or duplicate — goodput stays 1.0
+            p99_ttft_s=20.0, p99_itl_s=6.0, min_goodput=1.0,
+            max_retry_amplification=4.0, max_shed_fraction=1.0,
         ),
     )
 
@@ -428,15 +540,25 @@ def churn_10k_scenario(seed: int = 1234) -> Scenario:
     """The acceptance-scale trace (ISSUE 8): 10k requests over 4 replicas
     with preemptions, a rolling restart, a crash, a breaker trip, a shed
     storm and a slow-replica skew — deterministic on CPU, zero real
-    sleeps, assert_slo-hard."""
+    sleeps, assert_slo-hard.  The gray leg (ISSUE 14): late in the trace
+    replica-2 turns 15x slow while staying alive and pollable; the
+    watchdog + health-quarantine + hedge defense must keep p99 TTFT/ITL
+    inside the same SLO budget — the number a binary-only breaker fleet
+    fails, because nothing in it ever stops routing to a slow-but-200
+    replica."""
     return Scenario(
         name="churn-10k",
         seed=seed,
         n_replicas=4,
         # the prefix-store leg: every node persists its hot prefixes, so
         # the rolling-restart/crash recoveries inside the trace come back
-        # prefix-HOT (pageins > 0 asserted by the slow acceptance test)
-        spec=ReplicaSpec(costs=_CANNED_COSTS, kv_persist=True),
+        # prefix-HOT (pageins > 0 asserted by the slow acceptance test);
+        # watchdog on fleet-wide — the gray leg's backstop, and proof the
+        # monitor stays quiet through 10k requests of ordinary churn
+        spec=ReplicaSpec(costs=_CANNED_COSTS, kv_persist=True,
+                         watchdog=True, watchdog_suspect_s=2.0,
+                         watchdog_confirm_s=2.0),
+        hedge_itl_s=1.5,
         workload=WorkloadConfig(
             n_requests=10_000, duration_s=1200.0,
             # the 300s burst IS the shed storm's trigger; the later bursts
@@ -468,6 +590,12 @@ def churn_10k_scenario(seed: int = 1234) -> Scenario:
                        restart_after_s=5.0),
             ChurnEvent(at_s=800.0, kind="preempt", replica="replica-1",
                        count=3),
+            # the gray leg: replica-2 degrades 20x while alive and
+            # pollable — quarantine + hedge migration must hold the SLO
+            # (20x puts its inter-chunk gap ~1.6s, past the 1.5s hedge)
+            ChurnEvent(at_s=900.0, kind="slow_decode", replica="replica-2",
+                       factor=20.0),
+            ChurnEvent(at_s=980.0, kind="heal_skew", replica="replica-2"),
         ],
         budget=SLOBudget(
             p99_ttft_s=30.0, p99_itl_s=3.0, min_goodput=0.98,

@@ -83,8 +83,24 @@ class StubCosts:
 
 class StubDevice:
     """One replica's device timeline: dispatches accumulate `busy_until`,
-    fetches wait for it.  `skew` (set directly or via a clock_skew fault
-    targeting ``<name>.compute``) multiplies every subsequent cost."""
+    fetches wait for it.  `skew` (set directly or via a clock_skew /
+    slow_decode fault targeting ``<name>.compute``) multiplies every
+    subsequent cost.
+
+    Gray-failure knobs (docs/resilience.md — the replica stays alive and
+    pollable through all of these; detection belongs to the engine
+    watchdog and fleet health scoring, never to liveness):
+
+    - ``wedge_fetch_until(t)`` parks the ASYNC fetch path until virtual
+      time `t`: dispatches land, the fetch worker just never delivers —
+      the stall shape the engine watchdog exists to confirm.  Sync
+      fetches (batched-prefill admission) ignore it: a sync clock jump
+      to the wedge horizon would drag the whole fleet's virtual time
+      forward.
+    - ``flap(period_s, skew)`` alternates compute between normal and
+      ``skew``-slow in `period_s` windows — a flapping host that defeats
+      consecutive-failure counting.
+    """
 
     def __init__(self, name: str, costs: StubCosts, clock):
         self.name = name
@@ -92,23 +108,47 @@ class StubDevice:
         self.clock = clock
         self.busy_until = 0.0
         self.skew = 1.0
+        self.wedged_until = 0.0
+        self.flap_period_s = 0.0
+        self.flap_skew = 1.0
         # resilience.FaultPlan shared with the engine (SimReplica wires it)
         self.fault_plan = None
         self.dispatches = 0
 
+    def wedge_fetch_until(self, until_s: float) -> None:
+        self.wedged_until = max(self.wedged_until, until_s)
+
+    def flap(self, period_s: float, skew: float) -> None:
+        self.flap_period_s = period_s
+        self.flap_skew = skew
+
+    def heal_gray(self) -> None:
+        """Clear every gray-failure knob (the heal_skew churn leg)."""
+        self.skew = 1.0
+        self.wedged_until = 0.0
+        self.flap_period_s = 0.0
+        self.flap_skew = 1.0
+
+    def _effective_skew(self, now: float) -> float:
+        s = self.skew
+        if self.flap_period_s > 0 and int(now / self.flap_period_s) % 2:
+            s *= self.flap_skew
+        return s
+
     def dispatch(self, cost_s: float) -> None:
-        cost = cost_s * self.skew
+        now = self.clock.now()
+        cost = cost_s * self._effective_skew(now)
         if self.fault_plan is not None:
             spec = self.fault_plan.decide(f"{self.name}.compute")
-            if spec is not None and spec.kind == "clock_skew":
+            if spec is not None and spec.kind in ("clock_skew", "slow_decode"):
                 cost *= spec.skew
         self.dispatches += 1
-        self.busy_until = max(self.busy_until, self.clock.now()) + cost
+        self.busy_until = max(self.busy_until, now) + cost
 
     def reset(self) -> None:
         """Fresh device for a restarted replica."""
         self.busy_until = 0.0
-        self.skew = 1.0
+        self.heal_gray()
 
 
 class SimFetcher:
@@ -123,13 +163,22 @@ class SimFetcher:
         self.clock = clock
 
     def fetch(self, fn, timeout_s: float):
+        # sync fetches deliberately ignore the gray wedge (see
+        # StubDevice.wedge_fetch_until): jumping the shared clock to the
+        # wedge horizon would fast-forward the whole fleet
         out = fn()
         self.clock.advance_to(self.device.busy_until)
         return out
 
     async def fetch_async(self, fn, timeout_s: float):
         out = fn()
-        await self.clock.sleep_until(self.device.busy_until)
+        # a gray-wedged fetch worker: the result exists on the "device",
+        # it just never gets delivered until the wedge lifts — liveness
+        # stays green, the step deadline never fires (the sim fetcher
+        # has no wedge deadline by design), and only the engine
+        # watchdog's no-progress detection catches it
+        await self.clock.sleep_until(
+            max(self.device.busy_until, self.device.wedged_until))
         return out
 
     def close(self) -> None:

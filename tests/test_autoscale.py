@@ -75,6 +75,106 @@ class TestSignals:
         assert s.ttft_p99_s == 4.0  # worst ready replica
         assert s.held_requests == 2 and s.demand
 
+    def test_quarantined_replica_excluded_from_ready_count(self):
+        """ISSUE 14 satellite: ReactivePolicy sizes load per READY
+        replica — a gray (quarantined) replica takes no picks, so
+        counting it as ready would SUPPRESS the very scale-up that
+        routes around it."""
+        states = [
+            {"url": "a", "healthy": True, "lifecycle": "READY",
+             "queue_depth": 6, "inflight": 2,
+             "health": {"score": 0.9, "status": "healthy"}},
+            # alive, polls green, 20x slow: quarantined by the health
+            # layer — pickable-capacity-wise it does not exist
+            {"url": "b", "healthy": True, "lifecycle": "READY",
+             "queue_depth": 2, "inflight": 4,
+             "health": {"score": 0.1, "status": "quarantined"}},
+        ]
+        s = FleetSignals.from_replica_states(states, at_s=5.0)
+        assert s.ready_replicas == 1
+        assert s.quarantined_replicas == 1
+        assert s.queue_depth == 6  # the quarantined replica's queue is
+        # not the fleet's serviceable backlog
+        assert s.replicas[1].health_status == "quarantined"
+        # the policy consequence: 6 queued / 1 ready replica is past the
+        # high watermark -> scale up.  With the gray replica counted as
+        # ready (8 queued / 2 = 4, not > 4) the same fleet would HOLD —
+        # the gray replica suppressing the scale-up around itself.
+        policy = ReactivePolicy(ReactiveConfig(
+            queue_high_per_replica=4.0, up_cooldown_s=0.0))
+        decision = policy.decide(s, current=2)
+        assert decision.action == "scale_up"
+        assert decision.reason == "queue_depth"
+        wrong = FleetSignals.from_replica_states(
+            [dict(states[0]), {**states[1], "health": None}], at_s=5.0)
+        assert wrong.ready_replicas == 2  # the pre-fix reading
+        assert ReactivePolicy(ReactiveConfig(
+            queue_high_per_replica=4.0, up_cooldown_s=0.0)).decide(
+                wrong, current=2).action == "hold"
+
+    def test_quarantine_survives_the_wire_round_trip(self):
+        s = FleetSignals.from_replica_states(
+            [{"url": "a", "health": {"status": "quarantined"}}], at_s=0.0)
+        back = FleetSignals.from_dict(s.to_dict())
+        assert back.quarantined_replicas == 1
+        assert back.replicas[0].health_status == "quarantined"
+
+    def test_arrival_history_wall_anchor(self):
+        """ROADMAP 1c seed: an injectable wall anchor maps virtual/
+        monotonic time onto time-of-day so day-scale periodic detection
+        can be fabricated in the sim."""
+        # un-anchored: no wall mapping (today's behavior)
+        h = ArrivalHistory()
+        assert h.wall_time(100.0) is None
+        assert h.time_of_day_s(100.0) is None
+        # anchored: t=0 is 03:00 UTC
+        anchor = 1_700_000_000.0  # 2023-11-14 22:13:20 UTC
+        h2 = ArrivalHistory(wall_anchor_s=anchor)
+        assert h2.wall_time(10.0) == anchor + 10.0
+        assert h2.time_of_day_s(10.0) == pytest.approx(
+            (anchor + 10.0) % 86400.0)
+        # a fabricated "same time tomorrow" lands on the same
+        # seconds-past-midnight bucket — the periodic learner's key
+        assert h2.time_of_day_s(10.0) == pytest.approx(
+            h2.time_of_day_s(10.0 + 86400.0))
+
+    def test_epp_rebases_wall_anchor_onto_its_monotonic_clock(self):
+        """KSERVE_TPU_WALL_ANCHOR is CURRENT epoch seconds, but arrivals
+        are stamped on a monotonic clock whose zero is arbitrary (host
+        boot): the EPP must store anchor - now so wall_time(t) is right,
+        not off by the host's uptime."""
+        import os
+        from unittest import mock
+
+        from kserve_tpu.scheduler.epp import EPPServer
+        from kserve_tpu.scheduler.picker import EndpointPicker
+        from kserve_tpu.resilience import FakeClock
+
+        clock = FakeClock()
+        clock.advance(432_000.0)  # "host up 5 days"
+        picker = EndpointPicker([], clock=clock)
+        anchor_epoch = 1_700_000_000.0
+        with mock.patch.dict(os.environ,
+                             {"KSERVE_TPU_WALL_ANCHOR": str(anchor_epoch)}):
+            server = EPPServer(picker)
+        # an arrival stamped NOW maps to the anchor epoch exactly
+        assert server.arrivals.wall_time(clock.now()) == pytest.approx(
+            anchor_epoch)
+        # malformed values must not take down the fleet's front door
+        with mock.patch.dict(os.environ,
+                             {"KSERVE_TPU_WALL_ANCHOR": "2026-08-04"}):
+            server2 = EPPServer(picker)
+        assert server2.arrivals.wall_anchor_s is None
+
+    def test_sim_plumbs_wall_anchor_through_autoscaler_spec(self):
+        from kserve_tpu.sim import FleetSim, autoscale_smoke_scenario
+
+        scn = autoscale_smoke_scenario()
+        scn.autoscaler.wall_anchor_s = 1_700_000_000.0
+        fleet = FleetSim(scn)
+        assert fleet.arrivals.wall_anchor_s == 1_700_000_000.0
+        assert fleet.arrivals.time_of_day_s(0.0) is not None
+
     def test_shed_block_and_flat_forms_both_parse(self):
         flat = {"url": "a", "sheds_total": 5, "shedding": True}
         nested = {"url": "b", "shed": {"count": 7, "shedding": False}}

@@ -1,0 +1,379 @@
+"""Fleet health scoring + quarantine (kserve_tpu/scheduler/health.py).
+
+Unit layer: outlier scoring vs the fleet median on a FakeClock (slow
+replica quarantined, small fleets never latency-quarantine, errors
+degrade but never quarantine alone, watchdog stall_confirmed is a hard
+trigger).  Picker layer: quarantined replicas are excluded from picks,
+the canary re-probe rides exactly one live request per interval,
+consecutive canary successes reintroduce, an all-quarantined fleet
+recovers instead of deadlocking, and the recycled-url contract holds.
+The FleetSignals layer (quarantine excluded from ready_replicas) is
+covered in tests/test_autoscale.py.
+"""
+
+from kserve_tpu.resilience import FakeClock
+from kserve_tpu.scheduler import EndpointPicker
+from kserve_tpu.scheduler.health import (
+    DEGRADED,
+    HEALTHY,
+    QUARANTINED,
+    FleetHealth,
+    HealthConfig,
+)
+
+
+def state(url, *, ttft=None, itl=None, queue=0, wedged=False,
+          watchdog=None, lifecycle="READY"):
+    s = {
+        "queue_depth": queue, "free_pages": 100, "page_size": 16,
+        "running": True, "wedged": wedged, "prefix_digests": [],
+        "lifecycle": lifecycle,
+        "telemetry": {"ttft_p99_s": ttft, "itl_p99_s": itl},
+    }
+    if watchdog is not None:
+        s["watchdog"] = {"state": watchdog}
+    return s
+
+
+def make_picker(n=3, **kw):
+    clock = FakeClock()
+    urls = [f"http://r{i}:8080" for i in range(n)]
+    picker = EndpointPicker(urls, clock=clock, **kw)
+    return picker, urls, clock
+
+
+def poll(picker, urls, sick=None, sick_kw=None, healthy_kw=None):
+    """One EPP poll cycle: healthy baseline everywhere except `sick`."""
+    for u in urls:
+        if u == sick:
+            picker.observe_state(u, state(u, **(sick_kw or {})))
+        else:
+            picker.observe_state(
+                u, state(u, **(healthy_kw or {"ttft": 0.2, "itl": 0.02})))
+
+
+class TestOutlierScoring:
+    def test_gray_slow_replica_is_quarantined(self):
+        """A replica whose p99s are a big multiple of the fleet median
+        (alive, polls green, no errors — the gray shape) must degrade
+        then quarantine within a handful of polls."""
+        picker, urls, clock = make_picker(3)
+        sick = urls[1]
+        for _ in range(3):
+            poll(picker, urls)
+            clock.advance(0.5)
+        assert picker.health.status(sick) == HEALTHY
+        for _ in range(8):
+            poll(picker, urls, sick=sick,
+                 sick_kw={"ttft": 3.0, "itl": 0.4})  # 15-20x the median
+            clock.advance(0.5)
+        assert picker.health.status(sick) == QUARANTINED
+        # the healthy peers are untouched
+        assert picker.health.status(urls[0]) == HEALTHY
+        assert picker.health.status(urls[2]) == HEALTHY
+        # transitions are logged with timestamps (the detection-budget
+        # evidence the sim report exports)
+        kinds = [tr for _, u, tr in picker.health.transitions if u == sick]
+        assert kinds[-1] == "quarantine"
+
+    def test_two_replica_fleet_never_latency_quarantines(self):
+        """With one peer the 'median' is just the other replica, and
+        ordinary load asymmetry (a drain concentrating traffic on the
+        survivor) would read as sickness — latency/queue outlier
+        scoring needs min_latency_peers."""
+        picker, urls, clock = make_picker(2)
+        for _ in range(20):
+            poll(picker, urls, sick=urls[0],
+                 sick_kw={"ttft": 50.0, "itl": 5.0})
+            clock.advance(0.5)
+        assert picker.health.status(urls[0]) == HEALTHY
+
+    def test_errors_alone_degrade_but_never_quarantine(self):
+        """Served errors are the BREAKER's jurisdiction (and a shedding
+        replica is protecting itself, not gray-failing): the error
+        penalty is floored above the quarantine threshold."""
+        picker, urls, clock = make_picker(3)
+        sick = urls[0]
+        for _ in range(20):
+            for _ in range(4):
+                picker.observe_http_error(sick)
+            poll(picker, urls, sick=sick,
+                 sick_kw={"ttft": 0.2, "itl": 0.02})
+            clock.advance(0.5)
+        assert picker.health.status(sick) == DEGRADED
+        assert picker.health.score(sick) >= picker.health.config.quarantine_below
+
+    def test_watchdog_stall_confirmed_is_a_hard_trigger(self):
+        """One poll showing stall_confirmed quarantines immediately —
+        detection must not wait for the EWMA to drift."""
+        picker, urls, clock = make_picker(3)
+        poll(picker, urls)
+        picker.observe_state(urls[2], state(
+            urls[2], ttft=0.2, itl=0.02, watchdog="stall_confirmed"))
+        assert picker.health.status(urls[2]) == QUARANTINED
+
+    def test_restore_after_degradation_clears(self):
+        picker, urls, clock = make_picker(3)
+        h = picker.health
+        for _ in range(4):
+            h.observe(picker.replicas[urls[0]], picker.replicas.values(),
+                      error_level=8.0)
+        assert h.status(urls[0]) == DEGRADED
+        for _ in range(6):
+            h.observe(picker.replicas[urls[0]], picker.replicas.values())
+        assert h.status(urls[0]) == HEALTHY
+
+
+class TestQuarantineInPicker:
+    def quarantine(self, picker, url):
+        picker.health._h.setdefault(url, None)  # ensure entry exists
+        from kserve_tpu.scheduler.health import ReplicaHealth
+
+        h = ReplicaHealth(score=0.1, status=QUARANTINED,
+                          quarantined_at=picker.clock.now(),
+                          # production contract: first canary one full
+                          # reprobe interval after the quarantine verdict
+                          last_canary_at=picker.clock.now())
+        picker.health._h[url] = h
+        return h
+
+    def test_quarantined_replica_excluded_from_picks(self):
+        picker, urls, clock = make_picker(3)
+        poll(picker, urls)
+        self.quarantine(picker, urls[1])
+        # not yet due a canary (just quarantined): never picked
+        picker.health._h[urls[1]].last_canary_at = clock.now()
+        for _ in range(12):
+            assert picker.pick().url != urls[1]
+
+    def test_canary_rides_exactly_one_request_per_interval(self):
+        picker, urls, clock = make_picker(3)
+        poll(picker, urls)
+        self.quarantine(picker, urls[1])
+        clock.advance(picker.health.config.reprobe_interval_s + 0.1)
+        picks = [picker.pick().url for _ in range(6)]
+        assert picks.count(urls[1]) == 1  # the canary, then excluded again
+
+    def test_canary_successes_reintroduce(self):
+        picker, urls, clock = make_picker(3)
+        poll(picker, urls)
+        self.quarantine(picker, urls[1])
+        heal_n = picker.health.config.heal_successes
+        for _ in range(heal_n):
+            clock.advance(picker.health.config.reprobe_interval_s + 0.1)
+            assert any(picker.pick().url == urls[1] for _ in range(6))
+            picker.observe_canary(urls[1], True)
+        assert picker.health.status(urls[1]) == HEALTHY
+        kinds = [tr for _, u, tr in picker.health.transitions
+                 if u == urls[1]]
+        assert kinds[-1] == "reintroduce"
+
+    def test_failed_canary_resets_the_streak(self):
+        picker, urls, clock = make_picker(3)
+        poll(picker, urls)
+        self.quarantine(picker, urls[1])
+        clock.advance(picker.health.config.reprobe_interval_s + 0.1)
+        assert any(picker.pick().url == urls[1] for _ in range(6))
+        picker.observe_canary(urls[1], True)
+        clock.advance(picker.health.config.reprobe_interval_s + 0.1)
+        assert any(picker.pick().url == urls[1] for _ in range(6))
+        picker.observe_http_error(urls[1])  # canary failed
+        assert picker.health.status(urls[1]) == QUARANTINED
+        h = picker.health._h[urls[1]]
+        assert h.canary_successes == 0
+
+    def test_pre_quarantine_stream_success_is_not_canary_proof(self):
+        """URL-level 2xx signals must NOT count as probe results: a
+        stream seated BEFORE the quarantine completing would otherwise
+        reintroduce the sick replica (review finding) — only
+        observe_canary, attributed to the canary pick, reintroduces."""
+        picker, urls, clock = make_picker(3)
+        poll(picker, urls)
+        self.quarantine(picker, urls[1])
+        clock.advance(picker.health.config.reprobe_interval_s + 0.1)
+        assert any(picker.pick().url == urls[1] for _ in range(6))
+        # pre-quarantine streams keep finishing on the sick replica
+        for _ in range(6):
+            picker.observe_success(urls[1])
+        assert picker.health.status(urls[1]) == QUARANTINED
+        assert picker.health._h[urls[1]].canary_successes == 0
+        assert picker.health._h[urls[1]].canary_inflight  # probe pending
+        # the actual canary reporting back is what counts
+        picker.observe_canary(urls[1], True)
+        assert picker.health._h[urls[1]].canary_successes == 1
+
+    def test_slow_measured_canary_is_not_proof(self):
+        """A canary that served 200 at gray-sick latency (measured TTFT /
+        per-token time vs the fleet medians) proves the sickness, not
+        the health — the streak resets."""
+        picker, urls, clock = make_picker(3)
+        for _ in range(3):
+            poll(picker, urls)  # medians: ttft 0.2, itl 0.02
+            clock.advance(0.5)
+        self.quarantine(picker, urls[1])
+        clock.advance(picker.health.config.reprobe_interval_s + 0.1)
+        assert any(picker.pick().url == urls[1] for _ in range(6))
+        # 200 OK, but ~20x the fleet's per-token median
+        picker.observe_canary(urls[1], True, tpot_s=0.4)
+        assert picker.health.status(urls[1]) == QUARANTINED
+        assert picker.health._h[urls[1]].canary_successes == 0
+        # a FAST canary counts
+        clock.advance(picker.health.config.reprobe_interval_s + 0.1)
+        assert any(picker.pick().url == urls[1] for _ in range(6))
+        picker.observe_canary(urls[1], True, ttft_s=0.2, tpot_s=0.02)
+        assert picker.health._h[urls[1]].canary_successes == 1
+
+    def test_all_quarantined_fleet_recovers_via_canaries(self):
+        """Every replica quarantined must NOT deadlock into permanent
+        503s: canaries are still routed, and successes reintroduce."""
+        picker, urls, clock = make_picker(2)
+        poll(picker, urls)
+        for u in urls:
+            self.quarantine(picker, u)
+        assert picker.pick() is None  # no canary due yet... nothing
+        clock.advance(picker.health.config.reprobe_interval_s + 0.1)
+        r = picker.pick()
+        assert r is not None  # the canary IS the recovery path
+        for _ in range(picker.health.config.heal_successes):
+            picker.observe_canary(r.url, True)
+            clock.advance(picker.health.config.reprobe_interval_s + 0.1)
+            picker.pick()
+        assert picker.health.status(r.url) == HEALTHY
+
+    def test_allow_canary_false_never_hands_out_the_probe(self):
+        """The advisory /pick path cannot report a probe's outcome, so
+        it must never consume one: an unreported canary would burn one
+        real request per interval on the sick replica for nothing."""
+        picker, urls, clock = make_picker(3)
+        poll(picker, urls)
+        self.quarantine(picker, urls[1])
+        clock.advance(picker.health.config.reprobe_interval_s + 0.1)
+        for _ in range(6):
+            r, is_canary = picker.pick_ex(allow_canary=False)
+            assert r.url != urls[1]
+            assert not is_canary
+        # the canary is still armed for a caller that CAN report
+        r, is_canary = picker.pick_ex()
+        assert (r.url, is_canary) == (urls[1], True)
+
+    def test_lost_canary_rearms_after_timeout(self):
+        picker, urls, clock = make_picker(3)
+        poll(picker, urls)
+        self.quarantine(picker, urls[1])
+        clock.advance(picker.health.config.reprobe_interval_s + 0.1)
+        assert any(picker.pick().url == urls[1] for _ in range(6))
+        # the canary never reports back (client gave up); after the
+        # timeout the next interval re-arms instead of waiting forever
+        clock.advance(picker.health.config.canary_timeout_s + 0.1)
+        assert any(picker.pick().url == urls[1] for _ in range(6))
+
+    def test_recycled_url_does_not_inherit_quarantine(self):
+        picker, urls, clock = make_picker(3)
+        poll(picker, urls)
+        self.quarantine(picker, urls[1])
+        picker.set_replicas([urls[0], urls[2]])  # pod gone
+        picker.set_replicas(urls)  # fresh pod on the recycled url
+        assert picker.health.status(urls[1]) == HEALTHY
+
+    def test_snapshot_carries_health_and_watchdog(self):
+        picker, urls, clock = make_picker(3)
+        poll(picker, urls)
+        picker.observe_state(urls[2], state(
+            urls[2], ttft=0.2, itl=0.02, watchdog="stall_confirmed"))
+        rows = {r["url"]: r for r in picker.snapshot()}
+        assert rows[urls[2]]["watchdog"] == "stall_confirmed"
+        assert rows[urls[2]]["health"]["status"] == QUARANTINED
+        assert rows[urls[0]]["health"]["status"] == HEALTHY
+        assert 0.0 <= rows[urls[0]]["health"]["score"] <= 1.0
+
+
+class TestStaleWindowAfterReintroduction:
+    def heal_and_reintroduce(self, picker, urls, sick, clock):
+        """Drive a slow replica into quarantine, heal it, and walk the
+        canary path back to HEALTHY.  Its windows still report the
+        sick-era p99s (it served nothing while quarantined)."""
+        for _ in range(8):
+            poll(picker, urls, sick=sick,
+                 sick_kw={"ttft": 3.0, "itl": 0.4})
+            clock.advance(0.5)
+        assert picker.health.status(sick) == QUARANTINED
+        for _ in range(picker.health.config.heal_successes):
+            clock.advance(picker.health.config.reprobe_interval_s + 0.1)
+            assert any(picker.pick().url == sick for _ in range(6))
+            picker.observe_canary(sick, True)
+        assert picker.health.status(sick) == HEALTHY
+
+    def test_stale_windows_do_not_reflap_and_refresh_resumes_scoring(self):
+        picker, urls, clock = make_picker(3)
+        sick = urls[1]
+        self.heal_and_reintroduce(picker, urls, sick, clock)
+        # the windows still show sick-era values for a long stretch:
+        # NO re-quarantine (the pre-fix behavior flapped forever here)
+        for _ in range(30):
+            poll(picker, urls, sick=sick,
+                 sick_kw={"ttft": 3.0, "itl": 0.4})
+            clock.advance(0.5)
+        assert picker.health.status(sick) == HEALTHY
+        # the windows visibly refresh (healthy traffic displaced the
+        # sick samples): normal scoring resumes...
+        for _ in range(6):
+            poll(picker, urls, sick=sick,
+                 sick_kw={"ttft": 0.2, "itl": 0.02})
+            clock.advance(0.5)
+        assert picker.health.status(sick) == HEALTHY
+        # ...so a later GENUINE re-degradation is caught again (review
+        # finding: a lazily-captured healthy ref used to suppress
+        # latency scoring forever)
+        for _ in range(10):
+            poll(picker, urls, sick=sick,
+                 sick_kw={"ttft": 3.0, "itl": 0.4})
+            clock.advance(0.5)
+        assert picker.health.status(sick) == QUARANTINED
+
+    def test_stale_blindness_is_time_bounded(self):
+        """A near-idle replica's window may never visibly refresh; past
+        stale_max_s the suppression ends regardless."""
+        picker, urls, clock = make_picker(3)
+        sick = urls[1]
+        self.heal_and_reintroduce(picker, urls, sick, clock)
+        clock.advance(picker.health.config.stale_max_s + 1.0)
+        for _ in range(8):
+            poll(picker, urls, sick=sick,
+                 sick_kw={"ttft": 3.0, "itl": 0.4})
+            clock.advance(0.5)
+        assert picker.health.status(sick) == QUARANTINED
+
+
+class TestDegradedWeighting:
+    def test_degraded_replica_loses_pick_share(self):
+        """Weight reduction before quarantine: at equal queue depth the
+        degraded replica must lose the pick."""
+        picker, urls, clock = make_picker(2)
+        poll(picker, urls)
+        from kserve_tpu.scheduler.health import ReplicaHealth
+
+        picker.health._h[urls[1]] = ReplicaHealth(score=0.4, status=DEGRADED)
+        picks = [picker.pick().url for _ in range(6)]
+        assert all(u == urls[0] for u in picks)
+
+
+class TestStallEvidence:
+    def test_note_stall_compounds_toward_quarantine(self):
+        """Hedge-migration evidence alone (no poll signals at all) must
+        be able to quarantine a replica streams keep stalling on."""
+        cfg = HealthConfig()
+        health = FleetHealth(cfg, clock=FakeClock())
+
+        class R:  # the subset of picker.Replica the scorer reads
+            url = "http://r0:8080"
+            healthy = True
+            queue_depth = 0
+            inflight = 0
+            ttft_p99_s = None
+            itl_p99_s = None
+            watchdog = "ok"
+
+        health.observe(R(), [R()])
+        for _ in range(4):
+            health.note_stall(R.url)
+        assert health.status(R.url) == QUARANTINED

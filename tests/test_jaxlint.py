@@ -713,6 +713,67 @@ def test_pagein_host_sync_suppressed():
     assert "pagein-host-sync" not in rules_of(src)
 
 
+# ---------------------------------------------------------- task-leak
+
+BAD_TASK_LEAK = """
+    import asyncio
+
+    async def serve(self):
+        asyncio.create_task(self._poll_loop())  # dropped: GC can kill it
+        asyncio.get_running_loop().create_task(self._watch())  # dropped
+        loop = asyncio.get_event_loop()
+        loop.create_task(self._churn())  # dropped
+"""
+
+GOOD_TASK_LEAK = """
+    import asyncio
+
+    async def serve(self):
+        self._poll_task = asyncio.create_task(self._poll_loop())
+        self._tasks.append(asyncio.create_task(self._client(req)))
+        task = asyncio.get_running_loop().create_task(self._watch())
+        task.add_done_callback(self._tasks.discard)
+        await asyncio.create_task(self._once())  # awaited: held by await
+        return asyncio.create_task(self._run())  # returned to the caller
+"""
+
+
+def test_task_leak_fires_on_dropped_create_task():
+    assert rules_of(BAD_TASK_LEAK).count("task-leak") == 3
+
+
+def test_task_leak_quiet_when_reference_kept():
+    assert "task-leak" not in rules_of(GOOD_TASK_LEAK)
+
+
+def test_task_leak_quiet_on_other_expression_statements():
+    src = """
+        import asyncio
+
+        async def serve(self):
+            self._wake.set()
+            await asyncio.sleep(0)
+    """
+    assert "task-leak" not in rules_of(src)
+
+
+def test_task_leak_suppressed():
+    src = BAD_TASK_LEAK.replace(
+        "asyncio.create_task(self._poll_loop())  # dropped: GC can kill it",
+        "asyncio.create_task(self._poll_loop())  "
+        "# jaxlint: disable=task-leak — fire-and-forget by design",
+    ).replace(
+        "asyncio.get_running_loop().create_task(self._watch())  # dropped",
+        "asyncio.get_running_loop().create_task(self._watch())  "
+        "# jaxlint: disable=task-leak — fire-and-forget by design",
+    ).replace(
+        "loop.create_task(self._churn())  # dropped",
+        "loop.create_task(self._churn())  "
+        "# jaxlint: disable=task-leak — fire-and-forget by design",
+    )
+    assert "task-leak" not in rules_of(src)
+
+
 def test_suppression_budget():
     """≤ 10 jaxlint suppression comments across kserve_tpu/, each carrying
     justification prose in the suppressing comment or the line above."""

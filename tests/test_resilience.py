@@ -276,6 +276,80 @@ class TestFaultPlan:
             assert clock.sleeps == [2.0]  # 0.5s * skew 4
 
     @async_test
+    async def test_slow_decode_kind_scales_latency_then_proceeds(self):
+        """slow_decode is the GRAY shape of clock_skew: the backend is
+        alive and serves everything, just skew-times slower."""
+        clock = FakeClock()
+        plan = FaultPlan([
+            FaultSpec("gray", "slow_decode", latency_s=0.1, skew=20.0,
+                      count=1),
+        ])
+        transport = FaultInjectingTransport(plan, clock=clock)
+        async with httpx.AsyncClient(transport=transport) as client:
+            resp = await client.get("http://gray:8080/v1/x")
+            assert resp.status_code == 200
+            assert clock.sleeps == [pytest.approx(2.0)]  # 0.1s * skew 20
+
+    @async_test
+    async def test_wedged_fetch_kind_is_a_read_timeout(self):
+        """A wedged fetch worker never delivers: from the network's view
+        the read times out while the process stays up (the next call,
+        past count, succeeds — liveness would have stayed green)."""
+        plan = FaultPlan([FaultSpec("gray", "wedged_fetch", count=1)])
+        transport = FaultInjectingTransport(plan, clock=FakeClock())
+        async with httpx.AsyncClient(transport=transport) as client:
+            with pytest.raises(httpx.ReadTimeout, match="wedged"):
+                await client.get("http://gray:8080/v1/x")
+            ok = await client.get("http://gray:8080/v1/x")
+            assert ok.status_code == 200
+
+    @async_test
+    async def test_flapping_kind_alternates_down_and_slow(self):
+        """flapping defeats consecutive-failure counting by design: odd
+        injections are down (connect error), even ones serve slowly —
+        the streak keeps resetting."""
+        clock = FakeClock()
+        plan = FaultPlan([
+            FaultSpec("flap", "flapping", latency_s=0.2, skew=2.0,
+                      count=4),
+        ])
+        transport = FaultInjectingTransport(plan, clock=clock)
+        async with httpx.AsyncClient(transport=transport) as client:
+            outcomes = []
+            for _ in range(4):
+                try:
+                    resp = await client.get("http://flap:8080/v1/x")
+                    outcomes.append(resp.status_code)
+                except httpx.ConnectError:
+                    outcomes.append("down")
+            assert outcomes == ["down", 200, "down", 200]
+            assert clock.sleeps == [pytest.approx(0.4)] * 2
+
+    def test_gray_device_knobs_flap_and_wedge(self):
+        """The sim stub device's gray knobs (kserve_tpu/sim/stub.py):
+        flapping alternates the cost multiplier per period window, the
+        fetch wedge parks only the async path, and heal_gray clears
+        everything."""
+        from kserve_tpu.sim import SimClock, StubCosts, StubDevice
+
+        clock = SimClock()
+        dev = StubDevice("r0", StubCosts(decode_step_s=1.0), clock)
+        dev.flap(period_s=2.0, skew=10.0)
+        dev.dispatch(1.0)  # t=0: window 0 -> normal
+        assert dev.busy_until == pytest.approx(1.0)
+        clock.advance_to(2.5)  # window 1 -> flap-slow
+        dev.dispatch(1.0)
+        assert dev.busy_until == pytest.approx(12.5)
+        dev.heal_gray()
+        clock.advance_to(20.0)
+        dev.dispatch(1.0)
+        assert dev.busy_until == pytest.approx(21.0)
+        dev.wedge_fetch_until(100.0)
+        assert dev.wedged_until == 100.0
+        dev.heal_gray()
+        assert dev.wedged_until == 0.0
+
+    @async_test
     async def test_replica_crash_kind_kills_engine_loop(self):
         """The engine honors replica_crash at its fetch seam: the run loop
         dies (no drain, no checkpoint) and every in-flight stream fails —

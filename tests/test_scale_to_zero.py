@@ -277,6 +277,72 @@ class TestActivatorDataPath:
             await activator.stop()
 
     @async_test
+    async def test_watchdog_flip_after_wake_rehold_then_poison_window(self):
+        """ISSUE 14 satellite: the wake 'succeeds' (readiness goes green,
+        the held cohort replays) but the woken replica's watchdog flips
+        it straight back down before the replay lands (gray stall on
+        arrival: connection refused).  The replayed request must
+        RE-HOLD — not hang, not silently drop — and when the second
+        wake finds the backend dead, the cohort fails fast with 504
+        while follow-up arrivals inside the poison window bounce
+        503 + Retry-After immediately (and fire no redundant wake)."""
+        import types
+
+        wakes = []
+        flipped = {"n": 0}
+
+        async def scale_up():
+            wakes.append(1)
+
+        activator = Activator("http://127.0.0.1:1", scale_up=scale_up,
+                              poll_interval=0.02, wake_timeout=0.3,
+                              hold_timeout_s=10.0, port=0)
+        # scripted replica: readiness is green during the FIRST wake only
+        # (the watchdog flip kills it the moment the cohort replays)
+
+        async def scripted_ready():
+            return flipped["n"] == 0 and len(wakes) >= 1
+
+        activator._backend_is_ready = scripted_ready
+
+        async def flipping_proxy(request, body):
+            # the replayed request finds the listener gone: the watchdog
+            # readiness flip landed between the probe and the replay
+            flipped["n"] += 1
+            raise aiohttp.ClientConnectorError(
+                types.SimpleNamespace(ssl=None, host="b", port=1,
+                                      is_ssl=False),
+                OSError("watchdog flipped readiness"))
+
+        activator._proxy = flipping_proxy
+        act_port = await activator.start()
+        try:
+            async with aiohttp.ClientSession() as session:
+                async def held():
+                    async with session.post(
+                        f"http://127.0.0.1:{act_port}/v1/models/m:predict",
+                        json={},
+                    ) as resp:
+                        return resp.status, resp.headers
+
+                # the cohort: parked at zero, replayed on wake 1, re-held
+                # on the flip, failed by wake 2's timeout — never hung
+                status, _ = await asyncio.wait_for(held(), timeout=10.0)
+                assert status == 504
+                assert len(wakes) == 2  # the re-hold fired a fresh wake
+                assert flipped["n"] == 1  # exactly one replay attempt
+                assert activator.stats["wake_failed"] == 1
+                assert activator.stats["buffered"] == 2  # held, re-held
+                # poison window: fail fast with Retry-After, no new wake
+                status2, headers2 = await asyncio.wait_for(
+                    held(), timeout=10.0)
+                assert status2 == 503
+                assert "Retry-After" in headers2
+                assert len(wakes) == 2
+        finally:
+            await activator.stop()
+
+    @async_test
     async def test_replay_preserves_order_and_checkpoint_headers(self):
         """Released holds replay FIFO and pass generation-checkpoint
         headers through both directions (the resume-through-zero-window

@@ -1,0 +1,261 @@
+"""Gray-failure engine watchdog (kserve_tpu/engine/watchdog.py).
+
+Unit layer: the stall state machine on a FakeClock (suspect -> confirm,
+progress resets, idle never stalls, fetch diagnosis, stalled-task
+reaping).  Integration layer: a real LLMEngine over the sim stub whose
+fetch path wedges mid-generation — the watchdog must confirm the stall,
+flip readiness, and SELF-DRAIN with checkpoints (reason="stall") that
+resume token-exactly on a healthy replica, with no hard kill anywhere.
+"""
+
+import asyncio
+
+import pytest
+
+from conftest import async_test, counter_value
+
+from kserve_tpu.engine.sampling import SamplingParams
+from kserve_tpu.engine.watchdog import (
+    WATCHDOG_CONFIRMED,
+    WATCHDOG_OK,
+    WATCHDOG_SUSPECTED,
+    EngineWatchdog,
+    WatchdogConfig,
+    watchdog_enabled_from_env,
+)
+from kserve_tpu.lifecycle import GenerationPreempted
+from kserve_tpu.metrics import GENERATION_CHECKPOINTS
+from kserve_tpu.resilience import FakeClock
+from kserve_tpu.sim import (
+    ReplicaSpec,
+    SimClock,
+    SimReplica,
+    expected_stream,
+)
+
+
+def make_watchdog(clock, busy=True, tasks=None, **cfg):
+    confirmed = []
+    config = WatchdogConfig(**{
+        "interval_s": 0.25, "suspect_after_s": 1.0, "confirm_after_s": 1.0,
+        **cfg,
+    })
+    wd = EngineWatchdog(
+        config, clock=clock,
+        busy=(busy if callable(busy) else lambda: busy),
+        on_confirmed=confirmed.append,
+        tasks=tasks,
+    )
+    return wd, confirmed
+
+
+class TestStallStateMachine:
+    def test_busy_without_progress_suspects_then_confirms(self):
+        clock = FakeClock()
+        wd, confirmed = make_watchdog(clock)
+        wd.note_progress()
+        wd.tick()
+        assert wd.state == WATCHDOG_OK
+        clock.advance(1.1)  # past suspect_after_s
+        wd.tick()
+        assert wd.state == WATCHDOG_SUSPECTED
+        assert confirmed == []
+        clock.advance(1.1)  # past confirm_after_s
+        wd.tick()
+        assert wd.state == WATCHDOG_CONFIRMED
+        assert confirmed == ["no_progress"]
+        # terminal: further ticks never re-fire the handler
+        clock.advance(5.0)
+        wd.tick()
+        assert confirmed == ["no_progress"]
+
+    def test_progress_clears_a_suspicion(self):
+        clock = FakeClock()
+        wd, confirmed = make_watchdog(clock)
+        clock.advance(1.5)
+        wd.tick()
+        assert wd.state == WATCHDOG_SUSPECTED
+        wd.note_progress()
+        assert wd.state == WATCHDOG_OK
+        clock.advance(0.5)
+        wd.tick()
+        assert wd.state == WATCHDOG_OK
+        assert confirmed == []
+
+    def test_idle_engine_never_stalls(self):
+        clock = FakeClock()
+        wd, confirmed = make_watchdog(clock, busy=False)
+        clock.advance(100.0)
+        wd.tick()
+        assert wd.state == WATCHDOG_OK
+        assert confirmed == []
+
+    def test_going_idle_clears_suspicion_and_resets_baseline(self):
+        clock = FakeClock()
+        busy = {"v": True}
+        wd, confirmed = make_watchdog(clock, busy=lambda: busy["v"])
+        clock.advance(1.5)
+        wd.tick()
+        assert wd.state == WATCHDOG_SUSPECTED
+        busy["v"] = False  # last request finished/cancelled
+        wd.tick()
+        assert wd.state == WATCHDOG_OK
+        busy["v"] = True  # fresh work: a clean window, not instant stall
+        wd.tick()
+        assert wd.state == WATCHDOG_OK
+        assert confirmed == []
+
+    def test_inflight_fetch_diagnosed_as_fetch_stalled(self):
+        clock = FakeClock()
+        wd, confirmed = make_watchdog(clock)
+        wd.fetch_started()
+        clock.advance(1.5)
+        wd.tick()
+        assert wd.state == WATCHDOG_SUSPECTED
+        assert wd.reason == "fetch_stalled"
+        clock.advance(1.5)
+        wd.tick()
+        assert confirmed == ["fetch_stalled"]
+        snap = wd.snapshot()
+        assert snap["state"] == WATCHDOG_CONFIRMED
+        assert snap["reason"] == "fetch_stalled"
+        assert snap["confirmed_total"] == 1
+
+    @async_test
+    async def test_stalled_tracked_task_is_cancelled(self):
+        clock = FakeClock()
+        tasks = set()
+        wd, _ = make_watchdog(clock, busy=False, tasks=lambda: tasks,
+                              task_stall_s=5.0)
+
+        async def never():
+            await asyncio.Event().wait()
+
+        task = asyncio.get_running_loop().create_task(never())
+        task._wd_started_s = clock.now()
+        tasks.add(task)
+        clock.advance(4.0)
+        wd.tick()
+        assert not task.cancelled()
+        clock.advance(2.0)  # past task_stall_s
+        wd.tick()
+        await asyncio.sleep(0)
+        assert task.cancelled()
+        assert wd.cancelled_tasks == 1
+
+    def test_env_knob(self):
+        assert watchdog_enabled_from_env({"KSERVE_TPU_WATCHDOG": "on"})
+        assert watchdog_enabled_from_env({"KSERVE_TPU_WATCHDOG": "1"})
+        assert not watchdog_enabled_from_env({"KSERVE_TPU_WATCHDOG": "off"})
+        assert not watchdog_enabled_from_env({})
+
+
+WD_SPEC = dict(watchdog=True, watchdog_interval_s=0.25,
+               watchdog_suspect_s=1.0, watchdog_confirm_s=1.0)
+
+
+class TestEngineSelfDrain:
+    @async_test
+    async def test_wedged_fetch_confirms_salvages_and_resumes_elsewhere(self):
+        """The gray-failure rescue end to end: the sick engine's fetch
+        worker wedges mid-generation; the watchdog confirms within its
+        budget, readiness flips (admission 503s), the self-drain
+        checkpoints the live stream (reason='stall' — observed on the
+        production metric), and a healthy replica resumes it
+        token-exactly.  No hard kill: the wedged process stays alive
+        and pollable throughout."""
+        clock = SimClock()
+        sick = SimReplica("replica-sick", clock, ReplicaSpec(**WD_SPEC))
+        healthy = SimReplica("replica-ok", clock, ReplicaSpec(**WD_SPEC),
+                             params=sick.params)
+        await sick.start()
+        await healthy.start()
+        stall_ckpts_before = counter_value(
+            GENERATION_CHECKPOINTS, model_name="sim-llm", reason="stall")
+        shown = []
+        caught = {}
+
+        async def consume():
+            try:
+                async for out in sick.engine.generate(
+                        [60, 61, 62],
+                        SamplingParams(max_tokens=24, temperature=0.0,
+                                       ignore_eos=True),
+                        request_id="g1"):
+                    shown.append(out.token_id)
+            except GenerationPreempted as exc:
+                caught["ckpt"] = exc.checkpoint
+
+        task = asyncio.create_task(consume())
+        await clock.drive(until=lambda: len(shown) >= 3)
+        # the fetch worker wedges for 60 virtual seconds: alive, pollable,
+        # delivering nothing
+        wedge_t0 = clock.now()
+        sick.device.wedge_fetch_until(wedge_t0 + 60.0)
+        await clock.drive(until=lambda: task.done())
+        rescued_at = clock.now()
+        # detection inside the configured budget: suspect(1.0) +
+        # confirm(1.0) + tick slack — nowhere near the 60s wedge
+        assert rescued_at - wedge_t0 <= 4.0, (
+            f"stall rescue took {rescued_at - wedge_t0:.2f}s")
+        ckpt = caught["ckpt"]
+        assert ckpt.reason == "stall"
+        assert ckpt.generated == shown  # every in-flight token salvaged
+        assert counter_value(
+            GENERATION_CHECKPOINTS, model_name="sim-llm", reason="stall"
+        ) > stall_ckpts_before
+        # the engine flipped readiness itself (no kubelet involved): the
+        # process is alive, pollable, and refusing new work
+        assert sick.engine.running  # loop parked on the wedge, not dead
+        assert sick.engine.draining
+        assert sick.lifecycle.state == "DRAINING"
+        state = sick.engine.scheduler_state()
+        assert state["watchdog"]["state"] == "stall_confirmed"
+        assert state["watchdog"]["confirmed_total"] == 1
+        with pytest.raises(Exception):
+            sick.engine.generate([1, 2], SamplingParams(max_tokens=2))
+        # token-exact migration: the healthy replica continues the chain
+        cont = []
+
+        async def resume():
+            async for out in healthy.engine.resume_generation(
+                    ckpt, request_id="g1~r1"):
+                cont.append(out.token_id)
+
+        rtask = asyncio.create_task(resume())
+        await clock.drive(until=lambda: rtask.done())
+        assert shown + cont == expected_stream(3, 24)
+        sick.engine.stop_watchdog()
+        healthy.engine.stop_watchdog()
+        await clock.drain_timers()
+        await sick.stop()
+        await healthy.stop()
+
+    @async_test
+    async def test_watchdog_stays_quiet_through_normal_traffic(self):
+        """Ordinary generation — including multi-chunk decodes and queue
+        waits — must never suspect, let alone confirm."""
+        clock = SimClock()
+        replica = SimReplica("replica-q", clock, ReplicaSpec(**WD_SPEC))
+        await replica.start()
+        outs = []
+
+        async def consume():
+            async for out in replica.engine.generate(
+                    [40] * 12,
+                    SamplingParams(max_tokens=16, temperature=0.0,
+                                   ignore_eos=True),
+                    request_id="quiet-1"):
+                outs.append(out.token_id)
+
+        task = asyncio.create_task(consume())
+        await clock.drive(until=lambda: task.done())
+        assert outs == expected_stream(12, 16)
+        wd = replica.engine._watchdog
+        assert wd.state == WATCHDOG_OK
+        assert wd.confirmed_count == 0
+        assert replica.summary()["watchdog"] == {
+            "cancelled_tasks": 0, "confirmed": 0, "suspected": 0}
+        replica.engine.stop_watchdog()
+        await clock.drain_timers()
+        await replica.stop()
