@@ -1,5 +1,6 @@
-"""Rule ``ragged-metadata-host-sync``: host reads of ragged packing
-metadata inside jit-traced code.
+"""Rules ``ragged-metadata-host-sync`` and ``spec-accept-host-sync``:
+host reads of ragged packing / speculative-acceptance metadata inside
+jit-traced code.
 
 The unified ragged program (docs/kernels.md) threads per-sequence packing
 metadata — q_start / q_len / kv_start, the per-token token_seq /
@@ -26,6 +27,17 @@ RAGGED_METADATA_NAMES = {
     "block_seq", "block_qoff", "last_idx",
 }
 
+#: speculative-decoding acceptance/rollback metadata (docs/kernels.md):
+#: per-lane accepted-prefix lengths, emit counts, drafts and the bigram
+#: draft table.  A host cast on any of these inside traced code would
+#: sync the device PER VERIFY ROUND — the accept path must stay
+#: vectorized on device, with the host reading only the once-per-dispatch
+#: fetched (toks, n) outputs.
+SPEC_ACCEPT_NAMES = {
+    "acc", "acc_len", "n_emit", "drafts", "draft_table",
+    "spec_toks", "spec_n",
+}
+
 _SCALAR_CASTS = {"int", "float", "bool"}
 
 
@@ -41,14 +53,13 @@ def _base_name(node: ast.AST):
     return None
 
 
-@register
-class RaggedMetadataHostSync(Rule):
-    id = "ragged-metadata-host-sync"
-    description = (
-        ".item()/int()/float() on ragged packing metadata inside a "
-        "jit-traced function: a per-dispatch device->host sync on the "
-        "mixed program's hot path"
-    )
+class _MetadataHostSync(Rule):
+    """Shared detector: ``.item()`` / scalar casts on a named metadata
+    set inside jit-traced functions."""
+
+    names: frozenset = frozenset()
+    what: str = "metadata"
+    hint: str = ""
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         for fn in ctx.traced_functions():
@@ -62,15 +73,13 @@ class RaggedMetadataHostSync(Rule):
                         isinstance(node.func, ast.Attribute)
                         and node.func.attr == "item"
                         and not node.args
-                        and _base_name(node.func.value)
-                        in RAGGED_METADATA_NAMES
+                        and _base_name(node.func.value) in self.names
                     ):
                         yield self.finding(
                             ctx, node,
                             f"{_base_name(node.func.value)}.item() inside "
-                            "a jit-traced function syncs ragged packing "
-                            "metadata to the host; keep it on device "
-                            "(ops/attention.ragged_token_metadata)",
+                            f"a jit-traced function syncs {self.what} to "
+                            f"the host; {self.hint}",
                         )
                         continue
                     # int(<metadata>) / float(<metadata>) / bool(...)
@@ -78,13 +87,40 @@ class RaggedMetadataHostSync(Rule):
                         isinstance(node.func, ast.Name)
                         and node.func.id in _SCALAR_CASTS
                         and len(node.args) == 1
-                        and _base_name(node.args[0])
-                        in RAGGED_METADATA_NAMES
+                        and _base_name(node.args[0]) in self.names
                     ):
                         yield self.finding(
                             ctx, node,
-                            f"{node.func.id}() on ragged packing metadata "
-                            "inside a jit-traced function is a "
-                            "device->host sync; plan on the host (numpy) "
-                            "or derive on device",
+                            f"{node.func.id}() on {self.what} inside a "
+                            "jit-traced function is a device->host sync; "
+                            f"{self.hint}",
                         )
+
+
+@register
+class RaggedMetadataHostSync(_MetadataHostSync):
+    id = "ragged-metadata-host-sync"
+    description = (
+        ".item()/int()/float() on ragged packing metadata inside a "
+        "jit-traced function: a per-dispatch device->host sync on the "
+        "mixed program's hot path"
+    )
+    names = frozenset(RAGGED_METADATA_NAMES)
+    what = "ragged packing metadata"
+    hint = ("keep it on device (ops/attention.ragged_token_metadata) or "
+            "plan on the host (numpy)")
+
+
+@register
+class SpecAcceptHostSync(_MetadataHostSync):
+    id = "spec-accept-host-sync"
+    description = (
+        ".item()/int()/float() on speculative acceptance/rollback "
+        "metadata inside a jit-traced function: a per-verify-round "
+        "device->host sync on the mixed_decode hot path"
+    )
+    names = frozenset(SPEC_ACCEPT_NAMES)
+    what = "speculative acceptance metadata"
+    hint = ("compute the accepted-prefix/rollback entirely on device "
+            "(engine/compiled.py mixed_decode) — the host reads only the "
+            "once-per-dispatch fetched outputs")

@@ -77,13 +77,24 @@ class CompiledPrograms:
     inject: Callable
     inject_q: Callable
     mixed: Callable = None  # None when the config can't build it (pp>1)
+    # dense decode packing + self-drafting speculative verify
+    # (docs/kernels.md): built only when spec_decode_k is configured —
+    # the pure-decode fast path the engine chains depth-2
+    mixed_decode: Callable = None
 
 
 def build_compiled(model_config, engine_config, mesh,
-                   aot_cache=None) -> CompiledPrograms:
+                   aot_cache=None, spec_k=None) -> CompiledPrograms:
     """`aot_cache` (an engine/aot_cache.AOTExecutableCache) switches the
     program set from lazy ``jax.jit`` to persistent per-signature AOT
-    executables — same call surface, zero compiles on a warm start."""
+    executables — same call surface, zero compiles on a warm start.
+
+    `spec_k` (EngineConfig.spec_decode_k, passed EXPLICITLY so the
+    aot-cache-key-drift lint stays honest: the field is deliberately NOT
+    in the AOT key until hardware-validated, and the engine disables the
+    AOT cache whenever it is set) builds the `mixed_decode` dense/
+    speculative program: K draft tokens per decode lane verified as one
+    ragged multi-token chunk per round."""
     cfg = engine_config
     mc = model_config
 
@@ -431,6 +442,174 @@ def build_compiled(model_config, engine_config, mesh,
 
         return fn
 
+    def _make_mixed_decode(k_drafts: int):
+        """Dense decode packing + self-drafting speculative verify
+        (docs/kernels.md): the decode-only companion of `mixed`, chained
+        depth-2 by the engine on pure-decode steps.
+
+        Every round, each live lane packs a (K+1)-token slice — its last
+        accepted token plus K drafts walked out of a per-lane bigram
+        `draft_table` — at a STATIC stride, writes the slice's KV, runs
+        the ragged forward once, and samples a target token at every
+        slice position.  Acceptance is the vectorized longest prefix of
+        drafts matching the target samples; the lane emits acc+1 tokens
+        (accepted drafts ARE the target's samples there, plus the bonus
+        sample at the rejection/acceptance frontier) and advances kv_len
+        by the same amount.  Rollback costs nothing: rejected-draft KV
+        sits past every causal horizon (never read) and the lane's next
+        slice overwrites it in place.  Emitted tokens are ALWAYS samples
+        from the target distribution — greedy lanes are token-identical
+        to sequential decode, and seeded lanes are too (the per-row rng
+        folds the same (seed, generated-count) pairs sequential decode
+        folds).  K=0 degenerates to dense-packed plain decode: one token
+        per lane per round, no drafts, no table reads.
+
+        Returns ([rounds, B, K+1] target samples, [rounds, B] emit
+        counts, pinned kv_pages, updated draft_table, and the final
+        (token, pos, counters) device carry the engine feeds a chained
+        dispatch without a host round-trip)."""
+        from ..ops.attention import (
+            _should_use_ragged_pallas,
+            dense_stride_for,
+        )
+        from ..ops.pallas_paged_attention import RAGGED_BQ
+
+        Kp = k_drafts + 1
+        kernel_possible = cfg.use_pallas or (
+            cfg.use_pallas is None
+            and _should_use_ragged_pallas(mc.head_dim, jax.default_backend())
+        )
+        align = RAGGED_BQ if kernel_possible else 1
+        sp = dense_stride_for(Kp, align)  # padded slice stride
+        dense_stride = sp if (kernel_possible and sp < RAGGED_BQ) else None
+        dense_attention_fn = None
+        if cfg.tp > 1 or cfg.sp > 1:
+            from ..ops.attention import make_sharded_ragged_attention
+
+            dense_attention_fn = make_sharded_ragged_attention(
+                mesh,
+                logit_softcap=mc.attn_logit_softcap,
+                use_pallas=cfg.use_pallas,
+                quantized=_quantized,
+                scale=mc.attn_scale,
+                dense_stride=dense_stride,
+            )
+
+        def fn(params, tokens, pos, kv_pages, page_table, live, capacity,
+               counters, draft_table, state, rng, adapter_ids):
+            B = tokens.shape[0]
+            rounds = cfg.steps_per_sync
+            T = B * sp
+            lane_of = jnp.repeat(jnp.arange(B, dtype=jnp.int32), sp)  # [T]
+            off = jnp.tile(jnp.arange(sp, dtype=jnp.int32), B)  # [T]
+            in_slice = off < Kp  # rows beyond K+1 are stride padding
+            q_start = jnp.arange(B, dtype=jnp.int32) * sp
+            # packed indices of the real (non-padding) slice rows, in
+            # (lane, offset) order — the verify logits gather
+            logits_at = (
+                jnp.arange(B, dtype=jnp.int32)[:, None] * sp
+                + jnp.arange(Kp, dtype=jnp.int32)[None, :]
+            ).reshape(-1)
+            # per-ROW sampling state: lane i's params replicated over its
+            # K+1 slice rows, so every verify position samples with the
+            # lane's own temperature/top-k/top-p/seed
+            row_state = jax.tree.map(lambda a: jnp.repeat(a, Kp), state)
+            rngs = jax.random.split(rng, rounds)
+            lane_ix = jnp.arange(B)
+
+            def body(carry, step_rng):
+                tok, p, cnt, table, kv_pages = carry
+                # a lane runs a round only when its pages cover the whole
+                # K+1-token write window; starved lanes sit the round out
+                # (the host grows pages between dispatches) — mirrors the
+                # capacity freeze of the plain decode scan
+                ok = live & (p + Kp <= capacity)
+                drafts = []
+                prev = tok
+                for _ in range(k_drafts):
+                    nxt = table[lane_ix, prev]
+                    # unseen bigram: draft the token itself (repetition is
+                    # the cheapest guess; wrong drafts only cost
+                    # acceptance, never correctness)
+                    nxt = jnp.where(nxt >= 0, nxt, prev)
+                    drafts.append(nxt)
+                    prev = nxt
+                slice_toks = jnp.stack([tok] + drafts, axis=1)  # [B, Kp]
+                pad = jnp.zeros((B, sp - Kp), jnp.int32)
+                q_tokens = jnp.concatenate(
+                    [slice_toks, pad], axis=1).reshape(T)
+                token_seq = jnp.where(
+                    ok[lane_of] & in_slice, lane_of, -1)
+                token_pos = p[lane_of] + off
+                q_len = jnp.where(ok, Kp, 0).astype(jnp.int32)
+                logits, kv_pages = llama.forward_ragged(
+                    params, mc, q_tokens, token_seq, token_pos,
+                    q_start, q_len, p, kv_pages, page_table,
+                    cfg.page_size, q_start,  # last_idx unused (logits_at)
+                    adapter_ids=adapter_ids,
+                    attention_fn=dense_attention_fn,
+                    use_pallas=cfg.use_pallas,
+                    logits_at=logits_at,
+                    dense_stride=dense_stride,
+                )  # [B*Kp, V]
+                row_counters = (
+                    cnt[:, None] + jnp.arange(Kp, dtype=cnt.dtype)[None, :]
+                ).reshape(-1)
+                sampled = sample_tokens(
+                    logits, row_state, step_rng, row_counters
+                ).reshape(B, Kp)
+                if k_drafts > 0:
+                    match = (slice_toks[:, 1:] == sampled[:, :-1])
+                    acc = jnp.cumprod(
+                        match.astype(jnp.int32), axis=1).sum(axis=1)
+                else:
+                    acc = jnp.zeros((B,), jnp.int32)
+                n_emit = jnp.where(ok, acc + 1, 0)
+                new_tok = jnp.where(ok, sampled[lane_ix, acc], tok)
+                new_p = p + n_emit
+                new_cnt = cnt + n_emit
+                if k_drafts > 0:
+                    # learn the ACCEPTED chain's bigrams on device:
+                    # (chain[j] -> chain[j+1]) for the emitted prefix —
+                    # masked pairs scatter to a dropped out-of-range lane
+                    chain = jnp.concatenate(
+                        [tok[:, None], sampled], axis=1)  # [B, Kp+1]
+                    srcs = chain[:, :-1].reshape(-1)
+                    dsts = chain[:, 1:].reshape(-1)
+                    pair_off = jnp.tile(jnp.arange(Kp), B)
+                    pair_ok = (
+                        ok[jnp.repeat(lane_ix, Kp)]
+                        & (pair_off <= jnp.repeat(acc, Kp))
+                    )
+                    pair_lane = jnp.where(
+                        pair_ok, jnp.repeat(lane_ix, Kp), B)
+                    table = table.at[pair_lane, srcs].set(
+                        dsts, mode="drop")
+                new_carry = (new_tok, new_p, new_cnt, table, kv_pages)
+                return new_carry, (sampled, n_emit)
+
+            init = (tokens, pos, counters, draft_table, kv_pages)
+            (tok, p, cnt, table, kv_pages), (toks_out, n_out) = (
+                jax.lax.scan(body, init, rngs))
+            # pin the device carries to canonical spellings — the same
+            # settle hazard _kv_pin exists for: the table carry (and
+            # the chained tok/pos/cnt) would otherwise come back with a
+            # differently-SPELLED sharding and buy one retrace on the
+            # next dispatch (tests/test_retrace_budget.py pins the spec
+            # steady state at {mixed: 1, mixed_decode: 1}).  The table
+            # pins to draft_table_pspec — the spelling GSPMD propagates
+            # from the embedding it gathers against (a replicated
+            # constraint is treated as unconstrained and re-spelled);
+            # the engine commits refresh-built tables to the same.
+            rep = shd.named(mesh, _P())
+            pin = lambda a: jax.lax.with_sharding_constraint(a, rep)  # noqa: E731
+            table = jax.lax.with_sharding_constraint(
+                table, shd.named(mesh, shd.draft_table_pspec()))
+            return (toks_out, n_out, _kv_pin(kv_pages), table,
+                    pin(tok), pin(p), pin(cnt))
+
+        return fn
+
     def _make_sample_first(with_logprobs: bool):
         def fn(logits, state, rng, in_prompt):
             # same first-token penalty semantics as the batched prefill:
@@ -473,6 +652,19 @@ def build_compiled(model_config, engine_config, mesh,
         # the mixed program runs the flat per-layer forward; pp>1 engines
         # keep the staged legacy programs (use_ragged forces off there)
         defs["mixed"] = (_make_mixed(), (8,))
+        if spec_k is not None:
+            # kv_pages (3) is the device-resident carry the engine threads
+            # dispatch to dispatch.  The draft table (8) is deliberately
+            # NOT donated: on jaxlib 0.4.36's CPU runtime, donating a
+            # buffer that the program updates in place via scatter inside
+            # a scan corrupts the heap (nondeterministic segfault/abort at
+            # later allocation sites — reproduced at 50-100% per
+            # tests/test_spec_decode.py run, in-bounds indices included,
+            # while kv_pages-only donation is clean under the same loop).
+            # The copy this buys back is one
+            # [B, V] int32 per dispatch; re-donate after a jaxlib upgrade
+            # proves clean under the same stress loop.
+            defs["mixed_decode"] = (_make_mixed_decode(int(spec_k)), (3,))
     if aot_cache is not None:
         # persistent AOT path (engine/aot_cache.py): per-signature
         # executables lowered once and serialized to disk, so a warm

@@ -58,6 +58,7 @@ from ..metrics import (
     GENERATION_RESUMES,
     KV_PAGEIN_SECONDS,
     KV_PREFIX_HIT_TOKENS,
+    SPEC_TOKENS,
     TOKENS_SALVAGED,
 )
 from ..lifecycle.checkpoint import GenerationCheckpoint, GenerationPreempted
@@ -509,11 +510,77 @@ class LLMEngine:
             mixed_ok if engine_config.use_ragged is None
             else bool(engine_config.use_ragged)
         )
+        # speculative decoding + dense decode packing (docs/kernels.md):
+        # spec_decode_k=None keeps today's mixed-only behavior; an int K
+        # adds the decode-only `mixed_decode` program — dense (K+1)-token
+        # slices, on-device draft/verify/accept, depth-2 chaining
+        spec_k = engine_config.spec_decode_k
+        if spec_k is not None:
+            if spec_k < 0:
+                raise ValueError(
+                    f"spec_decode_k must be >= 0, got {spec_k}")
+            if not self._use_mixed:
+                raise NotImplementedError(
+                    "spec_decode_k requires the unified ragged (mixed) "
+                    "path; it does not compose with use_ragged=False, "
+                    "pp>1 or sp>1")
+            from ..ops.attention import dense_stride_for
+
+            stride = dense_stride_for(spec_k + 1, self._ragged_align)
+            if (self._ragged_align > 1
+                    and (engine_config.max_batch_size * stride)
+                    % self._ragged_align):
+                raise ValueError(
+                    "spec_decode_k on the Pallas kernel path needs "
+                    "max_batch_size * padded-slice stride "
+                    f"({engine_config.max_batch_size}*{stride}) to be a "
+                    f"multiple of the {self._ragged_align}-token block")
+            # the [B, V] draft table shards lane rows over the model axis
+            # (sharding.draft_table_pspec) — an indivisible batch would
+            # only surface as a JAX sharding error at the first dense
+            # dispatch, mid-serving
+            tp_size = self.mesh.shape[shd.MODEL_AXIS]
+            if engine_config.max_batch_size % tp_size:
+                raise ValueError(
+                    "spec_decode_k needs max_batch_size "
+                    f"({engine_config.max_batch_size}) divisible by the "
+                    f"tensor-parallel mesh axis ({tp_size}): the draft "
+                    "table shards lane rows over it")
+        self._spec_k = spec_k
+        # worst-case per-lane advance of one dispatch: every round accepts
+        # all K drafts plus the bonus token.  Page growth and the
+        # predictable-finish chain gate both plan against it.
+        self._max_step_advance = engine_config.steps_per_sync * (
+            (spec_k or 0) + 1 if spec_k is not None else 1)
+        # hard per-lane kv ceiling: a dense round needs a full (K+1)-token
+        # write window, so a lane within K tokens of this cap can NEVER
+        # run another dense round — _step_mixed hands such batches to the
+        # plain mixed path (1 token/step, same tokens) for the final
+        # stretch instead of livelocking on capacity-skipped dispatches
+        self._dense_lane_cap = min(
+            engine_config.max_model_len,
+            engine_config.max_pages_per_seq * engine_config.page_size)
+        # per-lane bigram draft table ([B, V] int32 on device, -1 = unseen)
+        # + the dirty-row set driving host re-seeding from prompt +
+        # generated tokens on every batch-composition change (None = all)
+        self._draft_table = None
+        self._draft_dirty: Optional[set] = None
+        self.spec_stats = {"drafted": 0, "accepted": 0, "rejected": 0}
         # per-step mixed composition (prefill-token vs decode-token counts)
         # — exported via ENGINE_STEP_BATCH_COMPOSITION and inspectable by
         # tests/the telemetry endpoint
         self.last_step_composition: Dict[str, int] = {}
         self._build_compiled(compiled_programs)
+        self._dense_ok = (
+            self._use_mixed
+            and self._spec_k is not None
+            and self._mixed_decode_fn is not None
+        )
+        if self._spec_k is not None and not self._dense_ok:
+            logger.info(
+                "spec_decode_k=%s set but the program set has no "
+                "mixed_decode; dense/speculative stepping disabled",
+                self._spec_k)
         if self._mixed_fn is None and self._use_mixed:
             if engine_config.use_ragged:
                 # an EXPLICIT opt-in must not silently serve the legacy
@@ -566,8 +633,19 @@ class LLMEngine:
                         "aot-cache-disabled dir=%s error=%s",
                         self.config.aot_cache_dir,
                         f"{type(exc).__name__}: {exc}")
+            if self.config.spec_decode_k is not None and cache is not None:
+                # spec_decode_k is deliberately NOT in the AOT cache key
+                # until hardware-validated: a spec engine sharing a
+                # non-spec digest would load stale executables, so the
+                # persistent cache is disabled outright for spec engines
+                # (they compile on start like pre-AOT replicas)
+                logger.info(
+                    "aot-cache-disabled: spec_decode_k=%s is not part of "
+                    "the AOT cache key yet", self.config.spec_decode_k)
+                cache = None
             p = build_compiled(
-                self.model_config, self.config, self.mesh, aot_cache=cache)
+                self.model_config, self.config, self.mesh, aot_cache=cache,
+                spec_k=self.config.spec_decode_k)
             self._aot_cache = cache
             if cache is not None:
                 loaded = sum(
@@ -596,6 +674,9 @@ class LLMEngine:
         # the unified ragged program; absent on program sets that predate
         # it (or pp>1 builds), which forces the legacy dispatch paths
         self._mixed_fn = getattr(p, "mixed", None)
+        # dense/speculative decode-only program (docs/kernels.md); present
+        # only when spec_decode_k is configured (stubs included)
+        self._mixed_decode_fn = getattr(p, "mixed_decode", None)
 
     # ---------------- public API ----------------
 
@@ -765,6 +846,12 @@ class LLMEngine:
             # and the autoscaler behind it — sees SLO pressure per replica
             "telemetry": self.telemetry.signal_windows(),
         }
+        if self._spec_k is not None and self._spec_k > 0:
+            # speculative-decoding block (docs/kernels.md): lifetime
+            # draft/accept tallies — accepted/drafted is this replica's
+            # live acceptance rate, the signal a drafter regression
+            # surfaces on before it surfaces as tok/s
+            state["spec"] = dict(self.spec_stats)
         if self._watchdog is not None:
             # gray-failure watchdog block (docs/resilience.md): the EPP's
             # fleet health scoring quarantines on stall_suspected /
@@ -2404,7 +2491,10 @@ class LLMEngine:
         The oldest slot is never preempted, so it always finishes — liveness.
         A single slot that exhausts the whole cache alone is truncated
         honestly (config smaller than one max-length sequence)."""
-        steps = self.config.steps_per_sync
+        # worst-case advance of ONE dispatch: steps_per_sync tokens on the
+        # plain paths, steps_per_sync * (K+1) under speculative decoding
+        # (every round accepts everything)
+        steps = self._max_step_advance
         ps = self.config.page_size
         # chaos seam (resilience/faults.py): a "preempt" spec targeting
         # "engine.preempt" forcibly requeues the newest active sequence —
@@ -2599,7 +2689,8 @@ class LLMEngine:
             else:
                 base = slot.pos
                 tokens[i] = slot.generated[-1]
-            grow = min(steps, self.config.max_model_len - base)
+            grow = min(self._max_step_advance,
+                       self.config.max_model_len - base)
             if grow <= 0:
                 if prev is None:
                     self._finish(slot, "length")  # genuinely at max_model_len
@@ -2697,11 +2788,82 @@ class LLMEngine:
         self._penalty_dirty_rows = set()
 
     def _mark_penalty_dirty(self, slot_index: Optional[int]) -> None:
-        """Record a batch-composition change; None invalidates everything."""
+        """Record a batch-composition change; None invalidates everything.
+        The speculative draft table shares the same dirty tracking: any
+        seat/finish/preempt that changes a row's occupant must re-seed
+        that row from the new occupant's prompt + generated tokens."""
         if slot_index is None:
             self._penalty_dirty_rows = None
-        elif self._penalty_dirty_rows is not None:
-            self._penalty_dirty_rows.add(slot_index)
+            self._draft_dirty = None
+        else:
+            if self._penalty_dirty_rows is not None:
+                self._penalty_dirty_rows.add(slot_index)
+            if self._draft_dirty is not None:
+                self._draft_dirty.add(slot_index)
+
+    def _refresh_draft_table(self) -> None:
+        """Bring the device [B, V] bigram draft table up to date for rows
+        whose occupant changed: each dirty row is re-seeded host-side from
+        prompt + generated bigrams (later occurrences win — numpy fancy
+        assignment applies in order), empty rows reset to -1 (unseen).
+        Rows that stayed resident are NOT touched: the device keeps the
+        bigrams it learned from accepted tokens between dispatches.
+
+        Every path commits the table to ONE replicated NamedSharding —
+        the spelling the program pins its table output to.  A host-fresh
+        table (UnspecifiedValue) and a device-output table would
+        otherwise be two different jit signatures: one retrace per
+        composition change (the kv_pages settle hazard again, pinned by
+        tests/test_retrace_budget.py)."""
+        if self._spec_k is None or self._spec_k == 0:
+            if self._spec_k == 0 and self._draft_table is None:
+                # K=0 (dense packing alone): the program never reads the
+                # table, but the signature still carries one — a [B, 1]
+                # placeholder keeps the dispatch shape static
+                self._draft_table = jax.device_put(
+                    jnp.zeros((self.config.max_batch_size, 1), jnp.int32),
+                    self._table_sharding)
+            return
+        V = self.model_config.vocab_size
+        B = self.config.max_batch_size
+
+        def row_data(i):
+            row = np.full((V,), -1, np.int32)
+            slot = self._slots[i]
+            if slot.request_id is not None and slot.prefilling is None:
+                seq = np.asarray(
+                    slot.prompt_ids + slot.generated, np.int64)
+                if seq.shape[0] >= 2:
+                    row[seq[:-1]] = seq[1:]
+            return row
+
+        if self._draft_table is None or self._draft_dirty is None:
+            self._draft_table = jnp.asarray(
+                np.stack([row_data(i) for i in range(B)]))
+        elif self._draft_dirty:
+            idx = sorted(self._draft_dirty)
+            rows = np.stack([row_data(i) for i in idx])
+            self._draft_table = self._draft_table.at[
+                jnp.asarray(idx)].set(jnp.asarray(rows))
+        self._draft_table = jax.device_put(
+            self._draft_table, self._table_sharding)
+        self._draft_dirty = set()
+
+    @property
+    def _replicated_sharding(self):
+        """The canonical replicated NamedSharding small per-lane control
+        arrays commit to before a mixed_decode dispatch, matching the
+        program's pinned output spelling (one jit signature whether the
+        array came from the host or from a previous dispatch's carry)."""
+        return shd.named(self.mesh, jax.sharding.PartitionSpec())
+
+    @property
+    def _table_sharding(self):
+        """Commit target for the draft table: the spelling GSPMD settles
+        the mixed_decode table output on (parallel/sharding.py
+        draft_table_pspec) — refresh-built and dispatch-output tables
+        must share one jit signature."""
+        return shd.named(self.mesh, shd.draft_table_pspec())
 
     def _dispatch_chunk(self, meta: dict, tokens_dev=None):
         """Launch one decode chunk (async); tokens_dev chains the previous
@@ -2887,6 +3049,25 @@ class LLMEngine:
         self._set_occupancy_gauges(self._active_decode_slots())
         if meta is None and not prefilling:
             return False
+        if self._dense_ok and not prefilling and meta is not None:
+            # pure-decode step with the dense/speculative program
+            # available: every lane packs a (K+1)-token slice at the
+            # dense stride, K draft tokens verify per round, and the
+            # next dispatch chains on this one's device carries
+            # (docs/kernels.md) — the decode-heavy fast path.  A lane
+            # within K tokens of its hard kv ceiling can never fit
+            # another full (K+1)-token slice: the whole batch runs the
+            # plain mixed path for that lane's final stretch (<= K+1
+            # tokens, token-identical) instead of dispatching rounds the
+            # device would skip forever.
+            kp = (self._spec_k or 0) + 1
+            if all(
+                s.request_id is None or not meta["active"][i]
+                or s.pos + kp <= self._dense_lane_cap
+                for i, s in enumerate(self._slots)
+            ):
+                await self._step_dense(meta)
+                return True
         plan = self._plan_ragged(meta, prefilling)
         dispatched_at = self._clock.now()
         rng = jax.random.fold_in(self._base_rng, self._next_step())
@@ -3123,6 +3304,247 @@ class LLMEngine:
         GENERATED_TOKENS.labels(model_name=self._mlabel).inc(routed)
         if routed or plan["chunks"]:
             self._note_progress()
+
+    # ---------------- dense / speculative decode stepping ----------------
+
+    def _plan_dense(self, meta: dict) -> dict:
+        """Host inputs for one `mixed_decode` dispatch, derived from a
+        _prepare_chunk meta (growth + preemption already ran there).  The
+        draft table is re-seeded for dirty rows first, so every lane's
+        drafter knows its prompt + everything emitted so far."""
+        self._refresh_draft_table()
+        return {
+            "tokens": meta["tokens"],
+            "pos": meta["pos"],
+            "live": meta["active"],
+            "capacity": meta["capacity"],
+            "counters": meta["counters"],
+            "adapters": meta["adapters"],
+            "page_table": meta["page_table"],
+            "state": meta["state"],
+        }
+
+    def _plan_dense_chained(self, prev: dict) -> Optional[dict]:
+        """Plan a dispatch chained on an in-flight one: positions, tokens
+        and counters come from the DEVICE carry (never fetched), so the
+        host only refreshes what it owns — page capacity (grown toward
+        the worst case of two in-flight dispatches) and the page table.
+        No preemption while the pipeline is busy, same as the legacy
+        depth-2 chain."""
+        B = self.config.max_batch_size
+        adv = self._max_step_advance
+        kp = (self._spec_k or 0) + 1
+        live = prev["live"]
+        capacity = np.zeros((B,), np.int32)
+        max_owned = 1
+        any_live = False
+        for i, slot in enumerate(self._slots):
+            if slot.request_id is None or not live[i]:
+                continue
+            if slot.pos + adv + kp > self._dense_lane_cap:
+                # the in-flight dispatch may carry this lane into the
+                # zone where no further (K+1)-token slice fits its hard
+                # kv ceiling — drain the pipeline instead of chaining a
+                # dispatch the device could only skip (the unchained
+                # re-plan falls back to the mixed path for the stretch)
+                return None
+            # device pos after the in-flight dispatch is at most
+            # slot.pos + adv; cover one more full dispatch beyond that,
+            # capped at max_model_len — positions past it can never hold
+            # usable tokens, and growing pages for them steals allocator
+            # headroom from other lanes (same cap _prepare_chunk applies)
+            grow = min(2 * adv, self.config.max_model_len - slot.pos)
+            if grow > 0:
+                self._ensure_pages_at(slot, slot.pos, grow)
+            capacity[i] = len(slot.pages) * self.config.page_size
+            max_owned = max(max_owned, len(slot.pages))
+            any_live = True
+        if not any_live:
+            return None
+        width = self.config.page_bucket(max_owned)
+        page_table = np.zeros((B, width), np.int32)
+        for i, slot in enumerate(self._slots):
+            if slot.request_id is not None and live[i]:
+                page_table[i, : len(slot.pages)] = slot.pages
+        return {
+            "tokens": prev["tokens"],  # unused (device carry chains)
+            "pos": prev["pos"],
+            "live": live,
+            "capacity": capacity,
+            "counters": prev["counters"],
+            "adapters": prev["adapters"],
+            "page_table": page_table,
+            "state": prev["state"],
+        }
+
+    def _dispatch_dense(self, plan: dict, chain: Optional[dict] = None):
+        """Launch one mixed_decode dispatch; `chain` threads the previous
+        dispatch's device (token, pos, counters) carry so the chained
+        program starts exactly where the in-flight one ends — no host
+        round-trip between them."""
+        plan["_dispatched_at"] = self._clock.now()
+        rng = jax.random.fold_in(self._base_rng, self._next_step())
+        if chain is not None:
+            tok, pos, cnt = chain["carry"]
+        else:
+            # committed to the same replicated spelling the program pins
+            # its carry outputs to: chained and unchained dispatches must
+            # share ONE jit signature (see _refresh_draft_table)
+            rep = self._replicated_sharding
+            tok = jax.device_put(jnp.asarray(plan["tokens"]), rep)
+            pos = jax.device_put(jnp.asarray(plan["pos"]), rep)
+            cnt = jax.device_put(jnp.asarray(plan["counters"]), rep)
+        out = self._mixed_decode_fn(
+            self.params,
+            tok,
+            pos,
+            self.kv_pages,
+            jnp.asarray(plan["page_table"]),
+            jnp.asarray(plan["live"]),
+            jnp.asarray(plan["capacity"]),
+            cnt,
+            self._draft_table,
+            plan["state"],
+            rng,
+            jnp.asarray(plan["adapters"]),
+        )
+        toks, n_emit_dev, self.kv_pages, self._draft_table, tok_o, pos_o, cnt_o = out
+        return {"toks": toks, "n": n_emit_dev, "carry": (tok_o, pos_o, cnt_o)}
+
+    async def _route_dense(self, plan: dict, chunk: dict) -> bool:
+        """Consume one mixed_decode dispatch: per round, each live lane
+        emits its accepted-prefix + bonus tokens (0 when the round was
+        skipped for capacity).  Slots evicted while the dispatch was in
+        flight are observed empty and their tokens discarded — only
+        ACCEPTED, routed tokens ever reach slot.generated, so checkpoints
+        (drain/preempt/hedge) can never carry an unverified draft tail.
+        Returns (any lane finished, any token routed)."""
+        toks_np = await self._fetch_async(chunk["toks"])  # [rounds, B, K+1]
+        n_np = await self._fetch_async(chunk["n"])  # [rounds, B]
+        step_s = self._clock.now() - plan["_dispatched_at"]
+        ENGINE_STEP_DURATION.labels(model_name=self._mlabel).observe(step_s)
+        self.telemetry.record_step(step_s)
+        k_drafts = self._spec_k or 0
+        rounds = toks_np.shape[0]
+        live = plan["live"]
+        routed = 0
+        drafted = 0
+        accepted = 0
+        finished_any = False
+        for i, slot in enumerate(self._slots):
+            if not live[i]:
+                continue
+            if slot.request_id is None:
+                # evicted (cancel/preempt/drain) while the dispatch was in
+                # flight: the whole lane is discarded — no stream consumed
+                # its drafts, so the acceptance-rate signal skips it too
+                finished_any = True
+                continue
+            for r in range(rounds):
+                n = int(n_np[r, i])
+                if n <= 0:
+                    continue  # capacity-skipped round (or inactive)
+                emitted = 0
+                for j in range(n):
+                    token = int(toks_np[r, i, j])
+                    slot.pos += 1
+                    slot.generated.append(token)
+                    self._emit(slot, token)
+                    routed += 1
+                    emitted += 1
+                    if slot.request_id is None:
+                        break  # finished at this token; discard the tail
+                # count only what the stream actually consumed: of the
+                # emitted tokens, all but the round's bonus sample are
+                # accepted drafts (a mid-round finish consumed drafts
+                # only), keeping spec_stats an emitted-token-exact signal
+                drafted += k_drafts
+                accepted += min(emitted, n - 1)
+                if slot.request_id is None:
+                    finished_any = True
+                    break
+            if (slot.request_id is not None
+                    and slot.pos >= self.config.max_model_len):
+                self._finish(slot, "length")
+                finished_any = True
+        GENERATED_TOKENS.labels(model_name=self._mlabel).inc(routed)
+        if k_drafts > 0:
+            s = SPEC_TOKENS
+            s.labels(model_name=self._mlabel, outcome="drafted").inc(drafted)
+            s.labels(model_name=self._mlabel, outcome="accepted").inc(accepted)
+            s.labels(model_name=self._mlabel,
+                     outcome="rejected").inc(drafted - accepted)
+            self.spec_stats["drafted"] += drafted
+            self.spec_stats["accepted"] += accepted
+            self.spec_stats["rejected"] += drafted - accepted
+        comp = {
+            "prefill_tokens": 0,
+            # token counts, matching the mixed program's semantics: each
+            # live lane contributes a (K+1)-token verify slice to the
+            # packed buffer per round
+            "decode_tokens": int(np.count_nonzero(live)) * (k_drafts + 1),
+            "spec_accepted_tokens": accepted,
+        }
+        self.last_step_composition = comp
+        g = ENGINE_STEP_BATCH_COMPOSITION
+        for role, value in comp.items():
+            g.labels(model_name=self._mlabel, role=role).set(value)
+        if routed or finished_any:
+            self._note_progress()
+        return finished_any, routed > 0
+
+    async def _step_dense(self, meta: dict) -> None:
+        """Dense/speculative decode with the depth-2 dispatch pipeline
+        restored on the mixed path: dispatch N+1 launches — chained on
+        N's device (token, pos, counters) carry — before N's tokens are
+        fetched, so draft+verify of step N+1 overlaps routing of step N
+        and the host round-trip hides behind device compute."""
+        plan = self._plan_dense(meta)
+        chunk = self._dispatch_dense(plan)
+        while True:
+            plan2 = None
+            chunk2 = None
+            admission_blocked = (
+                not self._waiting or self._free_slot_index() is None
+            )
+            # a lane guaranteed to hit max_tokens inside the in-flight
+            # dispatch forces a pipeline drain anyway — don't chain into
+            # a dispatch that would be wholly discarded
+            predictable_finish = any(
+                s.request_id is not None
+                and plan["live"][i]
+                and len(s.generated) + self._max_step_advance
+                >= s.params.max_tokens
+                for i, s in enumerate(self._slots)
+            )
+            if (
+                admission_blocked
+                and not predictable_finish
+                and not (self._stopped or self._draining)
+            ):
+                plan2 = self._plan_dense_chained(plan)
+            if plan2 is not None:
+                chunk2 = self._dispatch_dense(plan2, chain=chunk)
+                self._pipeline_busy = True
+            finished_any, routed_any = await self._route_dense(plan, chunk)
+            # flush streams while the chained dispatch runs on device
+            await asyncio.sleep(0)
+            if chunk2 is None:
+                break
+            plan, chunk = plan2, chunk2
+            if (finished_any or not routed_any
+                    or self._stopped or self._draining or (
+                        self._waiting
+                        and self._free_slot_index() is not None)):
+                # in-flight dispatch has stale lanes, admission can
+                # proceed, or every round was capacity-skipped (the
+                # lanes need host-side growth or the mixed-path ceiling
+                # fallback): drain the pipeline and re-plan
+                self._pipeline_busy = False
+                await self._route_dense(plan, chunk)
+                break
+        self._pipeline_busy = False
+        self._flush_deferred_frees()
 
     def _emit(self, slot: _Slot, token: int,
               logprob: Optional[float] = None,

@@ -147,6 +147,26 @@ class EngineConfig:
     # Requests needing per-step logprobs or sampling penalties fall back
     # to the legacy programs per engine iteration even when ragged is on.
     use_ragged: Optional[bool] = None
+    # speculative decoding + dense decode packing (docs/kernels.md):
+    # None = off (default — the mixed program alone, today's behavior).
+    # An int K >= 0 enables the decode-only `mixed_decode` program: all
+    # decode lanes pack DENSELY at a static (K+1)-token stride (no more
+    # one-kernel-block-per-lane waste) and each of the steps_per_sync
+    # rounds drafts K tokens per lane from an on-device per-lane bigram
+    # table (seeded host-side from the prompt + generated tokens, updated
+    # on device from accepted tokens), verifies them as ONE ragged
+    # multi-token chunk through the paged cache, accepts the vectorized
+    # longest-matching prefix plus the target's bonus sample, and rewinds
+    # by simply not advancing kv_len — rejected draft KV sits beyond every
+    # causal horizon and is overwritten in place.  K=0 is dense packing
+    # alone (no drafts).  Emitted tokens are ALWAYS target-model samples;
+    # greedy streams are token-identical to spec-off.  Requires the
+    # unified ragged path (use_ragged); lanes needing per-step logprobs or
+    # penalties fall back per iteration like the mixed path does.
+    # Deliberately NOT in the AOT cache key until validated on hardware:
+    # enabling it disables the persistent AOT executable cache for this
+    # engine (engine._build_compiled logs the downgrade).
+    spec_decode_k: Optional[int] = None
     # gray-failure watchdog (engine/watchdog.py, docs/resilience.md): a
     # clock-injectable monitor that tracks loop heartbeat, dispatch
     # progress, fetch-worker liveness and tracked-task stalls; a
@@ -185,6 +205,31 @@ class EngineConfig:
         while b < n_pages:
             b *= 2
         return min(b, self.max_pages_per_seq)
+
+
+def spec_decode_k_from_env() -> Optional[int]:
+    """$KSERVE_TPU_SPEC_DECODE_K -> EngineConfig.spec_decode_k: unset or
+    empty = off (None); an integer >= 0 enables speculative decoding /
+    dense packing with that K.  Malformed values are logged and ignored
+    rather than crash-looping the server on a typo'd env var (the same
+    contract the autoscaler's wall-anchor env follows)."""
+    import os
+
+    raw = os.environ.get("KSERVE_TPU_SPEC_DECODE_K", "").strip()
+    if not raw:
+        return None
+    try:
+        k = int(raw)
+        if k < 0:
+            raise ValueError("negative")
+        return k
+    except ValueError:
+        from ..logging import logger
+
+        logger.warning(
+            "ignoring malformed KSERVE_TPU_SPEC_DECODE_K=%r (want an "
+            "integer >= 0)", raw)
+        return None
 
 
 class EngineWedgedError(RuntimeError):

@@ -246,8 +246,23 @@ XLA_COMPILES = Counter(
 ENGINE_STEP_BATCH_COMPOSITION = Gauge(
     "engine_step_batch_composition",
     "decode-batch slots by role at the latest engine step "
-    "(decoding | prefilling | free)",
+    "(decoding | prefilling | free); under the unified ragged program the "
+    "roles are token counts (prefill_tokens | decode_tokens), and with "
+    "speculative decoding additionally spec_accepted_tokens — the latest "
+    "dispatch's accepted-draft length",
     ["model_name", "role"],
+)
+# Speculative decoding (docs/kernels.md): `outcome` is the closed
+# drafted | accepted | rejected set.  accepted/drafted is the fleet's
+# live acceptance rate; every ACCEPTED token is also counted in
+# engine_generated_tokens_total (these series classify drafts, they do
+# not double-count output).
+SPEC_TOKENS = Counter(
+    "engine_spec_tokens_total",
+    "speculative-decoding draft tokens by outcome (drafted | accepted | "
+    "rejected); bonus target samples are ordinary generated tokens and "
+    "are not counted here",
+    ["model_name", "outcome"],
 )
 
 # Replica startup phases (kserve_tpu/engine/aot_cache.py — docs/coldstart.md).

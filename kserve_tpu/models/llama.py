@@ -733,6 +733,12 @@ def forward_ragged(
     adapter_ids: Optional[jnp.ndarray] = None,  # [B] LoRA ids (-1 = base)
     attention_fn=None,  # sharded ragged attention for tp>1 (ops/attention)
     use_pallas: Optional[bool] = None,
+    logits_at: Optional[jnp.ndarray] = None,  # [N] packed indices: return
+    # logits at EVERY listed token instead of one per lane — the
+    # speculative-verify surface (docs/kernels.md), where each position of
+    # a K+1-token slice needs its own next-token distribution
+    dense_stride: Optional[int] = None,  # static dense-packing stride for
+    # the Pallas kernel (lanes share blocks; None = solo-block invariant)
 ) -> Tuple[jnp.ndarray, List[jnp.ndarray]]:
     """The unified mixed-batch forward (docs/kernels.md): every lane
     contributes an arbitrary-length query slice — a whole prompt, a prompt
@@ -778,6 +784,7 @@ def forward_ragged(
                 use_pallas=use_pallas,
                 scale=config.attn_scale,
                 window=window,
+                dense_stride=dense_stride,
             )
         attn_flat = attn.reshape(T, 1, -1)
         attn = _maybe_add(
@@ -794,6 +801,9 @@ def forward_ragged(
             out = _norm(out, layer["post_mlp_norm"], config)
         x = residual + out
         new_pages.append(pages)
+    if logits_at is not None:
+        x_sel = x[logits_at, 0]  # [N, h]
+        return _logits(params, x_sel[:, None], config)[:, 0], new_pages
     x_last = x[last_idx, 0]  # [B, h]
     return _logits(params, x_last[:, None], config)[:, 0], new_pages
 

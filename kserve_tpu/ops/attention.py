@@ -381,6 +381,32 @@ def _should_use_ragged_pallas(d: int, backend: str) -> bool:
     return d % 128 == 0 and backend == "tpu"
 
 
+def dense_stride_for(width: int, align: int) -> int:
+    """Packed-slice stride for lanes carrying `width` query tokens each
+    under a kernel block alignment of `align` (RAGGED_BQ on the kernel
+    path, 1 on the XLA reference — docs/kernels.md dense packing).
+
+    - align <= 1 (XLA reference): pack densely, stride == width.
+    - width a multiple of align, or larger than it: round up to the next
+      align multiple — every block still belongs to ONE lane, so the solo
+      kernel's invariant holds unchanged.
+    - width < align: the smallest power of two >= width (align is a power
+      of two, so the result divides it) — lanes SHARE blocks at this
+      stride and the dense-block kernel variant serves them.  This is
+      what stops a single-token decode lane burning a whole align-token
+      block (K+1-token speculative slices included)."""
+    if width <= 0:
+        raise ValueError(f"slice width must be positive, got {width}")
+    if align <= 1 or width % align == 0:
+        return width
+    if width > align:
+        return -(-width // align) * align
+    sp = 1
+    while sp < width:
+        sp *= 2
+    return sp
+
+
 def ragged_paged_attention(
     q: jnp.ndarray,  # [T, nq, d]
     kv_pages,
@@ -392,6 +418,9 @@ def ragged_paged_attention(
     use_pallas: Optional[bool] = None,
     scale: Optional[float] = None,
     window=None,  # traced int32 scalar (None = full attention)
+    dense_stride: Optional[int] = None,  # static lane stride for dense
+    # decode/spec-verify packing (< RAGGED_BQ shares blocks between lanes;
+    # ignored by the XLA reference, which is per-token already)
 ) -> jnp.ndarray:
     """Dispatch the ragged contract between the fused Pallas kernel and the
     XLA gather reference.  The ragged kernel (unlike the decode kernel)
@@ -408,6 +437,7 @@ def ragged_paged_attention(
         return ragged_paged_attention_pallas(
             q, kv_pages, page_table, q_start, q_len, kv_start,
             window=window, logit_softcap=logit_softcap, scale=scale,
+            dense_stride=dense_stride,
         )
     return ragged_paged_attention_xla(
         q, kv_pages, page_table, q_start, q_len, kv_start,
@@ -422,6 +452,8 @@ def make_sharded_ragged_attention(
     quantized: bool = False,
     interpret: bool = False,
     scale: Optional[float] = None,
+    dense_stride: Optional[int] = None,  # static: the spec-verify dense
+    # packing stride (compiled.py builds a second sharded fn with it set)
 ):
     """Ragged paged attention under `shard_map` over the model (head) axis
     — same seam as make_sharded_paged_attention: q heads and KV heads shard
@@ -448,11 +480,11 @@ def make_sharded_ragged_attention(
             return ragged_paged_attention_pallas(
                 q, kv_pages, page_table, q_start, q_len, kv_start,
                 window=window, logit_softcap=logit_softcap, scale=scale,
-                interpret=True)
+                interpret=True, dense_stride=dense_stride)
         return ragged_paged_attention(
             q, kv_pages, page_table, q_start, q_len, kv_start,
             logit_softcap=logit_softcap, use_pallas=use_pallas,
-            scale=scale, window=window)
+            scale=scale, window=window, dense_stride=dense_stride)
 
     return shard_map(
         inner,

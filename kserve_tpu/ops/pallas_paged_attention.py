@@ -553,6 +553,201 @@ def _ragged_kernel(
     )
 
 
+def _dense_ragged_kernel(
+    # scalar prefetch (SMEM)
+    page_table_ref,  # [B, W] int32
+    kv_start_ref,  # [B] int32 — history length per lane
+    q_len_ref,  # [B] int32 — valid slice tokens (<= sp; 0 = inactive)
+    window_ref,  # [1] int32 — sliding window (0 = full attention)
+    # inputs
+    q_ref,  # [BQ, nq, d] VMEM block
+    kv_hbm_ref,  # [num_pages, 2, nkv, ps, d] in HBM (int8 when quantized)
+    *rest,  # (scales_hbm?) out_ref, kv_bufs, kv_sems, (s_bufs, s_sems?)
+    sp: int,
+    page_size: int,
+    num_kv_heads: int,
+    head_dim: int,
+    scale: float,
+    logit_softcap: float,
+    quantized: bool,
+):
+    """Dense-block variant of `_ragged_kernel` (docs/kernels.md): every BQ
+    block holds L = BQ // sp lanes at a STATIC stride of `sp` query rows
+    each — the speculative-decode packing, where lane i's verify slice
+    (its last token + K drafts, padded to sp) sits at offset i*sp.  The
+    one-sequence-per-block invariant is relaxed to
+    one-sequence-per-STRIDE-SLOT: row j belongs to relative lane j // sp,
+    a static index, so the compute stays the decode kernel's batched
+    [L, nkv, rows, ·] shape while each iteration streams page i of all L
+    member lanes concurrently (L DMAs, like the decode kernel's SB)."""
+    if quantized:
+        scales_hbm_ref, out_ref, kv_bufs, kv_sems, s_bufs, s_sems = rest
+    else:
+        out_ref, kv_bufs, kv_sems = rest
+        scales_hbm_ref = s_bufs = s_sems = None
+
+    g = pl.program_id(0)
+    lanes = q_ref.shape[0] // sp  # L member lanes per block (static)
+    nq = q_ref.shape[1]
+    group = nq // num_kv_heads
+    rows = sp * group
+
+    kv0 = jnp.stack(
+        [kv_start_ref[g * lanes + l] for l in range(lanes)]
+    ).reshape(lanes, 1, 1, 1)
+    qn = jnp.stack(
+        [q_len_ref[g * lanes + l] for l in range(lanes)]
+    ).reshape(lanes, 1, 1, 1)
+    # keys each lane needs; a lane with no valid query rows (inactive, or
+    # capacity-starved mid-dispatch with a large kv_start) must not drive
+    # the page loop — all its rows are masked, so streaming its history
+    # would be pure wasted DMA
+    kv_hi = jnp.where(qn > 0, kv0 + qn, 0)
+    max_hi = kv_hi.max()
+    num_pages = (max_hi + page_size - 1) // page_size
+
+    def start_iter(i, slot):
+        for l in range(lanes):
+            # inactive lanes' padded table entries are the null page — a
+            # valid, masked-out fetch (same contract as the decode kernel)
+            page = page_table_ref[g * lanes + l, i]
+            pltpu.make_async_copy(
+                kv_hbm_ref.at[page], kv_bufs.at[slot, l], kv_sems.at[slot, l]
+            ).start()
+            if quantized:
+                pltpu.make_async_copy(
+                    scales_hbm_ref.at[page], s_bufs.at[slot, l],
+                    s_sems.at[slot, l]
+                ).start()
+
+    for j in range(NBUF - 1):
+        @pl.when(j < num_pages)
+        def _(j=j):
+            start_iter(j, j)
+
+    # [L, nkv, sp*group, d]: row r*group+j is the lane's query token r,
+    # q-head group j — the decode kernel's batched shape with sp query
+    # rows per lane instead of one
+    q = (
+        q_ref[...].astype(jnp.float32)
+        .reshape(lanes, sp, num_kv_heads, group, head_dim)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(lanes, num_kv_heads, rows, head_dim)
+    )
+    rowq = jax.lax.broadcasted_iota(jnp.int32, (1, 1, rows, 1), 2) // group
+    qpos = kv0 + rowq  # absolute position per query row
+    qvalid = rowq < qn
+    w = window_ref[0]
+
+    def body(i, carry):
+        m, l_, acc = carry
+        slot = jax.lax.rem(i, NBUF)
+        for l in range(lanes):
+            pltpu.make_async_copy(
+                kv_hbm_ref.at[0], kv_bufs.at[slot, l], kv_sems.at[slot, l]
+            ).wait()
+            if quantized:
+                pltpu.make_async_copy(
+                    scales_hbm_ref.at[0], s_bufs.at[slot, l],
+                    s_sems.at[slot, l]
+                ).wait()
+
+        @pl.when(i + NBUF - 1 < num_pages)
+        def _():
+            start_iter(i + NBUF - 1, jax.lax.rem(i + NBUF - 1, NBUF))
+
+        k = kv_bufs[slot, :, 0].astype(jnp.float32)  # [L, nkv, ps, d]
+        v = kv_bufs[slot, :, 1].astype(jnp.float32)
+        if quantized:
+            k = k * s_bufs[slot, :, 0].astype(jnp.float32)[..., None]
+            v = v * s_bufs[slot, :, 1].astype(jnp.float32)[..., None]
+        s_ = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((3,), (3,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [L, nkv, rows, ps]
+        if logit_softcap > 0.0:
+            s_ = jnp.tanh(s_ / logit_softcap) * logit_softcap
+        kpos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, 1, page_size), 3)
+        mask = (kpos <= qpos) & qvalid
+        mask = mask & ((qpos - kpos < w) | (w <= 0))
+        s_ = jnp.where(mask, s_, -1e30)
+        m_new = jnp.maximum(m, s_.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s_ - m_new)
+        l_new = l_ * alpha + p.sum(axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v,
+            dimension_numbers=(((3,), (2,)), ((0, 1), (0, 1))),
+            preferred_element_type=jnp.float32,
+        )  # [L, nkv, rows, d]
+        return m_new, l_new, acc * alpha + pv
+
+    m0 = jnp.full((lanes, num_kv_heads, rows, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((lanes, num_kv_heads, rows, 1), jnp.float32)
+    acc0 = jnp.zeros((lanes, num_kv_heads, rows, head_dim), jnp.float32)
+    m, l_, acc = jax.lax.fori_loop(0, num_pages, body, (m0, l0, acc0))
+    # rows past q_len (slice padding / inactive lanes) never see a valid
+    # key: mask them to exact zero (same contract as the solo-block kernel)
+    out = jnp.where(qvalid, acc / jnp.maximum(l_, 1e-30), 0.0)
+    out_ref[...] = (
+        out.reshape(lanes, num_kv_heads, sp, group, head_dim)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(lanes * sp, nq, head_dim)
+        .astype(out_ref.dtype)
+    )
+
+
+def _dense_ragged_call(q, pages, scales, page_table, q_len, kv_start, win,
+                       sp, logit_softcap, scale, interpret):
+    """pallas_call plumbing for the dense-stride kernel (shared scratch
+    ring shape with the solo kernel, widened to L pages per iteration)."""
+    T, nq, d = q.shape
+    quantized = scales is not None
+    nkv, ps = pages.shape[2], pages.shape[3]
+    lanes = RAGGED_BQ // sp
+    kernel = functools.partial(
+        _dense_ragged_kernel,
+        sp=sp,
+        page_size=ps,
+        num_kv_heads=nkv,
+        head_dim=d,
+        scale=float(scale),
+        logit_softcap=logit_softcap,
+        quantized=quantized,
+    )
+    in_specs = [
+        pl.BlockSpec((RAGGED_BQ, nq, d), lambda g, *_: (g, 0, 0)),
+        pl.BlockSpec(memory_space=_HBM),
+    ]
+    scratch = [
+        pltpu.VMEM((NBUF, lanes) + pages.shape[1:], pages.dtype),
+        pltpu.SemaphoreType.DMA((NBUF, lanes)),
+    ]
+    operands = [q, pages]
+    if quantized:
+        in_specs.append(pl.BlockSpec(memory_space=_HBM))
+        scratch += [
+            pltpu.VMEM((NBUF, lanes) + scales.shape[1:], scales.dtype),
+            pltpu.SemaphoreType.DMA((NBUF, lanes)),
+        ]
+        operands.append(scales)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(T // RAGGED_BQ,),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (RAGGED_BQ, nq, d), lambda g, *_: (g, 0, 0)),
+            scratch_shapes=scratch,
+        ),
+        out_shape=jax.ShapeDtypeStruct((T, nq, d), q.dtype),
+        interpret=interpret,
+    )(page_table, kv_start, q_len, win, *operands)
+
+
 def ragged_paged_attention_pallas(
     q: jnp.ndarray,  # [T, nq, d] — packed at RAGGED_BQ-aligned offsets
     kv_pages,  # [num_pages, 2, nkv, ps, d] or (int8 pages, scales)
@@ -564,6 +759,9 @@ def ragged_paged_attention_pallas(
     logit_softcap: float = 0.0,
     scale: Optional[float] = None,
     interpret: bool = False,
+    dense_stride: Optional[int] = None,  # static lane stride < RAGGED_BQ:
+    # lane i's slice sits at offset i*dense_stride and blocks hold
+    # BQ // dense_stride lanes (the speculative-verify packing)
 ) -> jnp.ndarray:
     T, nq, d = q.shape
     if T % RAGGED_BQ != 0:
@@ -582,6 +780,24 @@ def ragged_paged_attention_pallas(
         nkv, ps = kv_pages.shape[2], kv_pages.shape[3]
     if scale is None:
         scale = 1.0 / float(d) ** 0.5
+    if dense_stride is not None and dense_stride < RAGGED_BQ:
+        # dense-block packing (speculative verify): lanes share blocks at
+        # a static stride, so the one-sequence-per-block invariant becomes
+        # one-sequence-per-stride-slot (_dense_ragged_kernel)
+        if RAGGED_BQ % dense_stride != 0:
+            raise ValueError(
+                f"dense_stride {dense_stride} must divide RAGGED_BQ="
+                f"{RAGGED_BQ}")
+        B = page_table.shape[0]
+        if B * dense_stride != T:
+            raise ValueError(
+                f"dense packing expects T == B*stride "
+                f"({B}*{dense_stride}), got T={T}")
+        win = jnp.reshape(jnp.asarray(
+            window if window is not None else 0, jnp.int32), (1,))
+        return _dense_ragged_call(
+            q, pages, scales, page_table, q_len, kv_start, win,
+            dense_stride, logit_softcap, scale, interpret)
     G = T // RAGGED_BQ
     block_seq, block_qoff = _ragged_block_metadata(q_start, q_len, G, RAGGED_BQ)
     win = jnp.reshape(jnp.asarray(

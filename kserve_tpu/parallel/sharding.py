@@ -135,6 +135,18 @@ def kv_pages_pspec() -> P:
     return P(None, None, MODEL_AXIS, None, None)
 
 
+def draft_table_pspec() -> P:
+    """[B, V] speculative-decoding bigram draft table — lane rows over
+    the model axis.  This is the spelling GSPMD propagates onto the
+    table from the embedding/lm_head it interacts with inside
+    mixed_decode (a fully-replicated constraint is treated as
+    UNconstrained and re-spelled); the engine commits the host-seeded
+    table to the same spelling so refresh-built and dispatch-output
+    tables share one jit signature (the donated-kv_pages settle lesson,
+    tests/test_retrace_budget.py)."""
+    return P(MODEL_AXIS, None)
+
+
 def stacked_kv_pages_pspec() -> P:
     """[L, num_pages, 2, n_kv, ps, d] — pipeline mode: the layer axis
     shards over pipe (each stage holds its own layers' KV) and the KV-head

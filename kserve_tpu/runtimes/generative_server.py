@@ -22,6 +22,7 @@ import os
 from typing import AsyncIterator, List, Optional, Union
 
 from ..engine.aot_cache import aot_cache_dir_from_env
+from ..engine.types import spec_decode_k_from_env
 from ..engine.watchdog import watchdog_enabled_from_env
 from ..kvstore.persist import kv_persist_dir_from_env
 from ..engine.engine import EngineConfig, LLMEngine
@@ -811,6 +812,15 @@ def main(argv=None):
         "or kubelet; defaults to $KSERVE_TPU_WATCHDOG (off).  Enable "
         "once a warm AOT cache keeps steady-state dispatch compile-free",
     )
+    parser.add_argument(
+        "--spec_decode_k", default=None, type=int,
+        help="speculative decoding + dense decode packing "
+        "(docs/kernels.md): K draft tokens per lane verified per round "
+        "inside the dense mixed_decode program (0 = dense packing "
+        "alone); defaults to $KSERVE_TPU_SPEC_DECODE_K (off).  Greedy "
+        "and seeded streams stay token-identical to spec-off.  Disables "
+        "the AOT executable cache until hardware-validated",
+    )
     args = parser.parse_args(argv)
 
     model_config = _NAMED_CONFIGS[args.model_config]() if args.model_config else None
@@ -836,6 +846,8 @@ def main(argv=None):
         kv_persist_dir=args.kv_persist_dir or kv_persist_dir_from_env(),
         watchdog=(args.watchdog == "on" if args.watchdog is not None
                   else watchdog_enabled_from_env()),
+        spec_decode_k=(args.spec_decode_k if args.spec_decode_k is not None
+                       else spec_decode_k_from_env()),
     )
     lora_adapters = None
     if args.lora_adapters:

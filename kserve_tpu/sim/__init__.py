@@ -34,6 +34,7 @@ from .scenario import (  # noqa: F401
     prefix_store_scenario,
     scale_zero_scenario,
     smoke_scenario,
+    spec_decode_scenario,
 )
 from .stub import (  # noqa: F401
     SimFetcher,
@@ -44,5 +45,6 @@ from .stub import (  # noqa: F401
     expected_stream,
     stub_first_token,
     stub_next_token,
+    stub_spec_accept,
 )
 from .workload import SimRequest, WorkloadConfig, generate_trace  # noqa: F401

@@ -69,6 +69,12 @@ class ReplicaSpec:
     watchdog_interval_s: float = 0.25
     watchdog_suspect_s: float = 1.0
     watchdog_confirm_s: float = 1.0
+    # speculative decoding + dense decode packing (docs/kernels.md):
+    # None = off (byte-identical pre-spec traces); K >= 0 enables the
+    # stub's mixed_decode oracle — deterministic chain-state-seeded
+    # acceptance, so spec-on traces stay token-exact across preemption,
+    # checkpoint and cross-replica resume
+    spec_decode_k: Optional[int] = None
 
     def engine_config(self) -> EngineConfig:
         return EngineConfig(
@@ -88,6 +94,7 @@ class ReplicaSpec:
             watchdog_interval_s=self.watchdog_interval_s,
             watchdog_suspect_s=self.watchdog_suspect_s,
             watchdog_confirm_s=self.watchdog_confirm_s,
+            spec_decode_k=self.spec_decode_k,
         )
 
 
@@ -150,6 +157,10 @@ class SimReplica:
         # exports them when spec.watchdog — the gray-failure proof)
         self.watchdog_totals = {"suspected": 0, "confirmed": 0,
                                 "cancelled_tasks": 0}
+        # speculative-decoding tallies across engine lives (summary
+        # exports them when spec.spec_decode_k — the acceptance-rate and
+        # spec-actually-engaged evidence the scenarios assert on)
+        self.spec_totals = {"drafted": 0, "accepted": 0, "rejected": 0}
         self.prefix_totals = {
             "hits": 0, "misses": 0, "demotions": 0, "pageins": 0,
             "pagein_tokens": 0, "persist_writes": 0, "drops": 0,
@@ -316,6 +327,14 @@ class SimReplica:
         out["cancelled_tasks"] = wd.cancelled_tasks
         return out
 
+    def _engine_spec_stats(self, e) -> dict:
+        out = {k: 0 for k in self.spec_totals}
+        if e is None:
+            return out
+        for k in out:
+            out[k] = int(getattr(e, "spec_stats", {}).get(k, 0))
+        return out
+
     def _accumulate(self) -> None:
         e = self.engine
         self.totals["preemptions"] += e.preemption_count
@@ -326,6 +345,8 @@ class SimReplica:
             self.prefix_totals[k] += v
         for k, v in self._engine_watchdog_stats(e).items():
             self.watchdog_totals[k] += v
+        for k, v in self._engine_spec_stats(e).items():
+            self.spec_totals[k] += v
 
     def summary(self) -> dict:
         self_totals = dict(self.totals)
@@ -366,6 +387,12 @@ class SimReplica:
             out["watchdog"] = {
                 k: self.watchdog_totals[k] + live_wd[k]
                 for k in sorted(self.watchdog_totals)
+            }
+        if self.spec.spec_decode_k is not None:
+            live_sp = self._engine_spec_stats(e)
+            out["spec_decode"] = {
+                k: self.spec_totals[k] + live_sp[k]
+                for k in sorted(self.spec_totals)
             }
         return out
 

@@ -308,6 +308,48 @@ def gray_failure_scenario(seed: int = 23) -> Scenario:
     )
 
 
+def spec_decode_scenario(seed: int = 31, k_drafts: int = 4) -> Scenario:
+    """Speculative decoding under churn (tier-1; ISSUE 15,
+    docs/kernels.md): two spec-enabled replicas serve a decode-heavy
+    trace while the churn layer preempts lanes MID-VERIFY (the faults
+    land between dispatches, with speculative verify chunks in flight on
+    either side) and zero-grace-drains a replica so its checkpointed
+    streams resume token-exactly on the peer.  The stub's chain-state-
+    seeded acceptance pattern makes the accept/reject sequence itself
+    deterministic AND resume-invariant, so the goodput report proves the
+    spec contract end to end: checkpoints carry only ACCEPTED tokens
+    (never an unverified draft tail), zero lost / zero duplicated tokens
+    across preempt + drain + resume, byte-identical per seed."""
+    return Scenario(
+        name="spec-decode",
+        seed=seed,
+        n_replicas=2,
+        spec=ReplicaSpec(costs=_CANNED_COSTS, spec_decode_k=k_drafts),
+        workload=WorkloadConfig(
+            n_requests=50, duration_s=25.0,
+            # decode-heavy: mostly chat/batch generation with a burst so
+            # the preempts and the drain land on in-flight verify rounds
+            mix={"chat": 0.7, "batch": 0.3},
+            bursts=[(6.0, 12)],
+        ),
+        churn=[
+            ChurnEvent(at_s=6.3, kind="preempt", replica="replica-0",
+                       count=2),
+            ChurnEvent(at_s=6.6, kind="preempt", replica="replica-1",
+                       count=1),
+            # zero-grace drain mid-burst: everything in flight —
+            # including lanes whose last dispatch was a verify chunk —
+            # checkpoints out and resumes on the peer, token-exact
+            ChurnEvent(at_s=7.0, kind="drain_restart", replica="replica-0",
+                       restart_after_s=2.0, grace_s=0.0),
+        ],
+        budget=SLOBudget(
+            p99_ttft_s=20.0, p99_itl_s=2.0, min_goodput=0.95,
+            max_retry_amplification=3.0, max_shed_fraction=1.0,
+        ),
+    )
+
+
 def scale_zero_scenario(seed: int = 11) -> Scenario:
     """Serverless elasticity (ROADMAP item 3, docs/coldstart.md): the
     fleet scales 0→N→0 under deterministic traffic.  Both replicas build
@@ -536,7 +578,8 @@ def autoscale_burst_scenario(policy: str, seed: int = 21,
     )
 
 
-def churn_10k_scenario(seed: int = 1234) -> Scenario:
+def churn_10k_scenario(seed: int = 1234,
+                       spec_decode_k: Optional[int] = None) -> Scenario:
     """The acceptance-scale trace (ISSUE 8): 10k requests over 4 replicas
     with preemptions, a rolling restart, a crash, a breaker trip, a shed
     storm and a slow-replica skew — deterministic on CPU, zero real
@@ -555,9 +598,14 @@ def churn_10k_scenario(seed: int = 1234) -> Scenario:
         # prefix-HOT (pageins > 0 asserted by the slow acceptance test);
         # watchdog on fleet-wide — the gray leg's backstop, and proof the
         # monitor stays quiet through 10k requests of ordinary churn
+        # spec_decode_k=None keeps the canonical trace byte-identical to
+        # its pre-spec self; the slow acceptance suite runs a SECOND leg
+        # with speculation on fleet-wide (zero lost/duplicated tokens at
+        # 10k scale, byte-identical per seed — ISSUE 15)
         spec=ReplicaSpec(costs=_CANNED_COSTS, kv_persist=True,
                          watchdog=True, watchdog_suspect_s=2.0,
-                         watchdog_confirm_s=2.0),
+                         watchdog_confirm_s=2.0,
+                         spec_decode_k=spec_decode_k),
         hedge_itl_s=1.5,
         workload=WorkloadConfig(
             n_requests=10_000, duration_s=1200.0,

@@ -52,6 +52,17 @@ def stub_next_token(prev: int, pos: int) -> int:
     return SAFE_LO + ((prev - SAFE_LO) * 7 + pos * 13 + 29) % SAFE_BAND
 
 
+def stub_spec_accept(prev: int, pos: int, k_drafts: int) -> int:
+    """Tokens emitted by one speculative verify round for a lane whose
+    chain state is (prev token, kv position): 1..k_drafts+1, a pure
+    function of the CHAIN STATE — not of wall time, lane index or batch
+    composition — so the acceptance pattern is byte-identical per seed
+    AND resumes token-exactly: a checkpointed stream re-seated anywhere
+    replays the same accept/reject sequence the uninterrupted stream had
+    (the `expected_stream` oracle keeps holding with speculation on)."""
+    return 1 + (prev * 7 + pos * 11 + 3) % (k_drafts + 1)
+
+
 def expected_stream(prompt_len: int, n_tokens: int) -> List[int]:
     """The exact token stream a request with `prompt_len` prompt tokens
     generates — the goodput report's token-accounting oracle."""
@@ -79,6 +90,22 @@ class StubCosts:
     # is assertable in tier-1.  Default 0 keeps pre-AOT scenarios unchanged.
     compile_s: float = 0.0
     aot_load_s: float = 0.0
+    # speculative decoding (docs/kernels.md): each mixed_decode round
+    # costs one decode step PLUS this much per draft token verified — the
+    # ragged multi-token chunk is more compute than a single-token step,
+    # but far less than K separate dispatches.  With the stub's seeded
+    # acceptance pattern (avg (K+2)/2 tokens per round) the default makes
+    # decode-heavy spec traffic >2x tok/s in virtual time at K=4.
+    spec_verify_per_token_s: float = 2e-4
+    # kernel block-granularity modeling (docs/kernels.md dense packing):
+    # on the modeled TPU the ragged kernel walks this-many-token query
+    # blocks, so a mixed dispatch pays (align-1) wasted token-slots of
+    # step-0 compute PER DECODE LANE (each single-token lane burns a
+    # whole block), which the dense mixed_decode packing avoids.  0 (the
+    # default) disables the charge — every pre-dense scenario's virtual
+    # timeline stays byte-identical; bench --mode spec sets 8 (RAGGED_BQ)
+    # to price the K=0 dense-packing win in sim terms.
+    ragged_align_tokens: int = 0
 
 
 class StubDevice:
@@ -219,6 +246,12 @@ class StubPrograms:
         self.inject = self._inject
         self.inject_q = self._inject_q
         self.mixed = self._mixed
+        # the dense/speculative decode program exists only when the
+        # engine config asks for it — pre-spec scenarios keep their
+        # byte-identical traces (the engine falls back to mixed-only
+        # when the attribute is absent)
+        if getattr(engine_config, "spec_decode_k", None) is not None:
+            self.mixed_decode = self._mixed_decode
 
     # ---------------- prefill ----------------
 
@@ -347,6 +380,15 @@ class StubPrograms:
         cost = c.decode_step_s * steps
         if n_prefill:
             cost += c.prefill_base_s + c.prefill_per_token_s * n_prefill
+        if c.ragged_align_tokens > 1:
+            # block-granularity waste: every decode lane's single-token
+            # slice burns a whole align-token kernel block in step 0 —
+            # the cost the dense mixed_decode packing exists to avoid
+            n_decode = int(sum(
+                1 for i in range(B)
+                if ql[i] > 0 and emits0[i] == 1 and cnt[i] >= 1))
+            cost += (n_decode * (c.ragged_align_tokens - 1)
+                     * c.prefill_per_token_s)
         self._device.dispatch(cost)
         chunk = np.zeros((steps, B), np.int32)
         for i in range(B):
@@ -369,6 +411,54 @@ class StubPrograms:
                     p += 1
                 chunk[s, i] = prev
         return chunk, kv_pages
+
+    # ---------------- dense / speculative decode (mixed_decode) ----------------
+
+    def _mixed_decode(self, params, tokens, pos, kv_pages, page_table,
+                      live, capacity, counters, draft_table, state, rng,
+                      adapters):
+        """Host-math twin of engine/compiled.py's mixed_decode: every
+        round each live lane with page capacity for a full (K+1)-token
+        slice emits `stub_spec_accept(prev, pos)` tokens of the SAME
+        deterministic chain the other stub programs emit — acceptance
+        varies, the token stream never does, so `expected_stream()` stays
+        the oracle and the goodput report's zero-lost/zero-duplicated
+        accounting covers speculative traffic.  Returns the engine
+        contract: ([rounds, B, K+1] tokens, [rounds, B] emit counts,
+        kv_pages, draft_table, and the final (token, pos, counters)
+        carry for depth-2 chaining)."""
+        cfg = self._cfg
+        K = cfg.spec_decode_k or 0
+        Kp = K + 1
+        rounds = cfg.steps_per_sync
+        tok = np.array(np.asarray(tokens), np.int64)
+        p = np.array(np.asarray(pos), np.int64)
+        cnt = np.array(np.asarray(counters), np.int64)
+        lv = np.asarray(live)
+        cap = np.asarray(capacity)
+        B = tok.shape[0]
+        c = self._device.costs
+        self._device.dispatch(
+            rounds * (c.decode_step_s + c.spec_verify_per_token_s * K))
+        toks = np.zeros((rounds, B, Kp), np.int32)
+        n = np.zeros((rounds, B), np.int32)
+        for r in range(rounds):
+            for i in range(B):
+                if not lv[i] or p[i] + Kp > cap[i]:
+                    continue  # capacity-starved lanes sit the round out
+                acc = stub_spec_accept(int(tok[i]), int(p[i]), K)
+                prev = int(tok[i])
+                pp = int(p[i])
+                for j in range(acc):
+                    prev = stub_next_token(prev, pp)
+                    pp += 1
+                    toks[r, i, j] = prev
+                n[r, i] = acc
+                tok[i] = prev
+                p[i] = pp
+                cnt[i] += acc
+        return (toks, n, kv_pages, draft_table, tok.astype(np.int32),
+                p.astype(np.int32), cnt.astype(np.int32))
 
     # ---------------- KV injection (P/D, tier-store resume) ----------------
 

@@ -555,6 +555,72 @@ def test_ragged_host_sync_suppressed():
     assert "ragged-metadata-host-sync" not in rules_of(src)
 
 
+# ------------------------------------------- spec-accept-host-sync
+
+BAD_SPEC = """
+    import jax
+
+    @jax.jit
+    def verify_round(sampled, drafts, acc, n_emit, draft_table):
+        # per-round host syncs on acceptance metadata
+        k = int(acc[0])
+        m = n_emit.item()
+        return sampled[:k], m
+"""
+
+GOOD_SPEC_DEVICE = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def verify_round(sampled, drafts, acc):
+        # acceptance stays vectorized on device
+        n_emit = jnp.where(acc >= 0, acc + 1, 0)
+        return jnp.take_along_axis(sampled, acc[:, None], axis=1), n_emit
+"""
+
+GOOD_SPEC_HOST = """
+    def route_dense(plan, toks_np, n_np):
+        # HOST routing over the once-per-dispatch fetched numpy outputs
+        # is the intended place for scalar reads
+        return int(n_np[0, 0]) + int(toks_np[0, 0, 0])
+"""
+
+
+def test_spec_accept_host_sync_fires_on_item_and_int():
+    assert rules_of(BAD_SPEC).count("spec-accept-host-sync") == 2
+
+
+def test_spec_accept_host_sync_quiet_on_device_acceptance():
+    assert "spec-accept-host-sync" not in rules_of(GOOD_SPEC_DEVICE)
+
+
+def test_spec_accept_host_sync_quiet_outside_traced_code():
+    assert "spec-accept-host-sync" not in rules_of(GOOD_SPEC_HOST)
+
+
+def test_spec_accept_host_sync_draft_table_attribute_base():
+    src = """
+        import jax
+
+        @jax.jit
+        def step(state):
+            return int(state.draft_table[0, 0])
+    """
+    assert rules_of(src).count("spec-accept-host-sync") == 1
+
+
+def test_spec_accept_host_sync_suppressed():
+    src = BAD_SPEC.replace(
+        "k = int(acc[0])",
+        "k = int(acc[0])  # jaxlint: disable=spec-accept-host-sync"
+    ).replace(
+        "m = n_emit.item()",
+        "m = n_emit.item()  # jaxlint: disable=spec-accept-host-sync"
+    )
+    assert "spec-accept-host-sync" not in rules_of(src)
+
+
 # ------------------------------------------- aot-cache-key-drift
 
 BAD_AOTKEY = """

@@ -281,3 +281,96 @@ class TestRaggedDispatch:
             pytest.skip("CPU-only guard")
         with pytest.raises(ValueError, match="head_dim"):
             ragged_paged_attention(*case.args(), use_pallas=True)
+
+
+class TestDenseBlockPacking:
+    """Dense-stride packing (docs/kernels.md, ISSUE 15): lanes at a
+    static stride < RAGGED_BQ share kernel blocks — the speculative
+    verify layout where lane i's (K+1)-token slice sits at offset
+    i*stride.  The dense-block kernel variant must match the XLA gather
+    reference (which is per-token and needs no invariant change) over
+    active/inactive lanes, slice padding (stride > q_len), sliding
+    windows and int8 pages."""
+
+    def _dense_case(self, Kp, sp, B=8, seed=0, quantized=False):
+        rng = np.random.RandomState(seed)
+        T = B * sp
+        assert T % RAGGED_BQ == 0
+        W = 8
+        cfg = KVCacheConfig(
+            n_layers=1, n_kv_heads=NKV, head_dim=D, page_size=PS,
+            num_pages=1 + B * W, max_pages_per_seq=W, dtype="float32")
+        pages = jnp.asarray(
+            rng.randn(*init_kv_pages(cfg)[0].shape).astype(np.float32))
+        scales = None
+        if quantized:
+            # cache layout: int8 [P, 2, nkv, ps, d] + scales [P, 2, nkv, ps]
+            pages, scales = quantize_rows(pages)
+        page_table = np.zeros((B, W), np.int32)
+        kv_start = rng.randint(0, 12, B).astype(np.int32)
+        q_len = np.asarray(
+            [0 if i % 3 == 2 else Kp for i in range(B)], np.int32)
+        used = 1
+        for i in range(B):
+            for p in range(-(-(int(kv_start[i]) + Kp) // PS)):
+                page_table[i, p] = used
+                used += 1
+        q = np.zeros((T, NQ, D), np.float32)
+        tok_seq = np.full((T,), -1, np.int32)
+        tok_pos = np.zeros((T,), np.int32)
+        for i in range(B):
+            for j in range(int(q_len[i])):
+                r = i * sp + j
+                q[r] = rng.randn(NQ, D)
+                tok_seq[r] = i
+                tok_pos[r] = kv_start[i] + j
+        kv = (pages, scales) if quantized else pages
+        k_new = rng.randn(T, NKV, D).astype(np.float32)
+        v_new = rng.randn(T, NKV, D).astype(np.float32)
+        kv = write_ragged_kv(kv, jnp.asarray(k_new), jnp.asarray(v_new),
+                             jnp.asarray(page_table), jnp.asarray(tok_seq),
+                             jnp.asarray(tok_pos), PS)
+        q_start = (np.arange(B) * sp).astype(np.int32)
+        return (jnp.asarray(q), kv, jnp.asarray(page_table),
+                jnp.asarray(q_start), jnp.asarray(q_len),
+                jnp.asarray(kv_start))
+
+    @pytest.mark.parametrize("Kp,sp", [(1, 1), (2, 2), (3, 4), (4, 4)])
+    def test_dense_kernel_matches_xla_reference(self, Kp, sp):
+        args = self._dense_case(Kp, sp)
+        ref = ragged_paged_attention_xla(*args)
+        got = ragged_paged_attention_pallas(
+            *args, interpret=True, dense_stride=sp)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-4)
+
+    def test_dense_kernel_sliding_window(self):
+        args = self._dense_case(3, 4, seed=3)
+        win = jnp.asarray(5, jnp.int32)
+        ref = ragged_paged_attention_xla(*args, window=win)
+        got = ragged_paged_attention_pallas(
+            *args, window=win, interpret=True, dense_stride=4)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-4)
+
+    def test_dense_kernel_int8_pages(self):
+        args = self._dense_case(2, 2, seed=5, quantized=True)
+        ref = ragged_paged_attention_xla(*args)
+        got = ragged_paged_attention_pallas(
+            *args, interpret=True, dense_stride=2)
+        # the XLA reference dequantizes to bf16 (bandwidth), the kernel
+        # dequantizes in f32 — tolerance covers the bf16 rounding delta
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=1e-2)
+
+    def test_dense_stride_must_divide_block(self):
+        args = self._dense_case(2, 2)
+        with pytest.raises(ValueError, match="divide"):
+            ragged_paged_attention_pallas(
+                *args, interpret=True, dense_stride=3)
+
+    def test_dense_buffer_length_must_match(self):
+        q, kv, pt, qs, ql, ks = self._dense_case(2, 2)
+        with pytest.raises(ValueError, match="B\\*stride"):
+            ragged_paged_attention_pallas(
+                q, kv, pt, qs, ql, ks, interpret=True, dense_stride=1)

@@ -1336,3 +1336,44 @@ class TestFleetScenarioChaos:
         # reusable = rerunnable: same scenario, byte-identical report
         report2 = await FleetSim(scn).run()
         assert canonical_json(report) == canonical_json(report2)
+
+
+class TestSpecDecodeChaos:
+    """Speculative decoding under churn (ISSUE 15, docs/kernels.md):
+    checkpoints captured while verify chunks are in flight must carry
+    ONLY accepted tokens — never an unverified draft tail — and resume
+    token-exactly on the peer replica.  The canned spec_decode_scenario
+    preempts lanes mid-verify on both replicas and zero-grace-drains
+    replica-0 mid-burst; the stub's chain-state-seeded acceptance makes
+    the whole accept/reject sequence deterministic and resume-invariant,
+    so the goodput report's oracle accounting IS the proof."""
+
+    @async_test
+    async def test_preempt_mid_verify_resumes_token_exact(self):
+        from kserve_tpu.sim import (
+            FleetSim,
+            assert_slo,
+            canonical_json,
+            spec_decode_scenario,
+        )
+
+        scn = spec_decode_scenario()
+        report = await FleetSim(scn).run()
+        assert_slo(report, scn.budget)
+        # preempt + zero-grace drain landed on in-flight work and the
+        # checkpointed streams resumed on the peer
+        assert report["retries"]["preempt_resumes"] > 0
+        assert report["tokens"]["salvaged_via_resume"] > 0
+        # the oracle accounting: an unverified draft tail in any
+        # checkpoint would surface as duplicated (re-decoded) or lost
+        # (skipped) tokens on resume — there are none
+        assert report["tokens"]["lost"] == 0
+        assert report["tokens"]["duplicated"] == 0
+        # speculation genuinely engaged on both replicas
+        for rep in report["replicas"]:
+            assert rep["spec_decode"]["accepted"] > 0
+            assert rep["spec_decode"]["drafted"] >= (
+                rep["spec_decode"]["accepted"])
+        # deterministic: same seed, byte-identical report
+        report2 = await FleetSim(spec_decode_scenario()).run()
+        assert canonical_json(report) == canonical_json(report2)

@@ -144,6 +144,71 @@ class TestRetraceBudget:
             await engine.stop()
 
 
+class TestSpecDecodeBudget:
+    """Speculative decoding (docs/kernels.md, ISSUE 15): the spec-on
+    steady-state compile set is exactly {mixed: 1, mixed_decode: 1} —
+    one mixed program for admission/prefill steps, one dense decode
+    program for pure-decode steps — FROZEN over varying acceptance
+    patterns.  Acceptance varies with content (and with the rng for
+    stochastic lanes), but it is pure data: a growing count here would
+    mean acceptance leaked into a traced shape."""
+
+    @async_test
+    async def test_spec_steady_state_compile_set_frozen(self):
+        from test_engine import make_engine
+
+        engine = make_engine(spec_decode_k=2, num_pages=128,
+                             max_pages_per_seq=8)
+        assert engine._dense_ok
+        await engine.start()
+        try:
+            base = compile_counts()
+            params = SamplingParams(
+                max_tokens=10, temperature=0.0, ignore_eos=True)
+
+            async def run_one(prompt):
+                async for _ in engine.generate(prompt, params):
+                    pass
+
+            await run_one([5, 6, 7, 8])
+            assert delta(base) == {"mixed": 1, "mixed_decode": 1}, (
+                "spec-on request 1 must compile exactly one mixed + one "
+                f"mixed_decode program, got {delta(base)}"
+            )
+            # varying prompts = varying bigram tables = varying
+            # acceptance patterns; chained and unchained dispatches and
+            # host- vs device-carried tables must all share signatures
+            for i in range(5):
+                await run_one([9 + i, 3, 4 + i])
+            await asyncio.gather(*[
+                run_one([7, 7, 3 + i]) for i in range(4)])
+            assert delta(base) == {"mixed": 1, "mixed_decode": 1}, (
+                "spec steady state retraced over varying acceptance "
+                f"patterns: {delta(base)}"
+            )
+        finally:
+            await engine.stop()
+
+    @async_test
+    async def test_dense_k0_compile_set(self):
+        """K=0 (dense packing alone) carries the same two-program set."""
+        from test_engine import make_engine
+
+        engine = make_engine(spec_decode_k=0)
+        await engine.start()
+        try:
+            base = compile_counts()
+            params = SamplingParams(
+                max_tokens=8, temperature=0.0, ignore_eos=True)
+            for i in range(3):
+                async for _ in engine.generate([5, 6, 7 + i], params):
+                    pass
+            assert delta(base) == {"mixed": 1, "mixed_decode": 1}, (
+                delta(base))
+        finally:
+            await engine.stop()
+
+
 class TestWarmStartBudget:
     """Persistent AOT cache (engine/aot_cache.py, docs/coldstart.md): a
     replica starting against a populated cache performs ZERO XLA compiles

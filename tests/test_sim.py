@@ -499,6 +499,30 @@ class TestChurn10k:
         report2 = await FleetSim(churn_10k_scenario()).run()
         assert canonical_json(report) == canonical_json(report2)
 
+    @async_test
+    async def test_10k_churn_trace_spec_decode_leg(self):
+        """ISSUE 15 acceptance: the SAME 10k churn trace with speculative
+        decoding enabled fleet-wide (K=2).  Every churn shape now lands
+        on engines running draft/verify rounds — preemptions and
+        zero-grace drains checkpoint lanes whose last dispatch was a
+        verify chunk — and the oracle accounting must still show zero
+        lost / zero duplicated tokens, byte-identical per seed.  The
+        chain-state-seeded acceptance pattern is what makes a resumed
+        stream replay the identical accept/reject sequence anywhere."""
+        scn = churn_10k_scenario(spec_decode_k=2)
+        report = await FleetSim(scn).run()
+        assert report["requests"]["submitted"] >= 10_000
+        assert_slo(report, scn.budget)
+        assert report["tokens"]["lost"] == 0
+        assert report["tokens"]["duplicated"] == 0
+        assert report["retries"]["preempt_resumes"] > 0
+        # speculation engaged at scale on every replica
+        for rep in report["replicas"]:
+            spec = rep["spec_decode"]
+            assert spec["drafted"] > 0 and spec["accepted"] > 0
+        report2 = await FleetSim(churn_10k_scenario(spec_decode_k=2)).run()
+        assert canonical_json(report) == canonical_json(report2)
+
 
 # ---------------- scale-to-zero (AOT warm start, docs/coldstart.md) ----------------
 

@@ -1,0 +1,410 @@
+"""Speculative decoding + dense decode packing (ISSUE 15,
+docs/kernels.md).
+
+The contract under test: with `EngineConfig.spec_decode_k` set, the
+engine's pure-decode steps run the `mixed_decode` program — dense
+(K+1)-token slices, on-device draft/verify/accept, depth-2 chaining —
+and every emitted token is a TARGET-model sample, so greedy (and
+seeded-sampling) streams are token-identical to spec-off.  Checkpoints
+carry only accepted tokens, the stub oracle stays token-exact with a
+deterministic acceptance pattern, and the compile budget stays frozen
+(tests/test_retrace_budget.py pins that half)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+from conftest import async_test, counter_value
+
+from kserve_tpu.engine.engine import EngineConfig, LLMEngine
+from kserve_tpu.engine.sampling import SamplingParams
+from kserve_tpu.engine.tokenizer import ByteTokenizer
+from kserve_tpu.lifecycle.checkpoint import GenerationPreempted
+from kserve_tpu.metrics import SPEC_TOKENS
+from kserve_tpu.models.llama import LlamaConfig
+from kserve_tpu.ops.attention import dense_stride_for
+from kserve_tpu.resilience import Deadline, FakeClock
+
+
+def make_engine(**cfg_overrides):
+    model_config = LlamaConfig.tiny(dtype="float32")
+    cfg = dict(
+        max_batch_size=4,
+        page_size=8,
+        num_pages=128,
+        max_pages_per_seq=16,
+        max_prefill_len=32,
+        prefill_buckets=(16, 32),
+        dtype="float32",
+        use_pallas=False,
+        steps_per_sync=4,
+    )
+    cfg.update(cfg_overrides)
+    return LLMEngine(
+        model_config, EngineConfig(**cfg),
+        ByteTokenizer(model_config.vocab_size))
+
+
+async def collect_ids(engine, prompt, max_tokens=12, **params):
+    params.setdefault("temperature", 0.0)
+    out = []
+    async for o in engine.generate(
+        prompt,
+        SamplingParams(max_tokens=max_tokens, ignore_eos=True, **params),
+    ):
+        out.append(o.token_id)
+    return out
+
+
+class TestDenseStride:
+    def test_xla_reference_packs_densely(self):
+        assert dense_stride_for(1, 1) == 1
+        assert dense_stride_for(5, 1) == 5
+
+    def test_sub_block_widths_share_blocks(self):
+        # align=8: stride is the smallest pow2 >= width, dividing 8
+        assert dense_stride_for(1, 8) == 1
+        assert dense_stride_for(2, 8) == 2
+        assert dense_stride_for(3, 8) == 4
+        assert dense_stride_for(4, 8) == 4
+        assert dense_stride_for(5, 8) == 8
+
+    def test_super_block_widths_round_to_solo_blocks(self):
+        assert dense_stride_for(8, 8) == 8
+        assert dense_stride_for(9, 8) == 16
+        assert dense_stride_for(16, 8) == 16
+
+    def test_invalid_width_rejected(self):
+        with pytest.raises(ValueError):
+            dense_stride_for(0, 8)
+
+
+class TestSpecConfig:
+    def test_negative_k_rejected(self):
+        with pytest.raises(ValueError, match="spec_decode_k"):
+            make_engine(spec_decode_k=-1)
+
+    def test_requires_mixed_path(self):
+        with pytest.raises(NotImplementedError, match="unified ragged"):
+            make_engine(spec_decode_k=2, use_ragged=False)
+
+    def test_spec_engine_has_dense_program(self):
+        engine = make_engine(spec_decode_k=2)
+        assert engine._dense_ok
+        assert engine._mixed_decode_fn is not None
+
+    def test_off_by_default(self):
+        engine = make_engine()
+        assert engine._spec_k is None
+        assert engine._mixed_decode_fn is None
+        assert not engine._dense_ok
+
+    def test_env_knob(self, monkeypatch):
+        from kserve_tpu.engine.types import spec_decode_k_from_env
+
+        monkeypatch.delenv("KSERVE_TPU_SPEC_DECODE_K", raising=False)
+        assert spec_decode_k_from_env() is None
+        monkeypatch.setenv("KSERVE_TPU_SPEC_DECODE_K", "4")
+        assert spec_decode_k_from_env() == 4
+        monkeypatch.setenv("KSERVE_TPU_SPEC_DECODE_K", "0")
+        assert spec_decode_k_from_env() == 0
+        # malformed values log-and-ignore instead of crash-looping the pod
+        monkeypatch.setenv("KSERVE_TPU_SPEC_DECODE_K", "nope")
+        assert spec_decode_k_from_env() is None
+        monkeypatch.setenv("KSERVE_TPU_SPEC_DECODE_K", "-3")
+        assert spec_decode_k_from_env() is None
+
+    def test_spec_disables_aot_cache(self, tmp_path):
+        # spec_decode_k is deliberately NOT in the AOT cache key until
+        # hardware-validated: a spec engine must not read (or write)
+        # executables under a non-spec digest
+        engine = make_engine(spec_decode_k=2, aot_cache_dir=str(tmp_path))
+        assert engine._aot_cache is None
+
+
+class TestSpecTokenExact:
+    """Greedy and seeded-sampling streams with speculation on must be
+    token-identical to spec-off: every emitted token is a target-model
+    sample, the drafts only decide which positions were computed in one
+    dispatch."""
+
+    @async_test
+    async def test_greedy_token_exact_vs_spec_off(self):
+        ref_e = make_engine()
+        spec_e = make_engine(spec_decode_k=2)
+        await ref_e.start()
+        await spec_e.start()
+        try:
+            for prompt in ([5, 6, 7, 8], [9, 3, 4], [40] * 12):
+                ref = await collect_ids(ref_e, prompt, max_tokens=16)
+                got = await collect_ids(spec_e, prompt, max_tokens=16)
+                assert got == ref
+            assert spec_e.spec_stats["drafted"] > 0
+        finally:
+            await ref_e.stop()
+            await spec_e.stop()
+
+    @async_test
+    async def test_dense_k0_token_exact(self):
+        """K=0 — dense decode packing alone, no drafts — is plain decode
+        through the dense program; streams match exactly and nothing is
+        ever drafted."""
+        ref_e = make_engine()
+        dense_e = make_engine(spec_decode_k=0)
+        await ref_e.start()
+        await dense_e.start()
+        try:
+            ref = await collect_ids(ref_e, [5, 6, 7, 8], max_tokens=16)
+            got = await collect_ids(dense_e, [5, 6, 7, 8], max_tokens=16)
+            assert got == ref
+            assert dense_e.spec_stats["drafted"] == 0
+        finally:
+            await ref_e.stop()
+            await dense_e.stop()
+
+    @async_test
+    async def test_concurrent_batch_with_chaining_token_exact(self):
+        """Long concurrent generations keep admission blocked, so the
+        depth-2 chained dispatches engage — streams still match the
+        sequential spec-off reference exactly."""
+        ref_e = make_engine()
+        spec_e = make_engine(spec_decode_k=3)
+        await ref_e.start()
+        await spec_e.start()
+        try:
+            prompts = [[7, 7, 3 + i] for i in range(4)]
+            refs = [await collect_ids(ref_e, p, max_tokens=40)
+                    for p in prompts]
+            got = await asyncio.gather(*[
+                collect_ids(spec_e, p, max_tokens=40) for p in prompts])
+            assert list(got) == refs
+        finally:
+            await ref_e.stop()
+            await spec_e.stop()
+
+    @async_test
+    async def test_seeded_sampling_token_exact(self):
+        """A client-supplied seed folds (seed, generated-count) pairs —
+        the verify rows fold the same pairs sequential decode folds, so
+        seeded stochastic streams are reproduced bit-exactly too."""
+        ref_e = make_engine()
+        spec_e = make_engine(spec_decode_k=2)
+        await ref_e.start()
+        await spec_e.start()
+        try:
+            ref = await collect_ids(ref_e, [3, 4, 5], max_tokens=12,
+                                    temperature=0.8, seed=42)
+            got = await collect_ids(spec_e, [3, 4, 5], max_tokens=12,
+                                    temperature=0.8, seed=42)
+            assert got == ref
+        finally:
+            await ref_e.stop()
+            await spec_e.stop()
+
+
+class TestSpecObservability:
+    @async_test
+    async def test_spec_counters_and_composition(self):
+        drafted0 = counter_value(
+            SPEC_TOKENS, model_name="engine", outcome="drafted")
+        engine = make_engine(spec_decode_k=4)
+        await engine.start()
+        try:
+            await collect_ids(engine, [5, 6, 7, 8], max_tokens=24)
+            s = engine.spec_stats
+            assert s["drafted"] > 0
+            assert s["drafted"] == s["accepted"] + s["rejected"]
+            assert counter_value(
+                SPEC_TOKENS, model_name="engine", outcome="drafted"
+            ) - drafted0 == s["drafted"]
+            # the latest dense dispatch exported its accepted length
+            comp = engine.last_step_composition
+            assert "spec_accepted_tokens" in comp
+            # scheduler_state carries the lifetime tallies for the EPP
+            state = engine.scheduler_state()
+            assert state["spec"] == s
+        finally:
+            await engine.stop()
+
+    @async_test
+    async def test_spec_off_state_has_no_spec_block(self):
+        engine = make_engine()
+        await engine.start()
+        try:
+            await collect_ids(engine, [5, 6, 7], max_tokens=4)
+            assert "spec" not in engine.scheduler_state()
+        finally:
+            await engine.stop()
+
+
+class TestSpecCheckpointCorrectness:
+    """Checkpoints under speculation carry ONLY accepted tokens — never
+    an unverified draft tail: slot.generated is fed exclusively by the
+    routing loop, which emits accepted target samples and discards
+    anything past an eviction.  Proven end-to-end: drain a spec engine
+    mid-generation, assert the checkpoint is an exact prefix of the
+    uninterrupted reference stream, resume on a SECOND spec engine, and
+    assert the spliced stream equals the reference token-for-token."""
+
+    @async_test
+    async def test_drain_checkpoint_is_accepted_prefix_and_resumes_exact(self):
+        prompt = [11, 12, 13]
+        max_tokens = 48
+        ref_e = make_engine()
+        await ref_e.start()
+        ref = await collect_ids(ref_e, prompt, max_tokens=max_tokens)
+        await ref_e.stop()
+
+        clock = FakeClock()
+        a = make_engine(spec_decode_k=3)
+        await a.start()
+        got = []
+        preempted = {}
+
+        async def consume():
+            try:
+                async for o in a.generate(
+                    prompt,
+                    SamplingParams(max_tokens=max_tokens, temperature=0.0,
+                                   ignore_eos=True),
+                    request_id="spec-ckpt",
+                ):
+                    got.append(o.token_id)
+            except GenerationPreempted as exc:
+                preempted["ckpt"] = exc.checkpoint
+
+        task = asyncio.create_task(consume())
+        while len(got) < 8:  # mid-generation, with verify rounds behind us
+            await asyncio.sleep(0.01)
+        # expired budget: drain checkpoints everything in flight NOW —
+        # including the lane whose latest dispatch was a verify chunk
+        ckpts = await a.drain(
+            deadline=Deadline.after(0.0, clock), clock=clock)
+        await task
+        await a.stop()
+        ckpt = preempted.get("ckpt")
+        if ckpt is None:
+            assert ckpts, "drain produced no checkpoint"
+            ckpt = ckpts[0]
+        # accepted-only contract: the checkpointed tokens are an exact
+        # prefix of the uninterrupted reference stream
+        n = len(ckpt.generated)
+        assert 0 < n < max_tokens
+        assert list(ckpt.generated) == ref[:n]
+        # ...and never longer than what the client saw routed
+        assert n >= len(got)
+
+        b = make_engine(spec_decode_k=3)
+        await b.start()
+        try:
+            resumed = []
+            async for o in b.resume_generation(ckpt):
+                resumed.append(o.token_id)
+            assert list(ckpt.generated) + resumed == ref
+        finally:
+            await b.stop()
+
+
+class TestSpecStubOracle:
+    """The simulator's mixed_decode twin: acceptance is a pure function
+    of chain state (resume-invariant), the emitted stream is the same
+    deterministic chain every other stub program emits."""
+
+    def test_accept_pattern_is_chain_state_pure(self):
+        from kserve_tpu.sim.stub import stub_spec_accept
+
+        for k in (1, 2, 4, 8):
+            vals = {stub_spec_accept(40, 7, k) for _ in range(3)}
+            assert len(vals) == 1
+            for prev in range(32, 64):
+                for pos in range(0, 20):
+                    n = stub_spec_accept(prev, pos, k)
+                    assert 1 <= n <= k + 1
+
+    @async_test
+    async def test_sim_replica_spec_stream_matches_oracle(self):
+        from kserve_tpu.sim import expected_stream
+        from kserve_tpu.sim.clock import SimClock
+        from kserve_tpu.sim.replica import ReplicaSpec, SimReplica
+
+        clock = SimClock()
+        rep = SimReplica("spec-t", clock, ReplicaSpec(spec_decode_k=4))
+        await rep.start()
+        outs = []
+
+        async def consume():
+            async for out in rep.engine.generate(
+                [40] * 12,
+                SamplingParams(max_tokens=20, temperature=0.0,
+                               ignore_eos=True),
+                request_id="r-spec",
+            ):
+                outs.append(out.token_id)
+
+        task = asyncio.create_task(consume())
+        await clock.drive(until=lambda: task.done())
+        assert outs == expected_stream(12, 20)
+        assert rep.engine.spec_stats["accepted"] > 0
+        summary_block = rep.summary().get("spec_decode")
+        assert summary_block and summary_block["accepted"] > 0
+        await rep.stop()
+        await clock.drain_timers()
+
+    @async_test
+    async def test_stub_mixed_decode_absent_when_spec_off(self):
+        """Pre-spec scenarios must stay byte-identical: a spec-off stub
+        program set has no mixed_decode, so the engine keeps the plain
+        mixed stepping path."""
+        from kserve_tpu.sim.clock import SimClock
+        from kserve_tpu.sim.replica import ReplicaSpec, SimReplica
+
+        clock = SimClock()
+        rep = SimReplica("off-t", clock, ReplicaSpec())
+        assert getattr(
+            rep.engine, "_mixed_decode_fn", None) is None
+        assert "spec_decode" not in rep.summary()
+        await rep.stop()
+
+
+class TestSpecGrowthAccounting:
+    @async_test
+    async def test_page_growth_covers_worst_case_advance(self):
+        """One dispatch can advance a lane steps_per_sync*(K+1) tokens;
+        the engine must keep page capacity ahead of that (lanes starved
+        of a full slice window sit rounds out, but generation must never
+        stall permanently)."""
+        engine = make_engine(spec_decode_k=7, page_size=8,
+                             max_pages_per_seq=16, num_pages=128)
+        assert engine._max_step_advance == 4 * 8
+        await engine.start()
+        try:
+            out = await collect_ids(engine, [5, 6, 7], max_tokens=60)
+            assert len(out) == 60
+        finally:
+            await engine.stop()
+
+    @async_test
+    async def test_kv_ceiling_falls_back_to_mixed_not_livelock(self):
+        """A lane within K tokens of its hard kv ceiling
+        (max_pages_per_seq * page_size) can never fit another full
+        (K+1)-token dense slice; the engine must hand the final stretch
+        to the plain mixed path (token-identical) instead of dispatching
+        capacity-skipped rounds forever.  Regression: prompt+max_tokens
+        == max_model_len livelocked the live server (ISSUE 15 verify
+        drill) — 27k dispatches, zero tokens routed."""
+        # max_model_len = 3 * 8 = 24; prompt 4 + max_tokens 20 lands
+        # exactly on the ceiling, so the last rounds cannot fit K+1=5
+        ref_e = make_engine(max_pages_per_seq=3)
+        spec_e = make_engine(max_pages_per_seq=3, spec_decode_k=4)
+        await ref_e.start()
+        await spec_e.start()
+        try:
+            ref = await asyncio.wait_for(
+                collect_ids(ref_e, [5, 6, 7, 8], max_tokens=20), 60)
+            got = await asyncio.wait_for(
+                collect_ids(spec_e, [5, 6, 7, 8], max_tokens=20), 60)
+            assert got == ref
+            assert len(got) == 20
+        finally:
+            await ref_e.stop()
+            await spec_e.stop()
