@@ -7,6 +7,8 @@ next to the tier-1 pytest run (scripts/lint.sh does both).
 from __future__ import annotations
 
 import argparse
+import dataclasses
+import json
 import sys
 
 from .core import all_rules, lint_paths
@@ -25,6 +27,12 @@ def main(argv=None) -> int:
                         help="comma-separated rule ids to skip")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule registry and exit")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text", dest="fmt",
+                        help="output format: text (default, one "
+                        "path:line:col line per finding) or json (a list "
+                        "of {path,line,col,rule,message} records on "
+                        "stdout, for editor/CI integration)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -48,8 +56,14 @@ def main(argv=None) -> int:
     select = args.select.split(",") if args.select else None
     ignore = args.ignore.split(",") if args.ignore else None
     findings = lint_paths(args.paths, select=select, ignore=ignore)
-    for finding in findings:
-        print(finding.render())
+    if args.fmt == "json":
+        # machine-readable: the ONLY stdout is the JSON document; the
+        # human summary stays on stderr so `| jq` round-trips cleanly
+        print(json.dumps([dataclasses.asdict(f) for f in findings],
+                         indent=1))
+    else:
+        for finding in findings:
+            print(finding.render())
     if findings:
         print(f"jaxlint: {len(findings)} finding(s)", file=sys.stderr)
         return 1

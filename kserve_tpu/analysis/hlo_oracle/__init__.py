@@ -1,0 +1,37 @@
+"""HLO perf oracle: artifact-level static analysis of the engine's
+compiled device programs (docs/static_analysis.md, "HLO oracle").
+
+The 11 jaxlint rules audit SOURCE for JAX-serving hazards; this package
+audits the ARTIFACTS XLA actually produced.  For every program
+`engine/compiled.py:program_defs` builds (mixed, mixed_decode across K,
+inject/inject_q, per-bucket prefill/prefill_chunk, the legacy set —
+under tp=1 and a tp=2 CPU mesh) it lowers and compiles the canonical
+tiny-model signature on CPU and extracts:
+
+- FLOP / bytes-accessed / peak-memory accounting
+  (``compiled.cost_analysis()`` + ``memory_analysis()``);
+- the donation-alias table from the executable's input_output_alias
+  header, verifying every arg the program table marks donated is
+  ACTUALLY aliased (a silently dropped donation is a 2x HBM copy the
+  AST lint cannot see);
+- a collective inventory (op kind, count, byte volume) pinning the
+  expected tp communication pattern;
+- structural invariants (host transfers, rng/convert op counts).
+
+Costs normalize into the committed baseline ``perf_budgets.json``;
+``python -m kserve_tpu.analysis.hlo_oracle check|update|diff`` compares
+against it, and tier-1 (tests/test_hlo_oracle.py) plus scripts/lint.sh
+fail on >10% FLOP/byte growth, any lost alias, or any new collective.
+"""
+
+from .budgets import compare, load_budgets, write_budgets  # noqa: F401
+from .extract import compiled_report  # noqa: F401
+
+
+def collect(*args, **kwargs):
+    """Lazy alias for oracle.collect: importing this package must not
+    import jax (the CLI pins the jax environment BEFORE jax loads, and
+    jaxlint consumers stay jax-free)."""
+    from .oracle import collect as _collect
+
+    return _collect(*args, **kwargs)

@@ -1,0 +1,209 @@
+"""Baseline IO + the budget comparison the CI gate enforces.
+
+The committed baseline (repo-root ``perf_budgets.json``) pins, per
+program: FLOPs, bytes accessed, the honored donation-alias counts, the
+collective inventory, and the structural op tallies.  `compare` turns
+(baseline, current) into violations — the hard failures — plus a full
+delta table for the human reading the CI log.
+
+Violation semantics (ISSUE 18 acceptance):
+- flops / bytes_accessed growth beyond the tolerance (default +10%);
+- any donated arg whose aliased-leaf count dropped (vs baseline, AND vs
+  its own leaf count when the baseline had full coverage) — the
+  silently-dropped-donation 2x-HBM-copy hazard;
+- any NEW collective kind, or a count/byte increase in an existing one;
+- host transfers appearing, rng count growth, convert count growth
+  beyond tolerance (+2 absolute slack: tiny counts make percentages
+  meaningless);
+- a program present now but missing from the baseline (run `update` —
+  new programs must be budgeted deliberately, in the PR that adds
+  them).  A baseline program missing from the current build is a
+  warning, not a violation: `only`-filtered runs and config-gated
+  programs must not fail the gate.
+
+Shrinking costs never fail: `update` re-baselines wins.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+#: default relative growth tolerance for flops / bytes_accessed / convert
+DEFAULT_TOLERANCE = 0.10
+
+#: repo-root baseline, resolved relative to this package so the CLI and
+#: tests agree regardless of cwd
+DEFAULT_BUDGETS_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))),
+    "perf_budgets.json",
+)
+
+
+@dataclass
+class Comparison:
+    violations: List[str] = field(default_factory=list)
+    warnings: List[str] = field(default_factory=list)
+    deltas: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+def load_budgets(path: str = DEFAULT_BUDGETS_PATH) -> Optional[dict]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def write_budgets(programs: Dict[str, dict], stamp: dict,
+                  path: str = DEFAULT_BUDGETS_PATH,
+                  tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    doc = dict(stamp)
+    doc["tolerance"] = tolerance
+    doc["programs"] = {k: programs[k] for k in sorted(programs)}
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return doc
+
+
+def _pct(base: float, cur: float) -> float:
+    if base == 0:
+        return float("inf") if cur > 0 else 0.0
+    return (cur - base) / base * 100.0
+
+
+def _check_scalar(cmp: Comparison, key: str, metric: str, base: float,
+                  cur: float, tol: float) -> None:
+    pct = _pct(base, cur)
+    cmp.deltas.append(
+        f"{key:<28s} {metric:<14s} {base:>12.4g} -> {cur:>12.4g} "
+        f"({pct:+.1f}%)")
+    if cur > base * (1.0 + tol):
+        cmp.violations.append(
+            f"{key}: {metric} grew {pct:+.1f}% "
+            f"({base:.4g} -> {cur:.4g}), tolerance is +{tol * 100:.0f}%")
+
+
+def _check_donation(cmp: Comparison, key: str, base: dict,
+                    cur: dict) -> None:
+    for arg, b in base.items():
+        c = cur.get(arg)
+        if c is None:
+            cmp.violations.append(
+                f"{key}: donated arg {arg} is no longer donated "
+                f"(baseline aliased {b.get('aliased', 0)}/"
+                f"{b.get('leaves', 0)} leaves)")
+            continue
+        b_aliased = int(b.get("aliased", 0))
+        c_aliased = int(c.get("aliased", 0))
+        if c_aliased < b_aliased:
+            cmp.violations.append(
+                f"{key}: donation alias dropped on arg {arg} — "
+                f"{c_aliased}/{c.get('leaves', 0)} leaves aliased "
+                f"(baseline {b_aliased}/{b.get('leaves', 0)}): each lost "
+                "alias is a full extra buffer copy per dispatch")
+    for arg, c in cur.items():
+        # a donated arg the executable does not fully alias is suspect
+        # even without baseline drift — flag when the intent says all
+        # leaves should alias and none historically failed to
+        if arg in base:
+            continue
+        if int(c.get("aliased", 0)) < int(c.get("leaves", 0)):
+            cmp.warnings.append(
+                f"{key}: new donated arg {arg} only aliases "
+                f"{c.get('aliased', 0)}/{c.get('leaves', 0)} leaves")
+
+
+def _check_collectives(cmp: Comparison, key: str, base: dict,
+                       cur: dict) -> None:
+    for kind, c in cur.items():
+        b = base.get(kind)
+        if b is None:
+            cmp.violations.append(
+                f"{key}: NEW collective {kind} (count={c.get('count')}, "
+                f"bytes={c.get('bytes')}) not in baseline — the tp "
+                "communication pattern changed")
+            continue
+        if int(c.get("count", 0)) > int(b.get("count", 0)):
+            cmp.violations.append(
+                f"{key}: collective {kind} count grew "
+                f"{b.get('count')} -> {c.get('count')}")
+        elif int(c.get("bytes", 0)) > int(b.get("bytes", 0)):
+            cmp.violations.append(
+                f"{key}: collective {kind} byte volume grew "
+                f"{b.get('bytes')} -> {c.get('bytes')}")
+    for kind in base:
+        if kind not in cur:
+            cmp.warnings.append(
+                f"{key}: collective {kind} disappeared (baseline had "
+                f"{base[kind].get('count')}) — run update to re-baseline "
+                "the win")
+
+
+def _check_ops(cmp: Comparison, key: str, base: dict, cur: dict,
+               tol: float) -> None:
+    b_host = int(base.get("host_transfer", 0))
+    c_host = int(cur.get("host_transfer", 0))
+    if c_host > b_host:
+        cmp.violations.append(
+            f"{key}: host-transfer ops appeared ({b_host} -> {c_host}) — "
+            "a serving-loop program must stay device-resident")
+    b_rng = int(base.get("rng", 0))
+    c_rng = int(cur.get("rng", 0))
+    if c_rng > b_rng:
+        cmp.violations.append(
+            f"{key}: rng op count grew {b_rng} -> {c_rng}")
+    b_cv = int(base.get("convert", 0))
+    c_cv = int(cur.get("convert", 0))
+    if c_cv > int(b_cv * (1.0 + tol)) + 2:
+        cmp.violations.append(
+            f"{key}: convert op count grew {b_cv} -> {c_cv} "
+            f"(beyond +{tol * 100:.0f}% +2) — a dtype wobble is riding "
+            "this change")
+
+
+def compare(baseline: dict, current: Dict[str, dict],
+            only: Optional[str] = None) -> Comparison:
+    """Compare a collected {program key: entry} map against the loaded
+    baseline document.  `only` restricts the comparison domain the same
+    way it restricted collection, so a filtered check never reports the
+    unfiltered programs as missing."""
+    cmp = Comparison()
+    tol = float(baseline.get("tolerance", DEFAULT_TOLERANCE))
+    base_programs = baseline.get("programs", {})
+    if only:
+        base_programs = {k: v for k, v in base_programs.items()
+                         if only in k}
+    for key in sorted(set(base_programs) | set(current)):
+        b, c = base_programs.get(key), current.get(key)
+        if c is None:
+            cmp.warnings.append(
+                f"{key}: in baseline but not in this build (config-gated "
+                "or filtered); run update if it was removed on purpose")
+            continue
+        if b is None:
+            cmp.violations.append(
+                f"{key}: not in baseline — new programs must be budgeted "
+                "deliberately (run `python -m kserve_tpu.analysis."
+                "hlo_oracle update` and commit perf_budgets.json)")
+            continue
+        for metric in ("flops", "bytes_accessed"):
+            if metric in b and metric in c:
+                _check_scalar(cmp, key, metric, float(b[metric]),
+                              float(c[metric]), tol)
+        _check_donation(cmp, key, b.get("donation", {}),
+                        c.get("donation", {}))
+        _check_collectives(cmp, key, b.get("collectives", {}),
+                           c.get("collectives", {}))
+        _check_ops(cmp, key, b.get("ops", {}), c.get("ops", {}), tol)
+    return cmp

@@ -1,0 +1,240 @@
+"""Extraction: one compiled jax program -> a normalized metrics entry.
+
+Everything in here reads ONLY the compiled artifact (cost_analysis /
+memory_analysis / optimized HLO text) plus the dispatch args' pytree
+structure — no engine imports, so the AOTProgram compile seam can call
+it without a circular dependency.
+
+Field availability varies across jax/jaxlib versions and backends:
+every accessor degrades to None/empty rather than raising, and the CLI
+turns an all-None collection into a skip-with-warning (the gate must
+never block on backend drift, ISSUE satellite 6).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import Dict, List, Optional, Tuple
+
+_log = logging.getLogger(__name__)
+
+#: bytes per element for the HLO shape spellings that appear in engine
+#: programs (unknown dtypes fall back to 4 — collective byte volumes are
+#: budget anchors, not allocator truth)
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+#: HLO collective op kinds (async `-start` spellings count once; their
+#: `-done` halves are skipped so a collective is never double-counted)
+_COLLECTIVE_KINDS = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute", "collective-broadcast",
+)
+
+#: host-transfer op kinds (structural invariant: the serving-loop
+#: programs must stay device-resident; an infeed/outfeed showing up is a
+#: host sync the AST host-sync rules cannot see post-lowering)
+_HOST_TRANSFER_KINDS = ("infeed", "outfeed", "send", "recv")
+
+_RNG_KINDS = ("rng", "rng-bit-generator", "rng-get-and-update-state")
+
+#: one HLO instruction line: `[ROOT] %name = <shape> op-name(...)`
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(?P<shape>\(.*?\)|\S+)\s+"
+    r"(?P<op>[a-z][a-z0-9\-]*)\("
+)
+
+#: one entry of the module header's input_output_alias map:
+#: `{1}: (28, {}, may-alias)` — matched globally so nested braces in the
+#: surrounding header never truncate the scan
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{(?P<out>[\d,\s]*)\}:\s*\((?P<param>\d+),\s*\{[\d,\s]*\},\s*"
+    r"(?P<kind>may-alias|must-alias)\)"
+)
+
+_SHAPE_TOKEN_RE = re.compile(r"([a-z]\w*)\[([0-9,]*)\]")
+
+
+def shape_bytes(shape_str: str) -> int:
+    """Total byte size of every `dtype[dims]` token in an HLO shape
+    spelling (tuples sum their elements)."""
+    total = 0
+    for dtype, dims in _SHAPE_TOKEN_RE.findall(shape_str):
+        if dtype == "token":
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES.get(dtype, 4)
+    return total
+
+
+def cost_metrics(compiled) -> Optional[Dict[str, float]]:
+    """flops / bytes accessed / transcendentals from cost_analysis(),
+    None when this jax/backend does not report them (skip-with-warning
+    upstream).  Newer jax returns the dict directly, older wraps it in a
+    one-element list."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        _log.debug("cost_analysis unavailable", exc_info=True)
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict) or "flops" not in ca:
+        return None
+    return {
+        "flops": float(ca.get("flops", 0.0)),
+        "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+        "transcendentals": float(ca.get("transcendentals", 0.0)),
+    }
+
+
+def memory_metrics(compiled) -> Optional[Dict[str, int]]:
+    """Peak-memory accounting from memory_analysis(); None when the
+    backend does not implement it."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        _log.debug("memory_analysis unavailable", exc_info=True)
+        return None
+    if ma is None:
+        return None
+    out = {}
+    for key, attr in (
+        ("argument_bytes", "argument_size_in_bytes"),
+        ("output_bytes", "output_size_in_bytes"),
+        ("alias_bytes", "alias_size_in_bytes"),
+        ("temp_bytes", "temp_size_in_bytes"),
+        ("generated_code_bytes", "generated_code_size_in_bytes"),
+    ):
+        v = getattr(ma, attr, None)
+        if v is not None:
+            out[key] = int(v)
+    return out or None
+
+
+def hlo_text(compiled) -> Optional[str]:
+    try:
+        return compiled.as_text()
+    except Exception:
+        _log.debug("compiled.as_text unavailable", exc_info=True)
+        return None
+
+
+def alias_table(hlo: str) -> List[Tuple[str, int, str]]:
+    """The executable's buffer-donation table parsed from the HloModule
+    header: [(output_index, param_index, may|must-alias), ...].  This is
+    what XLA actually honored — a donate_argnums entry the compiler
+    could not alias simply has no entry here."""
+    header = hlo.split("\n", 1)[0]
+    if "input_output_alias=" not in header:
+        return []
+    return [
+        (m.group("out").replace(" ", ""), int(m.group("param")),
+         m.group("kind"))
+        for m in _ALIAS_ENTRY_RE.finditer(header)
+    ]
+
+
+def _instructions(hlo: str):
+    for line in hlo.splitlines():
+        m = _INSTR_RE.match(line)
+        if m:
+            yield m.group("shape"), m.group("op")
+
+
+def collective_inventory(hlo: str) -> Dict[str, Dict[str, int]]:
+    """{collective kind: {count, bytes}} over the optimized module.
+    Byte volume is the op's output shape size — a stable proxy for wire
+    volume that moves whenever the sharded tensor or mesh factor does."""
+    out: Dict[str, Dict[str, int]] = {}
+    for shape, op in _instructions(hlo):
+        if op.endswith("-done"):
+            continue
+        base = op[:-6] if op.endswith("-start") else op
+        if base not in _COLLECTIVE_KINDS:
+            continue
+        slot = out.setdefault(base, {"count": 0, "bytes": 0})
+        slot["count"] += 1
+        slot["bytes"] += shape_bytes(shape)
+    return out
+
+
+def op_counts(hlo: str) -> Dict[str, int]:
+    """Structural-invariant op tallies: host transfers must stay absent
+    from serving-loop programs, rng/convert growth flags a numerics or
+    sampling change riding an unrelated diff."""
+    rng = convert = host = 0
+    for _, op in _instructions(hlo):
+        if op.endswith("-done"):
+            continue
+        base = op[:-6] if op.endswith("-start") else op
+        if base in _RNG_KINDS:
+            rng += 1
+        elif base == "convert":
+            convert += 1
+        elif base in _HOST_TRANSFER_KINDS:
+            host += 1
+    return {"rng": rng, "convert": convert, "host_transfer": host}
+
+
+def donation_report(args: Tuple, donate_argnums: Tuple[int, ...],
+                    hlo: str) -> Dict[str, Dict[str, int]]:
+    """Per donated arg: how many of its flattened leaves the executable
+    actually aliased.  Leaf->HLO-parameter mapping assumes the jit kept
+    every argument (the oracle lowers with keep_unused=True so flattened
+    leaf ranges match HLO parameter numbers exactly); aliased < leaves
+    is the dropped-donation signal the budget check fails on."""
+    import jax
+
+    aliased_params = {param for _, param, _ in alias_table(hlo)}
+    start = 0
+    ranges = {}
+    for i, arg in enumerate(args):
+        n = len(jax.tree_util.tree_leaves(arg))
+        ranges[i] = (start, start + n)
+        start += n
+    out = {}
+    for i in donate_argnums:
+        if i not in ranges:
+            continue
+        lo, hi = ranges[i]
+        out[str(i)] = {
+            "leaves": hi - lo,
+            "aliased": sum(1 for p in range(lo, hi) if p in aliased_params),
+        }
+    return out
+
+
+def compiled_report(compiled, *, args: Optional[Tuple] = None,
+                    donate_argnums: Tuple[int, ...] = (),
+                    norm: Optional[dict] = None) -> dict:
+    """Assemble one program's full budget entry from its compiled
+    artifact.  `args` (the dispatch args the program was lowered from)
+    enables the donation check; `norm` carries workload normalization
+    (tokens/steps per dispatch) so sim costs can be derived from the
+    entry (StubCosts.from_oracle)."""
+    entry: dict = {}
+    cost = cost_metrics(compiled)
+    if cost is not None:
+        entry.update(cost)
+    mem = memory_metrics(compiled)
+    if mem is not None:
+        entry["memory"] = mem
+    hlo = hlo_text(compiled)
+    if hlo is not None:
+        entry["collectives"] = collective_inventory(hlo)
+        entry["ops"] = op_counts(hlo)
+        if args is not None and donate_argnums:
+            entry["donation"] = donation_report(args, donate_argnums, hlo)
+    if norm:
+        entry["norm"] = dict(norm)
+    return entry

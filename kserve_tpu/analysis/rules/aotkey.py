@@ -11,7 +11,9 @@ silently SHARE executables: the stale-executable hazard, which on a real
 fleet surfaces as wrong numerics or shape crashes on warm starts only —
 the worst kind of heisenbug.  This rule pins the two in lockstep: every
 ``<engine-config>.field`` read (attribute or ``getattr``) inside a
-``build_compiled`` function must appear in ``AOT_KEY_ENGINE_FIELDS``.
+compiled-program builder — a function named ``build_compiled`` or
+``program_defs`` (the extracted definition table both dispatch modes and
+the hlo_oracle build from) — must appear in ``AOT_KEY_ENGINE_FIELDS``.
 
 The allowlist is resolved from the linted source itself when it defines
 ``AOT_KEY_ENGINE_FIELDS`` (test fixtures), else from the sibling
@@ -31,6 +33,11 @@ from ..core import FileContext, Finding, Rule, register
 #: names the engine-config parameter (and its aliases) goes by in
 #: compiled-program builders
 _CONFIG_PARAM_NAMES = {"engine_config", "cfg"}
+
+#: the functions whose engine-config reads this rule audits.  program_defs
+#: is the extracted definition table (engine/compiled.py) — moving reads
+#: there must NOT escape the audit.
+_BUILDER_NAMES = {"build_compiled", "program_defs"}
 
 _LIST_NAME = "AOT_KEY_ENGINE_FIELDS"
 
@@ -88,16 +95,16 @@ def _config_aliases(fn: ast.FunctionDef) -> Set[str]:
 class AOTCacheKeyDrift(Rule):
     id = "aot-cache-key-drift"
     description = (
-        "engine-config field read inside build_compiled but missing from "
-        "AOT_KEY_ENGINE_FIELDS: configs differing in that field would "
-        "silently share stale AOT-cached executables"
+        "engine-config field read inside build_compiled/program_defs but "
+        "missing from AOT_KEY_ENGINE_FIELDS: configs differing in that "
+        "field would silently share stale AOT-cached executables"
     )
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         builders = [
             node for node in ast.walk(ctx.tree)
             if isinstance(node, ast.FunctionDef)
-            and node.name == "build_compiled"
+            and node.name in _BUILDER_NAMES
         ]
         if not builders:
             return
@@ -108,7 +115,7 @@ class AOTCacheKeyDrift(Rule):
             for fn in builders:
                 yield self.finding(
                     ctx, fn,
-                    "build_compiled found but no AOT_KEY_ENGINE_FIELDS "
+                    f"{fn.name} found but no AOT_KEY_ENGINE_FIELDS "
                     "literal is resolvable (in this file or a sibling "
                     "aot_cache.py): the cache-key digest cannot be "
                     "audited against the fields this builder reads",

@@ -288,6 +288,29 @@ class AOTExecutableCache:
             if n.startswith(prefix) and n.endswith(".aotexe")
         )
 
+    def oracle_reports(self) -> Dict[str, dict]:
+        """The per-compile oracle metric snapshots persisted alongside
+        the executables ({"<program>.<sig_hash>": report}; see
+        AOTProgram._observe).  Written only on genuine cold compiles, so
+        this is the cost record of what THIS digest's fleet actually
+        built — unreadable/corrupt snapshots are skipped."""
+        out: Dict[str, dict] = {}
+        suffix = ".oracle.json"
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return out
+        for n in sorted(names):
+            if not n.endswith(suffix):
+                continue
+            try:
+                with open(os.path.join(self.root, n),
+                          encoding="utf-8") as f:
+                    out[n[:-len(suffix)]] = json.load(f)
+            except (OSError, ValueError):
+                continue
+        return out
+
     def load(self, program: str, sig_hash: str):
         """Deserialize one executable; None on any miss/corruption/skew
         (the caller falls back to trace-and-compile — a bad cache entry
@@ -371,6 +394,25 @@ class AOTExecutableCache:
             _discard_tmp(tmp_name)
 
 
+#: callables invoked as ``observer(program, sig_hash, lowered, compiled)``
+#: after every genuine AOTProgram compile — the HLO perf oracle's
+#: extraction seam (analysis/hlo_oracle).  Warm starts never compile, so
+#: a warm fleet pays zero extraction cost by construction.
+_COMPILE_OBSERVERS: List[Callable] = []
+
+
+def register_compile_observer(fn: Callable) -> Callable:
+    _COMPILE_OBSERVERS.append(fn)
+    return fn
+
+
+def unregister_compile_observer(fn: Callable) -> None:
+    try:
+        _COMPILE_OBSERVERS.remove(fn)
+    except ValueError:
+        pass
+
+
 class AOTProgram:
     """Callable standing where ``jax.jit(fn)`` stood in CompiledPrograms:
     per-signature ahead-of-time compiled executables, persisted across
@@ -417,7 +459,7 @@ class AOTProgram:
                 n += 1
         return n
 
-    def _compile(self, args: Tuple):
+    def _compile(self, args: Tuple, sig_hash: str = ""):
         stats = self._cache.stats
         t0 = time.perf_counter()
         lowered = self._jit.lower(*args)
@@ -459,7 +501,55 @@ class AOTProgram:
         stats.compile_s += t2 - t1
         stats.compiles += 1
         XLA_COMPILES.labels(program=self._name).inc()
+        self._observe(lowered, compiled, sig_hash)
         return compiled
+
+    def _observe(self, lowered, compiled, sig_hash: str) -> None:
+        """Post-compile extraction seam (cold compiles only — warm starts
+        dispatch straight from the loaded executable and never get here):
+        record the compile fingerprint, notify registered observers, and
+        persist a best-effort oracle metrics snapshot next to the cached
+        executable so the perf deltas of a fleet's cold starts are
+        inspectable after the fact (AOTExecutableCache.oracle_reports)."""
+        try:
+            from .compiled import record_compile_fingerprint
+
+            hlo_hash = sha256(lowered.as_text().encode()).hexdigest()[:12]
+            record_compile_fingerprint(
+                self._name, f"aot-sig:{sig_hash}", hlo_hash)
+        except Exception:
+            logger.debug("aot-fingerprint-failed program=%s",
+                         self._name, exc_info=True)
+        for obs in list(_COMPILE_OBSERVERS):
+            try:
+                obs(self._name, sig_hash, lowered, compiled)
+            except Exception as exc:  # noqa: BLE001 — an observer must
+                # never take down a compile that already succeeded
+                logger.warning(
+                    "aot-compile-observer-failed program=%s error=%s",
+                    self._name, f"{type(exc).__name__}: {exc}")
+        try:
+            # donation intent is audited by the oracle's keep_unused
+            # builds (analysis/hlo_oracle/oracle.py); the snapshot keeps
+            # the artifact-level metrics + raw honored-alias count
+            from ..analysis.hlo_oracle import extract as _extract
+
+            report = _extract.compiled_report(compiled)
+            hlo = _extract.hlo_text(compiled)
+            if hlo is not None:
+                report["alias_entries"] = len(_extract.alias_table(hlo))
+            report["program"] = self._name
+            report["sig_hash"] = sig_hash
+            report["jax"] = jax.__version__
+            path = os.path.join(
+                self._cache.root, f"{self._name}.{sig_hash}.oracle.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(report, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except Exception:  # snapshots are diagnostics, never load-bearing
+            logger.debug("aot-oracle-snapshot-failed program=%s",
+                         self._name, exc_info=True)
 
     def _signature(self, args: Tuple) -> Tuple:
         """signature_of with a per-arg identity memo: stable big subtrees
@@ -486,7 +576,7 @@ class AOTProgram:
         if exe is None:
             exe = self._cache.load(self._name, sig_hash)
             if exe is None:
-                exe = self._compile(args)
+                exe = self._compile(args, sig_hash)
                 self._cache.store(self._name, sig_hash, exe)
             self._mem[sig_hash] = exe
         return exe(*args)

@@ -12,8 +12,10 @@ execution) are chosen here from the engine config.
 
 from __future__ import annotations
 
+import hashlib
+import logging
 from dataclasses import dataclass
-from typing import Callable
+from typing import Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -23,6 +25,77 @@ from ..models import llama
 from ..parallel import sharding as shd
 from .sampling import apply_penalties, compute_logprobs, sample_tokens
 
+_log = logging.getLogger(__name__)
+
+#: per-program compile events, appended by _CompileCounting (jit path) and
+#: AOTProgram._compile (AOT path).  Each event is a dict with the argument
+#: signature that triggered the compile — the jit cache key's observable
+#: spelling (shape/dtype/weak-type/sharding per leaf) — plus a short
+#: digest of it, so a retrace-budget failure can name WHICH spelling
+#: drifted between call N and call N+1 instead of just reporting a count.
+_COMPILE_FINGERPRINTS: Dict[str, List[dict]] = {}
+
+
+def _leaf_spelling(leaf) -> str:
+    """One leaf's jit-cache-relevant spelling: dtype[shape]@spec, with a
+    ``~w`` suffix for weak types (the classic invisible retrace source)."""
+    aval = getattr(leaf, "aval", None)
+    shape = getattr(aval, "shape", getattr(leaf, "shape", ()))
+    dtype = getattr(aval, "dtype", getattr(leaf, "dtype", type(leaf).__name__))
+    weak = bool(getattr(aval, "weak_type", getattr(leaf, "weak_type", False)))
+    sharding = getattr(leaf, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    s = f"{dtype}[{','.join(str(d) for d in shape)}]"
+    if spec is not None:
+        s += f"@{spec}"
+    if weak:
+        s += "~w"
+    return s
+
+
+def _args_signature(args, kwargs) -> str:
+    """Compact per-argument signature of a dispatch: big pytrees (params,
+    kv caches) collapse to ``<N leaves:digest8>`` so the string stays
+    log-line sized while still changing whenever any leaf's spelling does."""
+    parts = []
+    for arg in list(args) + [v for _, v in sorted(kwargs.items())]:
+        leaves = jax.tree_util.tree_leaves(arg)
+        spellings = [_leaf_spelling(leaf) for leaf in leaves]
+        if len(spellings) > 4:
+            digest = hashlib.sha256(
+                "|".join(spellings).encode()).hexdigest()[:8]
+            parts.append(f"<{len(spellings)} leaves:{digest}>")
+        elif len(spellings) == 1:
+            parts.append(spellings[0])
+        else:
+            parts.append("(" + ",".join(spellings) + ")")
+    return ", ".join(parts)
+
+
+def record_compile_fingerprint(program: str, signature: str,
+                               hlo_hash: str = "") -> None:
+    """Append one compile event for `program`.  `signature` is the arg
+    spelling that keyed the compile; `hlo_hash` (optional) is a digest of
+    the lowered module when the recorder has it (the AOT path does)."""
+    _COMPILE_FINGERPRINTS.setdefault(program, []).append({
+        "signature": signature,
+        "fingerprint": hashlib.sha256(
+            f"{program}:{signature}".encode()).hexdigest()[:12],
+        "hlo_hash": hlo_hash,
+    })
+
+
+def compile_fingerprints(program: Optional[str] = None):
+    """Recorded compile events: a list for one `program`, else the whole
+    {program: [events]} map (live view — copy before mutating)."""
+    if program is not None:
+        return list(_COMPILE_FINGERPRINTS.get(program, ()))
+    return {k: list(v) for k, v in _COMPILE_FINGERPRINTS.items()}
+
+
+def reset_compile_fingerprints() -> None:
+    _COMPILE_FINGERPRINTS.clear()
+
 
 class _CompileCounting:
     """Wrap a jitted program and count its jit-cache misses (compiles AND
@@ -30,7 +103,11 @@ class _CompileCounting:
     program's fixed name.  A growing count at steady state is the recompile
     alarm ROADMAP item 2's perf oracle needs (shape-bucket drift, weak-type
     wobble, donation mismatch all show up here before they show up as tail
-    latency)."""
+    latency).  Each counted miss also records the dispatch's argument
+    signature via record_compile_fingerprint, so the retrace-budget test
+    can diff the spellings of compile N and N+1.  The signature is built
+    from avals (which survive donation) AFTER the dispatch — cost is one
+    tree-flatten per compile event, nothing per steady-state call."""
 
     __slots__ = ("_name", "_fn", "_seen")
 
@@ -48,6 +125,12 @@ class _CompileCounting:
         if n > self._seen:
             XLA_COMPILES.labels(program=self._name).inc(n - self._seen)
             self._seen = n
+            try:
+                record_compile_fingerprint(
+                    self._name, _args_signature(args, kwargs))
+            except Exception:  # diagnostics must never fail a dispatch
+                _log.debug("compile fingerprint failed for %s",
+                           self._name, exc_info=True)
         return out
 
 
@@ -83,11 +166,14 @@ class CompiledPrograms:
     mixed_decode: Callable = None
 
 
-def build_compiled(model_config, engine_config, mesh,
-                   aot_cache=None, spec_k=None) -> CompiledPrograms:
-    """`aot_cache` (an engine/aot_cache.AOTExecutableCache) switches the
-    program set from lazy ``jax.jit`` to persistent per-signature AOT
-    executables — same call surface, zero compiles on a warm start.
+def program_defs(model_config, engine_config, mesh, spec_k=None) -> dict:
+    """The engine's program-definition table: ``{name: (python_fn,
+    donate_argnums)}`` for every program this config builds.  This is the
+    single source of truth `build_compiled` jits (or AOT-compiles) from —
+    and the seam the HLO perf oracle (analysis/hlo_oracle) re-enters to
+    lower the SAME programs standalone, so its budgets audit exactly what
+    the engine dispatches.  The aot-cache-key-drift lint audits the
+    engine-config reads in here (same scope as build_compiled).
 
     `spec_k` (EngineConfig.spec_decode_k, passed EXPLICITLY so the
     aot-cache-key-drift lint stays honest: the field is deliberately NOT
@@ -630,9 +716,8 @@ def build_compiled(model_config, engine_config, mesh,
         return fn
 
     n_kv_args = 3  # kv_pages is arg index 3 in the prefill/decode sigs
-    # program name -> (python fn, donated arg indices).  ONE definition
-    # table serves both dispatch modes below, so a program cannot exist
-    # jitted but be missing from the AOT-cached build (or vice versa).
+    # program name -> (python fn, donated arg indices): the one
+    # definition table every consumer (jit, AOT, hlo_oracle) builds from.
     defs = {
         "prefill": (_make_prefill(False), (n_kv_args,)),
         "prefill_lp": (_make_prefill(True), (n_kv_args,)),
@@ -665,6 +750,19 @@ def build_compiled(model_config, engine_config, mesh,
             # [B, V] int32 per dispatch; re-donate after a jaxlib upgrade
             # proves clean under the same stress loop.
             defs["mixed_decode"] = (_make_mixed_decode(int(spec_k)), (3,))
+    return defs
+
+
+def build_compiled(model_config, engine_config, mesh,
+                   aot_cache=None, spec_k=None) -> CompiledPrograms:
+    """`aot_cache` (an engine/aot_cache.AOTExecutableCache) switches the
+    program set from lazy ``jax.jit`` to persistent per-signature AOT
+    executables — same call surface, zero compiles on a warm start.  The
+    program table itself comes from `program_defs` (one definition table
+    serves both dispatch modes AND the hlo_oracle's standalone lowering,
+    so a program cannot exist jitted but be missing from the AOT-cached
+    build or the perf budgets)."""
+    defs = program_defs(model_config, engine_config, mesh, spec_k=spec_k)
     if aot_cache is not None:
         # persistent AOT path (engine/aot_cache.py): per-signature
         # executables lowered once and serialized to disk, so a warm

@@ -107,6 +107,74 @@ class StubCosts:
     # to price the K=0 dense-packing win in sim terms.
     ragged_align_tokens: int = 0
 
+    @classmethod
+    def from_oracle(cls, budgets: dict, decode_step_s: float = 2e-3,
+                    variant: str = "tp1", **overrides) -> "StubCosts":
+        """Derive cost RATIOS from the HLO perf oracle's committed
+        budgets (analysis/hlo_oracle, perf_budgets.json) instead of
+        inventing them: anchor one wall-clock number — `decode_step_s`,
+        a measured (or assumed) per-decode-step latency — and scale the
+        other program costs by their oracle-extracted FLOP/byte ratios
+        (ROADMAP 5b: sim SLO numbers become predictions, not fictions).
+
+        - prefill_per_token_s: decode's seconds-per-flop times the
+          largest prefill bucket's flops-per-token;
+        - inject_s: decode_step_s scaled by the inject/decode-step
+          bytes-accessed ratio (the scatter is bandwidth-, not
+          flop-bound);
+        - spec_verify_per_token_s: the extra flops a K-draft
+          mixed_decode round carries over a plain decode step, divided
+          by K, priced at decode's seconds-per-flop.
+
+        Programs missing from the budgets keep the dataclass defaults;
+        `overrides` pin any field explicitly.  Raises ValueError when
+        the decode anchor itself is missing — a cost model silently
+        built from nothing would be the old fiction with better
+        branding."""
+        programs = budgets.get("programs", budgets)
+
+        def _norm(entry, field, default=1):
+            return max(int(entry.get("norm", {}).get(field, default)), 1)
+
+        decode = programs.get(f"{variant}/decode")
+        if not decode or not decode.get("flops"):
+            raise ValueError(
+                f"from_oracle: no usable {variant}/decode entry in the "
+                "budgets (run `python -m kserve_tpu.analysis.hlo_oracle "
+                "update`)")
+        steps = _norm(decode, "steps")
+        flops_per_step = float(decode["flops"]) / steps
+        bytes_per_step = float(decode.get("bytes_accessed", 0.0)) / steps
+        s_per_flop = decode_step_s / flops_per_step
+        fields: dict = {"decode_step_s": decode_step_s}
+
+        prefills = sorted(
+            (k, e) for k, e in programs.items()
+            if k.startswith(f"{variant}/prefill/b") and e.get("flops"))
+        if prefills:
+            _, pf = prefills[-1]  # largest bucket: the steady-state shape
+            fields["prefill_per_token_s"] = s_per_flop * (
+                float(pf["flops"]) / _norm(pf, "tokens"))
+
+        inject = programs.get(f"{variant}/inject")
+        if inject and inject.get("bytes_accessed") and bytes_per_step:
+            fields["inject_s"] = decode_step_s * (
+                float(inject["bytes_accessed"]) / bytes_per_step)
+
+        spec = [
+            e for k, e in programs.items()
+            if f"/mixed_decode/k" in k and k.startswith(variant)
+            and e.get("norm", {}).get("k") and e.get("flops")
+        ]
+        if spec:
+            e = spec[0]
+            k = int(e["norm"]["k"])
+            round_flops = float(e["flops"]) / _norm(e, "steps")
+            extra = max(round_flops - flops_per_step, 0.0)
+            fields["spec_verify_per_token_s"] = s_per_flop * extra / k
+        fields.update(overrides)
+        return cls(**fields)
+
 
 class StubDevice:
     """One replica's device timeline: dispatches accumulate `busy_until`,

@@ -1,28 +1,52 @@
 #!/usr/bin/env bash
 # Repo lint gate: ruff for cheap generic checks (skipped when not
 # installed — the CI image does not bake it in), then jaxlint, the
-# domain-specific AST pass for JAX-serving hazards (docs/static_analysis.md).
+# domain-specific AST pass for JAX-serving hazards, the Prometheus
+# metric-cardinality gate, and the HLO perf oracle budget check
+# (docs/static_analysis.md).  Each gate's PASS/FAIL is echoed in a
+# summary at exit so a red CI log names the failing gate at a glance.
 # Run from the repo root:  scripts/lint.sh [extra paths...]
 set -u
 
 cd "$(dirname "$0")/.."
 if [ "$#" -gt 0 ]; then paths=("$@"); else paths=(kserve_tpu/ tests/); fi
 rc=0
+summary=()
+
+record() {  # record <gate-name> <exit-code>
+    if [ "$2" -eq 0 ]; then
+        summary+=("PASS  $1")
+    else
+        summary+=("FAIL  $1")
+        rc=1
+    fi
+}
 
 if command -v ruff >/dev/null 2>&1; then
     echo "== ruff check ${paths[*]}"
-    ruff check "${paths[@]}" || rc=1
+    ruff check "${paths[@]}"; record ruff $?
 else
     echo "== ruff not installed; skipping generic checks"
+    summary+=("SKIP  ruff (not installed)")
 fi
 
 echo "== jaxlint ${paths[*]}"
-python -m kserve_tpu.analysis "${paths[@]}" || rc=1
+python -m kserve_tpu.analysis "${paths[@]}"; record jaxlint $?
 
 # metric-cardinality gate: no Prometheus metric in kserve_tpu/ may declare
 # an unbounded label (backend ip:port, request id, ...) — the policy
 # documented in metrics.py, enforced (docs/observability.md)
 echo "== metrics-cardinality kserve_tpu/"
-python -m kserve_tpu.analysis.metrics_cardinality kserve_tpu/ || rc=1
+python -m kserve_tpu.analysis.metrics_cardinality kserve_tpu/; record metrics-cardinality $?
 
+# HLO perf oracle: compile the canonical program set and compare against
+# the committed perf_budgets.json — fails on >10% FLOP/byte growth, any
+# dropped donation alias, or any new collective.  Warm compile cache
+# makes this seconds; the CLI itself degrades to SKIP (exit 0) when the
+# environment cannot produce comparable numbers.
+echo "== hlo-oracle check"
+python -m kserve_tpu.analysis.hlo_oracle check; record hlo-oracle $?
+
+echo "== lint summary"
+for line in "${summary[@]}"; do echo "   $line"; done
 exit $rc

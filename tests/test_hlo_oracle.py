@@ -1,0 +1,388 @@
+"""HLO perf oracle (kserve_tpu/analysis/hlo_oracle, ISSUE 18): the
+artifact-level static-analysis gate over the engine's compiled programs.
+
+Structure mirrors the cost of each layer:
+- pure parsing/comparison units run on canned HLO text and dict
+  fixtures (no compiles);
+- the end-to-end gates compile only the small `inject`/`decode`
+  programs through the shared persistent compile cache;
+- the full 24-program check is @slow (scripts/lint.sh runs it anyway).
+
+The acceptance demonstrations live here: `check` exits 0 against the
+committed perf_budgets.json, and a seeded mutation — a program_defs
+variant with one donate_argnums dropped — fails the alias check with a
+violation naming the program and the arg.
+"""
+
+import json
+import os
+
+import pytest
+
+from kserve_tpu.analysis.hlo_oracle import budgets, extract
+from kserve_tpu.analysis.hlo_oracle.__main__ import main as oracle_main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: a miniature optimized-HLO module exercising every parsed feature:
+#: the header alias table, async-pair collectives, host transfers, rng
+_CANNED_HLO = """\
+HloModule jit_step, input_output_alias={ {0}: (3, {}, may-alias), {1}: (3, {1}, must-alias) }, entry_computation_layout={...}
+
+ENTRY %main.42 (p0: f32[4,8], p3: (f32[2,4,8], s8[16])) -> (f32[4,8], s8[16]) {
+  %p0 = f32[4,8]{1,0} parameter(0)
+  %ar = f32[4,8]{1,0} all-reduce(%p0), replica_groups={{0,1}}
+  %ag-start = (f32[4,8], f32[8,8]) all-gather-start(%ar), dimensions={0}
+  %ag-done = f32[8,8]{1,0} all-gather-done(%ag-start)
+  %cp = f32[8,8]{1,0} collective-permute(%ag-done), source_target_pairs={{0,1}}
+  %rng = f32[4,8]{1,0} rng-bit-generator(%p0), algorithm=rng_default
+  %cv = bf16[4,8]{1,0} convert(%rng)
+  %of = token[] outfeed(%cv), outfeed_config="x"
+  ROOT %tuple.1 = (f32[4,8], s8[16]) tuple(%ar, %p0)
+}
+"""
+
+
+class TestExtractParsing:
+    def test_shape_bytes(self):
+        assert extract.shape_bytes("f32[4,8]") == 128
+        assert extract.shape_bytes("bf16[2,3]") == 12
+        assert extract.shape_bytes("s8[16]") == 16
+        assert extract.shape_bytes("(f32[4], s8[4])") == 20
+        assert extract.shape_bytes("pred[]") == 1
+        assert extract.shape_bytes("token[]") == 0
+
+    def test_alias_table_parses_header_globally(self):
+        """Both entries come out of the module header — including the
+        nested-tuple one whose braces would truncate a naive regex."""
+        table = extract.alias_table(_CANNED_HLO)
+        assert ("0", 3, "may-alias") in table
+        assert ("1", 3, "must-alias") in table
+        assert len(table) == 2
+
+    def test_collective_inventory_counts_async_start_once(self):
+        inv = extract.collective_inventory(_CANNED_HLO)
+        assert inv["all-reduce"]["count"] == 1
+        # the -start/-done pair is ONE all-gather, not two
+        assert inv["all-gather"]["count"] == 1
+        assert inv["collective-permute"]["count"] == 1
+        assert inv["all-reduce"]["bytes"] == 128
+
+    def test_op_counts(self):
+        ops = extract.op_counts(_CANNED_HLO)
+        assert ops["rng"] == 1
+        assert ops["convert"] == 1
+        assert ops["host_transfer"] == 1
+
+
+def _entry(**over):
+    base = {
+        "flops": 1000.0, "bytes_accessed": 4000.0,
+        "donation": {"3": {"aliased": 2, "leaves": 2}},
+        "collectives": {"all-reduce": {"count": 2, "bytes": 512}},
+        "ops": {"rng": 0, "convert": 4, "host_transfer": 0},
+    }
+    base.update(over)
+    return base
+
+
+def _baseline(programs):
+    return {"schema_version": 1, "tolerance": 0.10, "backend": "cpu",
+            "jax": "0.0.test", "programs": programs}
+
+
+class TestCompare:
+    def test_clean_within_tolerance(self):
+        cmp = budgets.compare(
+            _baseline({"tp1/decode": _entry()}),
+            {"tp1/decode": _entry(flops=1050.0)})
+        assert cmp.ok and not cmp.warnings
+
+    def test_flop_growth_beyond_tolerance_names_metric_and_program(self):
+        cmp = budgets.compare(
+            _baseline({"tp1/decode": _entry()}),
+            {"tp1/decode": _entry(flops=1200.0)})
+        assert not cmp.ok
+        assert any("tp1/decode" in v and "flops" in v and "+20.0%" in v
+                   for v in cmp.violations), cmp.violations
+
+    def test_shrinking_costs_never_fail(self):
+        cmp = budgets.compare(
+            _baseline({"tp1/decode": _entry()}),
+            {"tp1/decode": _entry(flops=10.0, bytes_accessed=40.0)})
+        assert cmp.ok
+
+    def test_dropped_donation_alias_is_violation(self):
+        cur = _entry(donation={"3": {"aliased": 1, "leaves": 2}})
+        cmp = budgets.compare(
+            _baseline({"tp1/decode": _entry()}), {"tp1/decode": cur})
+        assert any("donation alias dropped" in v and "arg 3" in v
+                   for v in cmp.violations), cmp.violations
+
+    def test_undonated_arg_is_violation(self):
+        cmp = budgets.compare(
+            _baseline({"tp1/decode": _entry()}),
+            {"tp1/decode": _entry(donation={})})
+        assert any("no longer donated" in v for v in cmp.violations)
+
+    def test_new_collective_is_violation(self):
+        cur = _entry(collectives={
+            "all-reduce": {"count": 2, "bytes": 512},
+            "all-to-all": {"count": 1, "bytes": 64},
+        })
+        cmp = budgets.compare(
+            _baseline({"tp1/decode": _entry()}), {"tp1/decode": cur})
+        assert any("NEW collective all-to-all" in v
+                   for v in cmp.violations), cmp.violations
+
+    def test_collective_count_growth_is_violation(self):
+        cur = _entry(collectives={"all-reduce": {"count": 3, "bytes": 512}})
+        cmp = budgets.compare(
+            _baseline({"tp1/decode": _entry()}), {"tp1/decode": cur})
+        assert any("all-reduce count grew" in v for v in cmp.violations)
+
+    def test_host_transfer_appearing_is_violation(self):
+        cur = _entry(ops={"rng": 0, "convert": 4, "host_transfer": 1})
+        cmp = budgets.compare(
+            _baseline({"tp1/decode": _entry()}), {"tp1/decode": cur})
+        assert any("host-transfer" in v for v in cmp.violations)
+
+    def test_unbudgeted_program_is_violation_missing_is_warning(self):
+        cmp = budgets.compare(
+            _baseline({"tp1/decode": _entry()}),
+            {"tp1/new_thing": _entry()})
+        assert any("tp1/new_thing" in v and "not in baseline" in v
+                   for v in cmp.violations)
+        assert any("tp1/decode" in w for w in cmp.warnings)
+
+    def test_only_filter_restricts_baseline_domain(self):
+        """A filtered check must not report unfiltered programs missing."""
+        cmp = budgets.compare(
+            _baseline({"tp1/decode": _entry(), "tp1/mixed": _entry()}),
+            {"tp1/decode": _entry()}, only="decode")
+        assert cmp.ok and not cmp.warnings
+
+
+class TestCommittedBaseline:
+    """Invariants of the committed perf_budgets.json itself: the
+    document the gate trusts must hold the properties the gate sells."""
+
+    @pytest.fixture(scope="class")
+    def doc(self):
+        doc = budgets.load_budgets()
+        assert doc is not None, "perf_budgets.json missing from repo root"
+        return doc
+
+    def test_stamped_and_versioned(self, doc):
+        from kserve_tpu.analysis.hlo_oracle import oracle
+
+        assert doc["schema_version"] == oracle.SCHEMA_VERSION
+        assert doc["jax"] and doc["backend"]
+        assert 0 < doc["tolerance"] < 1
+
+    def test_every_donation_fully_aliased(self, doc):
+        for key, entry in doc["programs"].items():
+            for arg, d in entry.get("donation", {}).items():
+                assert d["aliased"] == d["leaves"] > 0, (
+                    f"{key} arg {arg}: committed baseline must show every "
+                    f"donated leaf aliased, got {d}")
+
+    def test_no_host_transfers_or_rng(self, doc):
+        for key, entry in doc["programs"].items():
+            ops = entry.get("ops", {})
+            assert ops.get("host_transfer", 0) == 0, key
+            assert ops.get("rng", 0) == 0, key
+
+    def test_tp2_sharded_programs_have_collectives(self, doc):
+        for key in ("tp2/decode", "tp2/mixed", "tp2/prefill/b16"):
+            inv = doc["programs"][key]["collectives"]
+            assert inv, f"{key} must carry a collective inventory"
+            assert all(c["count"] > 0 and c["bytes"] > 0
+                       for c in inv.values()), (key, inv)
+
+    def test_program_key_coverage(self, doc):
+        """The baseline covers the full variant matrix — a program
+        silently falling out of collection would otherwise only warn."""
+        keys = set(doc["programs"])
+        for want in ("tp1/mixed", "tp1/decode", "tp1/inject",
+                     "tp1/prefill/b16", "tp1/prefill/b32",
+                     "tp1/prefill_chunk/b16", "tp1/prefill_chunk/b32",
+                     "tp1_spec/mixed_decode/k2",
+                     "tp1_spec0/mixed_decode/k0",
+                     "tp1_q/inject_q", "tp2/decode", "tp2/mixed",
+                     "tp2_spec/mixed_decode/k2"):
+            assert want in keys, f"{want} missing from baseline"
+
+
+class TestCLIFastPaths:
+    """main() branches that never compile anything."""
+
+    def test_no_baseline_exits_1(self, tmp_path, capsys):
+        rc = oracle_main(["check", "--budgets", str(tmp_path / "none.json")])
+        assert rc == 1
+        assert "no baseline" in capsys.readouterr().out
+
+    def test_schema_mismatch_exits_1(self, tmp_path, capsys):
+        p = tmp_path / "b.json"
+        p.write_text(json.dumps(_baseline({}) | {"schema_version": 0}))
+        rc = oracle_main(["check", "--budgets", str(p)])
+        assert rc == 1
+        assert "schema_version" in capsys.readouterr().out
+
+    def test_backend_drift_skips_clean(self, tmp_path, capsys):
+        p = tmp_path / "b.json"
+        p.write_text(json.dumps(_baseline({}) | {"backend": "tpu"}))
+        rc = oracle_main(["check", "--budgets", str(p)])
+        assert rc == 0
+        assert "SKIP" in capsys.readouterr().out
+
+    def test_missing_cost_fields_skips_with_warning(self, monkeypatch,
+                                                    capsys):
+        """Satellite 6: a jax that reports no cost_analysis fields must
+        degrade the gate to an explicit skip, not a false pass/fail."""
+        from kserve_tpu.analysis.hlo_oracle import oracle
+
+        monkeypatch.setattr(
+            oracle, "collect",
+            lambda only=None, defs_override=None: {
+                "tp1/decode": {"donation": {}, "collectives": {},
+                               "ops": {}}})
+        rc = oracle_main(["check"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "SKIP" in out and "cost_analysis" in out
+
+
+def _dropped_donation_defs(mc, cfg, mesh, spec_k=None):
+    """program_defs with inject's donate_argnums dropped — the seeded
+    mutation: the scatter still compiles and still produces identical
+    results, but every dispatch now pays a full kv-cache copy."""
+    from kserve_tpu.engine.compiled import program_defs
+
+    defs = program_defs(mc, cfg, mesh, spec_k=spec_k)
+    fn, _donate = defs["inject"]
+    defs["inject"] = (fn, ())
+    return defs
+
+
+class TestOracleEndToEnd:
+    """Real lower+compile runs, kept cheap via --only filters and the
+    shared persistent compile cache."""
+
+    def test_check_passes_on_committed_baseline(self, capsys):
+        rc = oracle_main(["check", "--only", "inject"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "clean" in out
+
+    def test_seeded_mutation_dropped_donation_fails_alias_check(self):
+        """ISSUE 18 acceptance: drop one donate_argnums from the program
+        table and the oracle must fail, naming the program and the arg."""
+        from kserve_tpu.analysis.hlo_oracle import oracle
+
+        baseline = budgets.load_budgets()
+        mutated = oracle.collect(only="tp1/inject",
+                                 defs_override=_dropped_donation_defs)
+        assert "tp1/inject" in mutated
+        cmp = budgets.compare(baseline, mutated, only="tp1/inject")
+        assert not cmp.ok
+        assert any("tp1/inject" in v and "arg 0" in v
+                   and "no longer donated" in v
+                   for v in cmp.violations), cmp.violations
+
+    def test_tp2_collective_inventory_stable_across_builds(self):
+        """Satellite 4: the sharded decode program's collective
+        inventory is non-empty and bit-identical across two independent
+        builds — the budget is a property of the program, not of one
+        compile's mood."""
+        from kserve_tpu.analysis.hlo_oracle import oracle
+
+        a = oracle.collect(only="tp2/decode")["tp2/decode"]
+        b = oracle.collect(only="tp2/decode")["tp2/decode"]
+        assert a["collectives"], "tp2 decode must communicate"
+        assert a["collectives"] == b["collectives"]
+        assert a.get("donation") == b.get("donation")
+
+    def test_defs_table_matches_oracle_name_mirror(self):
+        """_default_program_names mirrors compiled.py's defs gating;
+        this is the tripwire that keeps them in sync."""
+        from kserve_tpu.analysis.hlo_oracle import oracle, signatures
+
+        ps = signatures.build_program_set(tp=1, spec_k=2)
+        # the defs table always carries inject_q; the oracle only
+        # budgets it where the config provides the quantized cache its
+        # signature needs (the tp1_q variant)
+        assert set(oracle._default_program_names(ps.cfg, 2)) == (
+            set(ps.defs) - {"inject_q"})
+
+    @pytest.mark.slow
+    def test_full_check_passes_on_committed_baseline(self, capsys):
+        rc = oracle_main(["check"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+
+
+class TestStubCostsFromOracle:
+    def test_derives_ratios_from_committed_baseline(self):
+        from kserve_tpu.sim.stub import StubCosts
+
+        doc = budgets.load_budgets()
+        costs = StubCosts.from_oracle(doc, decode_step_s=1e-3)
+        assert costs.decode_step_s == 1e-3
+        # every derived field left the dataclass default behind and is a
+        # sane positive ratio of the anchor
+        assert 0 < costs.prefill_per_token_s < 1.0
+        assert 0 < costs.inject_s < 1.0
+        assert 0 <= costs.spec_verify_per_token_s < 1.0
+        over = StubCosts.from_oracle(doc, inject_s=42.0)
+        assert over.inject_s == 42.0
+
+    def test_missing_decode_anchor_raises(self):
+        from kserve_tpu.sim.stub import StubCosts
+
+        with pytest.raises(ValueError, match="decode"):
+            StubCosts.from_oracle({"programs": {}})
+
+
+class TestAOTSeamSnapshots:
+    """The AOTProgram lower/compile seam records an oracle snapshot per
+    cold compile (warm starts cost nothing: no compile, no snapshot
+    write, no observer callback)."""
+
+    def test_cold_compile_snapshots_and_warm_reuse_is_silent(
+            self, tmp_path):
+        import jax.numpy as jnp
+
+        from kserve_tpu.analysis.hlo_oracle.signatures import (
+            tiny_engine_config, tiny_model_config)
+        from kserve_tpu.engine.aot_cache import (
+            AOTExecutableCache, AOTProgram, register_compile_observer,
+            unregister_compile_observer)
+
+        from kserve_tpu.parallel import sharding as shd
+
+        cfg = tiny_engine_config()
+        mesh = shd.create_mesh(tp=1, dp=1, sp=cfg.sp, pp=cfg.pp)
+        cache = AOTExecutableCache(
+            str(tmp_path), tiny_model_config(), cfg, mesh)
+
+        events = []
+
+        def observer(name, sig, lowered, compiled):
+            events.append((name, sig))
+
+        register_compile_observer(observer)
+        try:
+            prog = AOTProgram("probe", lambda x, y: x @ y + 1.0, cache)
+            x = jnp.ones((4, 4))
+            prog(x, x)
+            assert len(events) == 1 and events[0][0] == "probe"
+            snaps = cache.oracle_reports()
+            assert len(snaps) == 1
+            (snap,) = snaps.values()
+            assert snap["program"] == "probe"
+            assert snap.get("flops", 0) > 0
+            prog(x, x)  # warm: no new compile, no new observer event
+            assert len(events) == 1
+        finally:
+            unregister_compile_observer(observer)

@@ -6,6 +6,7 @@ known-good snippet it must stay quiet on, and the bad snippet with a
 assert the real tree lints clean and that the suppression budget holds.
 """
 
+import json
 import os
 import re
 import subprocess
@@ -885,3 +886,44 @@ def test_cli_exits_nonzero_on_findings(tmp_path):
     )
     assert proc.returncode == 1
     assert "swallowed-exception" in proc.stdout
+
+
+def test_cli_json_format_round_trips(tmp_path):
+    """--format json emits the findings as a machine-parseable list of
+    {path,line,col,rule,message} records on stdout, nothing else, and
+    the records round-trip to the same content text mode renders."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(BAD_EXCEPT))
+    proc = subprocess.run(
+        [sys.executable, "-m", "kserve_tpu.analysis", str(bad),
+         "--format", "json"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 1
+    records = json.loads(proc.stdout)  # stdout must be pure JSON
+    assert isinstance(records, list) and records
+    for rec in records:
+        assert set(rec) == {"path", "line", "col", "rule", "message"}
+        assert rec["path"] == str(bad)
+        assert isinstance(rec["line"], int) and rec["line"] >= 1
+    assert any(r["rule"] == "swallowed-exception" for r in records)
+
+    text_proc = subprocess.run(
+        [sys.executable, "-m", "kserve_tpu.analysis", str(bad)],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    rendered = {
+        f"{r['path']}:{r['line']}:{r['col']}: [{r['rule']}] {r['message']}"
+        for r in records
+    }
+    assert rendered == set(text_proc.stdout.splitlines())
+
+
+def test_cli_json_format_clean_is_empty_list():
+    proc = subprocess.run(
+        [sys.executable, "-m", "kserve_tpu.analysis",
+         os.path.join(PKG_DIR, "__init__.py"), "--format", "json"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout) == []

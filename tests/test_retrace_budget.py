@@ -27,6 +27,8 @@ import os
 
 from conftest import async_test
 
+from kserve_tpu.engine.compiled import (compile_fingerprints,
+                                        reset_compile_fingerprints)
 from kserve_tpu.engine.sampling import SamplingParams
 from kserve_tpu.metrics import XLA_COMPILES
 
@@ -38,6 +40,13 @@ def compile_counts() -> dict:
             if s.name.endswith("_total"):
                 out[s.labels["program"]] = int(s.value)
     return out
+
+
+def spellings(program: str) -> list:
+    """The recorded per-compile arg-signature spellings for a program —
+    what a retrace-budget failure message names so the drifted spelling
+    is in the CI log, not just 'count went 1 -> 2'."""
+    return [fp["signature"] for fp in compile_fingerprints(program)]
 
 
 def delta(base: dict) -> dict:
@@ -61,6 +70,7 @@ class TestRetraceBudget:
         assert engine._use_mixed
         await engine.start()
         try:
+            reset_compile_fingerprints()
             base = compile_counts()
             params = SamplingParams(
                 max_tokens=4, temperature=0.0, ignore_eos=True)
@@ -74,6 +84,12 @@ class TestRetraceBudget:
                 "first request must compile exactly one mixed program, "
                 f"got {delta(base)}"
             )
+            # each compile event left a fingerprint naming the compiled
+            # arg-signature spelling, so a budget failure below can say
+            # WHICH spelling drifted rather than just "count grew"
+            fps = compile_fingerprints("mixed")
+            assert len(fps) == 1, fps
+            assert fps[0]["signature"] and fps[0]["fingerprint"], fps
             # steady state: more same-bucket requests compile NOTHING —
             # including request 2, where the donated kv_pages used to pay
             # a benign settle retrace before the canonical-spelling fix
@@ -81,8 +97,9 @@ class TestRetraceBudget:
                 await run_one(i)
             assert delta(base) == {"mixed": 1}, (
                 "per-request recompile detected at steady state: "
-                f"{delta(base)}"
+                f"{delta(base)}; compiled spellings: {spellings('mixed')}"
             )
+            assert len(compile_fingerprints("mixed")) == 1
         finally:
             await engine.stop()
 
@@ -128,6 +145,7 @@ class TestRetraceBudget:
                     pass
 
             # settle the small bucket first
+            reset_compile_fingerprints()
             await run_one([1] * 4)
             await run_one([2] * 4)
             base = compile_counts()
@@ -140,6 +158,17 @@ class TestRetraceBudget:
             assert delta(base) == {"mixed": 1}, (
                 f"new-bucket mixed program kept retracing: {delta(base)}"
             )
+            # the two compiles left two fingerprints whose SIGNATURES
+            # differ — the diff names the drifted spelling (here the
+            # packed token buffer: 16-wide vs 32-wide bucket), which is
+            # exactly what a human needs when the budget assert fires
+            fps = compile_fingerprints("mixed")
+            assert len(fps) == 2, fps
+            assert fps[0]["signature"] != fps[1]["signature"], (
+                "bucket change must be visible in the recorded spelling: "
+                f"{fps}"
+            )
+            assert fps[0]["fingerprint"] != fps[1]["fingerprint"]
         finally:
             await engine.stop()
 
