@@ -958,6 +958,188 @@ async def run_prefix_bench(args):
     }
 
 
+async def run_peer_bench(args):
+    """Peer mode (docs/kv_hierarchy.md "Cross-replica page serving"):
+    TTFT for one shared prefix on a FRESH replica (empty local tiers)
+    across the cross-replica fabric's temperatures —
+
+    - cold_local: no peer fabric; the control (full prefill),
+    - peer_warm: a warm donor replica serves verified pages over the
+      fabric, so the fresh replica's first request pages the prefix in
+      instead of re-prefilling it,
+    - corrupt_peer: the same fetch against a lying donor (every body has
+      one bit flipped under an honest 200) — verification must reject
+      each page, count it, and degrade to the cold-local prefill.
+
+    The donor persists its prefix via the persist-on-reuse trigger and
+    stays alive as the page server; each fetcher is a separate engine on
+    an empty volume sharing one AOT cache, settled across both shape
+    buckets, so TTFT deltas are purely the KV story."""
+    import shutil
+    import tempfile
+
+    import httpx
+    import jax
+
+    from kserve_tpu.engine.engine import EngineConfig, LLMEngine
+    from kserve_tpu.engine.sampling import SamplingParams
+    from kserve_tpu.engine.tokenizer import ByteTokenizer
+    from kserve_tpu.kvstore import PeerPageClient, PeerPageIndex
+    from kserve_tpu.models.llama import LlamaConfig
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        model_config = LlamaConfig.bench_1b()
+        cfg = dict(
+            max_batch_size=16, page_size=16, num_pages=1024,
+            max_pages_per_seq=32, max_prefill_len=256,
+            prefill_buckets=(128, 256), dtype="bfloat16",
+            use_pallas=None, steps_per_sync=16, prefill_batch=8,
+        )
+        prefix_len, tail_len = 192, 16
+    else:  # CPU smoke: same code path at tiny shapes
+        model_config = LlamaConfig.tiny(dtype="float32")
+        cfg = dict(
+            max_batch_size=4, page_size=8, num_pages=128,
+            max_pages_per_seq=16, max_prefill_len=64,
+            prefill_buckets=(32, 64), dtype="float32", use_pallas=False,
+            steps_per_sync=4, prefill_batch=4,
+        )
+        prefix_len, tail_len = 48, 8
+    tokenizer = ByteTokenizer(model_config.vocab_size)
+    params = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+    prefix = [7 + (i % 40) for i in range(prefix_len)]
+    aot_dir = tempfile.mkdtemp(prefix="kserve-peer-bench-aot-")
+    donor_dir = tempfile.mkdtemp(prefix="kserve-peer-bench-donor-")
+    empty_dirs = [tempfile.mkdtemp(prefix="kserve-peer-bench-empty-")
+                  for _ in range(3)]
+    DONOR_URL = "http://donor:8080"
+
+    def build(kv_dir):
+        return LLMEngine(
+            model_config,
+            EngineConfig(**cfg, aot_cache_dir=aot_dir,
+                         kv_persist_dir=kv_dir),
+            tokenizer, rng_seed=0,
+        )
+
+    async def ttft_of(engine, tail_base: int) -> float:
+        t0 = time.perf_counter()
+        ttft = None
+        async for _ in engine.generate(
+            prefix + [tail_base + i for i in range(tail_len)], params
+        ):
+            if ttft is None:
+                ttft = time.perf_counter() - t0
+        return round(ttft, 4)
+
+    async def settle(engine):
+        for n in (prefix_len + tail_len, tail_len):
+            async for _ in engine.generate([3] * n, params):
+                pass
+
+    def make_peer_client(donor, corrupt: bool) -> PeerPageClient:
+        def handler(request: httpx.Request) -> httpx.Response:
+            try:
+                digest = bytes.fromhex(request.url.path.rsplit("/", 1)[-1])
+            except ValueError:
+                return httpx.Response(404)
+            body = donor.read_peer_page(digest)
+            if body is None:
+                return httpx.Response(404)
+            data = bytearray(body)
+            if corrupt:
+                data[len(data) // 2] ^= 0xFF
+            return httpx.Response(
+                200, content=bytes(data),
+                headers={"content-type": "application/octet-stream"})
+
+        index = PeerPageIndex()
+        index.update(DONOR_URL, donor.scheduler_state().get("peer_pages"))
+        return PeerPageClient(
+            httpx.AsyncClient(transport=httpx.MockTransport(handler)),
+            index=index, self_url="http://fetcher:8080")
+
+    points = []
+    clients = []
+    try:
+        donor = build(donor_dir)
+        await donor.start()
+        await settle(donor)
+        # persist-on-reuse: the first request seeds the HBM cache, the
+        # reuse proves the prefix hot and triggers the write-through
+        await ttft_of(donor, 60)
+        await ttft_of(donor, 80)
+        want = prefix_len // cfg["page_size"]
+        deadline = time.perf_counter() + 30.0
+        while (donor.scheduler_state()["prefix_store"]["persist_digests"]
+               < want and time.perf_counter() < deadline):
+            await asyncio.sleep(0.05)
+        persisted = donor.scheduler_state()["prefix_store"]["persist_digests"]
+
+        e_cold = build(empty_dirs[0])
+        await e_cold.start()
+        await settle(e_cold)
+        points.append({"point": "cold_local",
+                       "ttft_s": await ttft_of(e_cold, 60)})
+        await e_cold.stop()
+
+        e_warm = build(empty_dirs[1])
+        warm_client = make_peer_client(donor, corrupt=False)
+        clients.append(warm_client)
+        e_warm.set_peer_client(warm_client)
+        await e_warm.start()
+        await settle(e_warm)
+        points.append({"point": "peer_warm",
+                       "ttft_s": await ttft_of(e_warm, 60),
+                       "fetch": dict(warm_client.stats)})
+        await e_warm.stop()
+
+        e_bad = build(empty_dirs[2])
+        bad_client = make_peer_client(donor, corrupt=True)
+        clients.append(bad_client)
+        e_bad.set_peer_client(bad_client)
+        await e_bad.start()
+        await settle(e_bad)
+        points.append({"point": "corrupt_peer",
+                       "ttft_s": await ttft_of(e_bad, 60),
+                       "fetch": dict(bad_client.stats),
+                       "bad_pages": dict(bad_client.bad_pages)})
+        await e_bad.stop()
+        await donor.stop()
+    finally:
+        for c in clients:
+            await c.client.aclose()
+        shutil.rmtree(aot_dir, ignore_errors=True)
+        shutil.rmtree(donor_dir, ignore_errors=True)
+        for d in empty_dirs:
+            shutil.rmtree(d, ignore_errors=True)
+    by = {p["point"]: p for p in points}
+    warm = by["peer_warm"]["ttft_s"]
+    cold = by["cold_local"]["ttft_s"]
+    return {
+        "metric": ("llama3_1b_peer_ttft" if on_tpu
+                   else "tiny_peer_ttft_cpu_smoke"),
+        "unit": "s",
+        "mode": "peer",
+        "value": warm,
+        "detail": {
+            "backend": jax.default_backend(),
+            "prefix_tokens": prefix_len,
+            "donor_persist_digests": persisted,
+            "peer_warm_vs_cold_speedup": round(cold / max(warm, 1e-9), 2),
+            # the degradation contract: a lying peer costs the cold
+            # prefill (plus rejected fetches), never a wrong token
+            "corrupt_peer_vs_cold_ratio": round(
+                by["corrupt_peer"]["ttft_s"] / max(cold, 1e-9), 2),
+            "peer_pages_fetched": by["peer_warm"]["fetch"]["hit"],
+            "corrupt_pages_rejected":
+                by["corrupt_peer"]["fetch"]["corrupt"],
+        },
+        "points": points,
+    }
+
+
 async def run_spec_bench(args):
     """Spec mode (docs/kernels.md, ISSUE 15): speculative decoding +
     dense decode packing, swept over K on a decode-heavy and a 1:1
@@ -1167,7 +1349,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--mode",
         choices=("throughput", "latency", "mixed", "coldstart", "prefix",
-                 "spec"),
+                 "peer", "spec"),
         default="throughput",
         help="throughput: headline aggregate tok/s/chip (default, the "
              "driver contract).  latency: concurrency sweep reporting "
@@ -1181,6 +1363,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
              "store's temperatures — cold prefill vs HBM prefix-cache hit "
              "vs persistent-store page-in after a restart "
              "(docs/kv_hierarchy.md).  "
+             "peer: shared-prefix TTFT on a FRESH replica — cold local "
+             "prefill vs verified page-in from a warm peer vs the "
+             "corrupt-peer degradation path (docs/kv_hierarchy.md "
+             "Cross-replica page serving).  "
              "spec: speculative decoding + dense decode packing K-sweep "
              "on decode-heavy and 1:1 mixes — tok/s, acceptance rate, "
              "TTFT/ITL, plus the sim-cost-plane virtual tok/s "
@@ -1217,6 +1403,8 @@ if __name__ == "__main__":
         result = asyncio.run(run_coldstart_bench(cli_args))
     elif cli_args.mode == "prefix":
         result = asyncio.run(run_prefix_bench(cli_args))
+    elif cli_args.mode == "peer":
+        result = asyncio.run(run_peer_bench(cli_args))
     elif cli_args.mode == "spec":
         result = asyncio.run(run_spec_bench(cli_args))
     else:

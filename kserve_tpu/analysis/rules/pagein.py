@@ -14,10 +14,14 @@ a linter has to catch it).
 
 Scope: functions whose name contains ``page_in``/``pagein`` (the
 engine's ``_page_in``/``_maybe_page_in`` and any future kvstore upload
-helper).  The blocking work belongs inside the thunk handed to
-``fetch_async`` — which runs on the worker — not in the coroutine body.
-This is the upload-path extension of the ``host-sync`` /
-``ragged-metadata-host-sync`` family.
+helper) plus the peer-fetch family (``fetch_page``/``fetch_from``/
+``peer_fetch`` — kvstore/peer.py's verified cross-replica leg, which
+rides the same dispatch-only upload and additionally must never block
+the event loop, so ``time.sleep`` is flagged there too; waits go
+through the injected clock).  The blocking work belongs inside the
+thunk handed to ``fetch_async`` — which runs on the worker — not in
+the coroutine body.  This is the upload-path extension of the
+``host-sync`` / ``ragged-metadata-host-sync`` family.
 """
 
 from __future__ import annotations
@@ -29,7 +33,8 @@ from typing import Iterator
 from ..core import FileContext, Finding, Rule, register
 from ..jaxutil import dotted_name
 
-_PAGEIN_NAME = re.compile(r"page_?in", re.IGNORECASE)
+_PAGEIN_NAME = re.compile(
+    r"page_?in|fetch_page|fetch_from|peer_fetch", re.IGNORECASE)
 
 #: attribute calls that block the caller on the device
 _BLOCKING_METHODS = {"block_until_ready", "item", "tolist", "to_py"}
@@ -37,6 +42,9 @@ _BLOCKING_METHODS = {"block_until_ready", "item", "tolist", "to_py"}
 #: REQUIRED one on this path and is not flagged)
 _SYNC_FETCH_ATTRS = {"fetch", "_fetch"}
 _TRANSFER_CALLS = {"jax.device_get", "device_get"}
+#: wall-clock blocking inside the (async) peer-fetch path — waits there
+#: must ride the injected clock (clock.sleep), never the thread
+_WALL_SLEEP_CALLS = {"time.sleep"}
 
 
 @register
@@ -58,6 +66,15 @@ class PageInHostSync(Rule):
                 if not isinstance(sub, ast.Call):
                     continue
                 name = dotted_name(sub.func)
+                if name in _WALL_SLEEP_CALLS:
+                    yield self.finding(
+                        ctx, sub,
+                        f"{name}() inside {node.name}(): wall-clock "
+                        "sleep blocks the event loop on the page-in/"
+                        "peer-fetch path; await the injected "
+                        "clock.sleep() instead",
+                    )
+                    continue
                 if name in _TRANSFER_CALLS:
                     yield self.finding(
                         ctx, sub,

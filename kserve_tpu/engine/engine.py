@@ -418,6 +418,9 @@ class LLMEngine:
         # digests are deduplicated across admission passes
         self._pagein_tasks: set = set()
         self._persisting: set = set()
+        # cross-replica page fabric (kvstore/peer.py): set_peer_client
+        # attaches the verified peer fetch path; None = local tiers only
+        self._peer_client = None
         self.preemption_count = 0
         # wedge detection: device fetches run on a DAEMON worker with a
         # deadline; a timeout flips `wedged` (liveness).  Daemon, not a
@@ -867,7 +870,36 @@ class LLMEngine:
             stats["adopted_hit_tokens"] = (
                 self._prefix_cache.adopted_hits * self.config.page_size)
             state["prefix_store"] = stats
+            # peer-servable digest set (kvstore/peer.py digest_set_wire):
+            # the bounded, generation-stamped summary the EPP re-serves so
+            # a woken replica knows WHICH peer holds which pages.  A
+            # separate key, not a prefix_store field — the picker's
+            # multi-model prefix_store merge sums numbers and would mangle
+            # a nested digest list.
+            wire = self._kv_store.resident_digest_wire()
+            if wire is not None:
+                state["peer_pages"] = wire
+        if self._peer_client is not None:
+            # peer-fetch outcomes + per-peer bad-page evidence: the
+            # production channel health.note_bad_page rides (the EPP
+            # diffs bad_pages counts per poll — scheduler/picker.py)
+            state["peer"] = self._peer_client.snapshot()
         return state
+
+    # -------- cross-replica page fabric (docs/kv_hierarchy.md) --------
+
+    def set_peer_client(self, client) -> None:
+        """Attach a kvstore.peer.PeerPageClient: _maybe_page_in then
+        extends its longest-run search past the local tiers into
+        peer-resident digests, and _page_in fetches + verifies them."""
+        self._peer_client = client
+
+    def read_peer_page(self, digest: bytes):
+        """Wire-encoded page bytes for the REST page server, or None.
+        Pure store read — never touches the engine loop."""
+        if self._kv_store is None:
+            return None
+        return self._kv_store.read_peer_page(digest)
 
     @property
     def _offload_bytes(self) -> int:
@@ -1029,6 +1061,19 @@ class LLMEngine:
                 or self._draining or self._stopped or len(keys) <= n_hbm):
             return False
         run = self._kv_store.longest_prefix_run(keys[n_hbm:])
+        # peer leg (docs/kv_hierarchy.md "Cross-replica page serving"):
+        # the longest-run search continues past the local tiers into
+        # digests some OTHER replica advertises as persist-resident.
+        # Chain contiguity is preserved — peer entries only ever extend
+        # the local run's tail, and _page_in truncates back to the
+        # longest VERIFIED prefix if a fetch fails mid-transfer.
+        peer = self._peer_client
+        if peer is not None:
+            for digest in keys[n_hbm + len(run):]:
+                if not any(u != peer.self_url
+                           for u in peer.index.peers_for(digest)):
+                    break
+                run.append((digest, "peer"))
         # room for the incoming pages may come from evicting COLD cached
         # pages (which demote in turn — hierarchy rotation, not loss);
         # only a cache that stays full of hotter pages vetoes the page-in
@@ -1053,7 +1098,12 @@ class LLMEngine:
         store = self._kv_store
         t0 = self._clock.now()
         try:
-            digests = [d for d, _ in run]
+            # peer entries only ever sit at the tail (how _maybe_page_in
+            # builds the run); the local head reads off the fetch worker,
+            # the peer tail fetches over the verified fabric
+            n_local = sum(1 for _, tier in run if tier != "peer")
+            digests = [d for d, _ in run[:n_local]]
+            peer_digests = [d for d, _ in run[n_local:]]
 
             def read():
                 out = []
@@ -1076,6 +1126,26 @@ class LLMEngine:
                 if self._prefix_cache.contains_key(digest):
                     continue  # a concurrent page-in/prefill won the race
                 entries.append((digest, got[0], got[1]))
+            # peer leg: chain contiguity first — a truncated LOCAL run
+            # means the peer tail no longer extends a verified prefix, so
+            # drop it; otherwise fetch + verify page by page, truncating
+            # at the first failure (mid-transfer peer death degrades to
+            # the longest verified prefix run, never a failed admission)
+            adopted_from_peer = []  # (digest, payload) for write-through
+            if (peer_digests and self._peer_client is not None
+                    and len(payloads) == len(digests)):
+                for digest in peer_digests:
+                    if self._stopped or self._draining:
+                        return
+                    if self._prefix_cache.contains_key(digest):
+                        continue
+                    payload = await self._peer_client.fetch_page(digest)
+                    if payload is None:
+                        break  # verify failure / partition / deadline
+                    entries.append((digest, payload, "peer"))
+                    adopted_from_peer.append((digest, payload))
+            if self._stopped or self._draining:
+                return
             if not entries or not self.allocator.can_allocate(len(entries)):
                 return
             pages = self.allocator.allocate(len(entries))
@@ -1107,6 +1177,12 @@ class LLMEngine:
             except BaseException:
                 self.allocator.free(pages)
                 raise
+            # write-through: a page fetched from a peer becomes locally
+            # resident (tiers + persistent layer) so the NEXT wake in
+            # this zone serves it without crossing the fabric again, and
+            # this replica starts advertising it in its digest-set wire
+            for digest, payload in adopted_from_peer:
+                store.put_prefix(digest, payload)
             ps = self.config.page_size
             pages_by_tier: Dict[str, int] = {}
             for _, _, tier in entries:

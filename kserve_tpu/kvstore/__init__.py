@@ -15,6 +15,16 @@ the AOT executable cache.  A page dropped anywhere in the hierarchy is
 a performance event, never a correctness one: the engine re-prefills.
 """
 
+from .peer import (
+    PAGE_ROUTE,
+    PageVerifyError,
+    PeerPageClient,
+    PeerPageIndex,
+    decode_page,
+    decode_payload,
+    digest_set_wire,
+    encode_page,
+)
 from .persist import PersistentPrefixStore
 from .store import HierarchicalKVStore, KVStoreConfig, PrefixStoreStats
 from .tiers import KVTierStore, Payload, TierConfig, payload_nbytes
@@ -23,9 +33,17 @@ __all__ = [
     "HierarchicalKVStore",
     "KVStoreConfig",
     "KVTierStore",
+    "PAGE_ROUTE",
+    "PageVerifyError",
     "Payload",
+    "PeerPageClient",
+    "PeerPageIndex",
     "PersistentPrefixStore",
     "PrefixStoreStats",
     "TierConfig",
+    "decode_page",
+    "decode_payload",
+    "digest_set_wire",
+    "encode_page",
     "payload_nbytes",
 ]

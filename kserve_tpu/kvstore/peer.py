@@ -1,0 +1,389 @@
+"""Cross-replica KV page fabric: verified peer page-in
+(docs/kv_hierarchy.md "Cross-replica page serving").
+
+A woken or cache-cold replica should not have to re-prefill a prefix a
+PEER already holds in its persistent store (DeepServe, arXiv:2501.14417
+— cluster-wide KV reuse).  This module is the client half of that
+fabric plus the wire contract both halves share:
+
+- ``encode_page`` / ``decode_page`` — the self-verifying wire form one
+  page travels in.  The blake2b digest chain (scheduler/prefix.py)
+  commits to the prefix TOKENS and page size; the wire trailer binds
+  the requested digest to the exact payload bytes, so a tampered,
+  truncated, or mis-keyed page (a real page served under the wrong
+  digest) fails verification BEFORE adoption.  This is an integrity
+  check against lying/rotten peers and torn transfers — byte-level
+  proof the payload is what the serving store persisted for that
+  digest, not a semantic proof the KV numbers are correct.
+- ``PeerPageIndex`` — which peer holds which digests, fed by the
+  compact generation-stamped digest-set wire form the EPP re-serves
+  from each replica's ``/state`` prefix block (``digest_set_wire``).
+  Stale sets age out by generation, size is bounded.
+- ``PeerPageClient`` — the fetch path, built on the existing
+  resilience primitives: per-peer ``RetryPolicy`` capped by a hard
+  per-fetch deadline, a ``BreakerRegistry`` keyed by peer URL (a
+  partitioned peer trips its breaker and the fabric degrades to
+  local-only), bounded concurrency, and mandatory verification.  Every
+  failure degrades to a miss — the engine re-prefills; a peer fault is
+  a performance event, never a correctness one.
+
+The server half is ``GET /v1/internal/kv/pages/{digest}`` on the
+replica REST server (protocol/rest/server.py), streaming
+``encode_page`` bytes straight off the persistent store.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import io
+from typing import Callable, Dict, List, Optional, Tuple
+
+import httpx
+import numpy as np
+
+from ..logging import logger
+from ..metrics import KV_PEER_FETCH_SECONDS, KV_PEER_FETCH_TOTAL
+from ..resilience import MONOTONIC, BreakerRegistry, Clock, RetryPolicy
+from ..resilience.retry import parse_retry_after
+from .persist import PERSIST_FORMAT, Payload
+
+#: URL prefix of the page-server route (shared by server + client)
+PAGE_ROUTE = "/v1/internal/kv/pages"
+
+#: wire header magic; bump WIRE_FORMAT when the layout changes — old
+#: peers' pages then fail verification and read as misses, never misread
+MAGIC = b"KVPG"
+WIRE_FORMAT = 1
+
+_DIGEST_LEN = 16  # blake2b(digest_size=16) — scheduler/prefix.py
+_HEADER_LEN = len(MAGIC) + 2 + _DIGEST_LEN + 8  # magic+version+digest+length
+_TRAILER_LEN = 16
+
+#: digest-set wire bound: 2048 * 32 hex chars ≈ 64 KiB of /state block —
+#: plenty for a node-local prefix store, small enough to gossip per poll
+WIRE_MAX_DIGESTS = 2048
+
+#: the closed fetch-outcome enum (kv_peer_fetch_total label values)
+FETCH_OUTCOMES = ("hit", "miss", "corrupt", "timeout", "breaker_open")
+
+
+class PageVerifyError(ValueError):
+    """A peer-served page failed wire verification: bad magic/version,
+    mis-keyed digest, length skew, checksum mismatch, or an undecodable
+    payload.  Callers count it, mark the peer suspect, and read a miss."""
+
+
+# ---------------------------------------------------------------- codec
+
+
+def _trailer(digest: bytes, payload_bytes: bytes) -> bytes:
+    return hashlib.blake2b(
+        digest + payload_bytes, digest_size=_TRAILER_LEN).digest()
+
+
+def encode_page(digest: bytes, payload_bytes: bytes) -> bytes:
+    """Wrap one persisted page file's raw bytes for the wire."""
+    header = (
+        MAGIC
+        + WIRE_FORMAT.to_bytes(2, "big")
+        + digest
+        + len(payload_bytes).to_bytes(8, "big")
+    )
+    return header + payload_bytes + _trailer(digest, payload_bytes)
+
+
+def decode_page(wire: bytes, expected_digest: bytes) -> bytes:
+    """Verify + unwrap one wire page; the payload file bytes on success.
+
+    Raises PageVerifyError on ANY discrepancy.  The embedded digest must
+    equal the digest the caller REQUESTED — a real page served under the
+    wrong key (the mis-keyed / swapped-entry case) is as rejected as a
+    bit-flipped one."""
+    if len(wire) < _HEADER_LEN + _TRAILER_LEN:
+        raise PageVerifyError(f"short wire page: {len(wire)} bytes")
+    if wire[: len(MAGIC)] != MAGIC:
+        raise PageVerifyError("bad magic")
+    version = int.from_bytes(wire[len(MAGIC): len(MAGIC) + 2], "big")
+    if version != WIRE_FORMAT:
+        raise PageVerifyError(f"wire format skew: {version} != {WIRE_FORMAT}")
+    off = len(MAGIC) + 2
+    embedded = wire[off: off + _DIGEST_LEN]
+    if embedded != expected_digest:
+        raise PageVerifyError("digest mismatch: page keyed for another prefix")
+    off += _DIGEST_LEN
+    length = int.from_bytes(wire[off: off + 8], "big")
+    payload_bytes = wire[_HEADER_LEN: _HEADER_LEN + length]
+    trailer = wire[_HEADER_LEN + length:]
+    if len(payload_bytes) != length or len(trailer) != _TRAILER_LEN:
+        raise PageVerifyError("truncated wire page")
+    if trailer != _trailer(expected_digest, payload_bytes):
+        raise PageVerifyError("checksum mismatch")
+    return payload_bytes
+
+
+def decode_payload(payload_bytes: bytes) -> Payload:
+    """Parse the verified npz file bytes into a device-uploadable payload
+    (same entry layout PersistentPrefixStore writes).  A payload that
+    passed the checksum but will not parse is still a PageVerifyError —
+    the serving store's entry was rotten before it was wrapped."""
+    try:
+        with np.load(io.BytesIO(payload_bytes)) as data:
+            fmt = int(data["fmt"])
+            if fmt != PERSIST_FORMAT:
+                raise ValueError(
+                    f"persist format skew: {fmt} != {PERSIST_FORMAT}")
+            return {k: data[k] for k in data.files if k != "fmt"}
+    except Exception as exc:  # noqa: BLE001 — np.load failure surface is
+        # wide (OSError/ValueError/BadZipFile/KeyError); every shape of
+        # it means the same thing here: unusable page, count + miss
+        raise PageVerifyError(
+            f"undecodable payload: {type(exc).__name__}: {exc}") from exc
+
+
+# ----------------------------------------------------------- digest sets
+
+
+def digest_set_wire(generation: int, digests: List[bytes],
+                    cap: int = WIRE_MAX_DIGESTS) -> Dict:
+    """The compact resident-digest summary a replica advertises in its
+    ``/state`` prefix block and the EPP re-serves to the fleet: bounded,
+    generation-stamped so a consumer can age out stale sets."""
+    ordered = sorted(digests)
+    return {
+        "generation": int(generation),
+        "digests": [d.hex() for d in ordered[:cap]],
+        "truncated": len(ordered) > cap,
+    }
+
+
+class PeerPageIndex:
+    """digest -> which peers' persistent stores hold it.
+
+    Fed by ``update(url, wire)`` with each peer's digest-set wire form;
+    a lower-generation set than the one already held is stale gossip and
+    ignored.  Bounded per peer by the wire cap itself."""
+
+    def __init__(self) -> None:
+        # url -> (generation, frozenset of digests)
+        self._peers: Dict[str, Tuple[int, frozenset]] = {}
+
+    def update(self, url: str, wire: Optional[Dict]) -> bool:
+        """Ingest one peer's advertised set; False when ignored (stale
+        generation or unparseable wire)."""
+        if not isinstance(wire, dict):
+            return False
+        try:
+            generation = int(wire.get("generation", 0))
+            digests = frozenset(
+                bytes.fromhex(h) for h in wire.get("digests", ())
+            )
+        except (TypeError, ValueError):
+            return False
+        current = self._peers.get(url)
+        if current is not None and generation < current[0]:
+            return False  # stale set: a newer snapshot already landed
+        self._peers[url] = (generation, digests)
+        return True
+
+    def forget(self, url: str) -> None:
+        self._peers.pop(url, None)
+
+    def peers_for(self, digest: bytes) -> List[str]:
+        """Deterministically-ordered candidate peers for one digest."""
+        return sorted(
+            url for url, (_, digests) in self._peers.items()
+            if digest in digests
+        )
+
+    def has(self, digest: bytes) -> bool:
+        return any(digest in ds for _, ds in self._peers.values())
+
+    def generation(self, url: str) -> Optional[int]:
+        entry = self._peers.get(url)
+        return entry[0] if entry is not None else None
+
+    def snapshot(self) -> Dict[str, Dict]:
+        return {
+            url: {"generation": gen, "digests": len(digests)}
+            for url, (gen, digests) in sorted(self._peers.items())
+        }
+
+
+# ---------------------------------------------------------------- client
+
+
+class _FetchDeadline:
+    """Per-fetch hard cap on the injected clock, shaped like
+    resilience.Deadline for RetryPolicy.next_delay's deadline check."""
+
+    def __init__(self, clock: Clock, budget_s: float):
+        self._clock = clock
+        self._t0 = clock.now()
+        self._budget = budget_s
+
+    def remaining(self) -> float:
+        return self._budget - (self._clock.now() - self._t0)
+
+
+class PeerPageClient:
+    """Verified peer page fetches over the resilience primitives.
+
+    Fully async — in the simulator the httpx client rides a
+    FaultInjectingTransport on the SimClock, so nothing here may block
+    a thread or touch real time; all waiting goes through the injected
+    clock.  Production passes an ``httpx.AsyncClient`` with a real
+    connect/read timeout; the sim's transport returns (or virtually
+    sleeps) deterministically.
+
+    Degradation contract (the acceptance surface of docs/kv_hierarchy.md
+    "Cross-replica page serving"):
+
+    - corrupt page   -> counted, ``on_bad_page(peer)`` health evidence,
+                        no retry against the lying peer, miss
+    - partition      -> retries, then breaker failure; an OPEN breaker
+                        skips the peer outright (local-only degradation)
+    - slow peer      -> per-fetch deadline cap; past it, miss
+    - 404            -> clean miss (stale index), breaker success
+    """
+
+    def __init__(
+        self,
+        client: httpx.AsyncClient,
+        *,
+        index: Optional[PeerPageIndex] = None,
+        self_url: Optional[str] = None,
+        retry: Optional[RetryPolicy] = None,
+        breakers: Optional[BreakerRegistry] = None,
+        max_concurrent: int = 4,
+        fetch_deadline_s: float = 2.0,
+        clock: Clock = MONOTONIC,
+        on_bad_page: Optional[Callable[[str], None]] = None,
+    ):
+        self.client = client
+        self.index = index if index is not None else PeerPageIndex()
+        self.self_url = self_url
+        self.retry = retry or RetryPolicy(
+            max_attempts=3, base_backoff_s=0.05, max_backoff_s=0.5,
+            retry_budget_s=fetch_deadline_s, seed=0)
+        self.breakers = breakers or BreakerRegistry(clock=clock)
+        self.fetch_deadline_s = fetch_deadline_s
+        self.clock = clock
+        self.on_bad_page = on_bad_page
+        self._sem = asyncio.Semaphore(max_concurrent)
+        #: outcome counts (mirrors kv_peer_fetch_total) + per-peer
+        #: bad-page evidence — the /state peer block the EPP's
+        #: note_bad_page production channel diffs against
+        self.stats: Dict[str, int] = {k: 0 for k in FETCH_OUTCOMES}
+        self.bad_pages: Dict[str, int] = {}
+
+    # ------------------------------------------------------------ helpers
+
+    def _outcome(self, outcome: str) -> None:
+        self.stats[outcome] += 1
+        KV_PEER_FETCH_TOTAL.labels(outcome=outcome).inc()
+
+    def _note_bad(self, peer_url: str) -> None:
+        self.bad_pages[peer_url] = self.bad_pages.get(peer_url, 0) + 1
+        logger.warning(
+            "kv-peer-bad-page peer=%s: page failed verification, "
+            "degrading to miss", peer_url)
+        if self.on_bad_page is not None:
+            self.on_bad_page(peer_url)
+
+    def snapshot(self) -> Dict:
+        """The peer block scheduler_state() exports."""
+        return {
+            "fetches": dict(sorted(self.stats.items())),
+            "bad_pages": dict(sorted(self.bad_pages.items())),
+            "breakers": dict(sorted(self.breakers.snapshot().items())),
+            "index": self.index.snapshot(),
+        }
+
+    # ------------------------------------------------------------ fetches
+
+    async def fetch_page(self, digest: bytes) -> Optional[Payload]:
+        """One verified page, from whichever indexed peer answers first
+        (deterministic candidate order), or None — never raises.  Every
+        per-peer attempt is individually counted/breakered."""
+        for peer_url in self.index.peers_for(digest):
+            if self.self_url is not None and peer_url == self.self_url:
+                continue
+            payload = await self.fetch_from(peer_url, digest)
+            if payload is not None:
+                return payload
+        return None
+
+    async def fetch_from(self, peer_url: str,
+                         digest: bytes) -> Optional[Payload]:
+        """One verified page from one specific peer, or None."""
+        if not self.breakers.allow(peer_url):
+            self._outcome("breaker_open")
+            return None
+        async with self._sem:
+            return await self._fetch_locked(peer_url, digest)
+
+    async def _fetch_locked(self, peer_url: str,
+                            digest: bytes) -> Optional[Payload]:
+        started = self.clock.now()
+        deadline = _FetchDeadline(self.clock, self.fetch_deadline_s)
+        attempt = 0
+        while True:
+            attempt += 1
+            response: Optional[httpx.Response] = None
+            try:
+                response = await self.client.get(
+                    f"{peer_url}{PAGE_ROUTE}/{digest.hex()}")
+            except httpx.HTTPError:
+                # partition / timeout / torn stream: maybe retry below
+                pass
+            if response is not None and deadline.remaining() <= 0.0:
+                # straggler peer: the response landed past the per-fetch
+                # deadline cap.  A late page — even a verifiable one — is
+                # read as a miss, so one slow peer bounds how long it can
+                # hold an admission back.
+                self.breakers.record_failure(peer_url)
+                self._outcome("timeout")
+                return None
+            if response is not None and response.status_code == 404:
+                # clean miss: the index was stale, the peer is healthy
+                self.breakers.record_success(peer_url)
+                self._outcome("miss")
+                return None
+            if response is not None and response.status_code == 200:
+                try:
+                    payload = decode_payload(
+                        decode_page(response.content, digest))
+                except PageVerifyError as exc:
+                    # the lying peer: count, mark suspect, degrade to
+                    # miss — and do NOT retry a peer that just proved it
+                    # serves garbage
+                    logger.warning(
+                        "kv-peer-page-verify-failed peer=%s digest=%s "
+                        "error=%s", peer_url, digest.hex(), exc)
+                    self.breakers.record_failure(peer_url)
+                    self._note_bad(peer_url)
+                    self._outcome("corrupt")
+                    return None
+                self.breakers.record_success(peer_url)
+                self._outcome("hit")
+                KV_PEER_FETCH_SECONDS.observe(self.clock.now() - started)
+                return payload
+            # transport failure or an error status: retry inside the cap
+            retry_after = None
+            if response is not None:
+                if not self.retry.retryable(response.status_code):
+                    self.breakers.record_failure(peer_url)
+                    self._outcome("timeout")
+                    return None
+                retry_after = parse_retry_after(
+                    response.headers.get("Retry-After"))
+            elapsed = self.clock.now() - started
+            delay = self.retry.next_delay(
+                attempt, retry_after=retry_after, elapsed=elapsed,
+                deadline=deadline)
+            if delay is None or deadline.remaining() <= 0.0:
+                self.breakers.record_failure(peer_url)
+                self._outcome("timeout")
+                return None
+            await self.clock.sleep(delay)

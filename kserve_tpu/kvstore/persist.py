@@ -64,6 +64,10 @@ class PersistentPrefixStore:
         self._on_event = on_event
         self._digests: Set[bytes] = set()
         self.writable = True
+        #: bumped on every resident-set mutation (store / corrupt
+        #: discard) so the digest-set wire form peers gossip can age out
+        #: stale snapshots (kvstore/peer.py PeerPageIndex)
+        self.generation = 0
         try:
             os.makedirs(root, exist_ok=True)
         except OSError as exc:
@@ -105,6 +109,21 @@ class PersistentPrefixStore:
     def digests(self) -> List[bytes]:
         return sorted(self._digests)
 
+    def read_page_bytes(self, digest: bytes) -> Optional[bytes]:
+        """Raw on-disk bytes of one entry, for the peer page server
+        (protocol/rest/server.py GET /v1/internal/kv/pages/{digest}).
+        No validation here — the server stays cheap and the FETCHING
+        peer verifies against the digest chain before adoption, so a
+        locally-rotted file fails the client's check, not ours.  None on
+        miss or any filesystem error (the peer sees a 404 and moves on)."""
+        if digest not in self._digests:
+            return None
+        try:
+            with open(self._path(digest), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
     def store(self, digest: bytes, payload: Payload) -> bool:
         """Persist one page payload (atomic tmp+rename).  Content
         addressed: an existing entry is never rewritten.  Best-effort —
@@ -127,6 +146,7 @@ class PersistentPrefixStore:
             os.replace(tmp_name, self._path(digest))
             tmp_name = None
             self._digests.add(digest)
+            self.generation += 1
             self._event("store")
             return True
         except (OSError, ValueError) as exc:
@@ -166,8 +186,20 @@ class PersistentPrefixStore:
                 "page will be re-prefilled", digest.hex(), path,
                 f"{type(exc).__name__}: {exc}")
             self._digests.discard(digest)
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
+            self.generation += 1
+            # unlink is best-effort AND skipped outright on a volume we
+            # already know is read-only: a full/RO cache volume may make
+            # the unlink itself raise, and that must cost a prefill, not
+            # a crash — the in-memory discard above already guarantees
+            # the entry reads as a miss for the rest of this life
+            if self.writable:
+                try:
+                    os.unlink(path)
+                except OSError as unlink_exc:
+                    logger.warning(
+                        "kv-persist-unlink-failed digest=%s error=%s: "
+                        "entry left on disk (read-only volume?); writes "
+                        "disabled", digest.hex(),
+                        f"{type(unlink_exc).__name__}: {unlink_exc}")
+                    self.writable = False
             return None

@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from ..logging import logger
 from ..metrics import KV_TIER_EVENTS
 from ..resilience import MONOTONIC, Clock
+from .peer import digest_set_wire, encode_page
 from .persist import PersistentPrefixStore
 from .tiers import KVTierStore, Payload, TierConfig, payload_nbytes
 
@@ -256,6 +257,31 @@ class HierarchicalKVStore:
             self.stats.pagein_tokens += t
             self.stats.pagein_tokens_by_tier[tier] = (
                 self.stats.pagein_tokens_by_tier.get(tier, 0) + t)
+
+    # ---------------- peer fabric (kvstore/peer.py) ----------------
+
+    def read_peer_page(self, digest: bytes) -> Optional[bytes]:
+        """Wire-encoded page bytes for the peer page server, or None when
+        the digest is not durably held here.  Only PERSIST entries are
+        served: they are the content-addressed files whose bytes the wire
+        trailer binds to the digest, and the only tier a peer's index
+        learns about (resident_digest_wire below)."""
+        if self.persist is None:
+            return None
+        raw = self.persist.read_page_bytes(digest)
+        if raw is None:
+            return None
+        return encode_page(digest, raw)
+
+    def resident_digest_wire(self) -> Optional[Dict]:
+        """The bounded, generation-stamped digest-set summary this
+        replica advertises (scheduler_state -> EPP /state -> peers'
+        PeerPageIndex), or None when the persistent layer is off."""
+        if self.persist is None:
+            return None
+        with self._lock:
+            return digest_set_wire(
+                self.persist.generation, self.persist.digests())
 
     def needs_persist(self, digests: Sequence[bytes]) -> List[bytes]:
         """The subset of `digests` not yet in the persistent layer (the

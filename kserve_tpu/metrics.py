@@ -69,11 +69,14 @@ KV_TIER_EVENTS = Counter(
 )
 # `tier` is the closed source set: hbm counts admission hits served from
 # the device-resident prefix cache; host/disk/persist count tokens paged
-# in from that tier (and therefore served as hits instead of prefilled)
+# in from that tier (and therefore served as hits instead of prefilled);
+# peer counts tokens paged in over the network from another replica's
+# persistent store (kvstore/peer.py)
 KV_PREFIX_HIT_TOKENS = Counter(
     "kv_prefix_hit_tokens_total",
     "prompt tokens served from cached prefix pages instead of being "
-    "prefilled, by the tier that held them (hbm | host | disk | persist)",
+    "prefilled, by the tier that held them "
+    "(hbm | host | disk | persist | peer)",
     ["model_name", "tier"],
 )
 KV_PAGEIN_SECONDS = Histogram(
@@ -85,6 +88,35 @@ KV_PAGEIN_SECONDS = Histogram(
         0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
         0.5, 1.0, 2.5, 5.0, float("inf"),
     ),
+)
+
+# Cross-replica KV page fabric (kvstore/peer.py — docs/kv_hierarchy.md
+# "Cross-replica page serving").  `outcome` is the closed fetch-result
+# enum; peer identity is a pod ip:port (unbounded under churn — the
+# cardinality policy below) and lives in the scheduler_state() peer
+# block and the EPP snapshots, never in a label.
+KV_PEER_FETCH_TOTAL = Counter(
+    "kv_peer_fetch_total",
+    "cross-replica KV page fetch attempts by outcome: hit = verified and "
+    "adopted, miss = peer answered 404, corrupt = payload failed digest "
+    "verification (lying peer — also health evidence), timeout = "
+    "transport failure / deadline / retries exhausted, breaker_open = "
+    "skipped because the peer's circuit was open",
+    ["outcome"],
+)
+KV_PEER_FETCH_SECONDS = Histogram(
+    "kv_peer_fetch_seconds",
+    "wall time of one peer page fetch: request issued -> payload "
+    "digest-verified (successful fetches only)",
+    buckets=(
+        0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+        0.5, 1.0, 2.5, 5.0, float("inf"),
+    ),
+)
+KV_PEER_PAGES_SERVED = Counter(
+    "kv_peer_pages_served_total",
+    "persisted px- pages this replica served to peers over "
+    "GET /v1/internal/kv/pages/{digest}",
 )
 
 # Resilience layer (kserve_tpu/resilience — docs/resilience.md).

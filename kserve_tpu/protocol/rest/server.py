@@ -10,6 +10,7 @@ Parity: reference python/kserve/kserve/protocol/rest/server.py.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import time
 from typing import TYPE_CHECKING, List, Optional
@@ -37,8 +38,9 @@ from ...lifecycle import (
     lifecycle_middleware,
     register_admin_routes,
 )
+from ...kvstore import PAGE_ROUTE
 from ...logging import logger, trace_logger
-from ...metrics import DEADLINE_REJECTED, SHED_REQUESTS
+from ...metrics import DEADLINE_REJECTED, KV_PEER_PAGES_SERVED, SHED_REQUESTS
 from ...resilience import (
     DEADLINE_HEADER,
     Deadline,
@@ -187,6 +189,14 @@ class RESTServer:
         # POST /admin/profile session (observability/introspection.py);
         # injectable so tests drive the capture window with a FakeClock
         self.profiler = profiler
+        # peer page server bound (docs/kv_hierarchy.md "Cross-replica
+        # page serving"): at most this many concurrent page reads, so a
+        # fleet of cold-waking peers can't starve local decode of disk
+        # bandwidth or executor threads.  The route itself is read-only,
+        # GET, and therefore naturally exempt from the (POST-inference-
+        # only) shedder and lifecycle admission gates.
+        self.peer_page_concurrency = 4
+        self._peer_page_sem: Optional[asyncio.Semaphore] = None
         self._runner: Optional[web.AppRunner] = None
 
     def create_application(self) -> web.Application:
@@ -238,6 +248,10 @@ class RESTServer:
         PDEndpoints(self.dataplane.model_registry).register(app)
         app.router.add_get(
             "/v1/internal/scheduler/state", self._scheduler_state_handler
+        )
+        # cross-replica KV page server (kvstore/peer.py wire contract)
+        app.router.add_get(
+            PAGE_ROUTE + "/{digest}", self._peer_page_handler
         )
         if self.lifecycle is not None:
             register_admin_routes(app, self.lifecycle, on_drain=self.on_drain)
@@ -299,6 +313,35 @@ class RESTServer:
             },
         }
         return web.json_response(agg)
+
+    async def _peer_page_handler(self, request: web.Request) -> web.Response:
+        """GET /v1/internal/kv/pages/{digest} — serve one persisted px-
+        page to a peer replica in the self-verifying wire form
+        (kvstore/peer.py encode_page).  Read-only and engine-loop-free:
+        the page bytes come straight off the persistent store's files on
+        an executor thread, bounded by the server's page semaphore.  404
+        on miss (including an undecodable digest) — the peer degrades to
+        re-prefill, so a miss here is never worth more than a miss."""
+        try:
+            digest = bytes.fromhex(request.match_info["digest"])
+        except ValueError:
+            return _error_response(404, "not a page digest")
+        if self._peer_page_sem is None:
+            self._peer_page_sem = asyncio.Semaphore(self.peer_page_concurrency)
+        loop = asyncio.get_running_loop()
+        async with self._peer_page_sem:
+            for model in self.dataplane.model_registry.get_models().values():
+                engine = getattr(model, "engine", None)
+                reader = getattr(engine, "read_peer_page", None)
+                if reader is None:
+                    continue
+                wire = await loop.run_in_executor(None, reader, digest)
+                if wire is not None:
+                    KV_PEER_PAGES_SERVED.inc()
+                    return web.Response(
+                        body=wire, content_type="application/octet-stream"
+                    )
+        return _error_response(404, "page not resident")
 
     async def start(self) -> None:
         app = self.create_application()

@@ -49,6 +49,20 @@ liveness or breakers):
                      odd injections raise ConnectError, even ones sleep
                      ``latency_s * skew`` and proceed (a flapping NIC /
                      link that defeats naive consecutive-failure counts)
+
+Peer-fabric kinds (docs/kv_hierarchy.md "Cross-replica page serving" —
+the failure modes of fetching a KV page from another replica; the
+client must verify, degrade to miss, and never fail admission):
+
+- ``peer_corrupt``   the LYING peer: the real response is served with a
+                     200 but its body has a byte flipped in transit (or
+                     by bad peer disk/memory) — distinct from a 5xx,
+                     only digest verification can catch it
+- ``peer_partition`` the peer is unreachable (httpx.ConnectError): the
+                     network partition / dead-pod case the breaker must
+                     absorb so the fetcher degrades to local-only
+- ``peer_slow``      the peer serves, ``latency_s * skew`` late: the
+                     straggler the client's deadline cap bounds
 """
 
 from __future__ import annotations
@@ -75,7 +89,7 @@ class FaultSpec:
     target: str  # substring matched against the call target
     # latency | connect_error | http_status | wedge | partial_stream |
     # preempt | replica_crash | clock_skew | slow_decode | wedged_fetch |
-    # flapping
+    # flapping | peer_corrupt | peer_partition | peer_slow
     kind: str
     status: int = 503
     latency_s: float = 0.0
@@ -145,10 +159,15 @@ class _TruncatedStream(httpx.AsyncByteStream):
 class FaultInjectingTransport(httpx.AsyncBaseTransport):
     """httpx transport honoring a FaultPlan in front of a real handler.
 
-    `handler(request) -> (status, json_payload)` serves pass-through calls
-    (the in-memory stub idiom the router tests already use); alternatively
-    wrap an `inner` transport.  The target string handed to the plan is
-    the request host (or the full url when host-less).
+    `handler(request) -> (status, payload)` serves pass-through calls
+    (the in-memory stub idiom the router tests already use); a `bytes`
+    payload becomes a binary octet-stream body (the peer page-server
+    stub), anything else a JSON one.  Alternatively wrap an `inner`
+    transport.  The target string handed to the plan is the request host
+    (or the full url when host-less), with `target_suffix` appended —
+    transports sharing one FaultPlan namespace themselves so a spec
+    aimed at the peer-fetch path (target ``"replica-1/kv"``) can never
+    collide with the client path's ``"replica-1/proxy"`` specs.
     """
 
     def __init__(
@@ -157,23 +176,25 @@ class FaultInjectingTransport(httpx.AsyncBaseTransport):
         handler: Optional[Callable] = None,
         inner: Optional[httpx.AsyncBaseTransport] = None,
         clock: Clock = MONOTONIC,
+        target_suffix: str = "",
     ):
         self.plan = plan
         self.handler = handler
         self.inner = inner
         self.clock = clock
+        self.target_suffix = target_suffix
         self.calls: List[str] = []  # pass-through + faulted targets, in order
         # flapping state: per-spec injection parity (odd = sick leg)
         self._flaps: Dict[int, int] = {}
 
     async def handle_async_request(self, request: httpx.Request) -> httpx.Response:
-        target = request.url.host or str(request.url)
+        target = (request.url.host or str(request.url)) + self.target_suffix
         self.calls.append(target)
         spec = self.plan.decide(target)
         if spec is not None:
             if spec.kind == "latency":
                 await self.clock.sleep(spec.latency_s)
-            elif spec.kind in ("clock_skew", "slow_decode"):
+            elif spec.kind in ("clock_skew", "slow_decode", "peer_slow"):
                 # a slow backend, not a dead one: the latency is the spec's
                 # latency scaled by the skew factor, then the call proceeds
                 await self.clock.sleep(spec.latency_s * spec.skew)
@@ -193,6 +214,25 @@ class FaultInjectingTransport(httpx.AsyncBaseTransport):
                     "injected wedged fetch", request=request)
             elif spec.kind == "connect_error":
                 raise httpx.ConnectError("injected connect error", request=request)
+            elif spec.kind == "peer_partition":
+                # the peer side of the fence is unreachable; the page
+                # client's breaker must open and degrade to local-only
+                raise httpx.ConnectError(
+                    "injected peer partition", request=request)
+            elif spec.kind == "peer_corrupt":
+                # the lying peer: serve the REAL response with one byte
+                # flipped and a confident 200 — only the client's digest
+                # verification stands between this and adopted garbage
+                response = await self._serve(request, target)
+                body = bytearray(await response.aread())
+                if not body:
+                    body = bytearray(b"\x00")
+                body[len(body) // 2] ^= 0xFF
+                return httpx.Response(
+                    200, content=bytes(body),
+                    headers={"content-type": "application/octet-stream"},
+                    request=request,
+                )
             elif spec.kind == "replica_crash":
                 # the process is gone: connection refused from here on
                 raise httpx.ConnectError(
@@ -215,6 +255,10 @@ class FaultInjectingTransport(httpx.AsyncBaseTransport):
                 )
             else:
                 raise ValueError(f"unknown fault kind {spec.kind!r}")
+        return await self._serve(request, target)
+
+    async def _serve(self, request: httpx.Request, target: str) -> httpx.Response:
+        """The pass-through leg (also the base response peer_corrupt flips)."""
         if self.inner is not None:
             return await self.inner.handle_async_request(request)
         if self.handler is None:
@@ -222,4 +266,10 @@ class FaultInjectingTransport(httpx.AsyncBaseTransport):
                 200, json={"ok": True, "target": target}, request=request
             )
         status, payload = self.handler(request)
+        if isinstance(payload, (bytes, bytearray)):
+            return httpx.Response(
+                status, content=bytes(payload),
+                headers={"content-type": "application/octet-stream"},
+                request=request,
+            )
         return httpx.Response(status, json=payload, request=request)

@@ -268,6 +268,23 @@ class FleetHealth:
             h.canary_successes = 0
         self._transition(url, h, self.clock.now())
 
+    def note_bad_page(self, url: str) -> None:
+        """Peer-fabric evidence channel (kvstore/peer.py,
+        docs/kv_hierarchy.md): a KV page SERVED BY `url` failed digest
+        verification on the fetching replica.  A lying peer is the gray
+        failure at its purest — it answers 200, polls green, and hands
+        out garbage — so the penalty is immediate and compounding: every
+        verified-bad page halves the score (degrade, then quarantine on
+        repeated evidence) and fails any in-flight canary.  setdefault,
+        not get: bad-page evidence may arrive via a replica's /state
+        peer block before this peer's own first health observation."""
+        h = self._h.setdefault(url, ReplicaHealth())
+        h.score *= 0.5
+        if h.canary_inflight:
+            h.canary_inflight = False
+            h.canary_successes = 0
+        self._transition(url, h, self.clock.now())
+
     # ---------------- transitions ----------------
 
     def _record(self, url: str, transition: str, now: float) -> None:

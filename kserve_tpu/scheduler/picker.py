@@ -83,6 +83,19 @@ class Replica:
     # stall_confirmed — the gray-failure signal fleet health scoring
     # quarantines on (scheduler/health.py, docs/resilience.md)
     watchdog: str = "ok"
+    # cross-replica page fabric (docs/kv_hierarchy.md "Cross-replica
+    # page serving"): the replica's persist-resident digest-set wire
+    # (generation-stamped, bounded — kvstore/peer.py digest_set_wire),
+    # re-served verbatim through the EPP snapshot so every replica can
+    # feed its PeerPageIndex from ONE poll target; plus the parsed set
+    # for the expected-prefix-hit scoring term
+    peer_pages: Optional[Dict] = None
+    peer_digest_set: frozenset = field(default_factory=frozenset)
+    # last-seen per-peer bad-page counts from the replica's /state peer
+    # block — the diff against these is the production evidence channel
+    # into FleetHealth.note_bad_page (a replica that FETCHED a corrupt
+    # page reports it; the EPP dings the SERVING peer's health)
+    peer_bad_seen: Dict[str, int] = field(default_factory=dict)
 
     @property
     def digests(self) -> frozenset:
@@ -110,6 +123,10 @@ class EndpointPicker:
         clock: Clock = MONOTONIC,  # error-decay/poll stamps (sim injects)
         health: Optional[FleetHealth] = None,  # scheduler/health.py
         health_weight: float = 4.0,  # score penalty per point of lost health
+        resident_weight: float = 1.0,  # score per persist-resident page a
+        # replica could page in WITHOUT prefilling (peer-fabric expected-
+        # prefix-hit term; weaker than prefix_weight — HBM-hot beats
+        # page-in-able, which beats re-prefill)
     ):
         # every time the picker reads (poll freshness, error decay) comes
         # from this injectable clock so the fleet simulator's routing is a
@@ -135,6 +152,7 @@ class EndpointPicker:
         self.poll_interval_s = poll_interval_s
         self.queue_weight = queue_weight
         self.prefix_weight = prefix_weight
+        self.resident_weight = resident_weight
         self.unhealthy_after = unhealthy_after
         self.state_path = state_path
         # text-chunk digest -> replica url (LRU)
@@ -182,8 +200,33 @@ class EndpointPicker:
         models: Dict[str, tuple] = {}
         wedged = False
         prefix_store: Optional[Dict] = None
+        peer_pages: Optional[Dict] = None
+        peer_bad: Dict[str, int] = {}
         wd_state = "ok"
         _WD_ORDER = {"ok": 0, "stall_suspected": 1, "stall_confirmed": 2}
+
+        def merge_peer_pages(block):
+            # highest generation wins (one wire per replica url in the
+            # fleet index; in practice replicas run one persisting model)
+            nonlocal peer_pages
+            if not isinstance(block, dict):
+                return
+            if peer_pages is None or int(block.get("generation", 0)) > int(
+                    peer_pages.get("generation", 0)):
+                peer_pages = block
+
+        def merge_peer(block):
+            # sum per-peer bad-page counts across a replica's engines
+            if not isinstance(block, dict):
+                return
+            bad = block.get("bad_pages")
+            if not isinstance(bad, dict):
+                return
+            for peer_url, count in bad.items():
+                try:
+                    peer_bad[peer_url] = peer_bad.get(peer_url, 0) + int(count)
+                except (TypeError, ValueError):
+                    continue
 
         def merge_watchdog(block):
             # the worst engine's state wins on a multi-model replica: one
@@ -220,6 +263,8 @@ class EndpointPicker:
             wedged = wedged or bool(m.get("wedged"))
             merge_prefix_store(m.get("prefix_store"))
             merge_watchdog(m.get("watchdog"))
+            merge_peer_pages(m.get("peer_pages"))
+            merge_peer(m.get("peer"))
         # flat form (engine.scheduler_state() given directly, tests)
         if "prefix_digests" in state or "page_size" in state:
             models[""] = (
@@ -231,7 +276,26 @@ class EndpointPicker:
         wedged = wedged or bool(state.get("wedged"))
         merge_prefix_store(state.get("prefix_store"))
         merge_watchdog(state.get("watchdog"))
+        merge_peer_pages(state.get("peer_pages"))
+        merge_peer(state.get("peer"))
         r.prefix_store = prefix_store
+        r.peer_pages = peer_pages
+        if peer_pages is not None:
+            try:
+                r.peer_digest_set = frozenset(
+                    bytes.fromhex(d) for d in peer_pages.get("digests", ()))
+            except (TypeError, ValueError):
+                r.peer_digest_set = frozenset()
+        # bad-page evidence channel: each INCREMENT in a replica's
+        # per-peer corrupt-page count is one verified observation that
+        # the named peer served garbage — fold it into fleet health so
+        # the lying peer's score drops (and its pick share with it).
+        # Counter resets (replica restart) re-baseline without noting.
+        for peer_url, count in peer_bad.items():
+            seen = r.peer_bad_seen.get(peer_url, 0)
+            for _ in range(max(count - seen, 0)):
+                self.health.note_bad_page(peer_url.rstrip("/"))
+            r.peer_bad_seen[peer_url] = count
         r.models = models
         r.healthy = not wedged
         r.watchdog = wd_state
@@ -367,6 +431,35 @@ class EndpointPicker:
             best = max(best, hits)
         return best
 
+    def _resident_hits(
+        self,
+        r: Replica,
+        prompt_ids: Optional[Sequence[int]],
+        chains: Dict[int, List[bytes]],
+    ) -> int:
+        """Expected-prefix-hit term for the peer fabric: the longest
+        leading page run of this prompt that `r` holds PERSIST-resident
+        (its advertised digest-set wire).  Those pages are one verified
+        page-in from being HBM hits — cheaper than a re-prefill even
+        when the HBM cache is cold, so routing leans toward the replica
+        that already durably holds the prefix.  Shares the per-page-size
+        chain memo with _prefix_hits."""
+        if not prompt_ids or not r.peer_digest_set:
+            return 0
+        best = 0
+        for page_size, _ in r.models.values():
+            if page_size not in chains:
+                chains[page_size] = token_prefix_digests(
+                    prompt_ids, page_size, for_lookup=True
+                )
+            hits = 0
+            for key in chains[page_size]:
+                if key not in r.peer_digest_set:
+                    break
+                hits += 1
+            best = max(best, hits)
+        return best
+
     def _text_hits(self, r: Replica, text: Optional[str]) -> int:
         if not text:
             return 0
@@ -443,6 +536,9 @@ class EndpointPicker:
                 self._text_hits(r, prompt_text),
             )
             score = hits * self.prefix_weight - r.queue_depth * self.queue_weight
+            if self.resident_weight > 0:
+                score += self.resident_weight * self._resident_hits(
+                    r, prompt_ids, chains)
             score -= self.error_weight * self.decayed_errors(r)
             # gray-degradation weight reduction: a DEGRADED replica sheds
             # pick share smoothly before quarantine hard-cuts it.  Gated
@@ -492,6 +588,7 @@ class EndpointPicker:
                 "ttft_p99_s": r.ttft_p99_s,
                 "itl_p99_s": r.itl_p99_s,
                 "prefix_store": r.prefix_store,
+                "peer_pages": r.peer_pages,
                 "watchdog": r.watchdog,
                 "health": self.health.snapshot(r.url),
                 "breaker": (

@@ -31,6 +31,7 @@ from .scenario import (  # noqa: F401
     autoscale_smoke_scenario,
     churn_10k_scenario,
     gray_failure_scenario,
+    peer_fabric_scenario,
     prefix_store_scenario,
     scale_zero_scenario,
     smoke_scenario,

@@ -33,6 +33,9 @@ from ..autoscale import (
     ReplicaActuator,
 )
 from ..autoscale.signals import ArrivalHistory
+import httpx
+
+from ..kvstore import PeerPageClient
 from ..lifecycle import GenerationPreempted, ReplicaDrainingError
 from ..lifecycle.checkpoint import GenerationCheckpoint
 from ..logging import logger
@@ -47,6 +50,7 @@ from ..resilience import (
     BreakerRegistry,
     Deadline,
     DeadlineExceededError,
+    FaultInjectingTransport,
     FaultPlan,
     FaultSpec,
     RetryPolicy,
@@ -128,6 +132,10 @@ class FleetSim:
         self.picker = EndpointPicker(
             [r.url for r in self.replicas.values()],
             clock=self.clock,
+            # resident-prefix steering: scenario-tunable so symmetric-
+            # traffic proofs (prefix_store_scenario) can pin it off
+            **({} if scenario.resident_weight is None
+               else {"resident_weight": scenario.resident_weight}),
             # gray-failure health layer (scheduler/health.py): scenario-
             # tunable config; None takes the picker's production defaults
             health=(FleetHealth(scenario.health, clock=self.clock)
@@ -146,6 +154,35 @@ class FleetSim:
         # "<name>/proxy" target — a bare name would substring-match
         # replica-1 against replica-10+ in larger fleets
         self.net_plan = FaultPlan([], seed=scenario.seed + 1)
+        # cross-replica page fabric (docs/kv_hierarchy.md "Cross-replica
+        # page serving"): one verified PeerPageClient per persisting
+        # replica, riding a FaultInjectingTransport whose handler answers
+        # straight off the OTHER replicas' real engines/stores.  The
+        # transport shares net_plan under the "/kv"-suffixed target
+        # namespace, so peer churn ("replica-1/kv") can never collide
+        # with client-path specs ("replica-1/proxy").
+        self.peer_clients: Dict[str, PeerPageClient] = {}
+        if scenario.spec.kv_persist:
+            for i, r in enumerate(self.replicas.values()):
+                transport = FaultInjectingTransport(
+                    self.net_plan, handler=self._peer_page_handler,
+                    clock=self.clock, target_suffix="/kv")
+                client = PeerPageClient(
+                    httpx.AsyncClient(transport=transport),
+                    self_url=r.url,
+                    clock=self.clock,
+                    retry=RetryPolicy(
+                        max_attempts=3, base_backoff_s=0.05,
+                        max_backoff_s=0.4, retry_budget_s=2.0,
+                        seed=scenario.seed * 131 + i),
+                    breakers=BreakerRegistry(
+                        BreakerConfig(window=8, failure_threshold=0.5,
+                                      min_volume=2, open_for_s=5.0),
+                        clock=self.clock),
+                    fetch_deadline_s=2.0,
+                )
+                r.set_peer_client(client)
+                self.peer_clients[r.url] = client
         self._validate_churn()
         self.records: List[ClientRecord] = []
         self._completed = 0
@@ -192,6 +229,7 @@ class FleetSim:
         "shed_storm", "heal_shed", "skew", "heal_skew",
         "scale_down", "scale_up",
         "slow_decode", "wedged_fetch", "flapping",
+        "peer_corrupt", "peer_partition", "peer_slow", "disk_wipe",
     })
     _FLEET_WIDE = frozenset({"shed_storm", "heal_shed"})
 
@@ -213,15 +251,51 @@ class FleetSim:
 
     async def _poll_loop(self) -> None:
         """The EPP's scrape loop: feeds each replica's real scheduler
-        state (or a failure observation for a dead one) to the picker."""
+        state (or a failure observation for a dead one) to the picker —
+        and re-serves each replica's advertised digest-set wire to every
+        OTHER replica's peer index (the EPP gossip leg of the fabric)."""
         while True:
             for r in self.replicas.values():
                 if r.alive:
-                    self.picker.observe_state(r.url, r.state_payload())
+                    state = r.state_payload()
+                    self.picker.observe_state(r.url, state)
+                    self._gossip_peer_pages(r.url, state.get("peer_pages"))
                 else:
                     self.picker.observe_failure(r.url)
             self._release_holds()
             await self.clock.sleep(self.scenario.poll_interval_s)
+
+    def _gossip_peer_pages(self, url: str, wire) -> None:
+        """Feed one replica's resident digest-set into every other
+        replica's PeerPageIndex (generation-stamped: stale re-deliveries
+        are ignored by the index itself).  A dead replica's last set is
+        deliberately KEPT — fetching from a gone peer is the partition
+        case the breaker + miss degradation already absorb."""
+        if wire is None:
+            return
+        for owner_url, client in self.peer_clients.items():
+            if owner_url != url:
+                client.index.update(url, wire)
+
+    def _peer_page_handler(self, request: httpx.Request):
+        """The page-server half of the fabric, in-memory: GET
+        {PAGE_ROUTE}/{digest} answered from the named replica's REAL
+        engine + persistent store (protocol/rest/server.py's route minus
+        the aiohttp plumbing)."""
+        host = request.url.host or ""
+        server = self.replicas.get(host)
+        if server is None or not server.alive:
+            # nothing listening: same wire shape as a dead/partitioned pod
+            raise httpx.ConnectError("peer not listening", request=request)
+        try:
+            digest = bytes.fromhex(request.url.path.rsplit("/", 1)[-1])
+        except ValueError:
+            return 404, {"error": "not a page digest"}
+        wire = server.engine.read_peer_page(digest)
+        if wire is None:
+            return 404, {"error": "page not resident"}
+        server.peer_pages_served += 1
+        return 200, wire
 
     def _release_holds(self) -> None:
         """Replay parked requests once any backend is accepting again (the
@@ -307,6 +381,25 @@ class FleetSim:
             r.device.flap(ev.period_s, ev.factor)
         elif ev.kind == "heal_skew":
             r.device.heal_gray()
+        elif ev.kind in ("peer_corrupt", "peer_partition"):
+            # page-fabric faults: the "/kv" namespace of the shared net
+            # plan — fetches TO ev.replica's page server get a flipped
+            # byte under a 200 (corrupt) or connection-refused (partition)
+            self.net_plan.specs.append(FaultSpec(
+                f"{r.name}/kv", ev.kind, count=ev.count, after=ev.after))
+        elif ev.kind == "peer_slow":
+            # straggler page server: fetches proceed, `factor` virtual
+            # seconds late — the client's per-fetch deadline caps the
+            # damage to one admission's page-in budget
+            self.net_plan.specs.append(FaultSpec(
+                f"{r.name}/kv", "peer_slow", latency_s=ev.factor,
+                count=ev.count, after=ev.after))
+        elif ev.kind == "disk_wipe":
+            # node replacement: the persistent prefix files are gone (the
+            # replica should be down when this fires); the next build
+            # indexes an empty store and the wake must page hot prefixes
+            # in over the peer fabric instead of local disk
+            r.wipe_persist_dir()
         else:
             raise ValueError(f"unknown churn kind {ev.kind!r}")
 
@@ -684,6 +777,8 @@ class FleetSim:
             for r in self.replicas.values():
                 if r.engine is not None and r.engine.running:
                     await r.stop()
+        for client in self.peer_clients.values():
+            await client.client.aclose()
         for r in self.replicas.values():
             r.cleanup()  # the run owns the nodes' persist dirs
         faults = list(self.net_plan.log)

@@ -166,6 +166,16 @@ class SimReplica:
             "pagein_tokens": 0, "persist_writes": 0, "drops": 0,
             "adopted_hit_tokens": 0,
         }
+        # cross-replica page fabric (docs/kv_hierarchy.md): the replica's
+        # PeerPageClient — attached by the fleet layer when kv_persist is
+        # on — survives engine restarts (it is node/pod infrastructure,
+        # like the persist dir), so its fetch-outcome stats are already
+        # lifetime totals.  pages_served counts the SERVER side (fabric
+        # GETs this replica answered with a page); pagein_tokens_peer is
+        # accumulated per engine life like the prefix totals.
+        self.peer_client = None
+        self.peer_pages_served = 0
+        self._peer_pagein_tokens = 0
         # warm-pool cost accounting (docs/autoscaling.md): virtual seconds
         # this replica's process was up — the autoscaler's goodput report
         # charges policies in warm-replica-minutes
@@ -208,6 +218,10 @@ class SimReplica:
         if self.params is None:
             self.params = self.engine.params
         self.engine.fault_plan = self.fault_plan
+        if self.peer_client is not None:
+            # rewire the fabric on every build: a restarted engine keeps
+            # the node's peer client (and its learned peer index)
+            self.engine.set_peer_client(self.peer_client)
         # watchdog readiness flip: a confirmed stall drains the ENGINE
         # internally; this hook flips the replica's lifecycle so the
         # poll loop pulls it from picks (readiness red) while the
@@ -246,6 +260,28 @@ class SimReplica:
             "shedding": self.shedder.shedding,
         }
         return state
+
+    def set_peer_client(self, client) -> None:
+        """Attach the node's kvstore.peer.PeerPageClient (fleet layer);
+        wired into the live engine now and into every future build."""
+        self.peer_client = client
+        if self.engine is not None:
+            self.engine.set_peer_client(client)
+
+    def wipe_persist_dir(self) -> None:
+        """The disk-loss churn leg: the node was replaced and its
+        persistent prefix files are GONE (apply while the replica is
+        down — the next build indexes an empty store and must page hot
+        prefixes in over the peer fabric instead)."""
+        if self.persist_dir is None:
+            return
+        import os
+
+        for name in os.listdir(self.persist_dir):
+            try:
+                os.unlink(os.path.join(self.persist_dir, name))
+            except OSError:
+                pass
 
     def set_fault_plan(self, plan: Optional[FaultPlan]) -> None:
         self.fault_plan = plan
@@ -317,6 +353,16 @@ class SimReplica:
             out[k] = int(stats.get(k, 0) or 0)
         return out
 
+    def _engine_peer_pagein_tokens(self, e) -> int:
+        """Tokens this engine life adopted from PEER-fetched pages (the
+        'served tokens it never prefilled and never read off local disk'
+        evidence the fabric scenario asserts on)."""
+        if e is None or e._kv_store is None:
+            return 0
+        stats = e.scheduler_state(max_digests=0).get("prefix_store") or {}
+        by_tier = stats.get("pagein_tokens_by_tier") or {}
+        return int(by_tier.get("peer", 0) or 0)
+
     def _engine_watchdog_stats(self, e) -> dict:
         out = {k: 0 for k in self.watchdog_totals}
         wd = getattr(e, "_watchdog", None) if e is not None else None
@@ -343,6 +389,7 @@ class SimReplica:
         self.totals["finished"] += e.telemetry.finished_count
         for k, v in self._engine_prefix_stats(e).items():
             self.prefix_totals[k] += v
+        self._peer_pagein_tokens += self._engine_peer_pagein_tokens(e)
         for k, v in self._engine_watchdog_stats(e).items():
             self.watchdog_totals[k] += v
         for k, v in self._engine_spec_stats(e).items():
@@ -381,6 +428,23 @@ class SimReplica:
             out["prefix_store"] = {
                 k: self.prefix_totals[k] + live[k]
                 for k in sorted(self.prefix_totals)
+            }
+        if self.peer_client is not None:
+            # peer-fabric block (fixed, sorted key set — canonical-json
+            # byte-identical per seed): client-side fetch outcomes +
+            # verification failures, server-side pages served, and the
+            # tokens adopted from peer pages across engine lives
+            stats = self.peer_client.stats
+            out["peer"] = {
+                "bad_pages": sum(self.peer_client.bad_pages.values()),
+                "breaker_open": stats["breaker_open"],
+                "corrupt": stats["corrupt"],
+                "hit": stats["hit"],
+                "miss": stats["miss"],
+                "pagein_tokens": (self._peer_pagein_tokens
+                                  + self._engine_peer_pagein_tokens(e)),
+                "pages_served": self.peer_pages_served,
+                "timeout": stats["timeout"],
             }
         if self.spec.watchdog:
             live_wd = self._engine_watchdog_stats(e)

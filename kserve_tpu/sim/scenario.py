@@ -34,6 +34,21 @@ health-score / hedge defense catches them):
 - ``flapping``       compute alternates normal / `factor`-slow in
                      `period_s` windows; ``heal_skew`` restores
 
+Peer-fabric kinds (docs/kv_hierarchy.md "Cross-replica page serving" —
+faults on the verified cross-replica KV page-fetch path; always a
+performance event, never a correctness one):
+
+- ``peer_corrupt``   fetches TO this replica's page server return the
+                     real page with a byte flipped under a 200 — the
+                     lying peer only digest verification catches
+- ``peer_partition`` fetches TO this replica's page server are refused
+                     (the breaker opens; fetchers degrade local-only)
+- ``peer_slow``      fetches TO this replica proceed `factor` virtual
+                     seconds late (the client deadline caps the damage)
+- ``disk_wipe``      the replica's persistent prefix files are deleted
+                     (node replacement — apply while it is down; the
+                     wake must page hot prefixes in over the fabric)
+
 Canned scenarios back the test suite: `smoke_scenario()` and
 `gray_failure_scenario()` run in tier-1 on every PR;
 `churn_10k_scenario()` is the acceptance-scale trace (10k requests,
@@ -80,13 +95,18 @@ def _canned_spec() -> ReplicaSpec:
 class ChurnEvent:
     at_s: float
     # preempt | crash | drain_restart | breaker_trip | shed_storm |
-    # heal_shed | skew | heal_skew | slow_decode | wedged_fetch | flapping
+    # heal_shed | skew | heal_skew | slow_decode | wedged_fetch |
+    # flapping | peer_corrupt | peer_partition | peer_slow | disk_wipe
     kind: str
     replica: Optional[str] = None  # e.g. "replica-1" (None = fleet-wide)
     count: int = 1
     # skew/slow_decode/flapping: the compute multiplier; wedged_fetch:
-    # the wedge duration in virtual seconds
+    # the wedge duration in virtual seconds; peer_slow: the injected
+    # page-fetch latency in virtual seconds
     factor: float = 1.0
+    # peer_* fault kinds: skip the first N matching page fetches before
+    # injecting (sequences the chaos legs inside one wake's fetch wave)
+    after: int = 0
     restart_after_s: float = 2.0
     # drain_restart only: drain-budget override (None = the replica's
     # spec default; 0.0 = checkpoint everything in flight immediately —
@@ -162,6 +182,12 @@ class Scenario:
     # gray-failure health scoring config for the picker's FleetHealth
     # (scheduler/health.py); None takes the production defaults
     health: Optional[HealthConfig] = None
+    # EPP resident-prefix pick term (scheduler/picker.py resident_weight):
+    # None takes the picker's production default.  Scenarios that must
+    # observe SYMMETRIC traffic (e.g. "every node persists the prefix")
+    # pin it to 0.0 — with it on, the picker deliberately concentrates
+    # shared-prefix traffic on whichever replica already holds the pages.
+    resident_weight: Optional[float] = None
     # generous client persistence: a shed storm resolves in a few virtual
     # seconds, and a client that gives up during one is a goodput loss the
     # scenario is supposed to absorb, not accept
@@ -431,6 +457,14 @@ def prefix_store_scenario(seed: int = 17) -> Scenario:
         seed=seed,
         n_replicas=2,
         spec=ReplicaSpec(costs=costs, kv_persist=True),
+        # this scenario's claim is per-NODE: EVERY node persists the
+        # prefix in life 0 and wakes hot off its own durable files.  The
+        # resident-prefix pick term would defeat the setup by steering
+        # all chat traffic to whichever replica registered the prefix
+        # first; locality steering has its own proofs
+        # (peer_fabric_scenario, tests/test_epp_scheduler.py
+        # TestPickerPeerFabric), so pin it off here.
+        resident_weight=0.0,
         workload=WorkloadConfig(
             n_requests=40, duration_s=24.0,
             # chat-dominant: the shared system prefix is the traffic shape
@@ -452,6 +486,113 @@ def prefix_store_scenario(seed: int = 17) -> Scenario:
         budget=SLOBudget(
             # the zero window is absorbed in TTFT; what may NOT happen is
             # a drop or a duplicated token across the wake
+            p99_ttft_s=25.0, p99_itl_s=2.0, min_goodput=1.0,
+            # client-retry polling through the zero window (see
+            # scale_zero_scenario's note on why this is structurally high)
+            max_retry_amplification=12.0, max_shed_fraction=1.0,
+        ),
+        client_max_attempts=40,
+        client_retry_budget_s=240.0,
+    )
+
+
+def peer_fabric_scenario(seed: int = 29) -> Scenario:
+    """Cross-replica KV page fabric, end to end (tier-1; docs/
+    kv_hierarchy.md "Cross-replica page serving").  Life 0 persists the
+    shared chat prefix on both nodes; the fleet scales to zero and
+    replica-0's DISK IS WIPED during the window (node replacement).
+    replica-1 wakes first and serves off its own durable files; when
+    replica-0 wakes — HBM cold AND disk empty — the only place its hot
+    prefix exists is the peer, and its first admissions page it in over
+    the verified fabric (peer hit + adopted tokens with a local store
+    that never held the pages: exactly the fabric's claim).
+
+    replica-0 then cycles down/wipe/up twice more, so the SAME cold
+    fetch replays against an increasingly hostile peer — one wave per
+    degradation row in docs/kv_hierarchy.md:
+
+    - wave 1 (wake 12.8): clean fetch -> peer HIT, tokens adopted from
+      pages the local store never held;
+    - wave 2 (wake 17.0): replica-1 serves a lying 200 only digest
+      verification catches -> counted corrupt, degraded to a miss +
+      local re-prefill, the peer's health score visibly dinged through
+      the /state bad-page evidence channel;
+    - wave 3 (wake 24.05, deliberately past the 5 s cooldown of the
+      breaker the corrupt page opened): the half-open probe meets two
+      refused connections (partition), then a slowed-but-honest
+      response -> the retry path converges back to a verified HIT and
+      the success closes the breaker.
+
+    The contract under fire: the corrupt count equals the injected
+    count, nothing corrupt is ever adopted (the stub token oracle would
+    catch one token of drift) — and goodput stays 1.0 with zero
+    lost/duplicated tokens, byte-identical per seed."""
+    costs = StubCosts(
+        prefill_base_s=0.01, prefill_per_token_s=2e-4, decode_step_s=0.02,
+        compile_s=3.0, aot_load_s=0.1)
+    return Scenario(
+        name="peer-fabric",
+        seed=seed,
+        n_replicas=2,
+        spec=ReplicaSpec(costs=costs, kv_persist=True),
+        workload=WorkloadConfig(
+            n_requests=44, duration_s=26.0,
+            # chat-dominant: one shared system prefix is the page set the
+            # fabric moves; the batch leg keeps non-prefix pressure up
+            mix={"chat": 0.85, "batch": 0.15},
+            # bursts are pure batch load (no shared prefix): they exist
+            # to push the CHAT stream onto the cold node — the EPP
+            # resident-prefix term (correctly) steers chat AT the warm
+            # peer, so each wave needs the peer busy when a chat
+            # arrives.  Wave 3's burst lands at 24.0, while replica-0 is
+            # still DOWN: all 12 queue on the warm peer, replica-0 wakes
+            # at 24.05, and the trace's next chat arrival (~24.2) spills
+            # onto the idle cold node
+            bursts=[(13.0, 8), (17.2, 6), (24.0, 12)],
+        ),
+        churn=[
+            # life 0 registers + reuses + persists the prefix, then the
+            # fleet passes through zero
+            ChurnEvent(at_s=8.0, kind="scale_down", replica="replica-0",
+                       grace_s=0.0),
+            ChurnEvent(at_s=8.0, kind="scale_down", replica="replica-1",
+                       grace_s=0.0),
+            # node replacement while down: replica-0 loses its durable
+            # prefix files — its wake CANNOT hot-load from local disk
+            ChurnEvent(at_s=10.0, kind="disk_wipe", replica="replica-0"),
+            # the chaos legs, armed before any fetch.  `after` sequences
+            # them across the page-server request stream (specs fall
+            # through when skipped, so each wave meets exactly one leg):
+            # request 1 clean (wave-1 hit), request 2 corrupt (wave 2),
+            # requests 3-4 refused + request 5 slowed (wave 3's retry
+            # path: two ConnectErrors, then a late-but-honest hit)
+            ChurnEvent(at_s=11.5, kind="peer_corrupt", replica="replica-1",
+                       count=1, after=1),
+            ChurnEvent(at_s=11.5, kind="peer_partition",
+                       replica="replica-1", count=2, after=1),
+            ChurnEvent(at_s=11.5, kind="peer_slow", replica="replica-1",
+                       factor=0.25, count=1, after=1),
+            # replica-1 (disk-warm) wakes first so its digest-set wire is
+            # gossiped into replica-0's peer index BEFORE replica-0 takes
+            # its first admission
+            ChurnEvent(at_s=12.0, kind="scale_up", replica="replica-1"),
+            ChurnEvent(at_s=12.8, kind="scale_up", replica="replica-0"),
+            # waves 2 + 3: same down/wipe/wake cycle, hostile peer
+            ChurnEvent(at_s=16.0, kind="scale_down", replica="replica-0",
+                       grace_s=0.0),
+            ChurnEvent(at_s=16.4, kind="disk_wipe", replica="replica-0"),
+            ChurnEvent(at_s=17.0, kind="scale_up", replica="replica-0"),
+            ChurnEvent(at_s=20.0, kind="scale_down", replica="replica-0",
+                       grace_s=0.0),
+            ChurnEvent(at_s=20.4, kind="disk_wipe", replica="replica-0"),
+            # wake AFTER the corrupt-opened breaker's 5 s cooldown (open
+            # ~17.4-22.4) so the wave-3 fetch is the half-open probe,
+            # and just after the 24.0 burst has pinned the warm peer
+            ChurnEvent(at_s=24.05, kind="scale_up", replica="replica-0"),
+        ],
+        budget=SLOBudget(
+            # the zero window + peer chaos are absorbed in TTFT; what may
+            # NOT happen is a drop or a duplicated/corrupted token
             p99_ttft_s=25.0, p99_itl_s=2.0, min_goodput=1.0,
             # client-retry polling through the zero window (see
             # scale_zero_scenario's note on why this is structurally high)
@@ -588,7 +729,10 @@ def churn_10k_scenario(seed: int = 1234,
     watchdog + health-quarantine + hedge defense must keep p99 TTFT/ITL
     inside the same SLO budget — the number a binary-only breaker fleet
     fails, because nothing in it ever stops routing to a slow-but-200
-    replica."""
+    replica.  The peer-fabric leg (ISSUE 19): replica-0's rolling
+    restart doubles as a node replacement (disk_wipe), so its wake pages
+    hot prefixes in over the verified cross-replica fabric through a
+    lying peer and a straggler at 10k scale."""
     return Scenario(
         name="churn-10k",
         seed=seed,
@@ -629,6 +773,20 @@ def churn_10k_scenario(seed: int = 1234,
             # finish inside the budget
             ChurnEvent(at_s=420.0, kind="drain_restart", replica="replica-0",
                        restart_after_s=5.0, grace_s=0.0),
+            # the peer-fabric leg: replica-0's durable prefix files are
+            # lost during its restart window (node replacement), so hot
+            # prefixes page in over the fabric — replica-2 serves 0.2s
+            # late for a stretch and then turns outright hostile,
+            # corrupting two fetches under an honest-looking 200.  The
+            # after=2 skip leaves the first reached fetches clean so the
+            # corruption lands mid-wave, where verification + prefix
+            # truncation must degrade to local re-prefill without losing
+            # token-exactness
+            ChurnEvent(at_s=422.0, kind="disk_wipe", replica="replica-0"),
+            ChurnEvent(at_s=422.0, kind="peer_corrupt", replica="replica-2",
+                       count=2, after=2),
+            ChurnEvent(at_s=422.0, kind="peer_slow", replica="replica-2",
+                       factor=0.2, count=6),
             ChurnEvent(at_s=480.0, kind="drain_restart", replica="replica-1",
                        restart_after_s=5.0, grace_s=0.0),
             ChurnEvent(at_s=540.0, kind="drain_restart", replica="replica-2",

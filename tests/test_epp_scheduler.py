@@ -135,6 +135,119 @@ class TestPicker:
         assert picks == {"http://a:8080", "http://b:8080"}
 
 
+class TestPickerPeerFabric:
+    """ISSUE 19 index leg: the generation-stamped digest-set wire in
+    /state steers routing toward replicas whose persist tier already
+    holds the prompt's prefix, and per-peer bad-page counters feed the
+    fleet-health evidence channel."""
+
+    def test_peer_resident_prefix_steers_pick(self):
+        prompt = list(range(200, 264))  # 4 pages at page_size 16
+        keys = [k.hex() for k in token_prefix_digests(prompt, 16, for_lookup=False)]
+        p = make_picker()
+        # replica a holds the prefix persist-resident only (cold HBM:
+        # no prefix_digests) and is slightly busier
+        p.observe_state("http://a:8080", {
+            "queue_depth": 1, "free_pages": 50, "page_size": 16,
+            "peer_pages": {"generation": 1, "digests": keys},
+        })
+        p.observe_state("http://b:8080", {"queue_depth": 0, "free_pages": 50})
+        # 3 lookup-page resident hits * 1.0 resident weight > 1 queue
+        assert p.pick(prompt_ids=prompt).url == "http://a:8080"
+        # an unrelated prompt still goes to the idle replica
+        assert p.pick(prompt_ids=list(range(900, 940))).url == "http://b:8080"
+
+    def test_peer_pages_highest_generation_wins_wholesale(self):
+        prompt = list(range(300, 364))
+        keys = [k.hex() for k in token_prefix_digests(prompt, 16, for_lookup=False)]
+        p = make_picker()
+        # nested model form; a stale low-generation block rides along and
+        # must lose to the newer (post-wipe, empty) wire entirely —
+        # digest sets age wholesale, never merge across generations
+        p.observe_state("http://a:8080", {
+            "models": {
+                "stale": {"page_size": 16,
+                          "peer_pages": {"generation": 2, "digests": keys}},
+                "fresh": {"page_size": 16,
+                          "peer_pages": {"generation": 5, "digests": []}},
+            },
+            "queue_depth": 0, "free_pages": 50,
+        })
+        r = p.replicas["http://a:8080"]
+        assert r.peer_digest_set == frozenset()
+        assert r.peer_pages["generation"] == 5
+        # and the other way around: the populated wire wins when newer
+        p.observe_state("http://a:8080", {
+            "models": {
+                "stale": {"page_size": 16,
+                          "peer_pages": {"generation": 5, "digests": []}},
+                "fresh": {"page_size": 16,
+                          "peer_pages": {"generation": 6, "digests": keys}},
+            },
+            "queue_depth": 0, "free_pages": 50,
+        })
+        assert len(p.replicas["http://a:8080"].peer_digest_set) == len(keys)
+
+    def test_malformed_peer_pages_wire_is_ignored(self):
+        p = make_picker()
+        p.observe_state("http://a:8080", {
+            "queue_depth": 0, "free_pages": 50, "page_size": 16,
+            "peer_pages": {"generation": 1, "digests": ["zz-not-hex", 7]},
+        })
+        assert p.replicas["http://a:8080"].peer_digest_set == frozenset()
+        # a non-dict wire never replaces anything either
+        p.observe_state("http://a:8080", {
+            "queue_depth": 0, "free_pages": 50, "peer_pages": "gibberish",
+        })
+        assert p.pick(prompt_ids=[1, 2, 3]) is not None
+
+    def test_bad_page_evidence_dings_the_lying_peer(self):
+        p = make_picker()
+        victim = "http://b:8080"
+        # replica a reports it verified 2 corrupt pages served by b
+        p.observe_state("http://a:8080", {
+            "queue_depth": 0, "free_pages": 10,
+            "peer": {"bad_pages": {victim: 2}},
+        })
+        assert p.health.score(victim) == 0.25  # halved per bad page
+        # the same counter re-observed is NOT new evidence
+        p.observe_state("http://a:8080", {
+            "queue_depth": 0, "free_pages": 10,
+            "peer": {"bad_pages": {victim: 2}},
+        })
+        assert p.health.score(victim) == 0.25
+        # one increment = one more note
+        p.observe_state("http://a:8080", {
+            "queue_depth": 0, "free_pages": 10,
+            "peer": {"bad_pages": {victim: 3}},
+        })
+        assert p.health.score(victim) == 0.125
+
+    def test_bad_page_counter_reset_rebaselines_without_noting(self):
+        p = make_picker()
+        victim = "http://b:8080"
+        p.observe_state("http://a:8080", {
+            "queue_depth": 0, "free_pages": 10,
+            "peer": {"bad_pages": {victim: 4}},
+        })
+        score_after = p.health.score(victim)
+        assert score_after == 0.5 ** 4
+        # replica a restarts: its counter drops to 1.  A naive diff
+        # would note -3 or treat 1 as fresh evidence; the channel must
+        # re-baseline silently instead.
+        p.observe_state("http://a:8080", {
+            "queue_depth": 0, "free_pages": 10,
+            "peer": {"bad_pages": {victim: 1}},
+        })
+        assert p.health.score(victim) == score_after
+        # the NEXT increment past the new baseline counts again
+        p.observe_state("http://a:8080", {
+            "queue_depth": 0, "free_pages": 10,
+            "peer": {"bad_pages": {victim: 2}},
+        })
+        assert p.health.score(victim) == score_after * 0.5
+
+
 class TestExtractAffinity:
     def test_openai_chat(self):
         ids, text = extract_affinity({

@@ -765,6 +765,32 @@ def test_pagein_host_sync_covers_maybe_page_in_spelling():
     assert rules_of(src).count("pagein-host-sync") == 1
 
 
+def test_pagein_host_sync_covers_peer_fetch_family():
+    # ISSUE 19: kvstore/peer.py's verified cross-replica leg is in
+    # scope — a wall-clock sleep or sync fetch inside fetch_page/
+    # fetch_from blocks the event loop the breaker + deadline math
+    # assumes is free-running
+    src = """
+        import time
+
+        async def fetch_page(self, digest, peers):
+            time.sleep(0.05)  # backoff on the thread, not the clock
+            return self._transport.fetch(digest)
+    """
+    rules = rules_of(src)
+    assert rules.count("pagein-host-sync") == 2
+
+
+def test_pagein_host_sync_quiet_on_clock_injected_peer_fetch():
+    src = """
+        async def fetch_from(self, peer_url, digest):
+            await self.clock.sleep(delay)  # injected clock: simulable
+            resp = await self._client.get(self._url(peer_url, digest))
+            return decode_page(resp.content, digest)
+    """
+    assert "pagein-host-sync" not in rules_of(src)
+
+
 def test_pagein_host_sync_suppressed():
     src = BAD_PAGEIN.replace(
         "payloads = self._fetcher.fetch(read, 30.0)  # sync fetch: serializes",

@@ -133,6 +133,38 @@ class TestPersistentPrefixStore:
         assert not store.store(D1, page_payload())
         assert store.load(D1) is None
 
+    def test_corrupt_entry_on_readonly_volume_misses_without_crash(
+            self, tmp_path, monkeypatch):
+        """ISSUE 19 satellite: a corrupt entry whose unlink fails (the
+        cache volume went read-only underneath us) must read as a plain
+        miss, stay a miss, and flip the store to read-only — never
+        crash, never retry the unlink forever."""
+        events = []
+        store = PersistentPrefixStore(
+            str(tmp_path), on_event=lambda tier, ev: events.append(ev))
+        store.store(D1, page_payload())
+        path = os.path.join(str(tmp_path), f"px-{D1.hex()}.kvpage")
+        with open(path, "wb") as f:
+            f.write(b"torn garbage, not an npz")
+        gen = store.generation
+
+        def ro_unlink(p):
+            raise OSError(30, "Read-only file system", p)
+
+        monkeypatch.setattr(os, "unlink", ro_unlink)
+        assert store.load(D1) is None
+        assert "corrupt" in events
+        # the file could not be removed, but the in-memory index did
+        # forget it: subsequent loads are clean misses, not re-parses
+        assert os.path.exists(path)
+        assert store.load(D1) is None
+        assert events.count("corrupt") == 1
+        assert store.generation == gen + 1
+        # and the store stopped pretending the volume is writable
+        assert not store.writable
+        monkeypatch.undo()
+        assert not store.store(D2, page_payload())
+
 
 class TestHierarchicalStore:
     def _store(self, tmp_path, host=1 << 20, persist=True):
@@ -435,3 +467,283 @@ class TestPrefixStoreStatsFlow:
         # wire round trip (EPP /state fleet block -> autoscaler CLI)
         rebuilt = FleetSignals.from_dict(fleet.to_dict())
         assert rebuilt.replicas[0].prefix_store["resident_digests"] == 7
+
+
+# --------------------------------------------------------------------------
+# Cross-replica page fabric (kvstore/peer.py, docs/kv_hierarchy.md
+# "Cross-replica page serving")
+
+
+import io
+
+import httpx
+
+from kserve_tpu.kvstore import (
+    PAGE_ROUTE,
+    PageVerifyError,
+    PeerPageClient,
+    PeerPageIndex,
+    decode_page,
+    decode_payload,
+    digest_set_wire,
+    encode_page,
+)
+from kserve_tpu.kvstore.persist import PERSIST_FORMAT
+from kserve_tpu.resilience import BreakerConfig, BreakerRegistry, RetryPolicy
+
+
+def npz_bytes(fill=1.0):
+    """Raw persist-entry file bytes (what the page server wraps)."""
+    buf = io.BytesIO()
+    np.savez(buf, fmt=PERSIST_FORMAT, **page_payload(fill))
+    return buf.getvalue()
+
+
+class TestPeerWireCodec:
+    """Tamper property tests: every mutation class a wire page can
+    suffer — header flip, payload flip, trailing truncation, and a real
+    page served under another page's key — is rejected at verification,
+    BEFORE anything reaches the prefix cache."""
+
+    def test_round_trip(self):
+        raw = npz_bytes(3.0)
+        wire = encode_page(D1, raw)
+        assert decode_page(wire, D1) == raw
+        got = decode_payload(raw)
+        np.testing.assert_array_equal(got["kv"], page_payload(3.0)["kv"])
+
+    def test_header_flips_rejected(self):
+        wire = encode_page(D1, npz_bytes())
+        # magic, version, embedded digest, length field — one flipped
+        # bit anywhere in the header kills the page
+        for off in (0, 3, 4, 5, 6, 13, 21, 24, 29):
+            tampered = bytearray(wire)
+            tampered[off] ^= 0xFF
+            with pytest.raises(PageVerifyError):
+                decode_page(bytes(tampered), D1)
+
+    def test_payload_flips_rejected(self):
+        raw = npz_bytes()
+        wire = encode_page(D1, raw)
+        start = len(wire) - 16 - len(raw)
+        for off in range(start, len(wire) - 16, max(1, len(raw) // 9)):
+            tampered = bytearray(wire)
+            tampered[off] ^= 0x01
+            with pytest.raises(PageVerifyError):
+                decode_page(bytes(tampered), D1)
+
+    def test_trailer_flip_rejected(self):
+        tampered = bytearray(encode_page(D1, npz_bytes()))
+        tampered[-1] ^= 0x80
+        with pytest.raises(PageVerifyError):
+            decode_page(bytes(tampered), D1)
+
+    def test_truncation_rejected(self):
+        wire = encode_page(D1, npz_bytes())
+        for cut in (1, 7, 16, len(wire) // 2, len(wire) - 1):
+            with pytest.raises(PageVerifyError):
+                decode_page(wire[: len(wire) - cut], D1)
+
+    def test_key_swap_between_real_pages_rejected(self):
+        """Two HONEST pages served under each other's digests: both
+        payloads verify byte-for-byte against their own key, neither may
+        verify against the other's — integrity binds key to bytes."""
+        w1 = encode_page(D1, npz_bytes(1.0))
+        w2 = encode_page(D2, npz_bytes(2.0))
+        assert decode_page(w1, D1) and decode_page(w2, D2)
+        with pytest.raises(PageVerifyError):
+            decode_page(w1, D2)
+        with pytest.raises(PageVerifyError):
+            decode_page(w2, D1)
+
+    def test_rotten_payload_is_verify_error(self):
+        # checksum-valid wire around bytes that were never a persist
+        # entry: still a PageVerifyError, never an adoption
+        wire = encode_page(D1, b"not an npz at all")
+        with pytest.raises(PageVerifyError):
+            decode_payload(decode_page(wire, D1))
+
+
+class TestPeerPageIndex:
+    def test_generation_aging_and_candidate_order(self):
+        idx = PeerPageIndex()
+        assert idx.update("http://b:1", digest_set_wire(1, [D1]))
+        assert idx.update("http://a:1", digest_set_wire(2, [D2, D1]))
+        # candidates are deterministically ordered (sorted by url)
+        assert idx.peers_for(D1) == ["http://a:1", "http://b:1"]
+        assert idx.peers_for(D2) == ["http://a:1"]
+        # stale gossip (lower generation) is ignored...
+        assert not idx.update("http://a:1", digest_set_wire(1, [D3]))
+        assert idx.peers_for(D2) == ["http://a:1"]
+        # ...a newer set replaces the old one wholesale
+        assert idx.update("http://a:1", digest_set_wire(3, [D3]))
+        assert idx.peers_for(D2) == []
+        assert idx.peers_for(D3) == ["http://a:1"]
+        assert idx.has(D1) and not idx.has(D2)
+        idx.forget("http://a:1")
+        assert idx.peers_for(D3) == []
+
+    def test_unparseable_wire_ignored(self):
+        idx = PeerPageIndex()
+        assert not idx.update("http://a:1", None)
+        assert not idx.update("http://a:1", "gibberish")
+        assert not idx.update(
+            "http://a:1", {"generation": "x", "digests": ["zz"]})
+        assert idx.peers_for(D1) == []
+
+    def test_wire_cap_marks_truncation(self):
+        digests = [bytes([i]) * 16 for i in range(10)]
+        wire = digest_set_wire(5, digests, cap=4)
+        assert len(wire["digests"]) == 4
+        assert wire["truncated"] is True
+        full = digest_set_wire(5, digests)
+        assert full["truncated"] is False
+        assert full["digests"] == sorted(full["digests"])
+
+
+PEER_A = "http://peer-a:8080"
+PEER_B = "http://peer-b:8080"
+
+
+def make_peer_client(handler, clock, digests=(D1,), peers=(PEER_A,), **kw):
+    """A PeerPageClient over httpx.MockTransport + FakeClock: the same
+    wiring the fleet sim uses, minus the fault plan."""
+    index = PeerPageIndex()
+    for url in peers:
+        index.update(url, digest_set_wire(1, list(digests)))
+    return PeerPageClient(
+        httpx.AsyncClient(transport=httpx.MockTransport(handler)),
+        index=index,
+        retry=RetryPolicy(max_attempts=3, base_backoff_s=0.01,
+                          max_backoff_s=0.05, retry_budget_s=5.0, seed=1),
+        breakers=BreakerRegistry(
+            BreakerConfig(window=4, failure_threshold=0.5, min_volume=1,
+                          open_for_s=10.0),
+            clock=clock),
+        clock=clock, **kw)
+
+
+class TestPeerPageClient:
+    @async_test
+    async def test_verified_hit_adopts_payload(self):
+        clock = FakeClock()
+        calls = []
+
+        def handler(request):
+            calls.append(str(request.url))
+            return httpx.Response(200, content=encode_page(D1, npz_bytes(7.0)))
+
+        client = make_peer_client(handler, clock)
+        payload = await client.fetch_page(D1)
+        assert payload is not None
+        np.testing.assert_array_equal(
+            payload["kv"], page_payload(7.0)["kv"])
+        assert client.stats["hit"] == 1
+        assert calls == [f"{PEER_A}{PAGE_ROUTE}/{D1.hex()}"]
+        await client.client.aclose()
+
+    @async_test
+    async def test_404_is_clean_miss_not_failure(self):
+        clock = FakeClock()
+
+        def handler(request):
+            return httpx.Response(404, json={"error": "page not resident"})
+
+        client = make_peer_client(handler, clock)
+        assert await client.fetch_page(D1) is None
+        assert client.stats["miss"] == 1
+        # a stale index is not peer sickness: the breaker stays closed
+        assert client.breakers.allow(PEER_A)
+        await client.client.aclose()
+
+    @async_test
+    async def test_corrupt_page_counted_never_retried_never_adopted(self):
+        clock = FakeClock()
+        noted, calls = [], []
+
+        def handler(request):
+            calls.append(1)
+            body = bytearray(encode_page(D1, npz_bytes()))
+            body[len(body) // 2] ^= 0xFF  # the lying 200
+            return httpx.Response(200, content=bytes(body))
+
+        client = make_peer_client(handler, clock, on_bad_page=noted.append)
+        assert await client.fetch_page(D1) is None
+        assert len(calls) == 1, "a peer that served garbage must NOT be retried"
+        assert client.stats["corrupt"] == 1
+        assert client.bad_pages == {PEER_A: 1}
+        assert noted == [PEER_A]
+        await client.client.aclose()
+
+    @async_test
+    async def test_partition_retries_then_breaker_opens_then_recovers(self):
+        clock = FakeClock()
+        calls = []
+        healthy = False
+
+        def handler(request):
+            calls.append(1)
+            if not healthy:
+                raise httpx.ConnectError("refused", request=request)
+            return httpx.Response(200, content=encode_page(D1, npz_bytes()))
+
+        client = make_peer_client(handler, clock)
+        assert await client.fetch_page(D1) is None
+        assert len(calls) == 3, "partition must burn the retry budget"
+        assert client.stats["timeout"] == 1
+        # the breaker is now open: the next fetch skips the peer with
+        # ZERO network attempts (local-only degradation)
+        assert await client.fetch_page(D1) is None
+        assert len(calls) == 3
+        assert client.stats["breaker_open"] == 1
+        # cooldown passes, the peer heals: the half-open probe converges
+        # straight back to verified hits
+        clock.advance(11.0)
+        healthy = True
+        assert await client.fetch_page(D1) is not None
+        assert client.stats["hit"] == 1
+        await client.client.aclose()
+
+    @async_test
+    async def test_slow_response_past_deadline_reads_as_miss(self):
+        clock = FakeClock()
+
+        def handler(request):
+            clock.advance(3.0)  # straggler: past the 2 s fetch deadline
+            return httpx.Response(200, content=encode_page(D1, npz_bytes()))
+
+        client = make_peer_client(handler, clock)
+        assert await client.fetch_page(D1) is None, (
+            "a late page — even a verifiable one — must not hold the "
+            "admission back")
+        assert client.stats["timeout"] == 1
+        await client.client.aclose()
+
+    @async_test
+    async def test_fetch_page_fails_over_past_the_lying_peer(self):
+        clock = FakeClock()
+
+        def handler(request):
+            body = bytearray(encode_page(D1, npz_bytes(4.0)))
+            if request.url.host == "peer-a":
+                body[len(body) // 2] ^= 0xFF
+            return httpx.Response(200, content=bytes(body))
+
+        client = make_peer_client(handler, clock, peers=(PEER_A, PEER_B))
+        payload = await client.fetch_page(D1)
+        assert payload is not None, "the honest second candidate serves"
+        assert client.stats["corrupt"] == 1 and client.stats["hit"] == 1
+        assert client.bad_pages == {PEER_A: 1}
+        await client.client.aclose()
+
+    @async_test
+    async def test_self_url_excluded_from_candidates(self):
+        clock = FakeClock()
+
+        def handler(request):  # pragma: no cover - must never run
+            raise AssertionError("self must not be fetched from")
+
+        client = make_peer_client(handler, clock, self_url=PEER_A)
+        assert await client.fetch_page(D1) is None
+        assert all(v == 0 for v in client.stats.values())
+        await client.client.aclose()

@@ -325,6 +325,82 @@ class TestFaultPlan:
             assert outcomes == ["down", 200, "down", 200]
             assert clock.sleeps == [pytest.approx(0.4)] * 2
 
+    @async_test
+    async def test_peer_partition_kind_is_connect_error(self):
+        """peer_partition is the unreachable page server: a connect
+        error the fetch client's retry + breaker must absorb (vs
+        peer_corrupt, which answers confidently with garbage)."""
+        plan = FaultPlan([FaultSpec("peer/kv", "peer_partition", count=1)])
+        transport = FaultInjectingTransport(
+            plan, clock=FakeClock(), target_suffix="/kv")
+        async with httpx.AsyncClient(transport=transport) as client:
+            with pytest.raises(httpx.ConnectError, match="partition"):
+                await client.get("http://peer:8080/v1/internal/kv/pages/aa")
+            # count exhausted: the fence heals, the server answers
+            ok = await client.get("http://peer:8080/v1/internal/kv/pages/aa")
+            assert ok.status_code == 200
+
+    @async_test
+    async def test_peer_corrupt_kind_flips_one_byte_under_a_200(self):
+        """The lying peer: the REAL response body with one byte flipped
+        and a confident 200 — indistinguishable from an honest page by
+        status, only digest verification can reject it."""
+        honest = b"honest page server bytes"
+
+        def handler(request):
+            return 200, honest
+
+        plan = FaultPlan([FaultSpec("peer/kv", "peer_corrupt", count=1)])
+        transport = FaultInjectingTransport(
+            plan, handler=handler, clock=FakeClock(), target_suffix="/kv")
+        async with httpx.AsyncClient(transport=transport) as client:
+            lying = await client.get(
+                "http://peer:8080/v1/internal/kv/pages/aa")
+            assert lying.status_code == 200, "corrupt is NOT a 5xx"
+            assert lying.content != honest
+            diffs = [i for i, (a, b) in
+                     enumerate(zip(lying.content, honest)) if a != b]
+            assert diffs == [len(honest) // 2]  # exactly one flipped byte
+            # past count, the same server serves the honest bytes
+            ok = await client.get("http://peer:8080/v1/internal/kv/pages/aa")
+            assert ok.content == honest
+
+    @async_test
+    async def test_peer_slow_kind_delays_then_serves(self):
+        """peer_slow is the straggler page server: latency_s * skew on
+        the injected clock, then the honest response — the fetch
+        client's deadline cap decides whether it still counts."""
+        clock = FakeClock()
+        plan = FaultPlan([
+            FaultSpec("peer/kv", "peer_slow", latency_s=0.2, skew=2.0,
+                      count=1),
+        ])
+        transport = FaultInjectingTransport(
+            plan, handler=lambda req: (200, b"page"), clock=clock,
+            target_suffix="/kv")
+        async with httpx.AsyncClient(transport=transport) as client:
+            resp = await client.get(
+                "http://peer:8080/v1/internal/kv/pages/aa")
+            assert resp.status_code == 200 and resp.content == b"page"
+            assert clock.sleeps == [pytest.approx(0.4)]
+
+    @async_test
+    async def test_target_suffix_namespaces_peer_faults(self):
+        """One shared FaultPlan drives both the proxy and the page-fabric
+        transports: a '{name}/kv' spec must hit ONLY the transport
+        mounted with target_suffix='/kv', never the proxy leg."""
+        plan = FaultPlan([FaultSpec("peer/kv", "peer_partition")])
+        clock = FakeClock()
+        kv = FaultInjectingTransport(plan, clock=clock, target_suffix="/kv")
+        proxy = FaultInjectingTransport(
+            plan, clock=clock, target_suffix="/proxy")
+        async with httpx.AsyncClient(transport=proxy) as client:
+            ok = await client.get("http://peer:8080/v1/completions")
+            assert ok.status_code == 200  # proxy leg untouched
+        async with httpx.AsyncClient(transport=kv) as client:
+            with pytest.raises(httpx.ConnectError):
+                await client.get("http://peer:8080/v1/internal/kv/pages/aa")
+
     def test_gray_device_knobs_flap_and_wedge(self):
         """The sim stub device's gray knobs (kserve_tpu/sim/stub.py):
         flapping alternates the cost multiplier per period window, the
